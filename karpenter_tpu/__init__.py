@@ -1,0 +1,26 @@
+"""karpenter-tpu: a TPU-native node-provisioning autoscaler framework.
+
+A ground-up rebuild of the capabilities of Karpenter (reference snapshot
+~v0.8.0, Go) with the scheduling hot loop re-designed as a batched tensor
+solver on TPU (JAX/XLA), selected per-Provisioner via ``spec.solver``.
+
+Package map (mirrors reference layer map, SURVEY.md §1):
+
+- ``api``            Provisioner CRD types, Requirements algebra, labels
+                     (reference: pkg/apis/provisioning/v1alpha5)
+- ``utils``          complement sets, resource arithmetic, pod predicates,
+                     batcher, clocks (reference: pkg/utils)
+- ``cloudprovider``  CloudProvider/InstanceType interfaces, fake + simulated
+                     providers (reference: pkg/cloudprovider)
+- ``scheduling``     FFD reference scheduler + topology (reference:
+                     pkg/controllers/provisioning/scheduling)
+- ``solver``         the TPU-native batch bin-pack solver: tensor encoding,
+                     jitted kernels, multi-chip sharding, solve service
+                     (new capability; replaces the FFD hot loop)
+- ``controllers``    reconcile loops: provisioning, selection, node lifecycle,
+                     termination, counter, metrics (reference: pkg/controllers)
+- ``kube``           in-memory cluster state store with watches (the test/e2e
+                     substrate; reference uses envtest + controller-runtime)
+"""
+
+__version__ = "0.1.0"

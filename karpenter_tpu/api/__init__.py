@@ -1,0 +1,26 @@
+from karpenter_tpu.api import labels  # noqa: F401
+from karpenter_tpu.api.objects import (  # noqa: F401
+    Container,
+    DaemonSet,
+    LabelSelector,
+    Node,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodCondition,
+    PodDisruptionBudget,
+    StorageClass,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api.requirements import Requirements  # noqa: F401
+from karpenter_tpu.api.provisioner import (  # noqa: F401
+    Constraints,
+    Limits,
+    Provisioner,
+    ProvisionerSpec,
+    ProvisionerStatus,
+)

@@ -1,0 +1,93 @@
+"""Well-known label registry, normalization, and restriction rules.
+
+Mirrors ``pkg/apis/provisioning/v1alpha5/labels.go`` and the group constants in
+``register.go:229-246``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+# Kubernetes well-known labels.
+TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+TOPOLOGY_REGION = "topology.kubernetes.io/region"
+INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+ARCH = "kubernetes.io/arch"
+OS = "kubernetes.io/os"
+HOSTNAME = "kubernetes.io/hostname"
+
+# Group / domain constants (reference: register.go:229-246).
+GROUP = "karpenter.sh"
+LABEL_DOMAIN = GROUP
+CAPACITY_TYPE = LABEL_DOMAIN + "/capacity-type"
+PROVISIONER_NAME_LABEL = LABEL_DOMAIN + "/provisioner-name"
+NOT_READY_TAINT_KEY = LABEL_DOMAIN + "/not-ready"
+DO_NOT_EVICT_ANNOTATION = LABEL_DOMAIN + "/do-not-evict"
+EMPTINESS_TIMESTAMP_ANNOTATION = LABEL_DOMAIN + "/emptiness-timestamp"
+TERMINATION_FINALIZER = LABEL_DOMAIN + "/termination"
+
+ARCH_AMD64 = "amd64"
+ARCH_ARM64 = "arm64"
+OS_LINUX = "linux"
+
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+RESTRICTED_LABEL_DOMAINS: Set[str] = {"kubernetes.io", "k8s.io", LABEL_DOMAIN}
+LABEL_DOMAIN_EXCEPTIONS: Set[str] = {"kops.k8s.io"}
+
+WELL_KNOWN_LABELS: Set[str] = {
+    TOPOLOGY_ZONE,
+    INSTANCE_TYPE,
+    ARCH,
+    OS,
+    CAPACITY_TYPE,
+}
+
+RESTRICTED_LABELS: Set[str] = {
+    EMPTINESS_TIMESTAMP_ANNOTATION,
+    HOSTNAME,
+}
+
+# Aliased/beta labels → stable labels (reference: labels.go:66-73).
+NORMALIZED_LABELS: Dict[str, str] = {
+    "failure-domain.beta.kubernetes.io/zone": TOPOLOGY_ZONE,
+    "beta.kubernetes.io/arch": ARCH,
+    "beta.kubernetes.io/os": OS,
+    "beta.kubernetes.io/instance-type": INSTANCE_TYPE,
+    "failure-domain.beta.kubernetes.io/region": TOPOLOGY_REGION,
+}
+
+IGNORED_LABELS: Set[str] = {TOPOLOGY_REGION}
+
+
+def _label_domain(key: str) -> str:
+    if "/" in key:
+        return key.split("/", 1)[0]
+    return ""
+
+
+def check_restricted_label(key: str) -> Optional[str]:
+    """Return an error string if the label may not be used on a provisioner
+    (reference: labels.go:83-97)."""
+    if key in WELL_KNOWN_LABELS:
+        return None
+    if key in RESTRICTED_LABELS:
+        return f"label is restricted, {key}"
+    domain = _label_domain(key)
+    if domain in LABEL_DOMAIN_EXCEPTIONS:
+        return None
+    for restricted in RESTRICTED_LABEL_DOMAINS:
+        if domain.endswith(restricted):
+            return f"label domain not allowed, {domain}"
+    return None
+
+
+def is_restricted_node_label(key: str) -> bool:
+    """True if karpenter must not inject this label onto nodes it creates
+    (reference: labels.go:100-109)."""
+    domain = _label_domain(key)
+    for restricted in RESTRICTED_LABEL_DOMAINS:
+        if domain.endswith(restricted):
+            return True
+    return key in RESTRICTED_LABELS

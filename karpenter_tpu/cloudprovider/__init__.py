@@ -1,0 +1,11 @@
+from karpenter_tpu.cloudprovider.types import (  # noqa: F401
+    CloudProvider,
+    InstanceType,
+    NodeRequest,
+    Offering,
+)
+from karpenter_tpu.cloudprovider.requirements import (  # noqa: F401
+    catalog_requirements,
+    compatible,
+    filter_instance_types,
+)

@@ -1,0 +1,66 @@
+"""Catalog↔requirements glue (reference: pkg/cloudprovider/requirements.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.utils import resources as res
+
+
+def catalog_requirements(instance_types: Sequence[InstanceType]) -> Requirements:
+    """Union of supported {instance-type, zone, arch, os, capacity-type}
+    values, layered into every provisioner at apply
+    (reference: requirements.go:25-47)."""
+    supported: Dict[str, set] = {
+        lbl.INSTANCE_TYPE: set(),
+        lbl.TOPOLOGY_ZONE: set(),
+        lbl.ARCH: set(),
+        lbl.OS: set(),
+        lbl.CAPACITY_TYPE: set(),
+    }
+    for it in instance_types:
+        for offering in it.offerings:
+            supported[lbl.TOPOLOGY_ZONE].add(offering.zone)
+            supported[lbl.CAPACITY_TYPE].add(offering.capacity_type)
+        supported[lbl.INSTANCE_TYPE].add(it.name)
+        supported[lbl.ARCH].add(it.architecture)
+        supported[lbl.OS].update(it.operating_systems)
+    reqs = Requirements()
+    for key, values in supported.items():
+        reqs = reqs.add(NodeSelectorRequirement(key=key, operator="In", values=sorted(values)))
+    return reqs
+
+
+def compatible(it: InstanceType, requirements: Requirements) -> bool:
+    """Per-key membership + at least one offering whose zone AND capacity
+    type are both allowed (reference: requirements.go:49-66)."""
+    if not requirements.get(lbl.INSTANCE_TYPE).has(it.name):
+        return False
+    if not requirements.get(lbl.ARCH).has(it.architecture):
+        return False
+    if not requirements.get(lbl.OS).has_any(it.operating_systems):
+        return False
+    zone_set = requirements.get(lbl.TOPOLOGY_ZONE)
+    ct_set = requirements.get(lbl.CAPACITY_TYPE)
+    return any(zone_set.has(o.zone) and ct_set.has(o.capacity_type) for o in it.offerings)
+
+
+def filter_instance_types(
+    instance_types: Sequence[InstanceType],
+    requirements: Requirements,
+    requests: Mapping[str, float],
+) -> List[InstanceType]:
+    """Requirement-compatible types whose allocatable fits requests+overhead
+    (reference: requirements.go:68-80)."""
+    out: List[InstanceType] = []
+    for it in instance_types:
+        if not compatible(it, requirements):
+            continue
+        if not res.fits(res.merge(requests, it.overhead), it.resources):
+            continue
+        out.append(it)
+    return out

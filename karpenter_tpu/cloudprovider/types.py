@@ -1,0 +1,94 @@
+"""Cloud-provider abstraction.
+
+Mirrors ``pkg/cloudprovider/types.go``: ``CloudProvider`` {create, delete,
+get_instance_types, default, validate, name}, the ``InstanceType`` catalog
+record {name, offerings, architecture, operating_systems, resources, overhead,
+price}, and ``NodeRequest`` {template (constraints), instance-type options}.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence
+
+from karpenter_tpu.api.objects import Node
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.utils import resources as res
+
+
+@dataclass(frozen=True)
+class Offering:
+    """A purchasable (capacity type, zone) combination
+    (reference: types.go:76-81)."""
+
+    capacity_type: str
+    zone: str
+
+
+@dataclass
+class InstanceType:
+    """One catalog entry (reference: types.go:60-74). ``resources`` is the
+    node's allocatable; ``overhead`` the kubelet/system reserve subtracted
+    from it before pods fit; ``price`` the optimization weight."""
+
+    name: str
+    offerings: List[Offering] = field(default_factory=list)
+    architecture: str = "amd64"
+    operating_systems: FrozenSet[str] = frozenset({"linux"})
+    resources: Dict[str, float] = field(default_factory=dict)
+    overhead: Dict[str, float] = field(default_factory=dict)
+    price: Optional[float] = None
+
+    def effective_price(self) -> float:
+        """Explicit price, else the cpu+mem+gpu formula the fake catalog uses
+        (reference: fake/instancetype.go:146-163)."""
+        if self.price is not None and self.price != 0:
+            return self.price
+        price = 0.0
+        price += 0.1 * self.resources.get(res.CPU, 0.0)
+        price += 0.1 * self.resources.get(res.MEMORY, 0.0) / 1e9
+        if self.resources.get(res.NVIDIA_GPU, 0.0) or self.resources.get(res.AMD_GPU, 0.0):
+            price += 1.0
+        return price
+
+    def zones(self) -> FrozenSet[str]:
+        return frozenset(o.zone for o in self.offerings)
+
+    def capacity_types(self) -> FrozenSet[str]:
+        return frozenset(o.capacity_type for o in self.offerings)
+
+
+@dataclass
+class NodeRequest:
+    """What the provisioner asks the cloud for (reference: types.go:53-56)."""
+
+    template: Constraints
+    instance_type_options: Sequence[InstanceType] = ()
+
+
+class CloudProvider(abc.ABC):
+    """Vendor interface (reference: types.go:34-51)."""
+
+    @abc.abstractmethod
+    def create(self, request: NodeRequest) -> Node:
+        """Launch a node satisfying the request; returns the created node
+        (with instance-type/zone/capacity-type labels and allocatable set)."""
+
+    @abc.abstractmethod
+    def delete(self, node: Node) -> None:
+        """Terminate the backing instance."""
+
+    @abc.abstractmethod
+    def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
+        """The current catalog for a vendor provider config."""
+
+    def default(self, constraints: Constraints) -> None:
+        """Vendor defaulting hook (webhook DefaultHook)."""
+
+    def validate(self, constraints: Constraints) -> List[str]:
+        """Vendor validation hook (webhook ValidateHook)."""
+        return []
+
+    def name(self) -> str:
+        return type(self).__name__.lower()

@@ -1,0 +1,1 @@
+from karpenter_tpu.kube.client import Cluster  # noqa: F401

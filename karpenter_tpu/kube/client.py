@@ -1,0 +1,203 @@
+"""In-memory cluster state store with watch events.
+
+The reference's coordination substrate is the Kubernetes API server (watches,
+list/get, patches, Bind/Evict subresources — SURVEY.md §5.8). This framework
+is cluster-agnostic: controllers speak to this ``Cluster`` interface, which a
+deployment can back with a real apiserver client; the in-memory implementation
+is the test/benchmark substrate (the reference's envtest/fake-client analog).
+
+Optimistic concurrency: every mutation bumps ``resource_version``; watches are
+synchronous callbacks dispatched outside the store lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from karpenter_tpu.api.objects import (
+    DaemonSet,
+    LabelSelector,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    StorageClass,
+)
+from karpenter_tpu.api.provisioner import Provisioner
+
+WatchFn = Callable[[str, object], None]  # (event_type, object)
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class _Store:
+    def __init__(self):
+        self.objects: Dict[Tuple[str, str], object] = {}  # (namespace, name) -> obj
+        self.watchers: List[WatchFn] = []
+
+
+class Cluster:
+    """Typed object store: pods, nodes, daemonsets, provisioners, PVCs, PVs,
+    storage classes, PDBs."""
+
+    KINDS = ("pods", "nodes", "daemonsets", "provisioners", "pvcs", "pvs", "storageclasses", "pdbs")
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._lock = threading.RLock()
+        self._stores: Dict[str, _Store] = {k: _Store() for k in self.KINDS}
+        self._version = 0
+        self.clock = clock or time.time
+
+    # -- generic helpers ---------------------------------------------------
+    def _key(self, obj) -> Tuple[str, str]:
+        return (obj.metadata.namespace, obj.metadata.name)
+
+    def _notify(self, kind: str, event: str, obj) -> None:
+        for w in list(self._stores[kind].watchers):
+            w(event, obj)
+
+    def watch(self, kind: str, fn: WatchFn) -> None:
+        self._stores[kind].watchers.append(fn)
+
+    def create(self, kind: str, obj) -> object:
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(obj)
+            if key in store.objects:
+                raise Conflict(f"{kind} {key} already exists")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self.clock()
+            store.objects[key] = obj
+        self._notify(kind, "ADDED", obj)
+        return obj
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            obj = self._stores[kind].objects.get((namespace, name))
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        return obj
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, kind: str, obj) -> object:
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(obj)
+            if key not in store.objects:
+                raise NotFound(f"{kind} {key} not found")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            store.objects[key] = obj
+        self._notify(kind, "MODIFIED", obj)
+        return obj
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        """Delete with finalizer semantics: objects carrying finalizers only
+        get a deletion timestamp; removal happens when finalizers clear."""
+        with self._lock:
+            store = self._stores[kind]
+            obj = store.objects.get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            if obj.metadata.finalizers and obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = self.clock()
+                self._version += 1
+                obj.metadata.resource_version = self._version
+                event = "MODIFIED"
+            else:
+                del store.objects[(namespace, name)]
+                event = "DELETED"
+        self._notify(kind, event, obj)
+
+    def remove_finalizer(self, kind: str, obj, finalizer: str) -> None:
+        with self._lock:
+            if finalizer in obj.metadata.finalizers:
+                obj.metadata.finalizers.remove(finalizer)
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                key = self._key(obj)
+                self._stores[kind].objects.pop(key, None)
+                deleted = True
+            else:
+                deleted = False
+        self._notify(kind, "DELETED" if deleted else "MODIFIED", obj)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List:
+        with self._lock:
+            objs = list(self._stores[kind].objects.values())
+        if namespace is not None:
+            objs = [o for o in objs if o.metadata.namespace == namespace]
+        return objs
+
+    # -- typed conveniences ------------------------------------------------
+    def pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        return self.list("pods", namespace)
+
+    def nodes(self) -> List[Node]:
+        return self.list("nodes")
+
+    def daemonsets(self) -> List[DaemonSet]:
+        return self.list("daemonsets")
+
+    def provisioners(self) -> List[Provisioner]:
+        return self.list("provisioners")
+
+    def list_pods_matching(
+        self, namespace: Optional[str], selector: Optional[LabelSelector]
+    ) -> List[Pod]:
+        pods = self.pods(namespace)
+        if selector is None:
+            return pods
+        return [p for p in pods if selector.matches(p.metadata.labels)]
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        """The `spec.nodeName` field-index equivalent
+        (reference: manager.go:39)."""
+        return [p for p in self.pods() if p.spec.node_name == node_name]
+
+    # -- subresources ------------------------------------------------------
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """The Bind subresource: assign pod to node."""
+        with self._lock:
+            pod.spec.node_name = node_name
+            self._version += 1
+            pod.metadata.resource_version = self._version
+        self._notify("pods", "MODIFIED", pod)
+
+    def evict(self, pod: Pod) -> bool:
+        """The Evict subresource. Returns False (HTTP 429 analog) if a PDB
+        would be violated."""
+        with self._lock:
+            for pdb in self.list("pdbs", pod.metadata.namespace):
+                if pdb.selector is None or not pdb.selector.matches(pod.metadata.labels):
+                    continue
+                matching = [
+                    p
+                    for p in self.pods(pod.metadata.namespace)
+                    if pdb.selector is None or pdb.selector.matches(p.metadata.labels)
+                ]
+                healthy = [p for p in matching if p.metadata.deletion_timestamp is None]
+                if pdb.min_available is not None and len(healthy) - 1 < pdb.min_available:
+                    return False
+                if pdb.max_unavailable is not None and (len(matching) - (len(healthy) - 1)) > pdb.max_unavailable:
+                    return False
+            pod.metadata.deletion_timestamp = self.clock()
+            self._version += 1
+            pod.metadata.resource_version = self._version
+        self._notify("pods", "MODIFIED", pod)
+        return True

@@ -1,0 +1,77 @@
+"""Prometheus metrics with the reference's metric names
+(reference: pkg/metrics/constants.go, scheduling/scheduler.go:37-50,
+provisioning/provisioner.go:183-196).
+
+Uses its own registry so repeated imports/tests don't collide with the global
+default registry.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+NAMESPACE = "karpenter"
+
+REGISTRY = CollectorRegistry()
+
+# controller-runtime-compatible duration buckets
+# (reference: pkg/metrics/constants.go:33-40).
+DURATION_BUCKETS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5,
+    0.6, 0.7, 0.8, 0.9, 1.0, 1.25, 1.5, 1.75, 2.0, 2.5, 3.0, 3.5, 4.0, 4.5,
+    5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 15.0, 20.0, 25.0, 30.0, 40.0, 50.0, 60.0,
+]
+
+SCHEDULING_DURATION = Histogram(
+    "scheduling_duration_seconds",
+    "Duration of scheduling process in seconds. Broken down by provisioner.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="allocation_controller",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)
+
+BIND_DURATION = Histogram(
+    "bind_duration_seconds",
+    "Duration of bind process in seconds. Broken down by result.",
+    ["result"],
+    namespace=NAMESPACE,
+    subsystem="allocation_controller",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)
+
+CLOUDPROVIDER_DURATION = Histogram(
+    "duration_seconds",
+    "Duration of cloud provider method calls.",
+    ["controller", "method", "provider"],
+    namespace=NAMESPACE,
+    subsystem="cloudprovider",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)
+
+NODES_GAUGE = Gauge(
+    "karpenter_nodes_allocatable",
+    "Node allocatable are the resources allocatable by nodes.",
+    ["node_name", "provisioner", "zone", "arch", "capacity_type", "instance_type", "phase", "resource_type"],
+    registry=REGISTRY,
+)
+
+PODS_STATE_GAUGE = Gauge(
+    "karpenter_pods_state",
+    "Pod state is the current state of pods.",
+    ["name", "namespace", "node", "provisioner", "zone", "arch", "capacity_type", "instance_type", "phase"],
+    registry=REGISTRY,
+)
+
+SOLVER_BATCH_SIZE = Histogram(
+    "batch_size_pods",
+    "Pods per solver batch.",
+    ["backend"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    buckets=[1, 10, 50, 100, 500, 1000, 2000, 5000, 10000],
+    registry=REGISTRY,
+)

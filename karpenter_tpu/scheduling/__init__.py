@@ -1,0 +1,2 @@
+from karpenter_tpu.scheduling.ffd import VirtualNode, FFDScheduler  # noqa: F401
+from karpenter_tpu.scheduling.scheduler import Scheduler  # noqa: F401

@@ -1,0 +1,136 @@
+"""First-fit-decreasing reference scheduler (the CPU path).
+
+A faithful re-implementation of the reference's scheduling core
+(``pkg/controllers/provisioning/scheduling/scheduler.go:64-137``,
+``node.go:30-81``, ``nodeset.go:30-78``): sort pods by CPU-then-memory
+descending, instance types by price ascending, inject topology decisions as
+just-in-time NodeSelectors, then first-fit each pod into existing virtual
+nodes — incrementally narrowing each node's surviving instance-type set — or
+open a new one.
+
+This backend is the in-process fallback and the parity oracle for the TPU
+batch solver (``karpenter_tpu.solver``).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.requirements import filter_instance_types
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.utils import resources as res
+
+logger = logging.getLogger("karpenter.scheduling")
+
+
+@dataclass
+class VirtualNode:
+    """A set of constraints + compatible pods + surviving instance types;
+    becomes a real node after launch (reference: node.go:30-44)."""
+
+    constraints: Constraints
+    instance_type_options: List[InstanceType]
+    pods: List[Pod] = field(default_factory=list)
+    requests: Dict[str, float] = field(default_factory=dict)
+
+    def add(self, pod: Pod) -> Optional[str]:
+        """Try to place the pod; returns an error string or None on success
+        (reference: node.go:46-66)."""
+        pod_reqs = Requirements.from_pod(pod)
+        if self.pods:
+            errs = self.constraints.requirements.compatible(pod_reqs)
+            if errs:
+                return "; ".join(errs)
+        requirements = self.constraints.requirements.add(*pod_reqs.requirements)
+        requests = res.merge(self.requests, res.requests_for_pods(pod))
+        instance_types = filter_instance_types(self.instance_type_options, requirements, requests)
+        if not instance_types:
+            return (
+                f"no instance type satisfied resources {res.to_string(res.requests_for_pods(pod))} "
+                f"and requirements {requirements}"
+            )
+        self.pods.append(pod)
+        self.instance_type_options = instance_types
+        self.requests = requests
+        self.constraints.requirements = requirements
+        return None
+
+
+def daemon_overhead(cluster: Cluster, constraints: Constraints) -> Dict[str, float]:
+    """Resources of daemonsets that will land on these nodes
+    (reference: nodeset.go:36-74)."""
+    total: Dict[str, float] = {}
+    for ds in cluster.daemonsets():
+        pod = Pod(spec=copy.deepcopy(ds.pod_template))
+        # validate_pod covers both the taint toleration and the requirement
+        # compatibility filters the reference applies.
+        if constraints.validate_pod(pod):
+            continue
+        total = res.merge(total, res.requests_for_pods(pod))
+    return total
+
+
+def sort_pods_ffd(pods: Sequence[Pod]) -> List[Pod]:
+    """CPU-then-memory descending (reference: scheduler.go:116-137). Stable,
+    like Go's sort.Slice on equal keys is not — but FFD only cares about the
+    ordering of the keys."""
+    def key(p: Pod):
+        r = res.requests_for_pods(p)
+        return (-r.get(res.CPU, 0.0), -r.get(res.MEMORY, 0.0))
+
+    return sorted(pods, key=key)
+
+
+class FFDScheduler:
+    """``solve`` returns virtual nodes for a batch of pending pods
+    (reference: scheduler.go:64-108)."""
+
+    def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
+        self.cluster = cluster
+        self.topology = Topology(cluster, rng=rng)
+
+    def solve(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        pods: Sequence[Pod],
+    ) -> List[VirtualNode]:
+        constraints = copy.deepcopy(constraints)
+        pods = sort_pods_ffd(pods)
+        instance_types = sorted(instance_types, key=lambda it: it.effective_price())
+
+        self.topology.inject(constraints, list(pods))
+
+        daemons = daemon_overhead(self.cluster, constraints)
+        nodes: List[VirtualNode] = []
+        unschedulable = 0
+        for pod in pods:
+            placed = False
+            for node in nodes:
+                if node.add(pod) is None:
+                    placed = True
+                    break
+            if not placed:
+                node = VirtualNode(
+                    constraints=copy.deepcopy(constraints),
+                    instance_type_options=list(instance_types),
+                    requests=dict(daemons),
+                )
+                err = node.add(pod)
+                if err is None:
+                    nodes.append(node)
+                else:
+                    unschedulable += 1
+                    logger.error("Scheduling pod %s, %s", pod.key, err)
+        if unschedulable:
+            logger.error("Failed to schedule %d pods", unschedulable)
+        return nodes

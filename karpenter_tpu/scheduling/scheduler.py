@@ -1,0 +1,57 @@
+"""Scheduler facade: dispatches a solve to the backend selected by the
+provisioner's ``spec.solver`` field (the north-star seam from BASELINE.json —
+the reconcile loop and launch path are backend-agnostic)."""
+
+from __future__ import annotations
+
+import copy
+import random
+import time
+from typing import List, Optional, Sequence
+
+from karpenter_tpu.api.provisioner import Provisioner, SOLVER_TPU
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.ffd import FFDScheduler, VirtualNode
+from karpenter_tpu import metrics
+
+
+class Scheduler:
+    def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
+        self.cluster = cluster
+        self.ffd = FFDScheduler(cluster, rng=rng)
+        self._tpu = None  # built lazily: importing jax is not free
+        self._rng = rng
+
+    def _tpu_scheduler(self):
+        if self._tpu is None:
+            from karpenter_tpu.solver.backend import TpuScheduler
+
+            self._tpu = TpuScheduler(self.cluster, rng=self._rng)
+        return self._tpu
+
+    def solve(
+        self,
+        provisioner: Provisioner,
+        instance_types: Sequence[InstanceType],
+        pods: Sequence[Pod],
+    ) -> List[VirtualNode]:
+        start = time.perf_counter()
+        # Layer the live catalog's supported values into the constraints; the
+        # provisioning controller also refreshes these at apply (reference:
+        # provisioning/controller.go:104-106), but re-layering here is
+        # idempotent and keeps the facade safe to call standalone.
+        constraints = copy.deepcopy(provisioner.spec.constraints)
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(instance_types)
+        )
+        try:
+            if provisioner.spec.solver == SOLVER_TPU:
+                return self._tpu_scheduler().solve(constraints, instance_types, pods)
+            return self.ffd.solve(constraints, instance_types, pods)
+        finally:
+            metrics.SCHEDULING_DURATION.labels(provisioner=provisioner.name).observe(
+                time.perf_counter() - start
+            )

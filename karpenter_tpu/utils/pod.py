@@ -1,0 +1,50 @@
+"""Pod predicates (reference: pkg/utils/pod/scheduling.go)."""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.objects import Pod
+
+
+def failed_to_schedule(pod: Pod) -> bool:
+    return any(
+        c.type == "PodScheduled" and c.reason == "Unschedulable" for c in pod.status.conditions
+    )
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return pod.spec.node_name != ""
+
+
+def is_preempting(pod: Pod) -> bool:
+    return pod.status.nominated_node_name != ""
+
+
+def is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in ("Failed", "Succeeded")
+
+
+def is_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def is_owned_by_daemonset(pod: Pod) -> bool:
+    return any(
+        o.api_version == "apps/v1" and o.kind == "DaemonSet" for o in pod.metadata.owner_references
+    )
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    """Static pods are owned by their node."""
+    return any(o.api_version == "v1" and o.kind == "Node" for o in pod.metadata.owner_references)
+
+
+def has_required_pod_affinity(pod: Pod) -> bool:
+    aff = pod.spec.affinity
+    return aff is not None and aff.pod_affinity is not None and bool(aff.pod_affinity.required)
+
+
+def has_required_pod_anti_affinity(pod: Pod) -> bool:
+    aff = pod.spec.affinity
+    return (
+        aff is not None and aff.pod_anti_affinity is not None and bool(aff.pod_anti_affinity.required)
+    )

@@ -1,0 +1,86 @@
+"""Cloud-provider abstraction tests (mirrors pkg/cloudprovider behaviors)."""
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement as R
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider import (
+    catalog_requirements,
+    compatible,
+    filter_instance_types,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    default_catalog,
+    instance_types,
+    instance_types_assorted,
+    new_instance_type,
+)
+from karpenter_tpu.cloudprovider.types import NodeRequest, Offering
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.utils import resources as res
+
+
+class TestCatalogRequirements:
+    def test_union_of_supported_values(self):
+        reqs = catalog_requirements(default_catalog())
+        assert "default-instance-type" in reqs.instance_types()
+        assert "arm-instance-type" in reqs.instance_types()
+        assert reqs.architectures() == {"amd64", "arm64"}
+        assert "test-zone-1" in reqs.zones()
+        assert reqs.capacity_types() == {"spot", "on-demand"}
+
+    def test_generators(self):
+        assert len(instance_types(400)) == 400
+        assert len(instance_types_assorted()) == 7 * 8 * 3 * 2 * 2 * 2
+
+
+class TestCompatible:
+    def test_arch_mismatch(self):
+        it = new_instance_type("t", architecture="arm64")
+        reqs = catalog_requirements([it]).add(
+            R(key=lbl.ARCH, operator="In", values=["amd64"])
+        )
+        assert not compatible(it, reqs)
+
+    def test_zone_and_capacity_must_pair(self):
+        it = new_instance_type(
+            "t", offerings=[Offering("spot", "z-1"), Offering("on-demand", "z-2")]
+        )
+        base = catalog_requirements([it])
+        # spot only offered in z-1; restricting to z-2 + spot must fail
+        reqs = base.add(
+            R(key=lbl.TOPOLOGY_ZONE, operator="In", values=["z-2"]),
+            R(key=lbl.CAPACITY_TYPE, operator="In", values=["spot"]),
+        )
+        assert not compatible(it, reqs)
+        reqs = base.add(
+            R(key=lbl.TOPOLOGY_ZONE, operator="In", values=["z-1"]),
+            R(key=lbl.CAPACITY_TYPE, operator="In", values=["spot"]),
+        )
+        assert compatible(it, reqs)
+
+
+class TestFilter:
+    def test_resource_fit_includes_overhead(self):
+        small = new_instance_type(
+            "small", resources={res.CPU: 1.0, res.MEMORY: res.parse_quantity("1Gi")}
+        )
+        big = new_instance_type(
+            "big", resources={res.CPU: 16.0, res.MEMORY: res.parse_quantity("64Gi")}
+        )
+        reqs = catalog_requirements([small, big])
+        # 1 cpu request + 100m overhead exceeds the small type's 1 cpu
+        out = filter_instance_types([small, big], reqs, {res.CPU: 1.0, res.PODS: 1.0})
+        assert [it.name for it in out] == ["big"]
+
+
+class TestFakeProvider:
+    def test_create_records_and_labels(self):
+        provider = FakeCloudProvider()
+        catalog = provider.get_instance_types()
+        constraints = Constraints(requirements=catalog_requirements(catalog))
+        node = provider.create(NodeRequest(template=constraints, instance_type_options=catalog))
+        assert len(provider.create_calls) == 1
+        assert node.metadata.labels[lbl.INSTANCE_TYPE] == "default-instance-type"
+        assert node.metadata.labels[lbl.TOPOLOGY_ZONE] in constraints.requirements.zones()
+        assert node.status.allocatable[res.CPU] == 4.0

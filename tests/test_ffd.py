@@ -1,0 +1,204 @@
+"""FFD reference-scheduler behavior tests (mirrors contexts from
+pkg/controllers/provisioning/scheduling/suite_test.go and
+instance_selection_test.go)."""
+
+import random
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement as R, Taint, Toleration
+from karpenter_tpu.cloudprovider.fake import (
+    default_catalog,
+    instance_types,
+    instance_types_assorted,
+)
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.ffd import FFDScheduler
+from karpenter_tpu.utils import resources as res
+from tests.factories import hostname_spread, make_daemonset, make_pod, make_provisioner, zone_spread
+
+
+def solve(pods, catalog=None, provisioner=None, cluster=None):
+    catalog = catalog if catalog is not None else default_catalog()
+    cluster = cluster or Cluster()
+    provisioner = provisioner or make_provisioner()
+    constraints = provisioner.spec.constraints
+    constraints.requirements = constraints.requirements.merge(catalog_requirements(catalog))
+    sched = FFDScheduler(cluster, rng=random.Random(42))
+    return sched.solve(constraints, catalog, pods)
+
+
+class TestBasicPacking:
+    def test_one_pod_one_node(self):
+        nodes = solve([make_pod(requests={"cpu": "1"})])
+        assert len(nodes) == 1
+        assert len(nodes[0].pods) == 1
+
+    def test_packs_multiple_pods_on_one_node(self):
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(3)]
+        nodes = solve(pods)
+        assert len(nodes) == 1
+        assert len(nodes[0].pods) == 3
+
+    def test_opens_new_node_when_full(self):
+        # catalog of one 4-cpu type with 100m overhead: two 3-cpu pods can't share
+        catalog = instance_types(4)  # 1..4 cpu types
+        pods = [make_pod(requests={"cpu": "3"}) for _ in range(2)]
+        nodes = solve(pods, catalog=catalog)
+        assert len(nodes) == 2
+
+    def test_unschedulable_pod_dropped(self):
+        nodes = solve([make_pod(requests={"cpu": "1000"})])
+        assert nodes == []
+
+    def test_pod_count_limit(self):
+        # default-instance-type allows 5 pods; 100m cpu each fits cpu-wise
+        pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(7)]
+        nodes = solve(pods)
+        assert len(nodes) == 2
+        assert sum(len(n.pods) for n in nodes) == 7
+
+
+class TestInstanceSelection:
+    def test_lands_on_cheapest_feasible(self):
+        catalog = instance_types_assorted()
+        random.Random(0).shuffle(catalog)
+        nodes = solve([make_pod(requests={"cpu": "0.9"})], catalog=catalog)
+        assert len(nodes) == 1
+        # cheapest surviving option should be first and minimal-cpu
+        cheapest = min(nodes[0].instance_type_options, key=lambda it: it.effective_price())
+        assert nodes[0].instance_type_options[0].effective_price() == cheapest.effective_price()
+        assert nodes[0].instance_type_options[0].resources[res.CPU] == 1.0
+
+    def test_arch_constraint_respected(self):
+        catalog = instance_types_assorted()
+        nodes = solve(
+            [
+                make_pod(
+                    requests={"cpu": "0.5"},
+                    node_requirements=[R(key=lbl.ARCH, operator="In", values=["arm64"])],
+                )
+            ],
+            catalog=catalog,
+        )
+        assert len(nodes) == 1
+        assert all(it.architecture == "arm64" for it in nodes[0].instance_type_options)
+
+
+class TestConstraints:
+    def test_node_selector_zone(self):
+        pods = [
+            make_pod(requests={"cpu": "1"}, node_selector={lbl.TOPOLOGY_ZONE: "test-zone-1"}),
+            make_pod(requests={"cpu": "1"}, node_selector={lbl.TOPOLOGY_ZONE: "test-zone-2"}),
+        ]
+        nodes = solve(pods)
+        assert len(nodes) == 2
+
+    def test_incompatible_selector_unschedulable(self):
+        nodes = solve([make_pod(node_selector={lbl.TOPOLOGY_ZONE: "unknown-zone"})])
+        assert nodes == []
+
+    def test_taints_block_intolerant_pods(self):
+        provisioner = make_provisioner(taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        # FFD itself doesn't gate on taints (selection does), but the
+        # provisioner-level validate_pod must reject
+        pod = make_pod()
+        assert provisioner.spec.constraints.validate_pod(pod)
+        tolerant = make_pod(
+            tolerations=[Toleration(key="dedicated", operator="Equal", value="gpu")]
+        )
+        assert provisioner.spec.constraints.validate_pod(tolerant) == []
+
+    def test_provisioner_requirement_narrows_zones(self):
+        provisioner = make_provisioner(
+            requirements=[R(key=lbl.TOPOLOGY_ZONE, operator="In", values=["test-zone-2"])]
+        )
+        nodes = solve([make_pod(requests={"cpu": "1"})], provisioner=provisioner)
+        assert len(nodes) == 1
+        assert nodes[0].constraints.requirements.zones() == {"test-zone-2"}
+
+
+class TestTopology:
+    def test_zone_spread(self):
+        pods = [
+            make_pod(requests={"cpu": "0.5"}, labels={"app": "web"}, topology=[zone_spread(labels={"app": "web"})])
+            for _ in range(3)
+        ]
+        nodes = solve(pods)
+        zones = set()
+        for n in nodes:
+            zones.update(n.constraints.requirements.zones())
+        # 3 pods with maxSkew 1 over 3 zones → one pod per zone
+        assert len(nodes) == 3
+        assert len(zones) == 3
+
+    def test_hostname_spread(self):
+        pods = [
+            make_pod(
+                requests={"cpu": "0.5"},
+                labels={"app": "web"},
+                topology=[hostname_spread(labels={"app": "web"})],
+            )
+            for _ in range(3)
+        ]
+        nodes = solve(pods)
+        # maxSkew=1 over generated hostnames → one pod per hostname/node
+        assert len(nodes) == 3
+
+    def test_zone_spread_counts_existing_cluster_pods(self):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+
+        cluster = Cluster()
+        # an existing node in test-zone-1 running 2 matching pods
+        cluster.create(
+            "nodes",
+            Node(metadata=ObjectMeta(name="existing", namespace="", labels={lbl.TOPOLOGY_ZONE: "test-zone-1"})),
+        )
+        for i in range(2):
+            p = make_pod(labels={"app": "web"}, node_name="existing", unschedulable=False)
+            cluster.create("pods", p)
+        pods = [
+            make_pod(requests={"cpu": "0.5"}, labels={"app": "web"}, topology=[zone_spread(labels={"app": "web"})])
+            for _ in range(2)
+        ]
+        nodes = solve(pods, cluster=cluster)
+        zones = set()
+        for n in nodes:
+            zones.update(n.constraints.requirements.zones())
+        # skew counts make zone-2/zone-3 preferred over loaded zone-1
+        assert "test-zone-1" not in zones
+
+
+class TestDaemonOverhead:
+    def test_daemon_resources_reserved(self):
+        cluster = Cluster()
+        cluster.create("daemonsets", make_daemonset(requests={"cpu": "1"}))
+        # 4-cpu nodes, 100m type overhead + 1cpu daemon → 2.5cpu pod fits
+        # alone but two don't
+        pods = [make_pod(requests={"cpu": "1.5"}) for _ in range(2)]
+        nodes = solve(pods, catalog=instance_types(4), cluster=cluster)
+        assert len(nodes) == 2
+
+    def test_incompatible_daemonset_ignored(self):
+        cluster = Cluster()
+        cluster.create(
+            "daemonsets",
+            make_daemonset(requests={"cpu": "4"}, node_selector={"nope": "nope"}),
+        )
+        nodes = solve([make_pod(requests={"cpu": "1"})], cluster=cluster)
+        assert len(nodes) == 1
+
+
+class TestAccelerators:
+    def test_gpu_pod_gets_gpu_node(self):
+        nodes = solve([make_pod(requests={res.NVIDIA_GPU: "1"})])
+        assert len(nodes) == 1
+        assert all(
+            it.resources.get(res.NVIDIA_GPU, 0) >= 1 for it in nodes[0].instance_type_options
+        )
+
+    def test_benchmark_catalog_packs(self):
+        catalog = instance_types(50)
+        pods = [make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(20)]
+        nodes = solve(pods, catalog=catalog)
+        assert sum(len(n.pods) for n in nodes) == 20

@@ -1,0 +1,136 @@
+"""Requirements algebra tests (mirrors requirements.go semantics and parts of
+apis/provisioning/v1alpha5/suite_test.go)."""
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement as R,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+)
+from karpenter_tpu.api.requirements import Requirements
+from tests.factories import make_pod
+
+
+class TestAdd:
+    def test_intersects_per_key(self):
+        r = Requirements.new(
+            R(key="k", operator="In", values=["a", "b"]),
+            R(key="k", operator="In", values=["b", "c"]),
+        )
+        assert r.get("k").finite_values() == frozenset({"b"})
+
+    def test_not_in_narrows(self):
+        r = Requirements.new(
+            R(key="k", operator="In", values=["a", "b"]),
+            R(key="k", operator="NotIn", values=["b"]),
+        )
+        assert r.get("k").finite_values() == frozenset({"a"})
+
+    def test_normalizes_beta_labels(self):
+        r = Requirements.new(
+            R(key="beta.kubernetes.io/arch", operator="In", values=["amd64"]),
+        )
+        assert r.has(lbl.ARCH)
+        assert not r.has("beta.kubernetes.io/arch")
+
+    def test_ignores_region(self):
+        r = Requirements.new(
+            R(key=lbl.TOPOLOGY_REGION, operator="In", values=["us-east-1"]),
+        )
+        assert not r.has(lbl.TOPOLOGY_REGION)
+        assert len(r.requirements) == 0
+
+    def test_immutable(self):
+        a = Requirements.new(R(key="k", operator="In", values=["a"]))
+        b = a.add(R(key="k", operator="In", values=["b"]))
+        assert a.get("k").finite_values() == frozenset({"a"})
+        assert b.get("k").is_empty
+
+
+class TestCompatible:
+    def test_overlap_ok(self):
+        prov = Requirements.new(R(key="k", operator="In", values=["a", "b"]))
+        pod = Requirements.new(R(key="k", operator="In", values=["b", "c"]))
+        assert prov.compatible(pod) == []
+
+    def test_disjoint_fails(self):
+        prov = Requirements.new(R(key="k", operator="In", values=["a"]))
+        pod = Requirements.new(R(key="k", operator="In", values=["c"]))
+        assert prov.compatible(pod)
+
+    def test_missing_key_fails_for_in(self):
+        # Pod requires k In [a]; provisioner says nothing about k → zero-value
+        # set is empty → incompatible (matches reference zero-value Set).
+        prov = Requirements.new()
+        pod = Requirements.new(R(key="k", operator="In", values=["a"]))
+        assert prov.compatible(pod)
+
+    def test_not_in_escape_hatch(self):
+        prov = Requirements.new(R(key="k", operator="DoesNotExist"))
+        pod = Requirements.new(R(key="k", operator="NotIn", values=["a"]))
+        # NotIn ∩ DoesNotExist = empty, but both ops are in the escape set
+        assert prov.compatible(pod) == []
+
+    def test_exists_compatible_with_in(self):
+        prov = Requirements.new(R(key="k", operator="Exists"))
+        pod = Requirements.new(R(key="k", operator="In", values=["a"]))
+        assert prov.compatible(pod) == []
+
+
+class TestFromPod:
+    def test_node_selector(self):
+        pod = make_pod(node_selector={lbl.TOPOLOGY_ZONE: "z-1"})
+        r = Requirements.from_pod(pod)
+        assert r.get(lbl.TOPOLOGY_ZONE).finite_values() == frozenset({"z-1"})
+
+    def test_heaviest_preferred_term(self):
+        pod = make_pod(
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[R(key="w", operator="In", values=["light"])]
+                    ),
+                ),
+                PreferredSchedulingTerm(
+                    weight=10,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[R(key="w", operator="In", values=["heavy"])]
+                    ),
+                ),
+            ]
+        )
+        r = Requirements.from_pod(pod)
+        assert r.get("w").finite_values() == frozenset({"heavy"})
+
+    def test_first_required_term(self):
+        pod = make_pod()
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(match_expressions=[R(key="t", operator="In", values=["one"])]),
+                    NodeSelectorTerm(match_expressions=[R(key="t", operator="In", values=["two"])]),
+                ]
+            )
+        )
+        r = Requirements.from_pod(pod)
+        assert r.get("t").finite_values() == frozenset({"one"})
+
+
+class TestValidate:
+    def test_infeasible(self):
+        r = Requirements.new(
+            R(key="k", operator="In", values=["a"]),
+            R(key="k", operator="In", values=["b"]),
+        )
+        assert any("no feasible value" in e for e in r.validate())
+
+    def test_feasible(self):
+        r = Requirements.new(R(key="k", operator="In", values=["a"]))
+        assert r.validate() == []
+
+    def test_unsupported_operator(self):
+        r = Requirements.new(R(key="k", operator="Gt", values=["1"]))
+        assert any("operator" in e for e in r.validate())

@@ -1,0 +1,69 @@
+"""Resource parsing/arithmetic and dense-vector encoding tests."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.utils import resources as res
+from tests.factories import make_pod
+
+
+class TestParsing:
+    def test_plain(self):
+        assert res.parse_quantity("4") == 4.0
+        assert res.parse_quantity(2.5) == 2.5
+
+    def test_milli(self):
+        assert res.parse_quantity("100m") == pytest.approx(0.1)
+        assert res.parse_quantity("1500m") == pytest.approx(1.5)
+
+    def test_binary_suffixes(self):
+        assert res.parse_quantity("1Ki") == 1024
+        assert res.parse_quantity("2Gi") == 2 * 2**30
+        assert res.parse_quantity("1.5Gi") == pytest.approx(1.5 * 2**30)
+
+    def test_decimal_suffixes(self):
+        assert res.parse_quantity("1k") == 1000
+        assert res.parse_quantity("2G") == 2e9
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            res.parse_quantity("abc")
+
+
+class TestArithmetic:
+    def test_merge(self):
+        out = res.merge({"cpu": 1.0}, {"cpu": 2.0, "memory": 5.0})
+        assert out == {"cpu": 3.0, "memory": 5.0}
+
+    def test_fits(self):
+        assert res.fits({"cpu": 1.0}, {"cpu": 1.0, "memory": 5.0})
+        assert not res.fits({"cpu": 2.0}, {"cpu": 1.0})
+        # missing key in total counts as zero
+        assert not res.fits({"gpu": 1.0}, {"cpu": 1.0})
+
+    def test_requests_for_pods_adds_pod_count(self):
+        p1 = make_pod(requests={"cpu": "1"})
+        p2 = make_pod(requests={"cpu": "2"})
+        out = res.requests_for_pods(p1, p2)
+        assert out[res.CPU] == 3.0
+        assert out[res.PODS] == 2.0
+
+
+class TestVectorEncoding:
+    def test_known_axes(self):
+        v = res.to_vector({res.CPU: 2.0, res.MEMORY: 1024.0})
+        assert v[res.AXIS_INDEX[res.CPU]] == 2.0
+        assert v[res.AXIS_INDEX[res.MEMORY]] == 1024.0
+        assert v.dtype == np.float32
+
+    def test_extra_axes(self):
+        v = res.to_vector({"example.com/foo": 3.0}, extra_axes=["example.com/foo"])
+        assert v[res.NUM_RESOURCE_AXES] == 3.0
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(KeyError):
+            res.to_vector({"example.com/foo": 3.0})
+
+    def test_collect_extra_axes(self):
+        extras = res.collect_extra_axes([{"z.com/a": 1.0}, {res.CPU: 1.0, "a.com/b": 2.0}])
+        assert extras == ["a.com/b", "z.com/a"]

@@ -1,0 +1,69 @@
+"""Complement-set algebra tests (mirrors pkg/utils/sets semantics)."""
+
+from karpenter_tpu.utils.sets import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    ValueSet,
+    set_for_operator,
+)
+
+
+class TestIntersection:
+    def test_finite_finite(self):
+        a = ValueSet.of("a", "b")
+        b = ValueSet.of("b", "c")
+        assert a.intersection(b) == ValueSet.of("b")
+
+    def test_finite_complement(self):
+        a = ValueSet.of("a", "b")
+        b = ValueSet.complement_of("b")
+        assert a.intersection(b) == ValueSet.of("a")
+
+    def test_complement_finite(self):
+        a = ValueSet.complement_of("a")
+        b = ValueSet.of("a", "b")
+        assert a.intersection(b) == ValueSet.of("b")
+
+    def test_complement_complement(self):
+        a = ValueSet.complement_of("a")
+        b = ValueSet.complement_of("b")
+        out = a.intersection(b)
+        assert out.complement and out.values == frozenset({"a", "b"})
+
+    def test_universe_identity(self):
+        a = ValueSet.of("x")
+        assert ValueSet.universe().intersection(a) == a
+
+
+class TestOpType:
+    def test_types(self):
+        assert ValueSet.of("a").op_type() == OP_IN
+        assert ValueSet.empty().op_type() == OP_DOES_NOT_EXIST
+        assert ValueSet.complement_of("a").op_type() == OP_NOT_IN
+        assert ValueSet.universe().op_type() == OP_EXISTS
+
+
+class TestMembership:
+    def test_has(self):
+        assert ValueSet.of("a").has("a")
+        assert not ValueSet.of("a").has("b")
+        assert ValueSet.complement_of("a").has("b")
+        assert not ValueSet.complement_of("a").has("a")
+        assert ValueSet.universe().has("anything")
+
+    def test_cardinality(self):
+        assert ValueSet.of("a", "b").cardinality == 2
+        assert ValueSet.empty().cardinality == 0
+        assert ValueSet.universe().cardinality > 1 << 60
+        # complement of one value is still "infinite"
+        assert ValueSet.complement_of("a").cardinality > 1 << 60
+
+
+class TestOperatorConstruction:
+    def test_all_ops(self):
+        assert set_for_operator(OP_IN, ["a"]) == ValueSet.of("a")
+        assert set_for_operator(OP_NOT_IN, ["a"]) == ValueSet.complement_of("a")
+        assert set_for_operator(OP_EXISTS) == ValueSet.universe()
+        assert set_for_operator(OP_DOES_NOT_EXIST) == ValueSet.empty()
