@@ -111,6 +111,18 @@ class FFDScheduler:
         self.topology.inject(constraints, list(pods))
 
         daemons = daemon_overhead(self.cluster, constraints)
+        return self.solve_injected(constraints, instance_types, pods, daemons)
+
+    def solve_injected(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        pods: Sequence[Pod],
+        daemons: Dict[str, float],
+    ) -> List[VirtualNode]:
+        """The packing loop alone — pods already FFD-sorted, topology already
+        injected, types already price-sorted (shared entry for the TPU
+        backend's fallback path)."""
         nodes: List[VirtualNode] = []
         unschedulable = 0
         for pod in pods:

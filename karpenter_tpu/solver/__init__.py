@@ -1,0 +1,23 @@
+"""The TPU-native batch bin-pack solver.
+
+Replaces the reference's FFD hot loop
+(``pkg/controllers/provisioning/scheduling/scheduler.go:84-99`` +
+``node.go:46-66``) with a two-level design built for XLA:
+
+- **Host (signature layer)**: the full requirements algebra (complement sets,
+  escape hatches, taints, offerings) runs once per *constraint signature* —
+  the equivalence class of a pod's scheduling constraints — instead of once
+  per pod×node. Signatures, their pairwise join table, surviving
+  instance-type masks, and Pareto capacity frontiers are dense arrays handed
+  to the device. See ``signature.py``.
+- **Device (packing kernel)**: a jitted ``lax.scan`` performs exact first-fit
+  in FFD order; per-node state is just {signature id, hostname id, resource
+  totals}, and the fit test is a compare against the signature's capacity
+  frontier. See ``kernel.py``.
+
+The decomposition is behavior-preserving: the parity suite asserts
+assignment-identical results against the FFD reference on randomized
+scenarios (``tests/test_solver_parity.py``).
+"""
+
+from karpenter_tpu.solver.backend import TpuScheduler  # noqa: F401

@@ -1,0 +1,135 @@
+"""TpuScheduler: the accelerator-backed solve path.
+
+Same contract as ``FFDScheduler.solve`` (and assignment-identical results —
+see tests/test_solver_parity.py): sort, inject topology, encode to dense
+tensors, run the packing kernel, decode virtual nodes. Falls back to the host
+FFD when a batch's constraint diversity overflows the signature closure.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import random
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Pod
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.ffd import FFDScheduler, VirtualNode, daemon_overhead, sort_pods_ffd
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import encode as enc
+from karpenter_tpu.solver import kernel
+from karpenter_tpu.solver.signature import SignatureOverflow
+from karpenter_tpu.utils import resources as res
+
+logger = logging.getLogger("karpenter.solver")
+
+
+class TpuScheduler:
+    def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
+        self.cluster = cluster
+        self.topology = Topology(cluster, rng=rng)
+        self._ffd_fallback = FFDScheduler(cluster, rng=rng)
+
+    def solve(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        pods: Sequence[Pod],
+    ) -> List[VirtualNode]:
+        if not pods:
+            return []
+        constraints = copy.deepcopy(constraints)
+        pods = sort_pods_ffd(pods)
+        instance_types = sorted(instance_types, key=lambda it: it.effective_price())
+        self.topology.inject(constraints, list(pods))
+        daemon = daemon_overhead(self.cluster, constraints)
+
+        try:
+            batch = enc.encode(constraints, instance_types, pods, daemon)
+        except SignatureOverflow as e:
+            logger.warning("falling back to FFD: %s", e)
+            return self._ffd_fallback.solve_injected(constraints, instance_types, pods, daemon)
+
+        result = kernel.pack(
+            batch.pod_valid,
+            batch.pod_open_sig,
+            batch.pod_core,
+            batch.pod_host,
+            batch.pod_host_in_base,
+            batch.pod_open_host,
+            batch.pod_req,
+            batch.join_table,
+            batch.frontiers,
+            batch.daemon,
+            n_max=len(batch.pod_valid),
+        )
+        return self._decode(batch, result, constraints, instance_types)
+
+    def _decode(
+        self,
+        batch: enc.EncodedBatch,
+        result,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+    ) -> List[VirtualNode]:
+        assignment = np.asarray(result.assignment)[: batch.n_pods]
+        node_sig = np.asarray(result.node_sig)
+        node_host = np.asarray(result.node_host)
+        node_req = np.asarray(result.node_req)
+        n_nodes = int(result.n_nodes)
+
+        unschedulable = int((assignment < 0).sum())
+        if unschedulable:
+            logger.error("Failed to schedule %d pods", unschedulable)
+
+        # group pods per node (order-preserving, like FFD append order)
+        pods_by_node: Dict[int, List[Pod]] = {}
+        for i, a in enumerate(assignment):
+            if a >= 0:
+                pods_by_node.setdefault(int(a), []).append(batch.pods[i])
+
+        sig_masks = {s.sig_id: s.type_mask for s in batch.table.signatures}
+        nodes: List[VirtualNode] = []
+        for n in range(n_nodes):
+            if n not in pods_by_node:
+                continue
+            sig = batch.table.signatures[int(node_sig[n])]
+            total = node_req[n]
+            # surviving types: signature-compatible ∧ fit the node total
+            fit = np.all(batch.usable >= total[None, :], axis=-1)
+            surviving = [
+                it
+                for it, m, f in zip(instance_types, sig_masks[sig.sig_id], fit)
+                if m and f
+            ]
+            node_constraints = copy.deepcopy(constraints)
+            reqs = sig.requirements
+            h = int(node_host[n])
+            if h >= 0:
+                reqs = reqs.add(
+                    NodeSelectorRequirement(
+                        key=lbl.HOSTNAME, operator="In", values=[batch.hostnames[h]]
+                    )
+                )
+            node_constraints.requirements = reqs
+            scales = res.axis_scales(batch.axes)
+            requests = {
+                name: float(total[i]) / scales[i]
+                for i, name in enumerate(res.RESOURCE_AXES + batch.axes)
+                if total[i]
+            }
+            nodes.append(
+                VirtualNode(
+                    constraints=node_constraints,
+                    instance_type_options=surviving,
+                    pods=pods_by_node[n],
+                    requests=requests,
+                )
+            )
+        return nodes

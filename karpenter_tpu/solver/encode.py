@@ -1,0 +1,207 @@
+"""Tensorize one solve: pods × instance types × constraints → dense arrays.
+
+Host-side preparation for the packing kernel:
+
+1. canonicalize every pod into a (core, hostname) pair and intern cores;
+2. build the signature closure (base ⊕ cores under join) with the exact
+   requirements algebra (``signature.py``);
+3. emit dense arrays — join table ``[S, C]``, capacity frontiers
+   ``[S, F, R]``, per-pod core/hostname/request vectors — padded to bucketed
+   shapes so XLA compiles once per shape bucket.
+
+Complement-set semantics never reach the device: they are fully resolved into
+the join table and frontiers here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.solver.signature import (
+    Core,
+    SignatureOverflow,
+    SignatureTable,
+    pod_core_and_hostname,
+)
+from karpenter_tpu.utils import resources as res
+
+# Frontier rows are padded with this; requests are non-negative and include a
+# pods count ≥ 1, so a padded row can never satisfy a fit test.
+FRONTIER_PAD = -1.0
+
+
+def _bucket(n: int, minimum: int = 64) -> int:
+    """Next power of two ≥ n (≥ minimum) — the shape-bucketing discipline
+    that keeps jit cache hits high across varying batch sizes."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class EncodedBatch:
+    """Everything the kernel needs, plus the host-side context to decode."""
+
+    pods: List[Pod]  # solve order (FFD-sorted)
+    n_pods: int
+    # device arrays (padded to p_pad)
+    pod_valid: np.ndarray  # [P] bool
+    pod_open_sig: np.ndarray  # [P] i32 — signature of a fresh node for this pod
+    pod_core: np.ndarray  # [P] i32
+    pod_host: np.ndarray  # [P] i32, -1 = no hostname requirement
+    pod_host_in_base: np.ndarray  # [P] bool
+    pod_open_host: np.ndarray  # [P] i32 node hostname state when opened (-1/h/-2)
+    pod_req: np.ndarray  # [P, R] f32
+    join_table: np.ndarray  # [S, C] i32, -1 = incompatible
+    frontiers: np.ndarray  # [S, F, R] f32
+    daemon: np.ndarray  # [R] f32
+    # host context
+    table: SignatureTable
+    cores: List[Core]
+    hostnames: List[str]
+    axes: List[str]
+    usable: np.ndarray  # [T, R]
+
+
+def usable_capacity(
+    instance_types: Sequence[InstanceType], extra_axes: Sequence[str]
+) -> np.ndarray:
+    """[T, R] allocatable minus overhead — what requests compare against
+    (reference: requirements.go:68-80 merges requests+overhead vs capacity;
+    subtracting overhead once per type is the same inequality). Scaled to the
+    exact-integer device units (resources.AXIS_SCALES)."""
+    out = np.zeros((len(instance_types), res.NUM_RESOURCE_AXES + len(extra_axes)), np.float32)
+    for i, it in enumerate(instance_types):
+        out[i] = res.to_scaled_vector(it.resources, extra_axes) - res.to_scaled_vector(
+            it.overhead, extra_axes
+        )
+    return out
+
+
+def encode(
+    constraints: Constraints,
+    instance_types: Sequence[InstanceType],
+    pods: Sequence[Pod],
+    daemon: Dict[str, float],
+) -> EncodedBatch:
+    """Build the dense solve request. ``instance_types`` must already be
+    price-sorted and ``pods`` FFD-sorted; topology decisions must already be
+    injected (both shared with the FFD path). Raises SignatureOverflow when
+    constraint diversity exceeds the closure cap (caller falls back to FFD).
+    """
+    # resource axes: reserved + any extended resources in play
+    extras = res.collect_extra_axes(
+        [it.resources for it in instance_types]
+        + [it.overhead for it in instance_types]
+        + [p.resource_requests() for p in pods]
+        + [daemon]
+    )
+    axes = extras  # extra axis names appended after the reserved block
+    usable = usable_capacity(instance_types, axes)
+    table = SignatureTable(constraints, instance_types, usable, axes)
+
+    # canonicalize pods; intern cores + hostnames
+    cores: List[Core] = []
+    core_ids: Dict[Core, int] = {}
+    hostnames: List[str] = []
+    host_ids: Dict[str, int] = {}
+
+    n = len(pods)
+    pod_core = np.zeros(n, np.int32)
+    pod_host = np.full(n, -1, np.int32)
+    pod_host_in_base = np.zeros(n, bool)
+    pod_open_host = np.full(n, -1, np.int32)
+    pod_req = np.zeros((n, usable.shape[1]), np.float32)
+    base_has_hostname = constraints.requirements.has(lbl.HOSTNAME)
+
+    req_cache: Dict[Tuple, np.ndarray] = {}
+    for i, pod in enumerate(pods):
+        core, hostname = pod_core_and_hostname(pod)
+        cid = core_ids.get(core)
+        if cid is None:
+            cid = len(cores)
+            core_ids[core] = cid
+            cores.append(core)
+        pod_core[i] = cid
+        if hostname is not None:
+            hid = host_ids.get(hostname)
+            if hid is None:
+                hid = len(hostnames)
+                host_ids[hostname] = hid
+                hostnames.append(hostname)
+            pod_host[i] = hid
+            in_base = table.hostname_in_base(hostname)
+            pod_host_in_base[i] = in_base
+            # node hostname state if this pod opens a node: joinable (h) when
+            # the merged hostname set stays non-empty ({h}), poisoned (-2)
+            # when the base domains exclude h (set intersects to ∅ — later
+            # hostname pods can never match, reference requirements.go:175)
+            pod_open_host[i] = hid if (in_base or not base_has_hostname) else -2
+        requests = res.requests_for_pods(pod)
+        rkey = tuple(sorted(requests.items()))
+        vec = req_cache.get(rkey)
+        if vec is None:
+            vec = res.to_scaled_vector(requests, axes)
+            req_cache[rkey] = vec
+        pod_req[i] = vec
+
+    # signature closure: process every signature against every core until no
+    # new signatures appear (table.join interns joined signatures, growing
+    # table.signatures; raises SignatureOverflow past the cap)
+    open_sig_by_core = np.array([table.open_signature(c) for c in cores], np.int32)
+    processed = 0
+    while processed < len(table.signatures):
+        sid = processed
+        processed += 1
+        for core in cores:
+            table.join(sid, core)
+
+    S = len(table.signatures)
+    C = max(len(cores), 1)  # gathers need a non-empty core axis
+    join_table = np.full((S, C), -1, np.int32)
+    for (sid, core), out in table._join_cache.items():
+        join_table[sid, core_ids[core]] = out
+
+    f_max = max((len(s.frontier) for s in table.signatures), default=1) or 1
+    R = usable.shape[1]
+    frontiers = np.full((S, f_max, R), FRONTIER_PAD, np.float32)
+    for s in table.signatures:
+        if len(s.frontier):
+            frontiers[s.sig_id, : len(s.frontier)] = s.frontier
+
+    daemon_vec = res.to_scaled_vector(daemon, axes)
+
+    # pad pods to bucket
+    p_pad = _bucket(max(n, 1))
+    pad = p_pad - n
+
+    def pad1(a, fill):
+        return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)]) if pad else a
+
+    return EncodedBatch(
+        pods=list(pods),
+        n_pods=n,
+        pod_valid=pad1(np.ones(n, bool), False),
+        pod_open_sig=pad1(open_sig_by_core[pod_core], 0),
+        pod_core=pad1(pod_core, 0),
+        pod_host=pad1(pod_host, -1),
+        pod_host_in_base=pad1(pod_host_in_base, False),
+        pod_open_host=pad1(pod_open_host, -1),
+        pod_req=pad1(pod_req, 0.0),
+        join_table=join_table,
+        frontiers=frontiers,
+        daemon=daemon_vec,
+        table=table,
+        cores=cores,
+        hostnames=hostnames,
+        axes=axes,
+        usable=usable,
+    )

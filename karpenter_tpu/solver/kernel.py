@@ -1,0 +1,125 @@
+"""The jitted packing kernel.
+
+Exact first-fit in FFD order as a ``lax.scan`` over pods. Per-node carry
+state is {signature id, hostname id, resource total}; the accept test per
+(pod, node) is:
+
+    join_table[node_sig, pod_core] ≥ 0          (requirements compatibility)
+  ∧ hostname fields agree                       (single-value hostname join)
+  ∧ ∃ frontier row f: total + pod_req ≤ f       (∃ surviving type that fits)
+
+which is the tensorized form of ``scheduling/node.go:46-66``. ``argmax`` over
+the ok-mask picks the *first* fitting node, preserving first-fit semantics.
+
+Shapes are static per (P, S, C, F, R) bucket; no data-dependent control flow
+— unschedulable pods are masked, not branched on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class PackResult(NamedTuple):
+    assignment: jnp.ndarray  # [P] i32 node index, -1 = unschedulable/padding
+    node_sig: jnp.ndarray  # [N] i32 final signature per node, -1 = unopened
+    node_host: jnp.ndarray  # [N] i32
+    node_req: jnp.ndarray  # [N, R] f32 total requests (incl. daemon)
+    n_nodes: jnp.ndarray  # scalar i32
+
+
+@partial(jax.jit, static_argnames=("n_max",))
+def pack(
+    pod_valid,  # [P] bool
+    pod_open_sig,  # [P] i32
+    pod_core,  # [P] i32
+    pod_host,  # [P] i32, -1 = no hostname requirement
+    pod_host_in_base,  # [P] bool — hostname ∈ base constraint domains
+    pod_open_host,  # [P] i32 — node hostname state when opened by this pod
+    #   (-1 none, h ≥ 0 joinable, -2 poisoned: hostname set became empty)
+    pod_req,  # [P, R] f32
+    join_table,  # [S, C] i32
+    frontiers,  # [S, F, R] f32
+    daemon,  # [R] f32
+    n_max: int,
+) -> PackResult:
+    P, R = pod_req.shape
+
+    node_sig0 = jnp.full((n_max,), -1, jnp.int32)
+    node_host0 = jnp.full((n_max,), -1, jnp.int32)
+    node_req0 = jnp.zeros((n_max, R), jnp.float32)
+    count0 = jnp.zeros((), jnp.int32)
+
+    def step(carry, x):
+        node_sig, node_host, node_req, count = carry
+        valid, open_sig, core, host, host_in_base, open_host, req = x
+
+        is_open = node_sig >= 0
+        j = join_table[jnp.clip(node_sig, 0), core]  # [N]
+        ok_sig = (j >= 0) & is_open
+        # hostname join: pods without a hostname requirement always pass; a
+        # hostname pod joins a node whose hostname is unset only if its value
+        # is in the base domains (otherwise the intersection with the node's
+        # current hostname set would be empty with no escape hatch)
+        ok_host = (host < 0) | ((node_host == -1) & host_in_base) | (node_host == host)
+        new_req = node_req + req[None, :]  # [N, R]
+        fr = frontiers[jnp.clip(j, 0)]  # [N, F, R] gather from small table
+        fits = jnp.any(jnp.all(new_req[:, None, :] <= fr, axis=-1), axis=-1)
+        ok = ok_sig & ok_host & fits
+
+        any_ok = jnp.any(ok)
+        first_ok = jnp.argmax(ok)  # first open node that accepts → first-fit
+
+        open_req = daemon + req
+        open_fits = jnp.any(jnp.all(open_req[None, :] <= frontiers[open_sig], axis=-1))
+
+        schedulable = valid & (any_ok | open_fits)
+        target = jnp.where(any_ok, first_ok, count)
+
+        upd_sig = jnp.where(any_ok, j[first_ok], open_sig)
+        upd_host = jnp.where(
+            any_ok,
+            jnp.where(host >= 0, host, node_host[first_ok]),
+            open_host,
+        )
+        upd_req = jnp.where(any_ok, new_req[first_ok], open_req)
+
+        # masked scatter: write target slot only when the pod schedules
+        node_sig = node_sig.at[target].set(jnp.where(schedulable, upd_sig, node_sig[target]))
+        node_host = node_host.at[target].set(jnp.where(schedulable, upd_host, node_host[target]))
+        node_req = node_req.at[target].set(jnp.where(schedulable, upd_req, node_req[target]))
+        count = count + jnp.where(schedulable & ~any_ok, 1, 0).astype(jnp.int32)
+
+        assignment = jnp.where(schedulable, target, -1).astype(jnp.int32)
+        return (node_sig, node_host, node_req, count), assignment
+
+    (node_sig, node_host, node_req, count), assignment = lax.scan(
+        step,
+        (node_sig0, node_host0, node_req0, count0),
+        (pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base, pod_open_host, pod_req),
+    )
+    return PackResult(assignment, node_sig, node_host, node_req, count)
+
+
+@partial(jax.jit, static_argnames=())
+def cheapest_fitting_type(
+    node_req,  # [N, R]
+    node_sig,  # [N]
+    sig_type_mask,  # [S, T] bool
+    usable,  # [T, R]
+):
+    """Post-pack, one shot: for every node, the index of the cheapest
+    instance type that survives its signature and fits its total. Types are
+    price-sorted, so "cheapest" = first True. Returns [N] i32, -1 for
+    unopened nodes."""
+    mask = sig_type_mask[jnp.clip(node_sig, 0)]  # [N, T]
+    fits = jnp.all(node_req[:, None, :] <= usable[None, :, :], axis=-1)  # [N, T]
+    ok = mask & fits
+    idx = jnp.argmax(ok, axis=-1)
+    has = jnp.any(ok, axis=-1) & (node_sig >= 0)
+    return jnp.where(has, idx, -1).astype(jnp.int32)

@@ -95,9 +95,15 @@ def merge(*lists: Mapping[str, float]) -> ResourceList:
 
 
 def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
-    """Candidate fits iff every requested quantity <= total's
-    (missing keys in total count as zero; reference: resources.go:83-90)."""
-    return all(qty <= total.get(name, 0.0) for name, qty in candidate.items())
+    """Candidate fits iff every requested quantity <= total's (missing keys
+    in total count as zero; reference: resources.go:83-90). Comparison is in
+    integer milli-units, matching Go's resource.Quantity exact arithmetic —
+    float drift from summing parsed quantities (e.g. 0.1+0.25 > 0.35 in
+    binary) must not flip a fit decision."""
+    return all(
+        round(qty * 1000.0) <= round(total.get(name, 0.0) * 1000.0)
+        for name, qty in candidate.items()
+    )
 
 
 def requests_for_pods(*pods) -> ResourceList:
@@ -129,6 +135,33 @@ def to_string(rl: Mapping[str, float]) -> str:
 
 
 # -- dense encoding for the solver ----------------------------------------
+
+# Per-axis scale factors chosen so realistic quantities become integers that
+# float32 represents exactly (mantissa 2^24): cpu in milli-cores, memory and
+# ephemeral storage in Mi, counts as-is, extended resources in milli. The
+# solver's granularity contract: quantities milli-cpu / Mi-memory granular
+# compare exactly; sub-Mi memory differences are quantized on device.
+AXIS_SCALES = {
+    CPU: 1000.0,
+    MEMORY: 1.0 / (2.0**20),
+    PODS: 1.0,
+    EPHEMERAL_STORAGE: 1.0 / (2.0**20),
+}
+_DEFAULT_SCALE = 1000.0
+
+
+def axis_scales(extra_axes: Sequence[str] = ()) -> np.ndarray:
+    scales = [AXIS_SCALES.get(name, _DEFAULT_SCALE) for name in RESOURCE_AXES]
+    scales += [_DEFAULT_SCALE] * len(extra_axes)
+    return np.array(scales, dtype=np.float64)
+
+
+def to_scaled_vector(rl: Mapping[str, float], extra_axes: Sequence[str] = ()) -> np.ndarray:
+    """Encode for device arithmetic: scaled per AXIS_SCALES and rounded to
+    integers so float32 sums and compares stay exact."""
+    vec = to_vector(rl, extra_axes).astype(np.float64) * axis_scales(extra_axes)
+    return np.rint(vec).astype(np.float32)
+
 
 def to_vector(rl: Mapping[str, float], extra_axes: Sequence[str] = ()) -> np.ndarray:
     """Encode a ResourceList as a float32 vector in RESOURCE_AXES order,

@@ -1,0 +1,222 @@
+"""Parity harness: the TPU batch solver must produce assignment-identical
+results to the FFD reference on randomized scenarios (SURVEY.md §7 Phase 1).
+
+Both backends share sorting, topology injection, and daemon-overhead
+computation, so identical seeds give identical pod orderings; the kernel then
+must make the same accept decision at every step.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement as R, Taint
+from karpenter_tpu.cloudprovider.fake import (
+    default_catalog,
+    instance_types,
+    instance_types_assorted,
+    new_instance_type,
+)
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.ffd import FFDScheduler
+from karpenter_tpu.solver.backend import TpuScheduler
+from karpenter_tpu.utils import resources as res
+from tests.factories import hostname_spread, make_daemonset, make_pod, make_provisioner, zone_spread
+
+
+def both_solve(pods, catalog, cluster=None, provisioner=None, seed=42):
+    cluster = cluster or Cluster()
+    provisioner = provisioner or make_provisioner()
+    constraints = provisioner.spec.constraints
+    constraints.requirements = constraints.requirements.merge(catalog_requirements(catalog))
+    ffd_nodes = FFDScheduler(cluster, rng=random.Random(seed)).solve(constraints, catalog, pods)
+    tpu_nodes = TpuScheduler(cluster, rng=random.Random(seed)).solve(constraints, catalog, pods)
+    return ffd_nodes, tpu_nodes
+
+
+def assert_parity(ffd_nodes, tpu_nodes):
+    assert len(ffd_nodes) == len(tpu_nodes), (
+        f"node count: ffd={len(ffd_nodes)} tpu={len(tpu_nodes)}"
+    )
+    ffd_sets = sorted(sorted(p.metadata.name for p in n.pods) for n in ffd_nodes)
+    tpu_sets = sorted(sorted(p.metadata.name for p in n.pods) for n in tpu_nodes)
+    assert ffd_sets == tpu_sets, "pod→node assignments differ"
+    # same cheapest launchable type per node ⇒ same launch price
+    ffd_prices = sorted(n.instance_type_options[0].effective_price() for n in ffd_nodes)
+    tpu_prices = sorted(n.instance_type_options[0].effective_price() for n in tpu_nodes)
+    assert ffd_prices == pytest.approx(tpu_prices)
+
+
+class TestBasicParity:
+    def test_generic_pods(self):
+        pods = [make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(20)]
+        assert_parity(*both_solve(pods, instance_types(20)))
+
+    def test_single_pod(self):
+        assert_parity(*both_solve([make_pod(requests={"cpu": "1"})], default_catalog()))
+
+    def test_unschedulable_dropped_by_both(self):
+        pods = [make_pod(requests={"cpu": "10000"}), make_pod(requests={"cpu": "1"})]
+        ffd, tpu = both_solve(pods, instance_types(10))
+        assert_parity(ffd, tpu)
+        assert sum(len(n.pods) for n in tpu) == 1
+
+    def test_empty_batch(self):
+        ffd, tpu = both_solve([], instance_types(5))
+        assert ffd == [] and tpu == []
+
+    def test_selectors_and_assorted_catalog(self):
+        catalog = instance_types_assorted()
+        pods = (
+            [make_pod(requests={"cpu": "0.5"}) for _ in range(5)]
+            + [
+                make_pod(
+                    requests={"cpu": "1"},
+                    node_selector={lbl.TOPOLOGY_ZONE: "test-zone-2"},
+                )
+                for _ in range(5)
+            ]
+            + [
+                make_pod(
+                    requests={"cpu": "1"},
+                    node_requirements=[R(key=lbl.ARCH, operator="In", values=["arm64"])],
+                )
+                for _ in range(3)
+            ]
+            + [
+                make_pod(
+                    requests={"cpu": "1"},
+                    node_requirements=[
+                        R(key=lbl.CAPACITY_TYPE, operator="NotIn", values=["spot"])
+                    ],
+                )
+                for _ in range(3)
+            ]
+        )
+        assert_parity(*both_solve(pods, catalog))
+
+
+class TestTopologyParity:
+    def test_zone_spread(self):
+        pods = [
+            make_pod(
+                requests={"cpu": "0.5"},
+                labels={"app": "web"},
+                topology=[zone_spread(labels={"app": "web"})],
+            )
+            for _ in range(9)
+        ]
+        assert_parity(*both_solve(pods, instance_types(30)))
+
+    def test_hostname_spread(self):
+        pods = [
+            make_pod(
+                requests={"cpu": "0.5"},
+                labels={"app": "web"},
+                topology=[hostname_spread(labels={"app": "web"})],
+            )
+            for _ in range(6)
+        ]
+        assert_parity(*both_solve(pods, instance_types(30)))
+
+    def test_mixed_spread_and_generic(self):
+        pods = (
+            [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+            + [
+                make_pod(
+                    requests={"cpu": "0.5"},
+                    labels={"app": "a"},
+                    topology=[zone_spread(labels={"app": "a"})],
+                )
+                for _ in range(5)
+            ]
+            + [
+                make_pod(
+                    requests={"cpu": "0.25"},
+                    labels={"app": "b"},
+                    topology=[hostname_spread(labels={"app": "b"})],
+                )
+                for _ in range(5)
+            ]
+        )
+        assert_parity(*both_solve(pods, instance_types(30)))
+
+
+class TestDaemonParity:
+    def test_daemon_overhead(self):
+        cluster = Cluster()
+        cluster.create("daemonsets", make_daemonset(requests={"cpu": "500m"}))
+        pods = [make_pod(requests={"cpu": "2"}) for _ in range(6)]
+        assert_parity(*both_solve(pods, instance_types(6), cluster=cluster))
+
+
+class TestExtendedResourcesParity:
+    def test_gpu(self):
+        pods = [make_pod(requests={res.NVIDIA_GPU: "1", "cpu": "1"}) for _ in range(3)]
+        assert_parity(*both_solve(pods, default_catalog()))
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz(self, seed):
+        rng = random.Random(seed)
+        catalog_choice = rng.choice(["linear", "assorted", "default"])
+        catalog = {
+            "linear": lambda: instance_types(rng.randint(5, 60)),
+            "assorted": instance_types_assorted,
+            "default": default_catalog,
+        }[catalog_choice]()
+        pods = []
+        n = rng.randint(5, 60)
+        for i in range(n):
+            kind = rng.random()
+            requests = {
+                "cpu": f"{rng.choice([100, 250, 500, 1000, 1500])}m",
+                "memory": f"{rng.choice([128, 256, 512, 1024, 2048])}Mi",
+            }
+            if kind < 0.4:
+                pods.append(make_pod(requests=requests))
+            elif kind < 0.55:
+                pods.append(
+                    make_pod(
+                        requests=requests,
+                        node_selector={
+                            lbl.TOPOLOGY_ZONE: rng.choice(
+                                ["test-zone-1", "test-zone-2", "test-zone-3"]
+                            )
+                        },
+                    )
+                )
+            elif kind < 0.7:
+                pods.append(
+                    make_pod(
+                        requests=requests,
+                        labels={"group": rng.choice(["a", "b"])},
+                        topology=[zone_spread(labels={"group": rng.choice(["a", "b"])})],
+                    )
+                )
+            elif kind < 0.85:
+                pods.append(
+                    make_pod(
+                        requests=requests,
+                        labels={"group": rng.choice(["a", "b"])},
+                        topology=[hostname_spread(labels={"group": rng.choice(["a", "b"])})],
+                    )
+                )
+            else:
+                op = rng.choice(["In", "NotIn"])
+                pods.append(
+                    make_pod(
+                        requests=requests,
+                        node_requirements=[
+                            R(
+                                key=lbl.CAPACITY_TYPE,
+                                operator=op,
+                                values=[rng.choice(["spot", "on-demand"])],
+                            )
+                        ],
+                    )
+                )
+        assert_parity(*both_solve(pods, catalog, seed=seed))
