@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Headline benchmark: pods-scheduled/sec on the TPU batch solver.
+
+Reproduces the reference's scheduler benchmark scenario
+(``scheduling_benchmark_test.go``: 400 fake instance types × diverse pod mix)
+against the TPU solve path, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "pods/sec", "vs_baseline": N, ...}
+
+Baseline: the reference enforces ≥250 pods/sec on batches >100 pods
+(scheduling_benchmark_test.go:47,151-155); vs_baseline = value / 250.
+
+Run: python bench.py [--pods N] [--iters K] [--grid]
+"""
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import time
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+BASELINE_PODS_PER_SEC = 250.0  # reference's enforced CPU floor
+
+
+def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
+    catalog = instance_types(400)
+    provisioner = make_provisioner(solver=solver)
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    pods = diverse_pods(n_pods, random.Random(42))
+    scheduler = Scheduler(Cluster(), rng=random.Random(1))
+
+    # warmup (compile)
+    nodes = scheduler.solve(provisioner, catalog, pods)
+    assert nodes, "benchmark scenario must schedule"
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        nodes = scheduler.solve(provisioner, catalog, pods)
+        times.append(time.perf_counter() - t0)
+    scheduled = sum(len(n.pods) for n in nodes)
+    best = min(times)
+    return {
+        "pods_per_sec": scheduled / best,
+        "mean_s": statistics.mean(times),
+        "p99_s": sorted(times)[max(int(len(times) * 0.99) - 1, 0)] if len(times) > 1 else times[0],
+        "nodes": len(nodes),
+        "scheduled": scheduled,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=2000)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--solver", default="tpu", choices=["tpu", "ffd"])
+    ap.add_argument("--grid", action="store_true", help="run the reference's full batch grid")
+    args = ap.parse_args()
+
+    if args.grid:
+        for n in [1, 50, 100, 500, 1000, 2000, 5000]:
+            r = bench_once(n, max(args.iters, 2), args.solver)
+            print(
+                f"# {n:>5} pods × 400 types: {r['pods_per_sec']:>10,.0f} pods/sec "
+                f"({r['nodes']} nodes, mean {r['mean_s'] * 1e3:.1f}ms)",
+                file=sys.stderr,
+            )
+
+    r = bench_once(args.pods, args.iters, args.solver)
+    print(
+        json.dumps(
+            {
+                "metric": f"pods-scheduled/sec ({args.pods} pods x 400 instance types, {args.solver} solver)",
+                "value": round(r["pods_per_sec"], 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(r["pods_per_sec"] / BASELINE_PODS_PER_SEC, 2),
+                "nodes": r["nodes"],
+                "scheduled_pods": r["scheduled"],
+                "mean_solve_s": round(r["mean_s"], 4),
+                "p99_solve_s": round(r["p99_s"], 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

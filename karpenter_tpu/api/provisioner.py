@@ -54,6 +54,19 @@ class Constraints:
     kubelet_configuration: Optional[KubeletConfiguration] = None
     provider: Optional[Dict[str, Any]] = None  # vendor-specific block
 
+    def clone(self) -> "Constraints":
+        """Cheap copy: Requirements is immutable-by-convention (mutators
+        return new objects), so sharing it is safe; labels/taints are copied
+        one level deep. deepcopy here was the decode hot spot — the
+        requirements tuples embed the whole catalog vocabulary."""
+        return Constraints(
+            labels=dict(self.labels),
+            taints=list(self.taints),
+            requirements=self.requirements,
+            kubelet_configuration=self.kubelet_configuration,
+            provider=self.provider,
+        )
+
     def validate_pod(self, pod: Pod) -> List[str]:
         """Taint toleration + requirement validity + compatibility
         (reference: constraints.go:52-67). Empty list means the pod fits."""

@@ -1,0 +1,266 @@
+"""Provisioning: per-Provisioner workers that batch, solve, launch, and bind.
+
+Mirrors ``pkg/controllers/provisioning``: the controller reconciles
+Provisioner objects — hot-swapping an in-memory worker when the spec hash
+changes, layering the live catalog's requirements in at apply — and each
+worker runs batch → re-verify → get catalog → solve → parallel launch,
+creating the Node object itself (pre-registration with the not-ready taint)
+and binding pods directly (provisioner.go:81-181).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from karpenter_tpu import metrics
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.provisioner import Provisioner, validate_provisioner
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.cloudprovider.types import CloudProvider, NodeRequest
+from karpenter_tpu.kube.client import Cluster, Conflict
+from karpenter_tpu.scheduling.ffd import VirtualNode
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.batcher import Batcher
+
+logger = logging.getLogger("karpenter.provisioning")
+
+# Catalog refresh period — the reference requeues every 5 minutes to pick up
+# catalog drift (provisioning/controller.go:82).
+REQUEUE_INTERVAL = 300.0
+
+
+def is_provisionable(pod: Pod) -> bool:
+    """Re-verification between enqueue and solve
+    (reference: provisioner.go:121-134)."""
+    return (
+        not podutil.is_scheduled(pod)
+        and not podutil.is_preempting(pod)
+        and podutil.failed_to_schedule(pod)
+        and not podutil.is_owned_by_daemonset(pod)
+        and not podutil.is_owned_by_node(pod)
+    )
+
+
+class ProvisionerWorker:
+    """One worker goroutine-equivalent per Provisioner
+    (reference: provisioner.go:40-77)."""
+
+    def __init__(
+        self,
+        provisioner: Provisioner,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        scheduler: Optional[Scheduler] = None,
+        batcher: Optional[Batcher] = None,
+    ):
+        self.provisioner = provisioner
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.scheduler = scheduler or Scheduler(cluster)
+        self.batcher = batcher or Batcher()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.provision_once()
+            except Exception:
+                logger.exception("provisioning loop error")
+
+    # -- API ---------------------------------------------------------------
+    def add(self, pod: Pod) -> threading.Event:
+        """Enqueue a pod; returns the gate the selection reconciler blocks on
+        (reference: provisioner.go:77-79)."""
+        return self.batcher.add(pod)
+
+    # -- the provision loop ------------------------------------------------
+    def provision_once(self) -> List[VirtualNode]:
+        pods, _window = self.batcher.wait()
+        pods = [p for p in pods if is_provisionable(p)]
+        if not pods:
+            self.batcher.flush()
+            return []
+        metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
+        instance_types = self.cloud_provider.get_instance_types(
+            self.provisioner.spec.constraints.provider
+        )
+        nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
+        # parallel launch per virtual node (reference: provisioner.go:113)
+        with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
+            list(pool.map(self._launch, nodes))
+        self.batcher.flush()
+        return nodes
+
+    def _launch(self, vnode: VirtualNode) -> None:
+        try:
+            # fresh limits check against live status (reference:
+            # provisioner.go:138-144 re-reads the provisioner)
+            live = self.cluster.try_get("provisioners", self.provisioner.name, namespace="")
+            prov = live if live is not None else self.provisioner
+            if prov.spec.limits is not None:
+                err = prov.spec.limits.exceeded_by(prov.status.resources)
+                if err:
+                    logger.info("skipping launch: %s", err)
+                    return
+            start = time.perf_counter()
+            node = self.cloud_provider.create(
+                NodeRequest(
+                    template=vnode.constraints,
+                    instance_type_options=vnode.instance_type_options,
+                )
+            )
+            metrics.CLOUDPROVIDER_DURATION.labels(
+                controller="provisioning", method="create",
+                provider=self.cloud_provider.name(),
+            ).observe(time.perf_counter() - start)
+            # merge the constraint template into the returned node: labels,
+            # taints (incl. not-ready), finalizer (reference:
+            # provisioner.go:152-160 + constraints.go:69-105)
+            template = vnode.constraints.to_node()
+            node.metadata.labels = {**template.metadata.labels, **node.metadata.labels}
+            node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] = self.provisioner.name
+            node.metadata.finalizers = list(
+                set(node.metadata.finalizers) | set(template.metadata.finalizers)
+            )
+            node.spec.taints = node.spec.taints + [
+                t for t in template.spec.taints if t.key not in {x.key for x in node.spec.taints}
+            ]
+            try:
+                self.cluster.create("nodes", node)
+            except Conflict:
+                # node self-registered first — idempotent create
+                # (reference: provisioner.go:155-164)
+                pass
+            self._bind(vnode.pods, node.metadata.name)
+        except Exception:
+            logger.exception("launching node")
+
+    def _bind(self, pods: List[Pod], node_name: str) -> None:
+        start = time.perf_counter()
+        ok = True
+        for pod in pods:
+            try:
+                self.cluster.bind(pod, node_name)
+            except Exception:
+                ok = False
+                logger.exception("binding pod %s", pod.key)
+        metrics.BIND_DURATION.labels(result="success" if ok else "error").observe(
+            time.perf_counter() - start
+        )
+
+
+def spec_hash(provisioner: Provisioner) -> int:
+    """Change detection for worker hot-swap
+    (reference: controller.go:119 hashstructure of spec)."""
+    c = provisioner.spec.constraints
+    return hash(
+        (
+            tuple(sorted(c.labels.items())),
+            tuple((t.key, t.value, t.effect) for t in c.taints),
+            tuple(
+                (r.key, r.operator, tuple(r.values)) for r in c.requirements.requirements
+            ),
+            str(c.provider),
+            provisioner.spec.ttl_seconds_after_empty,
+            provisioner.spec.ttl_seconds_until_expired,
+            provisioner.spec.solver,
+            tuple(sorted((provisioner.spec.limits.resources if provisioner.spec.limits else {}).items())),
+        )
+    )
+
+
+class ProvisioningController:
+    """Reconciles Provisioner objects into running workers
+    (reference: provisioning/controller.go:43-154)."""
+
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, start_workers: bool = True):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.start_workers = start_workers  # False: tests drive provision_once inline
+        self.workers: Dict[str, ProvisionerWorker] = {}
+        self._hashes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def reconcile(self, name: str) -> None:
+        provisioner = self.cluster.try_get("provisioners", name, namespace="")
+        if provisioner is None or provisioner.metadata.deletion_timestamp is not None:
+            self._teardown(name)
+            return
+        self.apply(provisioner)
+
+    def apply(self, provisioner: Provisioner) -> None:
+        """Validate, default, layer live catalog requirements, and (re)start
+        the worker when the spec changed (reference: controller.go:93-116)."""
+        self.cloud_provider.default(provisioner.spec.constraints)
+        errs = validate_provisioner(provisioner)
+        errs += self.cloud_provider.validate(provisioner.spec.constraints)
+        if errs:
+            raise ValueError(f"invalid provisioner {provisioner.name}: {errs}")
+        h = spec_hash(provisioner)
+        with self._lock:
+            if self._hashes.get(provisioner.name) == h:
+                # still refresh catalog requirements (requeue path)
+                worker = self.workers[provisioner.name]
+                worker.provisioner = self._with_catalog(provisioner)
+                return
+        self._teardown(provisioner.name)
+        with self._lock:
+            worker = ProvisionerWorker(
+                self._with_catalog(provisioner), self.cluster, self.cloud_provider
+            )
+            self.workers[provisioner.name] = worker
+            self._hashes[provisioner.name] = h
+            if self.start_workers:
+                worker.start()
+
+    def _with_catalog(self, provisioner: Provisioner) -> Provisioner:
+        instance_types = self.cloud_provider.get_instance_types(
+            provisioner.spec.constraints.provider
+        )
+        c = provisioner.spec.constraints.clone()
+        c.requirements = c.requirements.merge(catalog_requirements(instance_types))
+        out = Provisioner(metadata=provisioner.metadata, spec=provisioner.spec, status=provisioner.status)
+        out.spec = type(provisioner.spec)(
+            constraints=c,
+            ttl_seconds_after_empty=provisioner.spec.ttl_seconds_after_empty,
+            ttl_seconds_until_expired=provisioner.spec.ttl_seconds_until_expired,
+            limits=provisioner.spec.limits,
+            solver=provisioner.spec.solver,
+        )
+        return out
+
+    def _teardown(self, name: str) -> None:
+        with self._lock:
+            worker = self.workers.pop(name, None)
+            self._hashes.pop(name, None)
+        if worker:
+            worker.stop()
+
+    def list_workers(self) -> List[ProvisionerWorker]:
+        """Active workers sorted by provisioner name — selection priority
+        order (reference: controller.go:136-145)."""
+        with self._lock:
+            return [self.workers[k] for k in sorted(self.workers)]
+
+    def stop(self) -> None:
+        for name in list(self.workers):
+            self._teardown(name)

@@ -1,0 +1,4 @@
+from karpenter_tpu.parallel.sharding import (  # noqa: F401
+    make_solver_mesh,
+    sharded_multi_solve,
+)

@@ -1,0 +1,100 @@
+"""Multi-chip solver sharding.
+
+The reference scales by multiplying processes (leader-elected controllers,
+10k concurrent reconciles — SURVEY.md §2.9); this framework scales the solve
+itself across a TPU slice via ``jax.sharding``:
+
+- **data axis**: independent provisioner batches (multi-Provisioner sharding,
+  BASELINE config 4) are vmapped and sharded one-per-device-group — the DP
+  analog.
+- **model axis**: the instance-type dimension of the post-pack
+  cheapest-type/feasibility computation is sharded — the TP analog — and XLA
+  inserts the cross-shard argmin collectives over ICI.
+
+The packing scan itself is sequential per batch (first-fit is a chain), so
+parallelism comes from batching many solves — which is exactly the shape of
+the production workload (many Provisioners, consolidation re-packs, and
+what-if scoring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.solver import kernel
+
+
+def make_solver_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
+    """2D mesh over (data, model). ``model_parallel`` shards the instance-type
+    axis; the rest of the devices shard independent solve batches."""
+    devices = np.array(jax.devices()[: n_devices or len(jax.devices())])
+    data = len(devices) // model_parallel
+    return Mesh(devices.reshape(data, model_parallel), ("data", "model"))
+
+
+@partial(jax.jit, static_argnames=("n_max",))
+def _packed_multi(
+    pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base, pod_open_host,
+    pod_req, join_table, frontiers, daemon, n_max,
+):
+    """vmap of the packing kernel over a leading batch axis [B, ...]."""
+    return jax.vmap(
+        lambda *a: kernel.pack(*a, n_max=n_max)
+    )(pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base, pod_open_host,
+      pod_req, join_table, frontiers, daemon)
+
+
+@jax.jit
+def _cheapest_multi(node_req, node_sig, sig_type_mask, usable, prices):
+    """Batched cheapest-fitting-type: [B,N,R]×[B,S,T]×[T,R]×[T] → [B,N].
+    With ``usable``/``prices`` sharded over the type axis, XLA turns the
+    argmin into a cross-shard reduction over ICI."""
+    def one(nr, ns, mask):
+        m = mask[jnp.clip(ns, 0)]  # [N, T]
+        fits = jnp.all(nr[:, None, :] <= usable[None, :, :], axis=-1)  # [N, T]
+        ok = m & fits & (ns >= 0)[:, None]
+        cost = jnp.where(ok, prices[None, :], jnp.inf)
+        best = jnp.argmin(cost, axis=-1)
+        has = jnp.any(ok, axis=-1)
+        return jnp.where(has, best, -1).astype(jnp.int32)
+
+    return jax.vmap(one)(node_req, node_sig, sig_type_mask)
+
+
+def sharded_multi_solve(
+    mesh: Mesh,
+    batch_arrays: Tuple,  # stacked [B, ...] kernel inputs
+    sig_type_mask,  # [B, S, T] bool
+    usable,  # [T, R] f32
+    prices,  # [T] f32
+    n_max: int,
+):
+    """Run B independent packing problems across the mesh and pick each
+    node's cheapest launchable type, with the batch axis sharded over 'data'
+    and the instance-type axis over 'model'."""
+    def shard(spec):
+        return NamedSharding(mesh, spec)
+
+    batch_specs = (
+        P("data"), P("data"), P("data"), P("data"), P("data"), P("data"),
+        P("data", None, None),  # pod_req [B, P, R]
+        P("data", None, None),  # join_table [B, S, C]
+        P("data", None, None, None),  # frontiers [B, S, F, R]
+        P("data", None),  # daemon [B, R]
+    )
+    placed = tuple(
+        jax.device_put(a, shard(s)) for a, s in zip(batch_arrays, batch_specs)
+    )
+    result = _packed_multi(*placed, n_max=n_max)
+
+    mask_s = jax.device_put(sig_type_mask, shard(P("data", None, "model")))
+    usable_s = jax.device_put(usable, shard(P("model", None)))
+    prices_s = jax.device_put(prices, shard(P("model")))
+    cheapest = _cheapest_multi(result.node_req, result.node_sig, mask_s, usable_s, prices_s)
+    return result, cheapest

@@ -104,7 +104,7 @@ class FFDScheduler:
         instance_types: Sequence[InstanceType],
         pods: Sequence[Pod],
     ) -> List[VirtualNode]:
-        constraints = copy.deepcopy(constraints)
+        constraints = constraints.clone()
         pods = sort_pods_ffd(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
 
@@ -133,7 +133,7 @@ class FFDScheduler:
                     break
             if not placed:
                 node = VirtualNode(
-                    constraints=copy.deepcopy(constraints),
+                    constraints=constraints.clone(),
                     instance_type_options=list(instance_types),
                     requests=dict(daemons),
                 )

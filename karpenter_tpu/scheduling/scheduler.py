@@ -43,7 +43,7 @@ class Scheduler:
         # provisioning controller also refreshes these at apply (reference:
         # provisioning/controller.go:104-106), but re-layering here is
         # idempotent and keeps the facade safe to call standalone.
-        constraints = copy.deepcopy(provisioner.spec.constraints)
+        constraints = provisioner.spec.constraints.clone()
         constraints.requirements = constraints.requirements.merge(
             catalog_requirements(instance_types)
         )

@@ -44,7 +44,7 @@ class TpuScheduler:
     ) -> List[VirtualNode]:
         if not pods:
             return []
-        constraints = copy.deepcopy(constraints)
+        constraints = constraints.clone()
         pods = sort_pods_ffd(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
         self.topology.inject(constraints, list(pods))
@@ -78,11 +78,13 @@ class TpuScheduler:
         constraints: Constraints,
         instance_types: Sequence[InstanceType],
     ) -> List[VirtualNode]:
-        assignment = np.asarray(result.assignment)[: batch.n_pods]
-        node_sig = np.asarray(result.node_sig)
-        node_host = np.asarray(result.node_host)
-        node_req = np.asarray(result.node_req)
-        n_nodes = int(result.n_nodes)
+        # single consolidated device→host transfer (the axon tunnel makes
+        # per-array fetches expensive)
+        import jax
+
+        assignment, node_sig, node_host, node_req, n_nodes_arr = jax.device_get(tuple(result))
+        assignment = assignment[: batch.n_pods]
+        n_nodes = int(n_nodes_arr)
 
         unschedulable = int((assignment < 0).sum())
         if unschedulable:
@@ -95,6 +97,8 @@ class TpuScheduler:
                 pods_by_node.setdefault(int(a), []).append(batch.pods[i])
 
         sig_masks = {s.sig_id: s.type_mask for s in batch.table.signatures}
+        scales = res.axis_scales(batch.axes)
+        axis_names = res.RESOURCE_AXES + batch.axes
         nodes: List[VirtualNode] = []
         for n in range(n_nodes):
             if n not in pods_by_node:
@@ -108,7 +112,7 @@ class TpuScheduler:
                 for it, m, f in zip(instance_types, sig_masks[sig.sig_id], fit)
                 if m and f
             ]
-            node_constraints = copy.deepcopy(constraints)
+            node_constraints = constraints.clone()
             reqs = sig.requirements
             h = int(node_host[n])
             if h >= 0:
@@ -118,10 +122,9 @@ class TpuScheduler:
                     )
                 )
             node_constraints.requirements = reqs
-            scales = res.axis_scales(batch.axes)
             requests = {
                 name: float(total[i]) / scales[i]
-                for i, name in enumerate(res.RESOURCE_AXES + batch.axes)
+                for i, name in enumerate(axis_names)
                 if total[i]
             }
             nodes.append(

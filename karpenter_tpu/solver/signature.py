@@ -97,22 +97,15 @@ class Signature:
 
 def _pareto_max(points: np.ndarray) -> np.ndarray:
     """Pareto-maximal rows of [n, R] (rows not dominated elementwise-≤ by
-    another row)."""
+    another row), deduplicated. Broadcasted O(n²·R) numpy — this runs once
+    per signature, inside the solve latency budget."""
     if len(points) == 0:
         return points
-    keep = []
-    for i in range(len(points)):
-        dominated = False
-        for j in range(len(points)):
-            if i != j and np.all(points[j] >= points[i]) and np.any(points[j] > points[i]):
-                dominated = True
-                break
-            if i > j and np.all(points[j] == points[i]):
-                dominated = True  # dedupe exact duplicates
-                break
-        if not dominated:
-            keep.append(i)
-    return points[keep]
+    points = np.unique(points, axis=0)  # dedupe (and sorts rows)
+    ge = np.all(points[:, None, :] >= points[None, :, :], axis=-1)  # ge[j,i]: j ≥ i everywhere
+    gt = np.any(points[:, None, :] > points[None, :, :], axis=-1)  # gt[j,i]: j > i somewhere
+    dominated = np.any(ge & gt, axis=0)  # i dominated by some j
+    return points[~dominated]
 
 
 class SignatureTable:

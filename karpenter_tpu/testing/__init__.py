@@ -1,0 +1,10 @@
+"""Test/benchmark object factories — a first-class deliverable, mirroring the
+reference's pkg/test (pods.go, nodes.go, daemonsets.go, storage.go)."""
+from karpenter_tpu.testing.factories import (  # noqa: F401
+    hostname_spread,
+    make_daemonset,
+    make_pod,
+    make_provisioner,
+    zone_spread,
+)
+from karpenter_tpu.testing.scenarios import diverse_pods  # noqa: F401

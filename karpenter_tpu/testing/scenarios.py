@@ -1,0 +1,82 @@
+"""Benchmark scenario generators.
+
+``diverse_pods`` mirrors the reference benchmark's pod mix
+(``scheduling_benchmark_test.go:159-216``): 1/7 each of generic,
+zone-topology-spread, hostname-topology-spread, pod-affinity (hostname),
+pod-affinity (zone), pod-anti-affinity (hostname), pod-anti-affinity (zone),
+with the same randomized label/cpu/memory pools.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import LabelSelector, Pod, PodAffinityTerm
+from karpenter_tpu.testing.factories import hostname_spread, make_pod, zone_spread
+
+_LABEL_VALUES = ["a", "b", "c", "d", "e", "f", "g"]
+_MEM_MI = [100, 256, 512, 1024, 2048, 4096]
+_CPU_M = [100, 250, 500, 1000, 1500]
+
+
+def _random_labels(rng: random.Random) -> dict:
+    return {"my-label": rng.choice(_LABEL_VALUES)}
+
+
+def _requests(rng: random.Random) -> dict:
+    return {
+        "cpu": f"{rng.choice(_CPU_M)}m",
+        "memory": f"{rng.choice(_MEM_MI)}Mi",
+    }
+
+
+def diverse_pods(count: int, rng: Optional[random.Random] = None) -> List[Pod]:
+    rng = rng or random.Random(42)
+    pods: List[Pod] = []
+    seventh = count // 7
+
+    for _ in range(seventh):  # generic
+        pods.append(make_pod(labels=_random_labels(rng), requests=_requests(rng)))
+    for key, builder in ((lbl.TOPOLOGY_ZONE, zone_spread), (lbl.HOSTNAME, hostname_spread)):
+        for _ in range(seventh):  # topology spread
+            sel = _random_labels(rng)
+            pods.append(
+                make_pod(
+                    labels=sel,
+                    requests=_requests(rng),
+                    topology=[builder(max_skew=1, labels=sel)],
+                )
+            )
+    for key in (lbl.HOSTNAME, lbl.TOPOLOGY_ZONE):  # pod affinity
+        for _ in range(seventh):
+            pods.append(
+                make_pod(
+                    labels=_random_labels(rng),
+                    requests=_requests(rng),
+                    pod_requirements=[
+                        PodAffinityTerm(
+                            label_selector=LabelSelector(match_labels=_random_labels(rng)),
+                            topology_key=key,
+                        )
+                    ],
+                )
+            )
+    for key in (lbl.HOSTNAME, lbl.TOPOLOGY_ZONE):  # pod anti-affinity
+        for _ in range(seventh):
+            pods.append(
+                make_pod(
+                    labels=_random_labels(rng),
+                    requests=_requests(rng),
+                    pod_anti_requirements=[
+                        PodAffinityTerm(
+                            label_selector=LabelSelector(match_labels=_random_labels(rng)),
+                            topology_key=key,
+                        )
+                    ],
+                )
+            )
+    while len(pods) < count:  # fill remainder with generic pods
+        pods.append(make_pod(labels=_random_labels(rng), requests=_requests(rng)))
+    return pods

@@ -1,0 +1,78 @@
+"""Windowed batcher (reference: pkg/controllers/provisioning/batcher.go).
+
+Separates a stream of ``add(item)`` calls into windowed slices: the window
+starts on the first item, closes after 1s idle or 10s max or 2,000 items.
+Callers block on a gate that flushes when their batch has been processed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional, Tuple
+
+MAX_BATCH_DURATION = 10.0
+BATCH_IDLE_DURATION = 1.0
+MAX_ITEMS_PER_BATCH = 2000
+
+
+class Batcher:
+    def __init__(
+        self,
+        max_duration: float = MAX_BATCH_DURATION,
+        idle_duration: float = BATCH_IDLE_DURATION,
+        max_items: int = MAX_ITEMS_PER_BATCH,
+    ):
+        self.max_duration = max_duration
+        self.idle_duration = idle_duration
+        self.max_items = max_items
+        self._queue: "queue.Queue" = queue.Queue()
+        self._gate = threading.Event()
+        self._gate_lock = threading.Lock()
+        self._stopped = False
+
+    def add(self, item) -> threading.Event:
+        """Enqueue an item; returns the gate event the caller may wait on —
+        it is set when the batch containing the item has been processed
+        (reference: batcher.go:61-69)."""
+        self._queue.put(item)
+        with self._gate_lock:
+            return self._gate
+
+    def flush(self) -> None:
+        """Release all waiters and open a new gate
+        (reference: batcher.go:72-77)."""
+        with self._gate_lock:
+            old = self._gate
+            self._gate = threading.Event()
+        old.set()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._queue.put(None)  # wake the waiter
+        self.flush()
+
+    def wait(self) -> Tuple[List, float]:
+        """Block for the first item, then collect until idle/max-duration/
+        max-items; returns (items, window) (reference: batcher.go:80-103)."""
+        items: List = []
+        first = self._queue.get()
+        if first is None or self._stopped:
+            return [], 0.0
+        items.append(first)
+        start = time.monotonic()
+        deadline = start + self.max_duration
+        while len(items) < self.max_items:
+            now = time.monotonic()
+            timeout = min(self.idle_duration, deadline - now)
+            if timeout <= 0:
+                break
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is None or self._stopped:
+                break
+            items.append(item)
+        return items, time.monotonic() - start

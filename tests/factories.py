@@ -1,152 +1,10 @@
-"""Object factories for tests — the analog of ``pkg/test``'s option-struct
-factories (pods.go, nodes.go, daemonsets.go, storage.go)."""
-
-from __future__ import annotations
-
-import itertools
-from typing import Dict, List, Optional
-
-from karpenter_tpu.api import labels as lbl
-from karpenter_tpu.api.objects import (
-    Affinity,
-    Container,
-    DaemonSet,
-    LabelSelector,
-    NodeAffinity,
-    NodeSelectorRequirement,
-    NodeSelectorTerm,
-    ObjectMeta,
-    Pod,
-    PodAffinity,
-    PodAffinityTerm,
-    PodAntiAffinity,
-    PodCondition,
-    PodSpec,
-    PodStatus,
-    PreferredSchedulingTerm,
-    Toleration,
-    TopologySpreadConstraint,
+"""Shim: factories are a first-class package deliverable (the reference ships
+pkg/test); tests import them from here for brevity."""
+from karpenter_tpu.testing.factories import *  # noqa: F401,F403
+from karpenter_tpu.testing.factories import (  # noqa: F401
+    hostname_spread,
+    make_daemonset,
+    make_pod,
+    make_provisioner,
+    zone_spread,
 )
-from karpenter_tpu.api.provisioner import Constraints, Limits, Provisioner, ProvisionerSpec
-from karpenter_tpu.api.requirements import Requirements
-from karpenter_tpu.utils import resources as res
-
-_counter = itertools.count(1)
-
-
-def make_pod(
-    name: Optional[str] = None,
-    namespace: str = "default",
-    labels: Optional[Dict[str, str]] = None,
-    requests: Optional[Dict[str, object]] = None,
-    limits: Optional[Dict[str, object]] = None,
-    node_selector: Optional[Dict[str, str]] = None,
-    node_requirements: Optional[List[NodeSelectorRequirement]] = None,
-    node_preferences: Optional[List[PreferredSchedulingTerm]] = None,
-    pod_requirements: Optional[List[PodAffinityTerm]] = None,
-    pod_anti_requirements: Optional[List[PodAffinityTerm]] = None,
-    tolerations: Optional[List[Toleration]] = None,
-    topology: Optional[List[TopologySpreadConstraint]] = None,
-    node_name: str = "",
-    unschedulable: bool = True,
-) -> Pod:
-    affinity = None
-    if node_requirements or node_preferences or pod_requirements or pod_anti_requirements:
-        affinity = Affinity()
-        if node_requirements or node_preferences:
-            affinity.node_affinity = NodeAffinity(
-                required=[NodeSelectorTerm(match_expressions=list(node_requirements or []))]
-                if node_requirements
-                else [],
-                preferred=list(node_preferences or []),
-            )
-        if pod_requirements:
-            affinity.pod_affinity = PodAffinity(required=list(pod_requirements))
-        if pod_anti_requirements:
-            affinity.pod_anti_affinity = PodAntiAffinity(required=list(pod_anti_requirements))
-    status = PodStatus()
-    if unschedulable and not node_name:
-        status.conditions.append(
-            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
-        )
-    return Pod(
-        metadata=ObjectMeta(
-            name=name or f"pod-{next(_counter)}", namespace=namespace, labels=dict(labels or {})
-        ),
-        spec=PodSpec(
-            node_name=node_name,
-            node_selector=dict(node_selector or {}),
-            affinity=affinity,
-            tolerations=list(tolerations or []),
-            containers=[
-                Container(
-                    requests=res.parse_resource_list(requests),
-                    limits=res.parse_resource_list(limits),
-                )
-            ],
-            topology_spread_constraints=list(topology or []),
-        ),
-        status=status,
-    )
-
-
-def make_provisioner(
-    name: str = "default",
-    labels: Optional[Dict[str, str]] = None,
-    taints=None,
-    requirements: Optional[List[NodeSelectorRequirement]] = None,
-    limits: Optional[Dict[str, object]] = None,
-    solver: str = "ffd",
-    ttl_after_empty: Optional[int] = None,
-    ttl_until_expired: Optional[int] = None,
-    provider: Optional[Dict] = None,
-) -> Provisioner:
-    return Provisioner(
-        metadata=ObjectMeta(name=name, namespace=""),
-        spec=ProvisionerSpec(
-            constraints=Constraints(
-                labels=dict(labels or {}),
-                taints=list(taints or []),
-                requirements=Requirements.new(*(requirements or [])),
-                provider=provider,
-            ),
-            limits=Limits(resources=res.parse_resource_list(limits)) if limits else None,
-            solver=solver,
-            ttl_seconds_after_empty=ttl_after_empty,
-            ttl_seconds_until_expired=ttl_until_expired,
-        ),
-    )
-
-
-def make_daemonset(
-    name: Optional[str] = None,
-    requests: Optional[Dict[str, object]] = None,
-    node_selector: Optional[Dict[str, str]] = None,
-    tolerations: Optional[List[Toleration]] = None,
-) -> DaemonSet:
-    return DaemonSet(
-        metadata=ObjectMeta(name=name or f"ds-{next(_counter)}", namespace="kube-system"),
-        pod_template=PodSpec(
-            node_selector=dict(node_selector or {}),
-            tolerations=list(tolerations or []),
-            containers=[Container(requests=res.parse_resource_list(requests))],
-        ),
-    )
-
-
-def zone_spread(max_skew: int = 1, labels: Optional[Dict[str, str]] = None) -> TopologySpreadConstraint:
-    return TopologySpreadConstraint(
-        max_skew=max_skew,
-        topology_key=lbl.TOPOLOGY_ZONE,
-        when_unsatisfiable="DoNotSchedule",
-        label_selector=LabelSelector(match_labels=dict(labels or {})),
-    )
-
-
-def hostname_spread(max_skew: int = 1, labels: Optional[Dict[str, str]] = None) -> TopologySpreadConstraint:
-    return TopologySpreadConstraint(
-        max_skew=max_skew,
-        topology_key=lbl.HOSTNAME,
-        when_unsatisfiable="DoNotSchedule",
-        label_selector=LabelSelector(match_labels=dict(labels or {})),
-    )

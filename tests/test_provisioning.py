@@ -1,0 +1,137 @@
+"""End-to-end provisioning slice tests (mirrors provisioning/suite_test.go):
+pending pods → batcher → worker → solve → fake cloud provider → node create +
+pod bind."""
+
+import time
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import (
+    ProvisionerWorker,
+    ProvisioningController,
+    is_provisionable,
+)
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.batcher import Batcher
+from tests.factories import make_pod, make_provisioner
+
+
+def provision(pods, provisioner=None, catalog=None, cluster=None, provider=None):
+    """Drive one synchronous provision cycle (tests invoke reconciles
+    directly, like the reference's ExpectProvisioned)."""
+    cluster = cluster or Cluster()
+    provider = provider or FakeCloudProvider(catalog or instance_types(20))
+    controller = ProvisioningController(cluster, provider, start_workers=False)
+    provisioner = provisioner or make_provisioner()
+    cluster.create("provisioners", provisioner)
+    for p in pods:
+        cluster.create("pods", p)
+    controller.apply(provisioner)
+    worker = controller.workers[provisioner.name]
+    for p in pods:
+        worker.batcher.add(p)
+    worker.batcher.idle_duration = 0.01
+    nodes = worker.provision_once()
+    controller.stop()
+    return cluster, provider, nodes
+
+
+class TestProvisioning:
+    def test_pods_bound_and_nodes_created(self):
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        cluster, provider, vnodes = provision(pods)
+        assert len(provider.create_calls) == len(vnodes) >= 1
+        created = cluster.nodes()
+        assert len(created) == len(vnodes)
+        for p in cluster.pods():
+            assert p.spec.node_name != ""
+
+    def test_node_has_startup_taint_finalizer_and_label(self):
+        cluster, provider, _ = provision([make_pod(requests={"cpu": "1"})])
+        node = cluster.nodes()[0]
+        assert any(t.key == lbl.NOT_READY_TAINT_KEY for t in node.spec.taints)
+        assert lbl.TERMINATION_FINALIZER in node.metadata.finalizers
+        assert node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] == "default"
+        assert lbl.INSTANCE_TYPE in node.metadata.labels
+
+    def test_already_scheduled_pods_skipped(self):
+        pod = make_pod(requests={"cpu": "1"}, node_name="existing", unschedulable=False)
+        assert not is_provisionable(pod)
+        cluster, provider, vnodes = provision([pod])
+        assert vnodes == []
+        assert provider.create_calls == []
+
+    def test_limits_block_launch(self):
+        provisioner = make_provisioner(limits={"cpu": "4"})
+        provisioner.status.resources = {res.CPU: 4.0}  # already at the limit
+        cluster, provider, vnodes = provision(
+            [make_pod(requests={"cpu": "1"})], provisioner=provisioner
+        )
+        assert provider.create_calls == []  # solve ran but launch was gated
+        assert cluster.nodes() == []
+
+    def test_tpu_solver_end_to_end(self):
+        provisioner = make_provisioner(solver="tpu")
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        cluster, provider, vnodes = provision(pods, provisioner=provisioner)
+        assert len(cluster.nodes()) == len(vnodes) >= 1
+        for p in cluster.pods():
+            assert p.spec.node_name != ""
+
+    def test_worker_hot_swap_on_spec_change(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        prov = make_provisioner()
+        cluster.create("provisioners", prov)
+        controller.apply(prov)
+        w1 = controller.workers["default"]
+        controller.apply(prov)  # unchanged spec → same worker
+        assert controller.workers["default"] is w1
+        prov2 = make_provisioner(labels={"team": "a"})
+        controller.apply(prov2)
+        assert controller.workers["default"] is not w1
+        controller.stop()
+
+    def test_reconcile_teardown_on_delete(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        prov = make_provisioner()
+        cluster.create("provisioners", prov)
+        controller.reconcile("default")
+        assert "default" in controller.workers
+        cluster.delete("provisioners", "default", namespace="")
+        controller.reconcile("default")
+        assert "default" not in controller.workers
+        controller.stop()
+
+
+class TestBatcher:
+    def test_window_closes_on_idle(self):
+        b = Batcher(idle_duration=0.05, max_duration=5.0)
+        b.add("a")
+        b.add("b")
+        items, window = b.wait()
+        assert items == ["a", "b"]
+        assert window < 1.0
+
+    def test_max_items_cap(self):
+        b = Batcher(idle_duration=1.0, max_items=3)
+        for i in range(5):
+            b.add(i)
+        items, _ = b.wait()
+        assert len(items) == 3
+
+    def test_gate_released_on_flush(self):
+        b = Batcher()
+        gate = b.add("x")
+        assert not gate.is_set()
+        b.flush()
+        assert gate.is_set()
+        # new adds get a fresh gate
+        gate2 = b.add("y")
+        assert not gate2.is_set()
