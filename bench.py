@@ -15,6 +15,7 @@ Run: python bench.py [--pods N] [--iters K] [--grid]
 
 import argparse
 import json
+import math
 import random
 import statistics
 import sys
@@ -51,7 +52,7 @@ def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
     return {
         "pods_per_sec": scheduled / best,
         "mean_s": statistics.mean(times),
-        "p99_s": sorted(times)[max(int(len(times) * 0.99) - 1, 0)] if len(times) > 1 else times[0],
+        "p99_s": sorted(times)[min(len(times) - 1, max(math.ceil(0.99 * len(times)) - 1, 0))],
         "nodes": len(nodes),
         "scheduled": scheduled,
     }

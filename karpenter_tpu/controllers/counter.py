@@ -1,0 +1,54 @@
+"""Counter: provisioner resource accounting.
+
+Mirrors ``pkg/controllers/counter``: maintains
+``provisioner.status.resources`` — the summed capacity of the provisioner's
+nodes — which is the input to ``Limits.exceeded_by`` checked before every
+launch (controller.go:51-87).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import resources as res
+
+
+class CounterController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self, name: str) -> None:
+        provisioner = self.cluster.try_get("provisioners", name, namespace="")
+        if provisioner is None:
+            return
+        counts = self.resource_counts_for(name)
+        if counts != provisioner.status.resources:
+            provisioner.status.resources = counts
+            self.cluster.update("provisioners", provisioner)
+
+    def resource_counts_for(self, provisioner_name: str) -> Dict[str, float]:
+        """Sum node capacity over this provisioner's nodes
+        (reference: controller.go:72-87)."""
+        total: Dict[str, float] = {}
+        for node in self.cluster.nodes():
+            if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) != provisioner_name:
+                continue
+            total = res.merge(total, node.status.capacity)
+        return total
+
+    def register(self, manager) -> None:
+        """Watch nodes, mapping each to its owning provisioner
+        (reference: controller.go:90-112)."""
+
+        def on_node(event: str, node) -> None:
+            name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+            if name:
+                manager.enqueue("counter", name)
+
+        def on_provisioner(event: str, provisioner) -> None:
+            manager.enqueue("counter", provisioner.metadata.name)
+
+        self.cluster.watch("nodes", on_node)
+        self.cluster.watch("provisioners", on_provisioner)

@@ -36,16 +36,9 @@ logger = logging.getLogger("karpenter.provisioning")
 REQUEUE_INTERVAL = 300.0
 
 
-def is_provisionable(pod: Pod) -> bool:
-    """Re-verification between enqueue and solve
-    (reference: provisioner.go:121-134)."""
-    return (
-        not podutil.is_scheduled(pod)
-        and not podutil.is_preempting(pod)
-        and podutil.failed_to_schedule(pod)
-        and not podutil.is_owned_by_daemonset(pod)
-        and not podutil.is_owned_by_node(pod)
-    )
+# Re-verification between enqueue and solve (reference: provisioner.go:121-134
+# and selection/controller.go:117-123 share this predicate).
+is_provisionable = podutil.is_provisionable
 
 
 class ProvisionerWorker:
@@ -94,21 +87,24 @@ class ProvisionerWorker:
 
     # -- the provision loop ------------------------------------------------
     def provision_once(self) -> List[VirtualNode]:
-        pods, _window = self.batcher.wait()
-        pods = [p for p in pods if is_provisionable(p)]
-        if not pods:
+        # flush unconditionally so gate waiters never stall on a failed solve
+        # (reference: provisioner.go:84 `defer p.batcher.Flush()`)
+        try:
+            pods, _window = self.batcher.wait()
+            pods = [p for p in pods if is_provisionable(p)]
+            if not pods:
+                return []
+            metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
+            instance_types = self.cloud_provider.get_instance_types(
+                self.provisioner.spec.constraints.provider
+            )
+            nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
+            # parallel launch per virtual node (reference: provisioner.go:113)
+            with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
+                list(pool.map(self._launch, nodes))
+            return nodes
+        finally:
             self.batcher.flush()
-            return []
-        metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
-        instance_types = self.cloud_provider.get_instance_types(
-            self.provisioner.spec.constraints.provider
-        )
-        nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
-        # parallel launch per virtual node (reference: provisioner.go:113)
-        with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
-            list(pool.map(self._launch, nodes))
-        self.batcher.flush()
-        return nodes
 
     def _launch(self, vnode: VirtualNode) -> None:
         try:
@@ -200,12 +196,15 @@ class ProvisioningController:
         self._hashes: Dict[str, int] = {}
         self._lock = threading.Lock()
 
-    def reconcile(self, name: str) -> None:
+    def reconcile(self, name: str) -> Optional[float]:
         provisioner = self.cluster.try_get("provisioners", name, namespace="")
         if provisioner is None or provisioner.metadata.deletion_timestamp is not None:
             self._teardown(name)
-            return
+            return None
         self.apply(provisioner)
+        # requeue to pick up instance-type catalog drift
+        # (reference: provisioning/controller.go:82, 5 minutes)
+        return REQUEUE_INTERVAL
 
     def apply(self, provisioner: Provisioner) -> None:
         """Validate, default, layer live catalog requirements, and (re)start
@@ -216,21 +215,22 @@ class ProvisioningController:
         if errs:
             raise ValueError(f"invalid provisioner {provisioner.name}: {errs}")
         h = spec_hash(provisioner)
+        enriched = self._with_catalog(provisioner)
+        # check + swap is one critical section so concurrent applies cannot
+        # both pass the hash check and leak a started worker
         with self._lock:
             if self._hashes.get(provisioner.name) == h:
                 # still refresh catalog requirements (requeue path)
-                worker = self.workers[provisioner.name]
-                worker.provisioner = self._with_catalog(provisioner)
+                self.workers[provisioner.name].provisioner = enriched
                 return
-        self._teardown(provisioner.name)
-        with self._lock:
-            worker = ProvisionerWorker(
-                self._with_catalog(provisioner), self.cluster, self.cloud_provider
-            )
+            old = self.workers.pop(provisioner.name, None)
+            worker = ProvisionerWorker(enriched, self.cluster, self.cloud_provider)
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h
             if self.start_workers:
                 worker.start()
+        if old:
+            old.stop()
 
     def _with_catalog(self, provisioner: Provisioner) -> Provisioner:
         instance_types = self.cloud_provider.get_instance_types(

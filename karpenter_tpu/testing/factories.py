@@ -134,6 +134,109 @@ def make_daemonset(
     )
 
 
+def make_node(
+    name: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    allocatable: Optional[Dict[str, object]] = None,
+    taints=None,
+    ready: bool = True,
+    provisioner_name: Optional[str] = None,
+    finalizers: Optional[List[str]] = None,
+):
+    """reference: pkg/test/nodes.go."""
+    from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus
+
+    node_labels = dict(labels or {})
+    if provisioner_name is not None:
+        node_labels[lbl.PROVISIONER_NAME_LABEL] = provisioner_name
+    cap = res.parse_resource_list(capacity)
+    return Node(
+        metadata=ObjectMeta(
+            name=name or f"node-{next(_counter)}",
+            namespace="",
+            labels=node_labels,
+            finalizers=list(finalizers or []),
+        ),
+        spec=NodeSpec(taints=list(taints or [])),
+        status=NodeStatus(
+            capacity=cap,
+            allocatable=res.parse_resource_list(allocatable) or dict(cap),
+            conditions=[
+                PodCondition(type="Ready", status="True" if ready else "False")
+            ],
+        ),
+    )
+
+
+def make_pvc(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    storage_class: str = "",
+    volume_name: str = "",
+):
+    from karpenter_tpu.api.objects import PersistentVolumeClaim
+
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name or f"pvc-{next(_counter)}", namespace=namespace),
+        storage_class_name=storage_class,
+        volume_name=volume_name,
+    )
+
+
+def make_pv(name: Optional[str] = None, zones: Optional[List[str]] = None):
+    from karpenter_tpu.api.objects import PersistentVolume
+
+    terms = []
+    if zones:
+        terms = [
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=list(zones))
+                ]
+            )
+        ]
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name or f"pv-{next(_counter)}", namespace=""),
+        node_affinity_required=terms,
+    )
+
+
+def make_storage_class(name: Optional[str] = None, zones: Optional[List[str]] = None):
+    from karpenter_tpu.api.objects import StorageClass
+
+    terms = []
+    if zones:
+        terms = [
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=list(zones))
+                ]
+            )
+        ]
+    return StorageClass(
+        metadata=ObjectMeta(name=name or f"sc-{next(_counter)}", namespace=""),
+        allowed_topologies=terms,
+    )
+
+
+def make_pdb(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    min_available: Optional[int] = None,
+    max_unavailable: Optional[int] = None,
+):
+    from karpenter_tpu.api.objects import PodDisruptionBudget
+
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name or f"pdb-{next(_counter)}", namespace=namespace),
+        selector=LabelSelector(match_labels=dict(labels or {})),
+        min_available=min_available,
+        max_unavailable=max_unavailable,
+    )
+
+
 def zone_spread(max_skew: int = 1, labels: Optional[Dict[str, str]] = None) -> TopologySpreadConstraint:
     return TopologySpreadConstraint(
         max_skew=max_skew,

@@ -38,6 +38,20 @@ def is_owned_by_node(pod: Pod) -> bool:
     return any(o.api_version == "v1" and o.kind == "Node" for o in pod.metadata.owner_references)
 
 
+def is_provisionable(pod: Pod) -> bool:
+    """Unscheduled, not preempting, marked unschedulable, and not a
+    daemonset/static pod (reference: selection/controller.go:117-123; the
+    provisioning worker re-checks it between enqueue and solve,
+    provisioner.go:121-134)."""
+    return (
+        not is_scheduled(pod)
+        and not is_preempting(pod)
+        and failed_to_schedule(pod)
+        and not is_owned_by_daemonset(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
 def has_required_pod_affinity(pod: Pod) -> bool:
     aff = pod.spec.affinity
     return aff is not None and aff.pod_affinity is not None and bool(aff.pod_affinity.required)

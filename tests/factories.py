@@ -4,7 +4,12 @@ from karpenter_tpu.testing.factories import *  # noqa: F401,F403
 from karpenter_tpu.testing.factories import (  # noqa: F401
     hostname_spread,
     make_daemonset,
+    make_node,
+    make_pdb,
     make_pod,
     make_provisioner,
+    make_pv,
+    make_pvc,
+    make_storage_class,
     zone_spread,
 )

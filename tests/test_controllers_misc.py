@@ -1,0 +1,184 @@
+"""Counter, PVC, and manager-runtime tests (mirrors counter + pvc suites and
+the controller-runtime wiring in pkg/controllers/manager.go)."""
+
+import threading
+import time
+
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.pvc import PVCController, SELECTED_NODE_ANNOTATION
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.workqueue import ExponentialBackoff, RateLimitingQueue, TokenBucket
+from tests.factories import make_node, make_pod, make_provisioner, make_pvc
+
+
+class TestCounter:
+    def test_sums_capacity_of_owned_nodes(self):
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner())
+        cluster.create("nodes", make_node(capacity={"cpu": "4", "memory": "8Gi"}, provisioner_name="default"))
+        cluster.create("nodes", make_node(capacity={"cpu": "2"}, provisioner_name="default"))
+        cluster.create("nodes", make_node(capacity={"cpu": "16"}, provisioner_name="other"))
+        CounterController(cluster).reconcile("default")
+        prov = cluster.get("provisioners", "default", namespace="")
+        assert prov.status.resources[res.CPU] == 6.0
+        assert prov.status.resources[res.MEMORY] == 8 * 1024**3
+
+    def test_watch_mapping_enqueues_owner(self):
+        cluster = Cluster()
+        manager = Manager(cluster)
+        counter = CounterController(cluster)
+        manager.register("counter", counter.reconcile, concurrency=1)
+        counter.register(manager)
+        cluster.create("provisioners", make_provisioner())
+        manager.start()
+        cluster.create("nodes", make_node(capacity={"cpu": "4"}, provisioner_name="default"))
+        deadline = time.monotonic() + 5
+        prov = cluster.get("provisioners", "default", namespace="")
+        while time.monotonic() < deadline and prov.status.resources.get(res.CPU) != 4.0:
+            time.sleep(0.01)
+        manager.stop()
+        assert prov.status.resources[res.CPU] == 4.0
+
+
+class TestPVC:
+    def test_selected_node_annotation_written(self):
+        cluster = Cluster()
+        pvc = make_pvc(name="claim")
+        cluster.create("pvcs", pvc)
+        pod = make_pod(node_name="node-1", unschedulable=False)
+        from karpenter_tpu.api.objects import Volume
+
+        pod.spec.volumes = [Volume(name="v", persistent_volume_claim="claim")]
+        cluster.create("pods", pod)
+        PVCController(cluster).reconcile(pod.metadata.name)
+        assert pvc.metadata.annotations[SELECTED_NODE_ANNOTATION] == "node-1"
+
+    def test_unscheduled_pod_skipped(self):
+        cluster = Cluster()
+        pvc = make_pvc(name="claim")
+        cluster.create("pvcs", pvc)
+        pod = make_pod()
+        from karpenter_tpu.api.objects import Volume
+
+        pod.spec.volumes = [Volume(name="v", persistent_volume_claim="claim")]
+        cluster.create("pods", pod)
+        PVCController(cluster).reconcile(pod.metadata.name)
+        assert SELECTED_NODE_ANNOTATION not in pvc.metadata.annotations
+
+
+class TestWorkqueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        q.add("a")
+        assert len(q) == 1
+
+    def test_re_add_while_processing_requeues_after_done(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        item = q.get()
+        q.add("a")  # dirty
+        assert len(q) == 0
+        q.done(item)
+        assert len(q) == 1
+
+    def test_add_after_delays(self):
+        q = RateLimitingQueue()
+        q.add_after("a", 0.05)
+        assert q.get(timeout=0.01) is None
+        assert q.get(timeout=1.0) == "a"
+
+    def test_exponential_backoff_grows_and_forgets(self):
+        b = ExponentialBackoff(base=0.01, cap=1.0)
+        assert b.when("x") == 0.01
+        assert b.when("x") == 0.02
+        assert b.when("x") == 0.04
+        b.forget("x")
+        assert b.when("x") == 0.01
+
+    def test_token_bucket_limits(self):
+        now = [0.0]
+        tb = TokenBucket(qps=10, burst=2, clock=lambda: now[0])
+        assert tb.try_take() and tb.try_take()
+        assert not tb.try_take()
+        now[0] += 0.1  # one token refilled
+        assert tb.try_take()
+        assert not tb.try_take()
+
+
+class TestManager:
+    def test_reconcile_retry_with_backoff(self):
+        cluster = Cluster()
+        manager = Manager(cluster)
+        calls = []
+        done = threading.Event()
+
+        def flaky(key):
+            calls.append(key)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            done.set()
+
+        manager.register("flaky", flaky, concurrency=1)
+        manager.start()
+        manager.enqueue("flaky", "k")
+        assert done.wait(timeout=5)
+        manager.stop()
+        assert len(calls) == 3
+
+    def test_requeue_after(self):
+        cluster = Cluster()
+        manager = Manager(cluster)
+        calls = []
+        done = threading.Event()
+
+        def periodic(key):
+            calls.append(time.monotonic())
+            if len(calls) >= 2:
+                done.set()
+                return None
+            return 0.05
+
+        manager.register("periodic", periodic, concurrency=1)
+        manager.start()
+        manager.enqueue("periodic", "k")
+        assert done.wait(timeout=5)
+        manager.stop()
+        assert calls[1] - calls[0] >= 0.04
+
+    def test_tuple_keys_unpack(self):
+        cluster = Cluster()
+        manager = Manager(cluster)
+        seen = []
+        manager.register("t", lambda name, ns: seen.append((name, ns)), concurrency=1)
+        assert manager.reconcile_now("t", ("a", "b")) is None
+        assert seen == [("a", "b")]
+
+    def test_stop_then_start_reconciles_again(self):
+        manager = Manager(Cluster())
+        seen = []
+        manager.register("echo", lambda k: seen.append(k), concurrency=1)
+        manager.start()
+        manager.enqueue("echo", "a")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.01)
+        manager.stop()
+        manager.start()
+        manager.enqueue("echo", "b")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(seen) < 2:
+            time.sleep(0.01)
+        manager.stop()
+        assert seen == ["a", "b"]
+
+    def test_healthz(self):
+        manager = Manager(Cluster())
+        manager.register("noop", lambda k: None)
+        assert not manager.healthz()
+        manager.start()
+        assert manager.healthz()
+        manager.stop()
+        assert not manager.healthz()

@@ -1,0 +1,324 @@
+"""Selection controller tests (mirrors selection/suite_test.go): pod →
+provisioner routing, preference relaxation, volume topology injection, and
+unsupported-feature rejection."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    Volume,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import (
+    Preferences,
+    SelectionController,
+    validate,
+)
+from karpenter_tpu.kube.client import Cluster
+from tests.factories import (
+    make_pod,
+    make_provisioner,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+)
+
+
+@pytest.fixture()
+def env():
+    cluster = Cluster()
+    provider = FakeCloudProvider(instance_types(10))
+    provisioning = ProvisioningController(cluster, provider, start_workers=False)
+    selection = SelectionController(cluster, provisioning, wait=False)
+    yield cluster, provisioning, selection
+    provisioning.stop()
+
+
+def drive(cluster, provisioning, selection, pod):
+    """Reconcile the pod through selection, then run the chosen worker's
+    provision loop synchronously (the ExpectProvisioned analog)."""
+    cluster.create("pods", pod)
+    result = selection.reconcile(pod.metadata.name, pod.metadata.namespace)
+    for worker in provisioning.list_workers():
+        worker.batcher.idle_duration = 0.01
+        if not worker.batcher._queue.empty():
+            worker.provision_once()
+    return result
+
+
+class TestRouting:
+    def test_routes_to_matching_provisioner(self, env):
+        cluster, provisioning, selection = env
+        provisioning.apply(make_provisioner(name="default"))
+        pod = make_pod(requests={"cpu": "1"})
+        assert drive(cluster, provisioning, selection, pod) == 5.0
+        assert pod.spec.node_name != ""
+
+    def test_provisioners_tried_in_name_order(self, env):
+        cluster, provisioning, selection = env
+        # "a" has a taint the pod does not tolerate; "b" matches
+        provisioning.apply(
+            make_provisioner(name="a", taints=[Taint(key="dedicated", value="x")])
+        )
+        provisioning.apply(make_provisioner(name="b"))
+        pod = make_pod(requests={"cpu": "1"})
+        drive(cluster, provisioning, selection, pod)
+        assert pod.spec.node_name != ""
+        node = cluster.get("nodes", pod.spec.node_name, namespace="")
+        assert node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] == "b"
+
+    def test_no_provisioner_matches_raises_for_retry(self, env):
+        from karpenter_tpu.controllers.selection import NoProvisionerMatched
+
+        cluster, provisioning, selection = env
+        provisioning.apply(
+            make_provisioner(name="a", taints=[Taint(key="dedicated", value="x")])
+        )
+        pod = make_pod(requests={"cpu": "1"})
+        cluster.create("pods", pod)
+        with pytest.raises(NoProvisionerMatched):
+            selection.reconcile(pod.metadata.name)
+        assert pod.spec.node_name == ""
+
+    def test_no_workers_is_a_noop(self, env):
+        cluster, _, selection = env
+        pod = make_pod(requests={"cpu": "1"})
+        cluster.create("pods", pod)
+        assert selection.reconcile(pod.metadata.name) == 5.0
+        assert pod.spec.node_name == ""
+
+    def test_scheduled_pod_ignored(self, env):
+        cluster, provisioning, selection = env
+        provisioning.apply(make_provisioner())
+        pod = make_pod(node_name="n1", unschedulable=False)
+        assert drive(cluster, provisioning, selection, pod) is None
+
+    def test_deleted_pod_ignored(self, env):
+        _, _, selection = env
+        assert selection.reconcile("nope") is None
+
+
+class TestValidation:
+    def test_unsupported_topology_key_rejected(self):
+        pod = make_pod(
+            topology=[TopologySpreadConstraint(topology_key="custom/key", max_skew=1)]
+        )
+        assert validate(pod)
+
+    def test_required_pod_affinity_rejected_without_support(self):
+        pod = make_pod(
+            pod_requirements=[PodAffinityTerm(topology_key=lbl.TOPOLOGY_ZONE)]
+        )
+        assert validate(pod, allow_pod_affinity=False)
+        assert not validate(pod, allow_pod_affinity=True)
+
+    def test_pod_affinity_bad_topology_key_rejected_even_with_support(self):
+        pod = make_pod(pod_requirements=[PodAffinityTerm(topology_key="rack")])
+        assert validate(pod, allow_pod_affinity=True)
+
+    def test_unsupported_node_selector_operator_rejected(self):
+        pod = make_pod(
+            node_requirements=[
+                NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="Gt", values=["1"])
+            ]
+        )
+        assert validate(pod)
+
+
+class TestPreferences:
+    def test_first_sighting_cached_not_relaxed(self):
+        prefs = Preferences()
+        pod = make_pod(
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=lbl.TOPOLOGY_ZONE, operator="In", values=["zone-1"]
+                            )
+                        ]
+                    ),
+                )
+            ]
+        )
+        prefs.relax(pod)
+        assert pod.spec.affinity.node_affinity.preferred  # untouched
+
+    def test_second_round_removes_heaviest_preferred_term(self):
+        prefs = Preferences()
+        light = PreferredSchedulingTerm(
+            weight=1,
+            preference=NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["zone-1"])
+                ]
+            ),
+        )
+        heavy = PreferredSchedulingTerm(
+            weight=10,
+            preference=NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["zone-2"])
+                ]
+            ),
+        )
+        pod = make_pod(node_preferences=[light, heavy])
+        prefs.relax(pod)
+        prefs.relax(pod)
+        remaining = pod.spec.affinity.node_affinity.preferred
+        assert len(remaining) == 1
+        assert remaining[0].weight == 1
+
+    def test_required_or_terms_relaxed_one_at_a_time_keeping_last(self):
+        prefs = Preferences()
+        pod = make_pod()
+        from karpenter_tpu.api.objects import Affinity, NodeAffinity
+
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=lbl.TOPOLOGY_ZONE, operator="In", values=[z]
+                            )
+                        ]
+                    )
+                    for z in ("zone-1", "zone-2")
+                ]
+            )
+        )
+        prefs.relax(pod)  # cache
+        prefs.relax(pod)  # removes first OR-term
+        assert len(pod.spec.affinity.node_affinity.required) == 1
+        assert pod.spec.affinity.node_affinity.required[0].match_expressions[0].values == ["zone-2"]
+        prefs.relax(pod)  # cannot remove the last required term → tolerates PreferNoSchedule
+        assert len(pod.spec.affinity.node_affinity.required) == 1
+        assert any(
+            t.operator == "Exists" and t.effect == "PreferNoSchedule"
+            for t in pod.spec.tolerations
+        )
+
+    def test_relaxation_forgotten_after_ttl(self):
+        now = [0.0]
+        prefs = Preferences(clock=lambda: now[0])
+        pod = make_pod(
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=lbl.TOPOLOGY_ZONE, operator="In", values=["zone-1"]
+                            )
+                        ]
+                    ),
+                )
+            ]
+        )
+        prefs.relax(pod)
+        now[0] = 301.0
+        prefs.relax(pod)  # cache expired → treated as first sighting again
+        assert pod.spec.affinity.node_affinity.preferred
+
+    def test_preferences_enable_scheduling_end_to_end(self, env):
+        """A pod preferring an unavailable zone schedules after relaxation
+        (the reference's preferential-fallback behavior)."""
+        cluster, provisioning, selection = env
+        provisioning.apply(
+            make_provisioner(
+                requirements=[
+                    NodeSelectorRequirement(
+                        key=lbl.TOPOLOGY_ZONE, operator="In", values=["test-zone-1"]
+                    )
+                ]
+            )
+        )
+        pod = make_pod(
+            requests={"cpu": "1"},
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=lbl.TOPOLOGY_ZONE, operator="In", values=["no-such-zone"]
+                            )
+                        ]
+                    ),
+                )
+            ],
+        )
+        from karpenter_tpu.controllers.selection import NoProvisionerMatched
+
+        cluster.create("pods", pod)
+        # round 1: preference still present → no provisioner matches; the
+        # raise drives the manager's backoff retry
+        with pytest.raises(NoProvisionerMatched):
+            selection.reconcile(pod.metadata.name)
+        assert pod.spec.node_name == ""
+        # round 2 (the retry): relaxed → schedules
+        selection.reconcile(pod.metadata.name)
+        for worker in provisioning.list_workers():
+            worker.batcher.idle_duration = 0.01
+            worker.provision_once()
+        assert pod.spec.node_name != ""
+
+
+class TestVolumeTopologyCacheIsolation:
+    def test_repeated_rounds_do_not_accumulate_injected_requirements(self, env):
+        """The preference cache must not alias the pod's affinity: volume
+        topology injection would otherwise grow the cached terms each retry."""
+        cluster, provisioning, selection = env
+        cluster.create("pvs", make_pv(name="pv-x", zones=["test-zone-1"]))
+        cluster.create("pvcs", make_pvc(name="claim-x", volume_name="pv-x"))
+        pod = make_pod(
+            requests={"cpu": "1"},
+            node_requirements=[
+                NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["test-zone-1"])
+            ],
+        )
+        pod.spec.volumes = [Volume(name="v", persistent_volume_claim="claim-x")]
+        cluster.create("pods", pod)
+        for _ in range(4):
+            selection.preferences.relax(pod)
+            selection.volume_topology.inject(pod)
+        n_terms = [
+            len(t.match_expressions) for t in pod.spec.affinity.node_affinity.required
+        ]
+        assert max(n_terms) <= 2  # original + one injected, never compounding
+
+
+class TestVolumeTopology:
+    def test_bound_pv_zone_injected(self, env):
+        cluster, provisioning, selection = env
+        provisioning.apply(make_provisioner())
+        cluster.create("pvs", make_pv(name="pv-a", zones=["test-zone-2"]))
+        cluster.create("pvcs", make_pvc(name="claim-a", volume_name="pv-a"))
+        pod = make_pod(requests={"cpu": "1"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="claim-a")]
+        drive(cluster, provisioning, selection, pod)
+        assert pod.spec.node_name != ""
+        node = cluster.get("nodes", pod.spec.node_name, namespace="")
+        assert node.metadata.labels[lbl.TOPOLOGY_ZONE] == "test-zone-2"
+
+    def test_unbound_pvc_storage_class_topology_injected(self, env):
+        cluster, provisioning, selection = env
+        provisioning.apply(make_provisioner())
+        cluster.create("storageclasses", make_storage_class(name="fast", zones=["test-zone-3"]))
+        cluster.create("pvcs", make_pvc(name="claim-b", storage_class="fast"))
+        pod = make_pod(requests={"cpu": "1"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="claim-b")]
+        drive(cluster, provisioning, selection, pod)
+        assert pod.spec.node_name != ""
+        node = cluster.get("nodes", pod.spec.node_name, namespace="")
+        assert node.metadata.labels[lbl.TOPOLOGY_ZONE] == "test-zone-3"
