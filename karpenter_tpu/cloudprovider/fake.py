@@ -125,6 +125,7 @@ class FakeCloudProvider(CloudProvider):
     def __init__(self, instance_types: Optional[List[InstanceType]] = None):
         self.instance_types: Optional[List[InstanceType]] = instance_types
         self.create_calls: List[NodeRequest] = []
+        self.delete_calls: List[str] = []
         self._mu = threading.Lock()
 
     def create(self, request: NodeRequest) -> Node:
@@ -160,7 +161,8 @@ class FakeCloudProvider(CloudProvider):
         )
 
     def delete(self, node: Node) -> None:
-        return None
+        with self._mu:
+            self.delete_calls.append(node.metadata.name)
 
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
         if self.instance_types is not None:

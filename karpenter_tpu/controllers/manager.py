@@ -80,8 +80,11 @@ class Manager:
                 reg.queue = RateLimitingQueue(backoff=reg.queue.backoff)
             reg.threads = [t for t in reg.threads if t.is_alive()]
             for i in range(reg.concurrency):
+                # workers pin the queue instance they were started with: a
+                # stop()/start() swap must not let an old worker touch the
+                # replacement queue (it would break the dedup invariant)
                 t = threading.Thread(
-                    target=self._worker, args=(reg,), daemon=True,
+                    target=self._worker, args=(reg, reg.queue), daemon=True,
                     name=f"{reg.name}-{i}",
                 )
                 reg.threads.append(t)
@@ -103,23 +106,23 @@ class Manager:
     readyz = healthz
 
     # -- worker loop -------------------------------------------------------
-    def _worker(self, reg: _Registration) -> None:
+    def _worker(self, reg: _Registration, queue) -> None:
         while True:
             try:
-                key = reg.queue.get()
+                key = queue.get()
             except ShutDown:
                 return
             try:
                 requeue_after = self._call(reg, key)
             except Exception:
                 logger.exception("%s: reconcile %r failed", reg.name, key)
-                reg.queue.done(key)
-                reg.queue.add_rate_limited(key)
+                queue.done(key)
+                queue.add_rate_limited(key)
                 continue
-            reg.queue.forget(key)
-            reg.queue.done(key)
+            queue.forget(key)
+            queue.done(key)
             if requeue_after is not None:
-                reg.queue.add_after(key, requeue_after)
+                queue.add_after(key, requeue_after)
 
     @staticmethod
     def _call(reg: _Registration, key) -> Optional[float]:

@@ -1,0 +1,205 @@
+"""Node lifecycle: initialization, expiration, emptiness, finalizer.
+
+Mirrors ``pkg/controllers/node``: watches karpenter-labeled nodes (plus mapped
+events from provisioner changes and pod assignments), runs four
+sub-reconcilers, persists a single update, and requeues at the soonest of the
+sub-reconcilers' requested times (controller.go:42-116, ``result.Min``).
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime, timezone
+from typing import List, Optional
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Node, Taint
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import node as nodeutil
+from karpenter_tpu.utils import pod as podutil
+
+logger = logging.getLogger("karpenter.node")
+
+INITIALIZATION_TIMEOUT = 15 * 60.0  # reference: initialization.go:32
+
+
+def _rfc3339(ts: float) -> str:
+    return datetime.fromtimestamp(ts, timezone.utc).isoformat()
+
+
+def _parse_rfc3339(s: str) -> float:
+    return datetime.fromisoformat(s).timestamp()
+
+
+def result_min(*results: Optional[float]) -> Optional[float]:
+    """Merge reconcile results, taking the soonest requeue
+    (reference: utils/result/result.go)."""
+    times = [r for r in results if r is not None]
+    return min(times) if times else None
+
+
+class Initialization:
+    """Remove the not-ready startup taint when the node goes Ready; delete
+    nodes that never initialize within the timeout
+    (reference: initialization.go:32-66)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self, provisioner: Provisioner, node: Node) -> Optional[float]:
+        if not any(t.key == lbl.NOT_READY_TAINT_KEY for t in node.spec.taints):
+            return None
+        if not nodeutil.is_ready(node):
+            age = self.cluster.clock() - node.metadata.creation_timestamp
+            if age < INITIALIZATION_TIMEOUT:
+                return INITIALIZATION_TIMEOUT - age
+            logger.info("Triggering termination for node %s that failed to become ready",
+                        node.metadata.name)
+            self.cluster.delete("nodes", node.metadata.name, namespace="")
+            return None
+        node.spec.taints = [t for t in node.spec.taints if t.key != lbl.NOT_READY_TAINT_KEY]
+        return None
+
+
+class Expiration:
+    """Delete nodes older than ``ttl_seconds_until_expired``
+    (reference: expiration.go:33-54)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self, provisioner: Provisioner, node: Node) -> Optional[float]:
+        ttl = provisioner.spec.ttl_seconds_until_expired
+        if ttl is None:
+            return None
+        expiration_time = node.metadata.creation_timestamp + ttl
+        now = self.cluster.clock()
+        if now > expiration_time:
+            logger.info("Triggering termination for expired node %s after %ss",
+                        node.metadata.name, ttl)
+            self.cluster.delete("nodes", node.metadata.name, namespace="")
+            return None
+        return expiration_time - now
+
+
+class Emptiness:
+    """Annotate empty nodes with an emptiness timestamp; delete them once the
+    TTL elapses; clear the annotation if pods land again
+    (reference: emptiness.go:36-100). Empty = every pod is terminal or
+    daemonset/static."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self, provisioner: Provisioner, node: Node) -> Optional[float]:
+        ttl = provisioner.spec.ttl_seconds_after_empty
+        if ttl is None:
+            return None
+        if not nodeutil.is_ready(node):
+            return None
+        empty = self.is_empty(node)
+        stamp = node.metadata.annotations.get(lbl.EMPTINESS_TIMESTAMP_ANNOTATION)
+        if not empty:
+            if stamp is not None:
+                del node.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION]
+                logger.info("Removed emptiness TTL from node %s", node.metadata.name)
+            return None
+        now = self.cluster.clock()
+        if stamp is None:
+            node.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION] = _rfc3339(now)
+            logger.info("Added TTL to empty node %s", node.metadata.name)
+            return float(ttl)
+        emptiness_time = _parse_rfc3339(stamp)
+        if now > emptiness_time + ttl:
+            logger.info("Triggering termination after %ss for empty node %s",
+                        ttl, node.metadata.name)
+            self.cluster.delete("nodes", node.metadata.name, namespace="")
+            return None
+        return emptiness_time + ttl - now
+
+    def is_empty(self, node: Node) -> bool:
+        for p in self.cluster.pods_on_node(node.metadata.name):
+            if podutil.is_terminal(p):
+                continue
+            if not podutil.is_owned_by_daemonset(p) and not podutil.is_owned_by_node(p):
+                return False
+        return True
+
+
+class Finalizer:
+    """Ensure self-registered nodes carry the termination finalizer — covers
+    instances that launch when the node-object create failed
+    (reference: finalizer.go:31-42)."""
+
+    def reconcile(self, provisioner: Provisioner, node: Node) -> Optional[float]:
+        if node.metadata.deletion_timestamp is not None:
+            return None
+        if lbl.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        return None
+
+
+class NodeController:
+    """reference: node/controller.go:42-150."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.initialization = Initialization(cluster)
+        self.expiration = Expiration(cluster)
+        self.emptiness = Emptiness(cluster)
+        self.finalizer = Finalizer()
+
+    def reconcile(self, name: str) -> Optional[float]:
+        node = self.cluster.try_get("nodes", name, namespace="")
+        if node is None or node.metadata.deletion_timestamp is not None:
+            return None
+        provisioner_name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+        if provisioner_name is None:
+            return None
+        provisioner = self.cluster.try_get("provisioners", provisioner_name, namespace="")
+        if provisioner is None:
+            return None
+        before = _snapshot(node)
+        results: List[Optional[float]] = []
+        for sub in (self.initialization, self.expiration, self.emptiness, self.finalizer):
+            results.append(sub.reconcile(provisioner, node))
+            # a sub-reconciler may delete the node (finalizer-bearing nodes
+            # stay in the store but start terminating); stop touching it then
+            if (
+                node.metadata.deletion_timestamp is not None
+                or self.cluster.try_get("nodes", name, namespace="") is None
+            ):
+                return None
+        if _snapshot(node) != before:
+            self.cluster.update("nodes", node)
+        return result_min(*results)
+
+    def register(self, manager) -> None:
+        """Watch nodes directly, provisioners mapped to their nodes, and pods
+        mapped to their node (reference: controller.go:118-150)."""
+
+        def on_node(event: str, node) -> None:
+            if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL):
+                manager.enqueue("node", node.metadata.name)
+
+        def on_provisioner(event: str, provisioner) -> None:
+            for node in self.cluster.nodes():
+                if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner.metadata.name:
+                    manager.enqueue("node", node.metadata.name)
+
+        def on_pod(event: str, pod) -> None:
+            if pod.spec.node_name:
+                manager.enqueue("node", pod.spec.node_name)
+
+        self.cluster.watch("nodes", on_node)
+        self.cluster.watch("provisioners", on_provisioner)
+        self.cluster.watch("pods", on_pod)
+
+
+def _snapshot(node: Node):
+    return (
+        tuple((t.key, t.value, t.effect) for t in node.spec.taints),
+        tuple(sorted(node.metadata.annotations.items())),
+        tuple(node.metadata.finalizers),
+    )

@@ -1,0 +1,189 @@
+"""Termination: finalizer-driven node teardown.
+
+Mirrors ``pkg/controllers/termination``: a deleted Node bearing the
+``karpenter.sh/termination`` finalizer is cordoned, drained (respecting
+do-not-evict, static pods, stuck-terminating pods, and PDBs via the eviction
+queue's 429-retry), then the cloud instance is deleted and the finalizer
+removed (terminate.go:43-141, eviction.go:33-107, controller.go:63-95).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Tuple
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Node, Pod, Taint
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.workqueue import ExponentialBackoff, RateLimitingQueue, ShutDown
+
+logger = logging.getLogger("karpenter.termination")
+
+# reference: eviction.go:34-36
+EVICTION_QUEUE_BASE_DELAY = 0.1
+EVICTION_QUEUE_MAX_DELAY = 10.0
+
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+UNSCHEDULABLE_TAINT = Taint(key="node.kubernetes.io/unschedulable", effect="NoSchedule")
+
+
+class EvictionQueue:
+    """Async rate-limited evictor: PDB-blocked evictions (the 429 analog)
+    retry with exponential backoff (reference: eviction.go:33-107)."""
+
+    def __init__(self, cluster: Cluster, start: bool = True):
+        self.cluster = cluster
+        self.queue = RateLimitingQueue(
+            ExponentialBackoff(base=EVICTION_QUEUE_BASE_DELAY, cap=EVICTION_QUEUE_MAX_DELAY)
+        )
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(target=self.run, daemon=True, name="eviction")
+            self._thread.start()
+
+    def add(self, pods: List[Pod]) -> None:
+        for pod in pods:
+            self.queue.add((pod.metadata.namespace, pod.metadata.name))
+
+    def run(self) -> None:
+        while True:
+            try:
+                key = self.queue.get()
+            except ShutDown:
+                return
+            if self.evict_once(key):
+                self.queue.forget(key)
+                self.queue.done(key)
+            else:
+                self.queue.done(key)
+                self.queue.add_rate_limited(key)
+
+    def evict_once(self, key: Tuple[str, str]) -> bool:
+        namespace, name = key
+        pod = self.cluster.try_get("pods", name, namespace)
+        if pod is None:  # 404 → nothing to evict
+            return True
+        ok = self.cluster.evict(pod)
+        if not ok:
+            logger.debug("eviction of %s/%s blocked by PDB (429)", namespace, name)
+        return ok
+
+    def stop(self) -> None:
+        self.queue.shut_down()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def is_stuck_terminating(pod: Pod, now: float) -> bool:
+    """Kubelet-partition guard: the pod is past its graceful-deletion window
+    (reference: terminate.go:144-149)."""
+    if pod.metadata.deletion_timestamp is None:
+        return False
+    return now > pod.metadata.deletion_timestamp + pod.spec.termination_grace_period_seconds
+
+
+class Terminator:
+    """reference: terminate.go:35-141."""
+
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, eviction_queue: EvictionQueue):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.eviction_queue = eviction_queue
+
+    def cordon(self, node: Node) -> None:
+        if node.spec.unschedulable:
+            return
+        node.spec.unschedulable = True
+        self.cluster.update("nodes", node)
+        logger.info("Cordoned node %s", node.metadata.name)
+
+    def drain(self, node: Node) -> bool:
+        """Evict pods; True when the node is fully drained."""
+        pods = self.get_pods(node)
+        for pod in pods:
+            if pod.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true":
+                logger.debug(
+                    "Unable to drain node %s: pod %s has do-not-evict",
+                    node.metadata.name, pod.key,
+                )
+                return False
+        self.evict(pods)
+        return len(pods) == 0
+
+    def terminate(self, node: Node) -> None:
+        self.cloud_provider.delete(node)
+        self.cluster.remove_finalizer("nodes", node, lbl.TERMINATION_FINALIZER)
+        logger.info("Deleted node %s", node.metadata.name)
+
+    def get_pods(self, node: Node) -> List[Pod]:
+        """Evictable pods: exclude pods tolerating the unschedulable taint
+        (they would reschedule right back), stuck-terminating pods, and
+        static pods (reference: terminate.go:98-120)."""
+        now = self.cluster.clock()
+        out = []
+        for p in self.cluster.pods_on_node(node.metadata.name):
+            if any(t.tolerates(UNSCHEDULABLE_TAINT) for t in p.spec.tolerations):
+                continue
+            if is_stuck_terminating(p, now):
+                continue
+            if podutil.is_owned_by_node(p):
+                continue
+            out.append(p)
+        return out
+
+    def evict(self, pods: List[Pod]) -> None:
+        """Critical pods evict only after all non-critical are gone
+        (reference: terminate.go:122-141)."""
+        critical, non_critical = [], []
+        for pod in pods:
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.spec.priority_class_name in CRITICAL_PRIORITY_CLASSES:
+                critical.append(pod)
+            else:
+                non_critical.append(pod)
+        self.eviction_queue.add(non_critical if non_critical else critical)
+
+
+class TerminationController:
+    """reference: termination/controller.go:50-113."""
+
+    DRAIN_REQUEUE = 1.0
+
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, start_queue: bool = True):
+        self.cluster = cluster
+        self.eviction_queue = EvictionQueue(cluster, start=start_queue)
+        self.terminator = Terminator(cluster, cloud_provider, self.eviction_queue)
+
+    def reconcile(self, name: str) -> Optional[float]:
+        node = self.cluster.try_get("nodes", name, namespace="")
+        if node is None:
+            return None
+        if node.metadata.deletion_timestamp is None:
+            return None
+        if lbl.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return None
+        self.terminator.cordon(node)
+        if not self.terminator.drain(node):
+            return self.DRAIN_REQUEUE
+        self.terminator.terminate(node)
+        return None
+
+    def register(self, manager) -> None:
+        def on_node(event: str, node) -> None:
+            manager.enqueue("termination", node.metadata.name)
+
+        def on_pod(event: str, pod) -> None:
+            # pod deletions progress drains; re-kick the hosting node
+            if pod.spec.node_name:
+                manager.enqueue("termination", pod.spec.node_name)
+
+        self.cluster.watch("nodes", on_node)
+        self.cluster.watch("pods", on_pod)
+
+    def stop(self) -> None:
+        self.eviction_queue.stop()

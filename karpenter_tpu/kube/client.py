@@ -109,18 +109,24 @@ class Cluster:
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Delete with finalizer semantics: objects carrying finalizers only
-        get a deletion timestamp; removal happens when finalizers clear."""
+        get a deletion timestamp; removal happens when finalizers clear.
+        Repeat deletes of an already-terminating object are no-ops, like the
+        apiserver — finalizers must never be bypassed by a second delete."""
         with self._lock:
             store = self._stores[kind]
             obj = store.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            if obj.metadata.finalizers and obj.metadata.deletion_timestamp is None:
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is not None:
+                    return  # already terminating
                 obj.metadata.deletion_timestamp = self.clock()
                 self._version += 1
                 obj.metadata.resource_version = self._version
                 event = "MODIFIED"
             else:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = self.clock()
                 del store.objects[(namespace, name)]
                 event = "DELETED"
         self._notify(kind, event, obj)
@@ -181,7 +187,9 @@ class Cluster:
 
     def evict(self, pod: Pod) -> bool:
         """The Evict subresource. Returns False (HTTP 429 analog) if a PDB
-        would be violated."""
+        would be violated; otherwise deletes the pod with the same finalizer
+        semantics as ``delete`` (there is no kubelet here, so eviction
+        completes immediately, like envtest)."""
         with self._lock:
             for pdb in self.list("pdbs", pod.metadata.namespace):
                 if pdb.selector is None or not pdb.selector.matches(pod.metadata.labels):
@@ -196,8 +204,17 @@ class Cluster:
                     return False
                 if pdb.max_unavailable is not None and (len(matching) - (len(healthy) - 1)) > pdb.max_unavailable:
                     return False
-            pod.metadata.deletion_timestamp = self.clock()
-            self._version += 1
-            pod.metadata.resource_version = self._version
-        self._notify("pods", "MODIFIED", pod)
+            key = self._key(pod)
+            if pod.metadata.finalizers:
+                if pod.metadata.deletion_timestamp is not None:
+                    return True  # already terminating
+                pod.metadata.deletion_timestamp = self.clock()
+                self._version += 1
+                pod.metadata.resource_version = self._version
+                event = "MODIFIED"
+            else:
+                self._stores["pods"].objects.pop(key, None)
+                pod.metadata.deletion_timestamp = pod.metadata.deletion_timestamp or self.clock()
+                event = "DELETED"
+        self._notify("pods", event, pod)
         return True

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+from collections import deque
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -103,7 +104,7 @@ class RateLimitingQueue:
     def __init__(self, backoff: Optional[ExponentialBackoff] = None):
         self.backoff = backoff or ExponentialBackoff()
         self._lock = threading.Condition()
-        self._queue: List[Any] = []
+        self._queue: deque = deque()
         self._queued: Set[Any] = set()
         self._processing: Set[Any] = set()
         self._dirty: Set[Any] = set()  # re-added while processing
@@ -159,7 +160,7 @@ class RateLimitingQueue:
             while True:
                 next_delay = self._pump_delayed_locked()
                 if self._queue:
-                    item = self._queue.pop(0)
+                    item = self._queue.popleft()
                     self._queued.discard(item)
                     self._processing.add(item)
                     return item

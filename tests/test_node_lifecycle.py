@@ -1,0 +1,189 @@
+"""Node lifecycle tests (mirrors node/suite_test.go): expiry TTL, readiness
+taint add/remove, init-timeout kill, emptiness TTL. Deterministic time via the
+cluster's injectable clock."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference, Taint
+from karpenter_tpu.controllers.node import (
+    INITIALIZATION_TIMEOUT,
+    NodeController,
+    result_min,
+)
+from karpenter_tpu.kube.client import Cluster
+from tests.factories import make_node, make_pod, make_provisioner
+
+
+@pytest.fixture()
+def env():
+    now = [1000.0]
+    cluster = Cluster(clock=lambda: now[0])
+    controller = NodeController(cluster)
+    return cluster, controller, now
+
+
+def karpenter_node(cluster, **kw):
+    kw.setdefault("provisioner_name", "default")
+    kw.setdefault("finalizers", [lbl.TERMINATION_FINALIZER])
+    node = make_node(**kw)
+    cluster.create("nodes", node)
+    return node
+
+
+class TestInitialization:
+    def test_not_ready_taint_removed_when_ready(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(
+            cluster, ready=True, taints=[Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        )
+        controller.reconcile(node.metadata.name)
+        assert not any(t.key == lbl.NOT_READY_TAINT_KEY for t in node.spec.taints)
+
+    def test_taint_kept_while_not_ready(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(
+            cluster, ready=False, taints=[Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        )
+        requeue = controller.reconcile(node.metadata.name)
+        assert any(t.key == lbl.NOT_READY_TAINT_KEY for t in node.spec.taints)
+        assert requeue is not None and requeue <= INITIALIZATION_TIMEOUT
+
+    def test_unready_node_deleted_after_timeout(self, env):
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(
+            cluster, ready=False, taints=[Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        )
+        now[0] += INITIALIZATION_TIMEOUT + 1
+        controller.reconcile(node.metadata.name)
+        # finalizer-bearing node: deletion timestamp set, awaiting termination
+        assert node.metadata.deletion_timestamp is not None
+
+    def test_other_taints_untouched(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(
+            cluster,
+            ready=True,
+            taints=[
+                Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule"),
+                Taint(key="dedicated", value="team", effect="NoSchedule"),
+            ],
+        )
+        controller.reconcile(node.metadata.name)
+        assert [t.key for t in node.spec.taints] == ["dedicated"]
+
+
+class TestExpiration:
+    def test_node_expires_after_ttl(self, env):
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner(ttl_until_expired=60))
+        node = karpenter_node(cluster)
+        requeue = controller.reconcile(node.metadata.name)
+        assert node.metadata.deletion_timestamp is None
+        assert requeue == pytest.approx(60.0, abs=1.0)
+        now[0] += 61
+        controller.reconcile(node.metadata.name)
+        assert node.metadata.deletion_timestamp is not None
+
+    def test_no_ttl_no_expiry(self, env):
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(cluster)
+        now[0] += 10_000_000
+        assert controller.reconcile(node.metadata.name) is None
+        assert node.metadata.deletion_timestamp is None
+
+
+class TestEmptiness:
+    def test_empty_node_annotated_then_deleted(self, env):
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=30))
+        node = karpenter_node(cluster, ready=True)
+        requeue = controller.reconcile(node.metadata.name)
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in node.metadata.annotations
+        assert requeue == pytest.approx(30.0)
+        now[0] += 31
+        controller.reconcile(node.metadata.name)
+        assert node.metadata.deletion_timestamp is not None
+
+    def test_annotation_removed_when_pod_lands(self, env):
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=30))
+        node = karpenter_node(cluster, ready=True)
+        controller.reconcile(node.metadata.name)
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in node.metadata.annotations
+        pod = make_pod(node_name=node.metadata.name, unschedulable=False)
+        cluster.create("pods", pod)
+        controller.reconcile(node.metadata.name)
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in node.metadata.annotations
+        # and the node survives well past the TTL
+        now[0] += 1000
+        controller.reconcile(node.metadata.name)
+        assert node.metadata.deletion_timestamp is None
+
+    def test_daemonset_pods_do_not_count(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=30))
+        node = karpenter_node(cluster, ready=True)
+        ds_pod = make_pod(node_name=node.metadata.name, unschedulable=False)
+        ds_pod.metadata.owner_references.append(
+            OwnerReference(api_version="apps/v1", kind="DaemonSet", name="ds")
+        )
+        cluster.create("pods", ds_pod)
+        controller.reconcile(node.metadata.name)
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in node.metadata.annotations
+
+    def test_not_ready_node_skipped(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=30))
+        node = karpenter_node(cluster, ready=False)
+        controller.reconcile(node.metadata.name)
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in node.metadata.annotations
+
+
+class TestFinalizer:
+    def test_finalizer_added_to_self_registered_node(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner())
+        node = karpenter_node(cluster, finalizers=[])
+        controller.reconcile(node.metadata.name)
+        assert lbl.TERMINATION_FINALIZER in node.metadata.finalizers
+
+
+class TestController:
+    def test_non_karpenter_node_ignored(self, env):
+        cluster, controller, _ = env
+        node = make_node()
+        cluster.create("nodes", node)
+        assert controller.reconcile(node.metadata.name) is None
+
+    def test_result_min(self):
+        assert result_min(None, 5.0, 2.0, None) == 2.0
+        assert result_min(None, None) is None
+
+    def test_double_delete_never_bypasses_finalizer(self, env):
+        """Init-timeout + expiry both firing must leave the node terminating
+        (finalizer intact), never hard-removed — a hard remove would skip the
+        termination controller and leak the cloud instance."""
+        cluster, controller, now = env
+        cluster.create("provisioners", make_provisioner(ttl_until_expired=60))
+        node = karpenter_node(
+            cluster, ready=False, taints=[Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        )
+        now[0] += INITIALIZATION_TIMEOUT + 1  # past both init timeout and expiry
+        controller.reconcile(node.metadata.name)
+        still = cluster.try_get("nodes", node.metadata.name, namespace="")
+        assert still is not None  # terminating, not gone
+        assert still.metadata.deletion_timestamp is not None
+        assert lbl.TERMINATION_FINALIZER in still.metadata.finalizers
+
+    def test_requeue_is_soonest_of_subreconcilers(self, env):
+        cluster, controller, _ = env
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=30, ttl_until_expired=600))
+        node = karpenter_node(cluster, ready=True)
+        requeue = controller.reconcile(node.metadata.name)
+        assert requeue == pytest.approx(30.0)  # emptiness sooner than expiry

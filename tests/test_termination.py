@@ -1,0 +1,152 @@
+"""Termination tests (mirrors termination/suite_test.go): cordon/drain/evict
+ordering, do-not-evict, PDB 429 handling, static pods."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference, Toleration
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.termination import TerminationController, is_stuck_terminating
+from karpenter_tpu.kube.client import Cluster
+from tests.factories import make_node, make_pdb, make_pod, make_provisioner
+
+
+@pytest.fixture()
+def env():
+    now = [1000.0]
+    cluster = Cluster(clock=lambda: now[0])
+    provider = FakeCloudProvider(instance_types(5))
+    controller = TerminationController(cluster, provider, start_queue=False)
+    return cluster, provider, controller, now
+
+
+def deleting_node(cluster, **kw):
+    kw.setdefault("provisioner_name", "default")
+    kw.setdefault("finalizers", [lbl.TERMINATION_FINALIZER])
+    node = make_node(**kw)
+    cluster.create("nodes", node)
+    cluster.delete("nodes", node.metadata.name, namespace="")
+    return node
+
+
+def drain_queue(controller):
+    """Run queued evictions synchronously (queue thread not started)."""
+    q = controller.eviction_queue
+    while len(q.queue):
+        key = q.queue.get(timeout=0.1)
+        if key is None:
+            break
+        ok = q.evict_once(key)
+        q.queue.done(key)
+        if not ok:
+            return False
+    return True
+
+
+class TestTermination:
+    def test_empty_node_terminated_and_instance_deleted(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        assert controller.reconcile(node.metadata.name) is None
+        assert cluster.try_get("nodes", node.metadata.name, namespace="") is None
+        assert node.metadata.name in provider.delete_calls
+
+    def test_node_cordoned_before_drain(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        cluster.create("pods", make_pod(node_name=node.metadata.name, unschedulable=False))
+        requeue = controller.reconcile(node.metadata.name)
+        assert node.spec.unschedulable
+        assert requeue == controller.DRAIN_REQUEUE  # not drained yet
+
+    def test_drain_evicts_then_terminates(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        pod = make_pod(node_name=node.metadata.name, unschedulable=False)
+        cluster.create("pods", pod)
+        controller.reconcile(node.metadata.name)
+        assert drain_queue(controller)  # eviction deletes the pod
+        assert cluster.try_get("pods", pod.metadata.name) is None
+        assert controller.reconcile(node.metadata.name) is None
+        assert cluster.try_get("nodes", node.metadata.name, namespace="") is None
+
+    def test_do_not_evict_blocks_drain(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        pod = make_pod(node_name=node.metadata.name, unschedulable=False)
+        pod.metadata.annotations[lbl.DO_NOT_EVICT_ANNOTATION] = "true"
+        cluster.create("pods", pod)
+        assert controller.reconcile(node.metadata.name) == controller.DRAIN_REQUEUE
+        assert cluster.try_get("pods", pod.metadata.name) is not None
+        assert cluster.try_get("nodes", node.metadata.name, namespace="") is not None
+
+    def test_critical_pods_evicted_last(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        normal = make_pod(node_name=node.metadata.name, unschedulable=False)
+        critical = make_pod(node_name=node.metadata.name, unschedulable=False)
+        critical.spec.priority_class_name = "system-node-critical"
+        cluster.create("pods", normal)
+        cluster.create("pods", critical)
+        controller.reconcile(node.metadata.name)
+        drain_queue(controller)
+        # first round only evicts the non-critical pod
+        assert cluster.try_get("pods", normal.metadata.name) is None
+        assert cluster.try_get("pods", critical.metadata.name) is not None
+        controller.reconcile(node.metadata.name)
+        drain_queue(controller)
+        assert cluster.try_get("pods", critical.metadata.name) is None
+
+    def test_static_pods_ignored(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        static = make_pod(node_name=node.metadata.name, unschedulable=False)
+        static.metadata.owner_references.append(OwnerReference(api_version="v1", kind="Node", name=node.metadata.name))
+        cluster.create("pods", static)
+        assert controller.reconcile(node.metadata.name) is None  # drained despite static pod
+        assert cluster.try_get("nodes", node.metadata.name, namespace="") is None
+
+    def test_tolerating_unschedulable_pods_ignored(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        ds_like = make_pod(
+            node_name=node.metadata.name,
+            unschedulable=False,
+            tolerations=[Toleration(operator="Exists")],
+        )
+        cluster.create("pods", ds_like)
+        assert controller.reconcile(node.metadata.name) is None
+
+    def test_pdb_blocks_eviction_with_429(self, env):
+        cluster, provider, controller, _ = env
+        node = deleting_node(cluster)
+        pod = make_pod(node_name=node.metadata.name, unschedulable=False, labels={"app": "db"})
+        cluster.create("pods", pod)
+        cluster.create("pdbs", make_pdb(labels={"app": "db"}, min_available=1))
+        controller.reconcile(node.metadata.name)
+        assert not drain_queue(controller)  # blocked → 429 retry path
+        assert cluster.try_get("pods", pod.metadata.name) is not None
+
+    def test_node_without_finalizer_ignored(self, env):
+        cluster, provider, controller, _ = env
+        node = make_node(provisioner_name="default")
+        cluster.create("nodes", node)
+        cluster.delete("nodes", node.metadata.name, namespace="")
+        assert controller.reconcile(node.metadata.name) is None
+        assert provider.delete_calls == []
+
+    def test_live_node_ignored(self, env):
+        cluster, provider, controller, _ = env
+        node = make_node(provisioner_name="default", finalizers=[lbl.TERMINATION_FINALIZER])
+        cluster.create("nodes", node)
+        assert controller.reconcile(node.metadata.name) is None
+        assert not node.spec.unschedulable
+
+
+class TestStuckTerminating:
+    def test_past_grace_window(self):
+        pod = make_pod(unschedulable=False)
+        assert not is_stuck_terminating(pod, 1000.0)
+        pod.metadata.deletion_timestamp = 900.0
+        assert not is_stuck_terminating(pod, 920.0)  # within 30s grace
+        assert is_stuck_terminating(pod, 931.0)
