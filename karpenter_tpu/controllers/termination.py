@@ -40,6 +40,11 @@ class EvictionQueue:
         self.queue = RateLimitingQueue(
             ExponentialBackoff(base=EVICTION_QUEUE_BASE_DELAY, cap=EVICTION_QUEUE_MAX_DELAY)
         )
+        # membership set spanning queued + delayed-for-retry keys: repeated
+        # drain rounds must not bypass a parked key's backoff
+        # (reference: eviction.go:56-63 pairs the workqueue with a set.Set)
+        self._in_flight: set = set()
+        self._in_flight_mu = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(target=self.run, daemon=True, name="eviction")
@@ -47,7 +52,12 @@ class EvictionQueue:
 
     def add(self, pods: List[Pod]) -> None:
         for pod in pods:
-            self.queue.add((pod.metadata.namespace, pod.metadata.name))
+            key = (pod.metadata.namespace, pod.metadata.name)
+            with self._in_flight_mu:
+                if key in self._in_flight:
+                    continue
+                self._in_flight.add(key)
+            self.queue.add(key)
 
     def run(self) -> None:
         while True:
@@ -55,12 +65,20 @@ class EvictionQueue:
                 key = self.queue.get()
             except ShutDown:
                 return
-            if self.evict_once(key):
-                self.queue.forget(key)
-                self.queue.done(key)
-            else:
-                self.queue.done(key)
-                self.queue.add_rate_limited(key)
+            self.process_one(key)
+
+    def process_one(self, key: Tuple[str, str]) -> bool:
+        """Evict + queue bookkeeping for one dequeued key; returns whether
+        the eviction succeeded."""
+        if self.evict_once(key):
+            self.queue.forget(key)
+            with self._in_flight_mu:
+                self._in_flight.discard(key)
+            self.queue.done(key)
+            return True
+        self.queue.done(key)
+        self.queue.add_rate_limited(key)
+        return False
 
     def evict_once(self, key: Tuple[str, str]) -> bool:
         namespace, name = key

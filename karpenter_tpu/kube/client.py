@@ -191,6 +191,10 @@ class Cluster:
         semantics as ``delete`` (there is no kubelet here, so eviction
         completes immediately, like envtest)."""
         with self._lock:
+            # already-terminating pods evict without PDB enforcement, like
+            # the apiserver — they no longer count against the budget
+            if pod.metadata.deletion_timestamp is not None and pod.metadata.finalizers:
+                return True
             for pdb in self.list("pdbs", pod.metadata.namespace):
                 if pdb.selector is None or not pdb.selector.matches(pod.metadata.labels):
                     continue

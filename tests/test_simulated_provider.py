@@ -1,0 +1,285 @@
+"""Simulated vendor provider tests (mirrors aws/suite_test.go driven against
+fake EC2/SSM): capacity types, ICE cache behavior, launch templates, subnets,
+security groups, GPU preference, overhead model, defaulting/validation."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.cloudprovider.simulated import (
+    CloudAPIError,
+    InsufficientCapacityError,
+    SimCloudAPI,
+    SimInstanceTypeInfo,
+    SimProviderConfig,
+    SimSubnet,
+    SimulatedCloudProvider,
+    compute_overhead,
+    network_limited_pods,
+)
+from karpenter_tpu.cloudprovider.types import NodeRequest
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import resources as res
+from tests.factories import make_pod, make_provisioner
+
+
+@pytest.fixture()
+def env():
+    now = [1000.0]
+    api = SimCloudAPI()
+    provider = SimulatedCloudProvider(api, clock=lambda: now[0])
+    return api, provider, now
+
+
+def constraints_for(provider, requirements=None, provider_cfg=None):
+    c = Constraints(
+        requirements=Requirements.new(*(requirements or [])), provider=provider_cfg
+    )
+    provider.default(c)
+    catalog = provider.get_instance_types(provider_cfg)
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    return c, catalog
+
+
+class TestCatalog:
+    def test_metal_filtered_offering_zones_from_subnets(self, env):
+        api, provider, _ = env
+        catalog = provider.get_instance_types()
+        names = {it.name for it in catalog}
+        assert "sim.metal-96x" not in names
+        assert "sim.gp-4x" in names
+        for it in catalog:
+            assert {o.zone for o in it.offerings} <= {"sim-zone-1a", "sim-zone-1b", "sim-zone-1c"}
+
+    def test_catalog_cached_five_minutes(self, env):
+        api, provider, now = env
+        provider.get_instance_types()
+        provider.get_instance_types()
+        assert api.calls["describe_instance_types"] == 1
+        now[0] += 301
+        provider.get_instance_types()
+        assert api.calls["describe_instance_types"] == 2
+
+    def test_subnet_selector_restricts_zones(self, env):
+        api, provider, _ = env
+        cfg = {"subnetSelector": {"Name": "private-a"}}
+        catalog = provider.get_instance_types(cfg)
+        for it in catalog:
+            assert {o.zone for o in it.offerings} == {"sim-zone-1a"}
+
+    def test_no_matching_subnets_raises(self, env):
+        api, provider, _ = env
+        with pytest.raises(CloudAPIError):
+            provider.get_instance_types({"subnetSelector": {"Name": "nope"}})
+
+
+class TestOverheadModel:
+    def test_cpu_ladder(self):
+        info = SimInstanceTypeInfo(name="t", vcpus=4, memory_gib=8)
+        # 100m system + 60m (first core) + 10m (second) + 10m (cores 3-4)
+        assert compute_overhead(info)[res.CPU] == pytest.approx(0.18)
+
+    def test_memory_formula(self):
+        info = SimInstanceTypeInfo(name="t", vcpus=2, memory_gib=4,
+                                   max_network_interfaces=3, ips_per_interface=10)
+        pods = network_limited_pods(info)
+        assert pods == 3 * 9 + 2
+        assert compute_overhead(info)[res.MEMORY] == (11 * pods + 455) * 1024**2
+
+
+class TestLaunch:
+    def test_launch_creates_node_with_labels_and_allocatable(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        node = provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+        assert node.metadata.labels[lbl.INSTANCE_TYPE] == cheapest[0].name
+        assert node.metadata.labels[lbl.CAPACITY_TYPE] == lbl.CAPACITY_TYPE_ON_DEMAND
+        assert node.metadata.labels[lbl.TOPOLOGY_ZONE].startswith("sim-zone-")
+        assert node.status.allocatable[res.CPU] < node.status.capacity[res.CPU]
+        assert api.instances  # really launched
+
+    def test_spot_used_when_requested(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(
+            provider,
+            requirements=[
+                NodeSelectorRequirement(
+                    key=lbl.CAPACITY_TYPE, operator="In",
+                    values=[lbl.CAPACITY_TYPE_SPOT, lbl.CAPACITY_TYPE_ON_DEMAND],
+                )
+            ],
+        )
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        assert node.metadata.labels[lbl.CAPACITY_TYPE] == lbl.CAPACITY_TYPE_SPOT
+
+    def test_on_demand_default_without_spot(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        assert node.metadata.labels[lbl.CAPACITY_TYPE] == lbl.CAPACITY_TYPE_ON_DEMAND
+
+    def test_gpu_types_dropped_when_generic_available(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        it = next(i for i in catalog if i.name == node.metadata.labels[lbl.INSTANCE_TYPE])
+        assert not it.resources.get(res.NVIDIA_GPU)
+
+    def test_gpu_only_options_still_launch(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        gpu_only = [it for it in catalog if it.resources.get(res.NVIDIA_GPU)]
+        node = provider.create(NodeRequest(template=c, instance_type_options=gpu_only))
+        assert "gpu" in node.metadata.labels[lbl.INSTANCE_TYPE]
+
+    def test_delete_terminates_instance(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        provider.delete(node)
+        instance_id = node.spec.provider_id.rsplit("/", 1)[-1]
+        assert api.instances[instance_id].state == "terminated"
+
+
+class TestICE:
+    def test_ice_marks_offering_unavailable_and_skips_it(self, env):
+        api, provider, now = env
+        c, catalog = constraints_for(provider)
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())[0]
+        # exhaust the cheapest type in every zone
+        for z in ("sim-zone-1a", "sim-zone-1b", "sim-zone-1c"):
+            api.insufficient_capacity_pools.add((lbl.CAPACITY_TYPE_ON_DEMAND, cheapest.name, z))
+        node = provider.create(NodeRequest(template=c, instance_type_options=list(catalog)))
+        # fleet fell through to a non-exhausted type
+        assert node.metadata.labels[lbl.INSTANCE_TYPE] != cheapest.name
+        # next catalog read excludes the ICE'd offerings entirely
+        refreshed = provider.get_instance_types()
+        it = next(i for i in refreshed if i.name == cheapest.name)
+        assert lbl.CAPACITY_TYPE_ON_DEMAND not in {o.capacity_type for o in it.offerings}
+
+    def test_ice_cache_expires_after_45s(self, env):
+        api, provider, now = env
+        provider.instance_type_provider.unavailable.mark_unavailable(
+            lbl.CAPACITY_TYPE_ON_DEMAND, "sim.gp-1x", "sim-zone-1a"
+        )
+        assert provider.instance_type_provider.unavailable.is_unavailable(
+            lbl.CAPACITY_TYPE_ON_DEMAND, "sim.gp-1x", "sim-zone-1a"
+        )
+        now[0] += 46
+        assert not provider.instance_type_provider.unavailable.is_unavailable(
+            lbl.CAPACITY_TYPE_ON_DEMAND, "sim.gp-1x", "sim-zone-1a"
+        )
+
+    def test_all_pools_exhausted_raises(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        one = [sorted(catalog, key=lambda it: it.effective_price())[0]]
+        for z in ("sim-zone-1a", "sim-zone-1b", "sim-zone-1c"):
+            api.insufficient_capacity_pools.add((lbl.CAPACITY_TYPE_ON_DEMAND, one[0].name, z))
+        with pytest.raises(InsufficientCapacityError):
+            provider.create(NodeRequest(template=c, instance_type_options=one))
+
+
+class TestLaunchTemplates:
+    def test_identical_configs_share_one_template(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        assert len(api.launch_templates) == 1
+
+    def test_different_labels_get_different_templates(self, env):
+        api, provider, _ = env
+        c1, catalog = constraints_for(provider)
+        c2, _ = constraints_for(provider)
+        c2.labels = {"team": "a"}
+        provider.create(NodeRequest(template=c1, instance_type_options=catalog))
+        provider.create(NodeRequest(template=c2, instance_type_options=catalog))
+        assert len(api.launch_templates) == 2
+
+    def test_gpu_nodes_get_gpu_image(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        gpu_only = [it for it in catalog if it.resources.get(res.NVIDIA_GPU)]
+        provider.create(NodeRequest(template=c, instance_type_options=gpu_only))
+        data = next(iter(api.launch_templates.values()))
+        assert "gpu" in data["image"]
+
+    def test_byo_launch_template_respected(self, env):
+        api, provider, _ = env
+        cfg = {"launchTemplate": "my-custom-lt"}
+        c, catalog = constraints_for(provider, provider_cfg=cfg)
+        c.provider = cfg
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        instance_id = node.spec.provider_id.rsplit("/", 1)[-1]
+        assert api.instances[instance_id].launch_template == "my-custom-lt"
+        assert api.launch_templates == {}  # nothing created
+
+
+class TestValidationDefaults:
+    def test_defaults_applied(self, env):
+        _, provider, _ = env
+        c = Constraints()
+        provider.default(c)
+        assert c.requirements.capacity_types() == {lbl.CAPACITY_TYPE_ON_DEMAND}
+        assert c.requirements.architectures() == {lbl.ARCH_AMD64}
+
+    def test_defaults_idempotent(self, env):
+        _, provider, _ = env
+        c = Constraints(
+            requirements=Requirements.new(
+                NodeSelectorRequirement(
+                    key=lbl.CAPACITY_TYPE, operator="In", values=[lbl.CAPACITY_TYPE_SPOT]
+                )
+            )
+        )
+        provider.default(c)
+        assert c.requirements.capacity_types() == {lbl.CAPACITY_TYPE_SPOT}
+
+    def test_bad_image_family_rejected(self, env):
+        _, provider, _ = env
+        errs = provider.validate(Constraints(provider={"imageFamily": "nope"}))
+        assert errs
+
+    def test_restricted_tags_rejected(self, env):
+        _, provider, _ = env
+        errs = provider.validate(
+            Constraints(provider={"tags": {"karpenter.sh/provisioner-name": "x"}})
+        )
+        assert errs
+
+    def test_empty_selector_rejected(self, env):
+        _, provider, _ = env
+        errs = provider.validate(Constraints(provider={"subnetSelector": {}}))
+        assert errs
+
+
+class TestEndToEnd:
+    def test_provisioning_through_simulated_provider(self, env):
+        """The full slice — pending pods → solve → fleet launch → bind —
+        against the simulated vendor instead of the plain fake."""
+        api, provider, _ = env
+        cluster = Cluster()
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        provisioner = make_provisioner()
+        cluster.create("provisioners", provisioner)
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(5)]
+        for p in pods:
+            cluster.create("pods", p)
+        controller.apply(provisioner)
+        worker = controller.workers[provisioner.name]
+        for p in pods:
+            worker.batcher.add(p)
+        worker.batcher.idle_duration = 0.01
+        vnodes = worker.provision_once()
+        controller.stop()
+        assert vnodes
+        assert all(p.spec.node_name for p in cluster.pods())
+        node = cluster.nodes()[0]
+        assert node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] == "default"
+        assert node.spec.provider_id.startswith("sim:///")

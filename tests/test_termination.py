@@ -36,9 +36,7 @@ def drain_queue(controller):
         key = q.queue.get(timeout=0.1)
         if key is None:
             break
-        ok = q.evict_once(key)
-        q.queue.done(key)
-        if not ok:
+        if not q.process_one(key):
             return False
     return True
 
