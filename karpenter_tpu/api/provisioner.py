@@ -125,8 +125,17 @@ class ProvisionerSpec:
     ttl_seconds_after_empty: Optional[int] = None
     ttl_seconds_until_expired: Optional[int] = None
     limits: Optional[Limits] = None
-    # Scheduling backend: "ffd" (in-process) or "tpu" (batched tensor solve).
+    # Scheduling backend: "ffd" (in-process) or "tpu" (batched tensor solve);
+    # "" = unset, resolved to the process default at admission/apply.
     solver: str = SOLVER_FFD
+
+
+def default_provisioner(provisioner: Provisioner, default_solver: str = SOLVER_FFD) -> None:
+    """Framework defaulting pass (reference: provisioner_defaults.go:154-161);
+    the vendor hook runs separately. The process-level ``--default-solver``
+    option lands here for provisioners that leave ``spec.solver`` unset."""
+    if not provisioner.spec.solver:
+        provisioner.spec.solver = default_solver
 
 
 @dataclass

@@ -1,0 +1,38 @@
+"""Cloud-provider registry — the build-tag switch analog.
+
+The reference selects its vendor at compile time (``registry/aws.go``
+``//go:build aws`` vs ``registry/fake.go``); here the selection is by name at
+process start (reference: registry/register.go:24-37). Registering installs
+the vendor's Default/Validate hooks, which the webhook and the provisioning
+controller both call.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from karpenter_tpu.cloudprovider.types import CloudProvider
+
+_FACTORIES: Dict[str, Callable[[], CloudProvider]] = {}
+
+
+def register(name: str, factory: Callable[[], CloudProvider]) -> None:
+    _FACTORIES[name] = factory
+
+
+def new_cloud_provider(name: str = "fake", **kwargs) -> CloudProvider:
+    factory = _FACTORIES.get(name)
+    if factory is None:
+        raise ValueError(f"unknown cloud provider {name!r}; registered: {sorted(_FACTORIES)}")
+    return factory(**kwargs) if kwargs else factory()
+
+
+def _register_builtins() -> None:
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.cloudprovider.simulated import SimulatedCloudProvider
+
+    register("fake", FakeCloudProvider)
+    register("simulated", SimulatedCloudProvider)
+
+
+_register_builtins()

@@ -256,6 +256,9 @@ class SimProviderConfig:
     image_family: str = DEFAULT_IMAGE_FAMILY
     tags: Dict[str, str] = field(default_factory=dict)
     launch_template: str = ""  # bring-your-own template name
+    # presence flag: an explicitly-specified selector conflicts with
+    # launchTemplate even when it equals the default
+    security_group_selector_specified: bool = False
 
     @staticmethod
     def deserialize(provider: Optional[Dict[str, Any]]) -> "SimProviderConfig":
@@ -271,6 +274,7 @@ class SimProviderConfig:
             image_family=provider.get("imageFamily", DEFAULT_IMAGE_FAMILY),
             tags=dict(provider.get("tags", {})),
             launch_template=provider.get("launchTemplate", ""),
+            security_group_selector_specified="securityGroupSelector" in provider,
         )
 
     def validate(self) -> List[str]:
@@ -278,7 +282,7 @@ class SimProviderConfig:
         errs = []
         if self.image_family not in IMAGE_FAMILIES:
             errs.append(f"imageFamily {self.image_family} not in {IMAGE_FAMILIES}")
-        if self.launch_template and self.security_group_selector != {"purpose": "nodes"}:
+        if self.launch_template and self.security_group_selector_specified:
             # a custom launch template brings its own security groups
             errs.append("may not specify both launchTemplate and securityGroupSelector")
         for selector, name in ((self.subnet_selector, "subnetSelector"),
@@ -495,8 +499,10 @@ class InstanceProvider:
         self.launch_templates = launch_templates
 
     def create(self, config: SimProviderConfig, request: NodeRequest) -> Node:
-        options = list(request.instance_type_options)[:MAX_INSTANCE_TYPES]
-        options = self._prefer_generic(options)
+        # GPU filter BEFORE the 20-type cap: a GPU-heavy prefix must not
+        # starve out the generic types (reference: aws/instance.go:73-75)
+        options = self._prefer_generic(list(request.instance_type_options))
+        options = options[:MAX_INSTANCE_TYPES]
         if not options:
             raise InsufficientCapacityError("no instance type options")
         capacity_type = self._get_capacity_type(request.template, options)

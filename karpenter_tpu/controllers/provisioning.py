@@ -19,7 +19,12 @@ from typing import Dict, List, Optional
 from karpenter_tpu import metrics
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Pod
-from karpenter_tpu.api.provisioner import Provisioner, validate_provisioner
+from karpenter_tpu.api.provisioner import (
+    SOLVER_FFD,
+    Provisioner,
+    default_provisioner,
+    validate_provisioner,
+)
 from karpenter_tpu.cloudprovider.requirements import catalog_requirements
 from karpenter_tpu.cloudprovider.types import CloudProvider, NodeRequest
 from karpenter_tpu.kube.client import Cluster, Conflict
@@ -188,10 +193,17 @@ class ProvisioningController:
     """Reconciles Provisioner objects into running workers
     (reference: provisioning/controller.go:43-154)."""
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, start_workers: bool = True):
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        start_workers: bool = True,
+        default_solver: str = SOLVER_FFD,
+    ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
+        self.default_solver = default_solver
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -208,7 +220,10 @@ class ProvisioningController:
 
     def apply(self, provisioner: Provisioner) -> None:
         """Validate, default, layer live catalog requirements, and (re)start
-        the worker when the spec changed (reference: controller.go:93-116)."""
+        the worker when the spec changed (reference: controller.go:93-116).
+        Defaulting re-runs here so the control loop is safe without the
+        webhook (reference: provisioning/controller.go:94-95)."""
+        default_provisioner(provisioner, self.default_solver)
         self.cloud_provider.default(provisioner.spec.constraints)
         errs = validate_provisioner(provisioner)
         errs += self.cloud_provider.validate(provisioner.spec.constraints)

@@ -210,8 +210,7 @@ class Cluster:
                     return False
             key = self._key(pod)
             if pod.metadata.finalizers:
-                if pod.metadata.deletion_timestamp is not None:
-                    return True  # already terminating
+                # (terminating finalizer pods short-circuited above)
                 pod.metadata.deletion_timestamp = self.clock()
                 self._version += 1
                 pod.metadata.resource_version = self._version

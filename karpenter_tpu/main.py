@@ -1,0 +1,142 @@
+"""Process wiring: build the manager with all controllers registered.
+
+Mirrors ``cmd/controller/main.go:67-105``: options → cloud provider from the
+registry → manager → register the eight controllers (provisioning, selection,
+pvc, termination, node, metrics-pod, metrics-node, counter) with their
+watches → start. ``run_controller_process`` is the ``main()`` equivalent; it
+returns the assembled runtime so embedding callers (tests, simulations, a
+real-apiserver deployment shim) can drive or stop it.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.cloudprovider import registry
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.metrics_node import NodeMetricsController
+from karpenter_tpu.controllers.metrics_pod import PodMetricsController
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.pvc import PVCController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.options import Options
+from karpenter_tpu.webhook import Webhook
+
+logger = logging.getLogger("karpenter")
+
+
+@dataclass
+class Runtime:
+    """Everything a running controller process owns."""
+
+    options: Options
+    cluster: Cluster
+    cloud_provider: CloudProvider
+    manager: Manager
+    provisioning: ProvisioningController
+    selection: SelectionController
+    termination: TerminationController
+    webhook: Webhook
+
+    def stop(self) -> None:
+        self.manager.stop()
+        self.provisioning.stop()
+        self.termination.stop()
+
+
+def build_runtime(
+    options: Optional[Options] = None,
+    cluster: Optional[Cluster] = None,
+    cloud_provider: Optional[CloudProvider] = None,
+    start_workers: bool = True,
+    allow_pod_affinity: bool = True,
+) -> Runtime:
+    """Assemble (but do not start) the full controller process."""
+    options = options or Options()
+    cluster = cluster or Cluster()
+    cloud_provider = cloud_provider or registry.new_cloud_provider(options.cloud_provider)
+
+    manager = Manager(cluster)
+    provisioning = ProvisioningController(
+        cluster,
+        cloud_provider,
+        start_workers=start_workers,
+        default_solver=options.default_solver,
+    )
+    selection = SelectionController(
+        cluster, provisioning, allow_pod_affinity=allow_pod_affinity
+    )
+    termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
+    node = NodeController(cluster)
+    counter = CounterController(cluster)
+    pvc = PVCController(cluster)
+    metrics_node = NodeMetricsController(cluster)
+    metrics_pod = PodMetricsController(cluster)
+
+    # concurrency mirrors the reference: selection widest, the rest 10
+    # (selection/controller.go:183, provisioning/controller.go:152)
+    manager.register("provisioning", provisioning.reconcile, concurrency=10)
+    manager.register("selection", selection.reconcile, concurrency=32)
+    manager.register("termination", termination.reconcile, concurrency=10)
+    manager.register("node", node.reconcile, concurrency=10)
+    manager.register("counter", counter.reconcile, concurrency=2)
+    manager.register("pvc", pvc.reconcile, concurrency=2)
+    manager.register("metrics_node", metrics_node.reconcile, concurrency=2)
+    manager.register("metrics_pod", metrics_pod.reconcile, concurrency=2)
+
+    # watches
+    cluster.watch(
+        "provisioners", lambda e, o: manager.enqueue("provisioning", o.metadata.name)
+    )
+    cluster.watch(
+        "pods", lambda e, o: manager.enqueue("selection", (o.metadata.name, o.metadata.namespace))
+    )
+    node.register(manager)
+    counter.register(manager)
+    pvc.register(manager)
+    termination.register(manager)
+    metrics_node.register(manager)
+    metrics_pod.register(manager)
+
+    return Runtime(
+        options=options,
+        cluster=cluster,
+        cloud_provider=cloud_provider,
+        manager=manager,
+        provisioning=provisioning,
+        selection=selection,
+        termination=termination,
+        webhook=Webhook(cloud_provider, default_solver=options.default_solver),
+    )
+
+
+def run_controller_process(options: Optional[Options] = None) -> Runtime:
+    """The ``main()`` equivalent: build and start."""
+    runtime = build_runtime(options)
+    runtime.manager.start()
+    logger.info(
+        "karpenter-tpu controller started (provider=%s, solver=%s)",
+        runtime.cloud_provider.name(),
+        runtime.options.default_solver,
+    )
+    return runtime
+
+
+if __name__ == "__main__":
+    import time as _time
+
+    from karpenter_tpu.options import parse_args
+
+    rt = run_controller_process(parse_args())
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        rt.stop()

@@ -52,12 +52,43 @@ CLOUDPROVIDER_DURATION = Histogram(
     registry=REGISTRY,
 )
 
-NODES_GAUGE = Gauge(
-    "karpenter_nodes_allocatable",
-    "Node allocatable are the resources allocatable by nodes.",
-    ["node_name", "provisioner", "zone", "arch", "capacity_type", "instance_type", "phase", "resource_type"],
-    registry=REGISTRY,
+# Per-node resource gauges (reference: metrics/node/controller.go:53-110).
+NODE_GAUGE_LABELS = [
+    "node_name", "provisioner", "zone", "arch", "capacity_type",
+    "instance_type", "phase", "resource_type",
+]
+
+
+def _node_gauge(name: str, doc: str) -> Gauge:
+    return Gauge(name, doc, NODE_GAUGE_LABELS, registry=REGISTRY)
+
+
+NODES_ALLOCATABLE = _node_gauge(
+    "karpenter_nodes_allocatable", "Resources allocatable by nodes."
 )
+NODES_TOTAL_POD_REQUESTS = _node_gauge(
+    "karpenter_nodes_total_pod_requests",
+    "Total resources requested by non-daemonset pods on the node.",
+)
+NODES_TOTAL_POD_LIMITS = _node_gauge(
+    "karpenter_nodes_total_pod_limits",
+    "Total resource limits of non-daemonset pods on the node.",
+)
+NODES_TOTAL_DAEMON_REQUESTS = _node_gauge(
+    "karpenter_nodes_total_daemon_requests",
+    "Total resources requested by daemonset pods on the node.",
+)
+NODES_TOTAL_DAEMON_LIMITS = _node_gauge(
+    "karpenter_nodes_total_daemon_limits",
+    "Total resource limits of daemonset pods on the node.",
+)
+NODES_SYSTEM_OVERHEAD = _node_gauge(
+    "karpenter_nodes_system_overhead",
+    "Difference between node capacity and allocatable.",
+)
+
+# back-compat alias
+NODES_GAUGE = NODES_ALLOCATABLE
 
 PODS_STATE_GAUGE = Gauge(
     "karpenter_pods_state",

@@ -1,0 +1,77 @@
+"""Process options: flags with environment fallbacks.
+
+Mirrors ``pkg/utils/options``: cluster identity, ports, client QPS/burst,
+plus this framework's solver knobs; validated at startup
+(reference: utils/options/options.go:34-89, utils/env/env.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def _env(key: str, default: str) -> str:
+    return os.environ.get(key, default)
+
+
+@dataclass
+class Options:
+    cluster_name: str = field(default_factory=lambda: _env("CLUSTER_NAME", ""))
+    cluster_endpoint: str = field(default_factory=lambda: _env("CLUSTER_ENDPOINT", ""))
+    metrics_port: int = field(default_factory=lambda: int(_env("METRICS_PORT", "8080")))
+    health_probe_port: int = field(default_factory=lambda: int(_env("HEALTH_PROBE_PORT", "8081")))
+    kube_client_qps: float = field(default_factory=lambda: float(_env("KUBE_CLIENT_QPS", "200")))
+    kube_client_burst: int = field(default_factory=lambda: int(_env("KUBE_CLIENT_BURST", "300")))
+    cloud_provider: str = field(default_factory=lambda: _env("CLOUD_PROVIDER", "fake"))
+    # solver knobs (new in this framework)
+    default_solver: str = field(default_factory=lambda: _env("KARPENTER_SOLVER", "ffd"))
+    solver_service_address: str = field(
+        default_factory=lambda: _env("SOLVER_SERVICE_ADDRESS", "")
+    )  # empty = in-process
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.metrics_port <= 0 or self.metrics_port > 65535:
+            errs.append(f"metrics port {self.metrics_port} out of range")
+        if self.health_probe_port <= 0 or self.health_probe_port > 65535:
+            errs.append(f"health probe port {self.health_probe_port} out of range")
+        if self.kube_client_qps <= 0:
+            errs.append("kube client QPS must be positive")
+        if self.kube_client_burst <= 0:
+            errs.append("kube client burst must be positive")
+        if self.default_solver not in ("ffd", "tpu"):
+            errs.append(f"solver must be ffd|tpu, got {self.default_solver}")
+        return errs
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Options:
+    opts = Options()
+    ap = argparse.ArgumentParser(prog="karpenter-tpu")
+    ap.add_argument("--cluster-name", default=opts.cluster_name)
+    ap.add_argument("--cluster-endpoint", default=opts.cluster_endpoint)
+    ap.add_argument("--metrics-port", type=int, default=opts.metrics_port)
+    ap.add_argument("--health-probe-port", type=int, default=opts.health_probe_port)
+    ap.add_argument("--kube-client-qps", type=float, default=opts.kube_client_qps)
+    ap.add_argument("--kube-client-burst", type=int, default=opts.kube_client_burst)
+    ap.add_argument("--cloud-provider", default=opts.cloud_provider)
+    ap.add_argument("--default-solver", default=opts.default_solver)
+    ap.add_argument("--solver-service-address", default=opts.solver_service_address)
+    ns = ap.parse_args(argv)
+    out = Options(
+        cluster_name=ns.cluster_name,
+        cluster_endpoint=ns.cluster_endpoint,
+        metrics_port=ns.metrics_port,
+        health_probe_port=ns.health_probe_port,
+        kube_client_qps=ns.kube_client_qps,
+        kube_client_burst=ns.kube_client_burst,
+        cloud_provider=ns.cloud_provider,
+        default_solver=ns.default_solver,
+        solver_service_address=ns.solver_service_address,
+    )
+    errs = out.validate()
+    if errs:
+        ap.error("; ".join(errs))
+    return out

@@ -1,0 +1,58 @@
+"""Admission webhook: Provisioner defaulting and validation.
+
+Mirrors ``cmd/webhook`` + the knative admission plumbing: a ``defaulting``
+pass (spec defaults, then the cloud provider's DefaultHook) and a
+``validation`` pass (spec validation, then the ValidateHook)
+(reference: cmd/webhook/main.go:46-94, apis/provisioning/v1alpha5/
+provisioner_defaults.go:154-161, provisioner_validation.go:34-132,
+register.go:225-227).
+
+The provisioning controller re-runs both at Apply so the control loop is
+safe without the webhook (reference: provisioning/controller.go:94-95) — the
+webhook's job is fast feedback at ``kubectl apply`` time.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from karpenter_tpu.api.provisioner import (
+    SOLVER_FFD,
+    Provisioner,
+    default_provisioner,
+    validate_provisioner,
+)
+from karpenter_tpu.cloudprovider.types import CloudProvider
+
+
+class AdmissionError(Exception):
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+class Webhook:
+    def __init__(self, cloud_provider: CloudProvider, default_solver: str = SOLVER_FFD):
+        self.cloud_provider = cloud_provider
+        self.default_solver = default_solver
+
+    def default(self, provisioner: Provisioner) -> Provisioner:
+        """CRD defaulting: framework defaults then the vendor hook
+        (the /default-resource endpoint)."""
+        default_provisioner(provisioner, self.default_solver)
+        self.cloud_provider.default(provisioner.spec.constraints)
+        return provisioner
+
+    def validate(self, provisioner: Provisioner) -> None:
+        """CRD validation: framework rules then the vendor hook
+        (the /validate-resource endpoint). Raises AdmissionError."""
+        errs = validate_provisioner(provisioner)
+        errs += self.cloud_provider.validate(provisioner.spec.constraints)
+        if errs:
+            raise AdmissionError(errs)
+
+    def admit(self, provisioner: Provisioner) -> Provisioner:
+        """Default + validate, the full admission pass."""
+        self.default(provisioner)
+        self.validate(provisioner)
+        return provisioner

@@ -1,0 +1,191 @@
+"""Webhook admission, metrics controllers, options, registry, and the full
+runtime wiring (mirrors cmd/webhook, metrics node/pod suites, and
+cmd/controller/main.go)."""
+
+import time
+
+import pytest
+from prometheus_client import generate_latest
+
+from karpenter_tpu import metrics
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement, OwnerReference
+from karpenter_tpu.cloudprovider import registry
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.simulated import SimulatedCloudProvider
+from karpenter_tpu.controllers.metrics_node import NodeMetricsController
+from karpenter_tpu.controllers.metrics_pod import PodMetricsController
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.main import build_runtime
+from karpenter_tpu.options import Options, parse_args
+from karpenter_tpu.webhook import AdmissionError, Webhook
+from tests.factories import make_node, make_pod, make_provisioner
+
+
+def scrape() -> str:
+    return generate_latest(metrics.REGISTRY).decode()
+
+
+class TestWebhook:
+    def test_defaulting_applies_vendor_hook(self):
+        webhook = Webhook(SimulatedCloudProvider())
+        prov = make_provisioner()
+        webhook.default(prov)
+        c = prov.spec.constraints
+        assert c.requirements.capacity_types() == {lbl.CAPACITY_TYPE_ON_DEMAND}
+        assert c.requirements.architectures() == {lbl.ARCH_AMD64}
+
+    def test_validation_rejects_bad_spec(self):
+        webhook = Webhook(FakeCloudProvider(instance_types(2)))
+        prov = make_provisioner(ttl_after_empty=-1)
+        with pytest.raises(AdmissionError):
+            webhook.validate(prov)
+
+    def test_validation_rejects_vendor_errors(self):
+        webhook = Webhook(SimulatedCloudProvider())
+        prov = make_provisioner(provider={"imageFamily": "bogus"})
+        with pytest.raises(AdmissionError) as e:
+            webhook.admit(prov)
+        assert any("imageFamily" in err for err in e.value.errors)
+
+    def test_admit_passes_good_spec(self):
+        webhook = Webhook(SimulatedCloudProvider())
+        prov = make_provisioner()
+        assert webhook.admit(prov) is prov
+
+    def test_default_solver_flows_to_unset_provisioners(self):
+        webhook = Webhook(FakeCloudProvider(instance_types(2)), default_solver="tpu")
+        prov = make_provisioner()
+        prov.spec.solver = ""  # unset
+        webhook.default(prov)
+        assert prov.spec.solver == "tpu"
+        # explicit choice wins over the process default
+        prov2 = make_provisioner(solver="ffd")
+        webhook.default(prov2)
+        assert prov2.spec.solver == "ffd"
+
+    def test_restricted_requirement_op_rejected(self):
+        webhook = Webhook(FakeCloudProvider(instance_types(2)))
+        prov = make_provisioner(
+            requirements=[
+                NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="DoesNotExist")
+            ]
+        )
+        with pytest.raises(AdmissionError):
+            webhook.validate(prov)
+
+
+class TestNodeMetrics:
+    def test_gauges_published_and_removed(self):
+        cluster = Cluster()
+        controller = NodeMetricsController(cluster)
+        node = make_node(
+            name="metrics-node-1",
+            capacity={"cpu": "4", "memory": "8Gi"},
+            allocatable={"cpu": "3.8", "memory": "7Gi"},
+            provisioner_name="default",
+            labels={lbl.TOPOLOGY_ZONE: "z1", lbl.INSTANCE_TYPE: "t3", lbl.ARCH: "amd64",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+        )
+        cluster.create("nodes", node)
+        pod = make_pod(node_name="metrics-node-1", unschedulable=False, requests={"cpu": "1"})
+        cluster.create("pods", pod)
+        ds_pod = make_pod(node_name="metrics-node-1", unschedulable=False, requests={"cpu": "0.2"})
+        ds_pod.metadata.owner_references.append(
+            OwnerReference(api_version="apps/v1", kind="DaemonSet", name="ds")
+        )
+        cluster.create("pods", ds_pod)
+        controller.reconcile("metrics-node-1")
+        out = scrape()
+        assert 'karpenter_nodes_allocatable{arch="amd64"' in out
+        assert "karpenter_nodes_total_pod_requests" in out
+        assert "karpenter_nodes_total_daemon_requests" in out
+        assert "karpenter_nodes_system_overhead" in out
+        cluster.delete("nodes", "metrics-node-1", namespace="")
+        controller.reconcile("metrics-node-1")
+        assert 'node_name="metrics-node-1"' not in scrape()
+
+
+class TestPodMetrics:
+    def test_pod_state_gauge_lifecycle(self):
+        cluster = Cluster()
+        controller = PodMetricsController(cluster)
+        node = make_node(name="pm-node", provisioner_name="default",
+                         labels={lbl.TOPOLOGY_ZONE: "z9"})
+        cluster.create("nodes", node)
+        pod = make_pod(name="pm-pod", node_name="pm-node", unschedulable=False)
+        cluster.create("pods", pod)
+        controller.reconcile("pm-pod")
+        out = scrape()
+        assert 'karpenter_pods_state{' in out
+        assert 'name="pm-pod"' in out and 'zone="z9"' in out
+        cluster.delete("pods", "pm-pod")
+        controller.reconcile("pm-pod")
+        assert 'name="pm-pod"' not in scrape()
+
+
+class TestOptionsRegistry:
+    def test_options_defaults_valid(self):
+        assert Options().validate() == []
+
+    def test_parse_args_overrides(self):
+        opts = parse_args(["--cloud-provider", "simulated", "--default-solver", "tpu"])
+        assert opts.cloud_provider == "simulated"
+        assert opts.default_solver == "tpu"
+
+    def test_bad_solver_rejected(self):
+        with pytest.raises(SystemExit):
+            parse_args(["--default-solver", "quantum"])
+
+    def test_registry_builds_providers(self):
+        assert registry.new_cloud_provider("fake").name() == "fake"
+        assert registry.new_cloud_provider("simulated").name() == "simulated"
+        with pytest.raises(ValueError):
+            registry.new_cloud_provider("gcp")
+
+
+class TestRuntime:
+    def test_full_runtime_end_to_end(self):
+        """cmd/controller/main.go analog: start everything, create a
+        provisioner + pods, watch them get scheduled; then delete the node
+        and watch termination drain it."""
+        runtime = build_runtime(
+            cloud_provider=FakeCloudProvider(instance_types(10)), start_workers=True
+        )
+        runtime.manager.start()
+        try:
+            cluster = runtime.cluster
+            prov = runtime.webhook.admit(make_provisioner())
+            cluster.create("provisioners", prov)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not runtime.provisioning.list_workers():
+                time.sleep(0.02)
+            for w in runtime.provisioning.list_workers():
+                w.batcher.idle_duration = 0.05
+            pods = [make_pod(requests={"cpu": "1"}) for _ in range(3)]
+            for p in pods:
+                cluster.create("pods", p)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and any(
+                p.spec.node_name == "" for p in cluster.pods()
+            ):
+                time.sleep(0.05)
+            assert all(p.spec.node_name for p in cluster.pods())
+            assert cluster.nodes()
+            # usage accounting flowed into status
+            deadline = time.monotonic() + 5
+            prov_live = cluster.get("provisioners", "default", namespace="")
+            while time.monotonic() < deadline and not prov_live.status.resources:
+                time.sleep(0.05)
+            assert prov_live.status.resources
+            # now delete the node: termination should drain + remove it
+            node = cluster.nodes()[0]
+            cluster.delete("nodes", node.metadata.name, namespace="")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and cluster.try_get(
+                "nodes", node.metadata.name, namespace=""
+            ) is not None:
+                time.sleep(0.05)
+            assert cluster.try_get("nodes", node.metadata.name, namespace="") is None
+        finally:
+            runtime.stop()
