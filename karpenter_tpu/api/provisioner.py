@@ -127,7 +127,7 @@ class ProvisionerSpec:
     limits: Optional[Limits] = None
     # Scheduling backend: "ffd" (in-process) or "tpu" (batched tensor solve);
     # "" = unset, resolved to the process default at admission/apply.
-    solver: str = SOLVER_FFD
+    solver: str = ""
 
 
 def default_provisioner(provisioner: Provisioner, default_solver: str = SOLVER_FFD) -> None:

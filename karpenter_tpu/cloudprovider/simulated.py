@@ -282,7 +282,10 @@ class SimProviderConfig:
         errs = []
         if self.image_family not in IMAGE_FAMILIES:
             errs.append(f"imageFamily {self.image_family} not in {IMAGE_FAMILIES}")
-        if self.launch_template and self.security_group_selector_specified:
+        if self.launch_template and (
+            self.security_group_selector_specified
+            or self.security_group_selector != {"purpose": "nodes"}
+        ):
             # a custom launch template brings its own security groups
             errs.append("may not specify both launchTemplate and securityGroupSelector")
         for selector, name in ((self.subnet_selector, "subnetSelector"),

@@ -1,0 +1,199 @@
+"""Consolidation: cost-optimal re-pack of the live cluster.
+
+New capability beyond the reference snapshot (its deprovisioning is only
+emptiness/expiry TTLs — node/emptiness.go, node/expiration.go); required by
+BASELINE.json config 5 ("Consolidation re-pack of 1k live nodes"). The tensor
+formulation makes this natural: feed the *entire* cluster's pods through the
+same batched solver used for pending pods and compare the proposed packing's
+price against what is currently running.
+
+Plan: collect the provisioner's consolidatable nodes (ready, not deleting,
+no do-not-evict pods) and their reschedulable pods, re-solve in one batch,
+price both sides. Execute: launch the replacement nodes, migrate pods onto
+them (direct rebind — the same bind authority the provisioner already
+exercises for pending pods; a real-apiserver backend would evict and let the
+workload controller recreate), then delete the now-empty old nodes so the
+termination controller reclaims the instances.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Node, Pod
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
+from karpenter_tpu.controllers.provisioning import REQUEUE_INTERVAL
+from karpenter_tpu.kube.client import Cluster, Conflict
+from karpenter_tpu.scheduling.ffd import VirtualNode
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.utils import node as nodeutil
+from karpenter_tpu.utils import pod as podutil
+
+logger = logging.getLogger("karpenter.consolidation")
+
+# Savings below this fraction of current cost are not worth the churn.
+MIN_SAVINGS_FRACTION = 0.05
+
+
+@dataclass
+class ConsolidationPlan:
+    provisioner: Provisioner
+    nodes: List[Node] = field(default_factory=list)  # candidates, old world
+    pods: List[Pod] = field(default_factory=list)  # reschedulable pods
+    proposed: List[VirtualNode] = field(default_factory=list)  # new world
+    current_price: float = 0.0
+    proposed_price: float = 0.0
+
+    @property
+    def savings(self) -> float:
+        return self.current_price - self.proposed_price
+
+    @property
+    def worthwhile(self) -> bool:
+        if not self.nodes or self.current_price <= 0:
+            return False
+        # every reschedulable pod must have a seat in the new world
+        placed = sum(len(v.pods) for v in self.proposed)
+        if placed < len(self.pods):
+            return False
+        return self.savings / self.current_price >= MIN_SAVINGS_FRACTION
+
+
+class ConsolidationController:
+    """Batched re-pack + deprovision. Registered per provisioner; requeues on
+    the provisioning cadence."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        scheduler: Optional[Scheduler] = None,
+        enabled: bool = True,
+    ):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.scheduler = scheduler or Scheduler(cluster)
+        self.enabled = enabled
+
+    # -- planning ----------------------------------------------------------
+    def plan(self, provisioner: Provisioner) -> ConsolidationPlan:
+        catalog = self.cloud_provider.get_instance_types(
+            provisioner.spec.constraints.provider
+        )
+        price_by_type: Dict[str, float] = {it.name: it.effective_price() for it in catalog}
+        nodes, pods = self._candidates(provisioner)
+        plan = ConsolidationPlan(provisioner=provisioner, nodes=nodes, pods=pods)
+        if not nodes:
+            return plan
+        plan.current_price = sum(
+            price_by_type.get(n.metadata.labels.get(lbl.INSTANCE_TYPE, ""), 0.0)
+            for n in nodes
+        )
+        # the batched re-pack: the whole cluster's pods in ONE solve. Solve on
+        # clones — topology injection writes nodeSelectors — and re-resolve to
+        # the live objects at execution time.
+        clones = [copy.deepcopy(p) for p in pods]
+        plan.proposed = self.scheduler.solve(provisioner, catalog, clones) if pods else []
+        plan.proposed_price = sum(
+            v.instance_type_options[0].effective_price() for v in plan.proposed
+        )
+        return plan
+
+    def _candidates(self, provisioner: Provisioner) -> Tuple[List[Node], List[Pod]]:
+        """Nodes safe to consolidate and the pods that must be re-seated."""
+        nodes: List[Node] = []
+        pods: List[Pod] = []
+        # one pass over pods instead of a per-node scan (1k nodes × 10k pods
+        # would otherwise be 10M predicate evaluations)
+        by_node: Dict[str, List[Pod]] = {}
+        for p in self.cluster.pods():
+            if p.spec.node_name:
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        for node in self.cluster.nodes():
+            if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) != provisioner.name:
+                continue
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            if not nodeutil.is_ready(node) or node.spec.unschedulable:
+                continue
+            node_pods = [
+                p
+                for p in by_node.get(node.metadata.name, [])
+                if not podutil.is_terminal(p)
+                and not podutil.is_owned_by_daemonset(p)
+                and not podutil.is_owned_by_node(p)
+            ]
+            if any(
+                p.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true"
+                for p in node_pods
+            ):
+                continue
+            nodes.append(node)
+            pods.extend(node_pods)
+        return nodes, pods
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, plan: ConsolidationPlan) -> List[Node]:
+        """Launch the new world, migrate pods, retire the old world."""
+        launched: List[Node] = []
+        for vnode in plan.proposed:
+            node = self.cloud_provider.create(
+                NodeRequest(
+                    template=vnode.constraints,
+                    instance_type_options=vnode.instance_type_options,
+                )
+            )
+            template = vnode.constraints.to_node()
+            node.metadata.labels = {**template.metadata.labels, **node.metadata.labels}
+            node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] = plan.provisioner.name
+            node.metadata.finalizers = list(
+                set(node.metadata.finalizers) | set(template.metadata.finalizers)
+            )
+            # replacement nodes are immediately schedulable: consolidation
+            # binds directly, so the not-ready scheduler fence is unnecessary
+            node.spec.taints = [
+                t for t in template.spec.taints if t.key != lbl.NOT_READY_TAINT_KEY
+            ]
+            try:
+                self.cluster.create("nodes", node)
+            except Conflict:
+                pass
+            launched.append(node)
+            for pod in vnode.pods:
+                live = self.cluster.try_get("pods", pod.metadata.name, pod.metadata.namespace)
+                if live is not None:
+                    self.cluster.bind(live, node.metadata.name)
+        for old in plan.nodes:
+            try:
+                self.cluster.delete("nodes", old.metadata.name, namespace="")
+            except Exception:
+                logger.exception("retiring node %s", old.metadata.name)
+        logger.info(
+            "consolidated %d nodes -> %d nodes, price %.3f -> %.3f (saved %.3f)",
+            len(plan.nodes), len(launched),
+            plan.current_price, plan.proposed_price, plan.savings,
+        )
+        return launched
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self, name: str) -> Optional[float]:
+        if not self.enabled:
+            return None
+        provisioner = self.cluster.try_get("provisioners", name, namespace="")
+        if provisioner is None:
+            return None
+        plan = self.plan(provisioner)
+        if plan.worthwhile:
+            self.execute(plan)
+        return REQUEUE_INTERVAL
+
+    def register(self, manager) -> None:
+        def on_provisioner(event: str, provisioner) -> None:
+            manager.enqueue("consolidation", provisioner.metadata.name)
+
+        self.cluster.watch("provisioners", on_provisioner)

@@ -16,6 +16,7 @@ from typing import Optional
 
 from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.manager import Manager
 from karpenter_tpu.controllers.metrics_node import NodeMetricsController
@@ -44,11 +45,50 @@ class Runtime:
     selection: SelectionController
     termination: TerminationController
     webhook: Webhook
+    servers: list = None  # HTTP servers (metrics, health) when serving
 
     def stop(self) -> None:
         self.manager.stop()
         self.provisioning.stop()
         self.termination.stop()
+        for server in self.servers or []:
+            server.shutdown()
+
+
+def _serve_endpoints(runtime: Runtime) -> None:
+    """Prometheus registry on :metrics_port, healthz/readyz on
+    :health_probe_port (reference: cmd/controller/main.go:86-89,
+    controllers/manager.go:54-59)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from prometheus_client import start_http_server
+
+    from karpenter_tpu import metrics as m
+
+    metrics_server, _ = start_http_server(
+        runtime.options.metrics_port, registry=m.REGISTRY
+    )
+
+    manager = runtime.manager
+
+    class HealthHandler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path in ("/healthz", "/readyz"):
+                ok = manager.healthz()
+                self.send_response(200 if ok else 503)
+                self.end_headers()
+                self.wfile.write(b"ok" if ok else b"unhealthy")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # silence per-request stderr noise
+            return
+
+    health = HTTPServer(("0.0.0.0", runtime.options.health_probe_port), HealthHandler)
+    threading.Thread(target=health.serve_forever, daemon=True, name="healthz").start()
+    runtime.servers = [metrics_server, health]
 
 
 def build_runtime(
@@ -57,6 +97,7 @@ def build_runtime(
     cloud_provider: Optional[CloudProvider] = None,
     start_workers: bool = True,
     allow_pod_affinity: bool = True,
+    consolidation_enabled: bool = False,
 ) -> Runtime:
     """Assemble (but do not start) the full controller process."""
     options = options or Options()
@@ -75,6 +116,7 @@ def build_runtime(
     )
     termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
     node = NodeController(cluster)
+    consolidation = ConsolidationController(cluster, cloud_provider, enabled=consolidation_enabled)
     counter = CounterController(cluster)
     pvc = PVCController(cluster)
     metrics_node = NodeMetricsController(cluster)
@@ -86,6 +128,7 @@ def build_runtime(
     manager.register("selection", selection.reconcile, concurrency=32)
     manager.register("termination", termination.reconcile, concurrency=10)
     manager.register("node", node.reconcile, concurrency=10)
+    manager.register("consolidation", consolidation.reconcile, concurrency=2)
     manager.register("counter", counter.reconcile, concurrency=2)
     manager.register("pvc", pvc.reconcile, concurrency=2)
     manager.register("metrics_node", metrics_node.reconcile, concurrency=2)
@@ -99,6 +142,7 @@ def build_runtime(
         "pods", lambda e, o: manager.enqueue("selection", (o.metadata.name, o.metadata.namespace))
     )
     node.register(manager)
+    consolidation.register(manager)
     counter.register(manager)
     pvc.register(manager)
     termination.register(manager)
@@ -117,10 +161,12 @@ def build_runtime(
     )
 
 
-def run_controller_process(options: Optional[Options] = None) -> Runtime:
-    """The ``main()`` equivalent: build and start."""
+def run_controller_process(options: Optional[Options] = None, serve: bool = True) -> Runtime:
+    """The ``main()`` equivalent: build, start, and serve metrics/health."""
     runtime = build_runtime(options)
     runtime.manager.start()
+    if serve:
+        _serve_endpoints(runtime)
     logger.info(
         "karpenter-tpu controller started (provider=%s, solver=%s)",
         runtime.cloud_provider.name(),

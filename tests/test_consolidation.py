@@ -1,0 +1,162 @@
+"""Consolidation re-pack tests (BASELINE config 5 — capability beyond the
+reference): batched re-solve of live nodes, price accounting, safety gates,
+and end-to-end migration."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types, new_instance_type
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils import resources as res
+from tests.factories import make_node, make_pod, make_provisioner
+
+
+def build_env(catalog=None, solver="ffd"):
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog if catalog is not None else instance_types(20))
+    provisioner = make_provisioner(solver=solver)
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(
+        catalog_requirements(provider.get_instance_types())
+    )
+    cluster.create("provisioners", provisioner)
+    controller = ConsolidationController(cluster, provider)
+    return cluster, provider, provisioner, controller
+
+
+def fragmented_cluster(cluster, n_nodes=4, pods_per_node=1, instance_type="fake-it-19"):
+    """N big nodes each nearly empty — the classic consolidation target."""
+    for i in range(n_nodes):
+        node = make_node(
+            name=f"big-{i}",
+            capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+            provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: instance_type, lbl.TOPOLOGY_ZONE: "test-zone-1",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+            finalizers=[lbl.TERMINATION_FINALIZER],
+        )
+        cluster.create("nodes", node)
+        for j in range(pods_per_node):
+            cluster.create(
+                "pods",
+                make_pod(
+                    name=f"pod-{i}-{j}",
+                    requests={"cpu": "0.5"},
+                    node_name=node.metadata.name,
+                    unschedulable=False,
+                ),
+            )
+
+
+class TestPlanning:
+    def test_plan_finds_cheaper_packing(self):
+        cluster, provider, provisioner, controller = build_env()
+        fragmented_cluster(cluster)
+        plan = controller.plan(provisioner)
+        assert len(plan.nodes) == 4
+        assert len(plan.pods) == 4
+        assert plan.proposed  # everything fits on far fewer/cheaper nodes
+        assert plan.proposed_price < plan.current_price
+        assert plan.worthwhile
+
+    def test_empty_cluster_no_plan(self):
+        cluster, provider, provisioner, controller = build_env()
+        plan = controller.plan(provisioner)
+        assert not plan.worthwhile
+
+    def test_do_not_evict_node_excluded(self):
+        cluster, provider, provisioner, controller = build_env()
+        fragmented_cluster(cluster, n_nodes=2)
+        pod = cluster.get("pods", "pod-0-0")
+        pod.metadata.annotations[lbl.DO_NOT_EVICT_ANNOTATION] = "true"
+        plan = controller.plan(provisioner)
+        assert {n.metadata.name for n in plan.nodes} == {"big-1"}
+
+    def test_deleting_and_cordoned_nodes_excluded(self):
+        cluster, provider, provisioner, controller = build_env()
+        fragmented_cluster(cluster, n_nodes=3)
+        cluster.get("nodes", "big-0", namespace="").spec.unschedulable = True
+        cluster.delete("nodes", "big-1", namespace="")
+        plan = controller.plan(provisioner)
+        assert {n.metadata.name for n in plan.nodes} == {"big-2"}
+
+    def test_unplaceable_pods_block_consolidation(self):
+        """If the re-pack cannot seat every pod, the plan must not execute."""
+        catalog = [new_instance_type("tiny", resources={res.CPU: 1.0, res.PODS: 2.0})]
+        cluster, provider, provisioner, controller = build_env(catalog=catalog)
+        node = make_node(
+            name="old", capacity={"cpu": "64"}, provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: "huge-legacy"},
+        )
+        cluster.create("nodes", node)
+        cluster.create(
+            "pods",
+            make_pod(requests={"cpu": "32"}, node_name="old", unschedulable=False),
+        )
+        plan = controller.plan(provisioner)
+        assert sum(len(v.pods) for v in plan.proposed) == 0
+        assert not plan.worthwhile
+
+    def test_marginal_savings_not_worthwhile(self):
+        """Savings under the 5% churn threshold are rejected."""
+        cluster, provider, provisioner, controller = build_env()
+        # one pod on the node it would choose anyway → zero savings
+        node = make_node(
+            name="right-sized",
+            capacity={"cpu": "1", "memory": "2Gi", "pods": "10"},
+            provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: "fake-it-0", lbl.TOPOLOGY_ZONE: "test-zone-1",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+        )
+        cluster.create("nodes", node)
+        cluster.create(
+            "pods",
+            make_pod(requests={"cpu": "0.5"}, node_name="right-sized", unschedulable=False),
+        )
+        plan = controller.plan(provisioner)
+        assert not plan.worthwhile
+
+
+class TestExecution:
+    def test_execute_migrates_pods_and_retires_nodes(self):
+        cluster, provider, provisioner, controller = build_env()
+        fragmented_cluster(cluster)
+        plan = controller.plan(provisioner)
+        launched = controller.execute(plan)
+        assert len(launched) < 4  # consolidated
+        live_nodes = {
+            n.metadata.name
+            for n in cluster.nodes()
+            if n.metadata.deletion_timestamp is None
+        }
+        assert live_nodes == {n.metadata.name for n in launched}
+        for pod in cluster.pods():
+            assert pod.spec.node_name in live_nodes
+        # old nodes are terminating (finalizer-bearing), awaiting drain
+        for i in range(4):
+            old = cluster.try_get("nodes", f"big-{i}", namespace="")
+            assert old is None or old.metadata.deletion_timestamp is not None
+
+    def test_reconcile_runs_plan_and_requeues(self):
+        cluster, provider, provisioner, controller = build_env()
+        fragmented_cluster(cluster)
+        assert controller.reconcile("default") == 300.0
+        live = [n for n in cluster.nodes() if n.metadata.deletion_timestamp is None]
+        assert len(live) < 4
+
+    def test_disabled_controller_noop(self):
+        cluster, provider, provisioner, controller = build_env()
+        controller.enabled = False
+        fragmented_cluster(cluster)
+        assert controller.reconcile("default") is None
+        assert len(cluster.nodes()) == 4
+
+    def test_tpu_solver_consolidation(self):
+        cluster, provider, provisioner, controller = build_env(solver="tpu")
+        fragmented_cluster(cluster)
+        plan = controller.plan(provisioner)
+        assert plan.worthwhile
+        launched = controller.execute(plan)
+        assert 1 <= len(launched) < 4

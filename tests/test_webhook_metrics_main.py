@@ -54,9 +54,11 @@ class TestWebhook:
         assert webhook.admit(prov) is prov
 
     def test_default_solver_flows_to_unset_provisioners(self):
+        from karpenter_tpu.api.provisioner import Provisioner
+
         webhook = Webhook(FakeCloudProvider(instance_types(2)), default_solver="tpu")
-        prov = make_provisioner()
-        prov.spec.solver = ""  # unset
+        prov = Provisioner()  # solver left unset ("")
+        assert prov.spec.solver == ""
         webhook.default(prov)
         assert prov.spec.solver == "tpu"
         # explicit choice wins over the process default
@@ -142,6 +144,35 @@ class TestOptionsRegistry:
         assert registry.new_cloud_provider("simulated").name() == "simulated"
         with pytest.raises(ValueError):
             registry.new_cloud_provider("gcp")
+
+
+class TestServedEndpoints:
+    def test_metrics_and_healthz_served(self):
+        import socket
+        import urllib.request
+
+        from karpenter_tpu.main import run_controller_process
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        opts = Options(metrics_port=free_port(), health_probe_port=free_port())
+        runtime = run_controller_process(opts)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{opts.metrics_port}/metrics", timeout=5
+            ).read().decode()
+            assert "karpenter" in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{opts.health_probe_port}/healthz", timeout=5
+            )
+            assert health.status == 200
+        finally:
+            runtime.stop()
 
 
 class TestRuntime:
