@@ -53,6 +53,7 @@ UNAVAILABLE_OFFERINGS_TTL = 45.0  # reference: aws/instancetypes.go:41
 MAX_INSTANCE_TYPES = 20  # reference: aws/cloudprovider.go:57
 
 DEFAULT_IMAGE_FAMILY = "standard"
+DEFAULT_SELECTOR = {"purpose": "nodes"}
 IMAGE_FAMILIES = ("standard", "minimal", "gpu")
 
 
@@ -251,8 +252,8 @@ class SimProviderConfig:
     """The vendor block embedded in ``provisioner.spec.provider``."""
 
     instance_profile: str = ""
-    subnet_selector: Dict[str, str] = field(default_factory=lambda: {"purpose": "nodes"})
-    security_group_selector: Dict[str, str] = field(default_factory=lambda: {"purpose": "nodes"})
+    subnet_selector: Dict[str, str] = field(default_factory=lambda: dict(DEFAULT_SELECTOR))
+    security_group_selector: Dict[str, str] = field(default_factory=lambda: dict(DEFAULT_SELECTOR))
     image_family: str = DEFAULT_IMAGE_FAMILY
     tags: Dict[str, str] = field(default_factory=dict)
     launch_template: str = ""  # bring-your-own template name
@@ -267,9 +268,9 @@ class SimProviderConfig:
             return SimProviderConfig()
         return SimProviderConfig(
             instance_profile=provider.get("instanceProfile", ""),
-            subnet_selector=dict(provider.get("subnetSelector", {"purpose": "nodes"})),
+            subnet_selector=dict(provider.get("subnetSelector", DEFAULT_SELECTOR)),
             security_group_selector=dict(
-                provider.get("securityGroupSelector", {"purpose": "nodes"})
+                provider.get("securityGroupSelector", DEFAULT_SELECTOR)
             ),
             image_family=provider.get("imageFamily", DEFAULT_IMAGE_FAMILY),
             tags=dict(provider.get("tags", {})),
@@ -284,7 +285,7 @@ class SimProviderConfig:
             errs.append(f"imageFamily {self.image_family} not in {IMAGE_FAMILIES}")
         if self.launch_template and (
             self.security_group_selector_specified
-            or self.security_group_selector != {"purpose": "nodes"}
+            or self.security_group_selector != DEFAULT_SELECTOR
         ):
             # a custom launch template brings its own security groups
             errs.append("may not specify both launchTemplate and securityGroupSelector")

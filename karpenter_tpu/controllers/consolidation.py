@@ -72,12 +72,10 @@ class ConsolidationController:
         self,
         cluster: Cluster,
         cloud_provider: CloudProvider,
-        scheduler: Optional[Scheduler] = None,
         enabled: bool = True,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
-        self.scheduler = scheduler or Scheduler(cluster)
         self.enabled = enabled
 
     # -- planning ----------------------------------------------------------
@@ -95,14 +93,37 @@ class ConsolidationController:
             for n in nodes
         )
         # the batched re-pack: the whole cluster's pods in ONE solve. Solve on
-        # clones — topology injection writes nodeSelectors — and re-resolve to
-        # the live objects at execution time.
+        # clones — topology injection writes nodeSelectors — against a shadow
+        # cluster with the candidates removed: the candidates' own live pods
+        # must not count as existing topology/affinity occupants, or
+        # anti-affinity workloads could never consolidate (their old seats
+        # would block their new ones).
         clones = [copy.deepcopy(p) for p in pods]
-        plan.proposed = self.scheduler.solve(provisioner, catalog, clones) if pods else []
+        for clone in clones:
+            clone.spec.node_name = ""
+        shadow = self._shadow_cluster(nodes, pods)
+        scheduler = Scheduler(shadow)
+        plan.proposed = scheduler.solve(provisioner, catalog, clones) if pods else []
         plan.proposed_price = sum(
             v.instance_type_options[0].effective_price() for v in plan.proposed
         )
         return plan
+
+    def _shadow_cluster(self, excluded_nodes: List[Node], excluded_pods: List[Pod]) -> Cluster:
+        """The world as it will look once the candidates are gone: every
+        other node/pod plus the daemonsets (for overhead computation)."""
+        shadow = Cluster(clock=self.cluster.clock)
+        gone_nodes = {n.metadata.name for n in excluded_nodes}
+        gone_pods = {(p.metadata.namespace, p.metadata.name) for p in excluded_pods}
+        for node in self.cluster.nodes():
+            if node.metadata.name not in gone_nodes:
+                shadow.create("nodes", copy.deepcopy(node))
+        for pod in self.cluster.pods():
+            if (pod.metadata.namespace, pod.metadata.name) not in gone_pods:
+                shadow.create("pods", copy.deepcopy(pod))
+        for ds in self.cluster.daemonsets():
+            shadow.create("daemonsets", copy.deepcopy(ds))
+        return shadow
 
     def _candidates(self, provisioner: Provisioner) -> Tuple[List[Node], List[Pod]]:
         """Nodes safe to consolidate and the pods that must be re-seated."""

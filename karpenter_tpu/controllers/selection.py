@@ -7,8 +7,10 @@ and enqueue into its batcher (controller.go:61-115).
 
 Divergence from the reference: required pod affinity/anti-affinity is rejected
 there (controller.go:145-150); this framework schedules it (BASELINE config 3)
-when the routing controller is constructed with ``allow_pod_affinity=True``,
-validating only that the affinity topology keys are supported.
+via topology pre-assignment (scheduling/topology.py), so the routing
+controller accepts it by default, validating only that the affinity topology
+keys are supported. Pass ``allow_pod_affinity=False`` for reference-parity
+rejection.
 """
 
 from __future__ import annotations
@@ -250,7 +252,7 @@ class SelectionController:
         self,
         cluster: Cluster,
         provisioning_controller,
-        allow_pod_affinity: bool = False,
+        allow_pod_affinity: bool = True,
         clock=None,
         wait: bool = True,
     ):

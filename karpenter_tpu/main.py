@@ -97,10 +97,12 @@ def build_runtime(
     cloud_provider: Optional[CloudProvider] = None,
     start_workers: bool = True,
     allow_pod_affinity: bool = True,
-    consolidation_enabled: bool = False,
+    consolidation_enabled: Optional[bool] = None,
 ) -> Runtime:
     """Assemble (but do not start) the full controller process."""
     options = options or Options()
+    if consolidation_enabled is None:
+        consolidation_enabled = options.consolidation_enabled
     cluster = cluster or Cluster()
     cloud_provider = cloud_provider or registry.new_cloud_provider(options.cloud_provider)
 

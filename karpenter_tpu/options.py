@@ -31,6 +31,9 @@ class Options:
     solver_service_address: str = field(
         default_factory=lambda: _env("SOLVER_SERVICE_ADDRESS", "")
     )  # empty = in-process
+    consolidation_enabled: bool = field(
+        default_factory=lambda: _env("KARPENTER_CONSOLIDATION", "false").lower() == "true"
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -59,6 +62,12 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--cloud-provider", default=opts.cloud_provider)
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
+    ap.add_argument(
+        "--consolidation",
+        action="store_true",
+        default=opts.consolidation_enabled,
+        help="enable the consolidation (cost-optimal deprovisioning) controller",
+    )
     ns = ap.parse_args(argv)
     out = Options(
         cluster_name=ns.cluster_name,
@@ -70,6 +79,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         cloud_provider=ns.cloud_provider,
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
+        consolidation_enabled=ns.consolidation,
     )
     errs = out.validate()
     if errs:

@@ -1,12 +1,37 @@
-"""Topology-spread handling by pre-assignment.
+"""Topology handling by pre-assignment: spread constraints AND pod
+(anti-)affinity.
 
-Mirrors ``pkg/controllers/provisioning/scheduling/topology.go`` +
+Spread mirrors ``pkg/controllers/provisioning/scheduling/topology.go`` +
 ``topologygroup.go``: pods are grouped by equivalent (namespace, constraint);
 existing matching pods are counted per domain from the live cluster (zones:
 viable zones from requirements; hostnames: ``ceil(len(pods)/maxSkew)`` fresh
 generated names); then each pod gets the current min-count domain written into
 its nodeSelector, turning TopologySpreadConstraints into just-in-time
 NodeSelectors the packing core understands natively.
+
+Pod affinity/anti-affinity is NEW capability (BASELINE config 3; the
+reference rejects it at selection, selection/controller.go:145-150, with its
+intended semantics sketched by the skipped suite contexts,
+scheduling/suite_test.go:1014-1080). The same pre-assignment trick applies —
+pairwise pod×pod×domain constraints become per-pod domain decisions made
+sequentially against membership counters:
+
+- affinity(S, zone):    land in a zone already containing a pod matching S
+                        (cluster counts seed the table); a self-matching or
+                        batch-provided group with no existing matches gets a
+                        single seed zone so it co-locates with itself.
+- affinity(S, host):    the group shares one fresh hostname — one node.
+- anti(S, zone):        land in a zone with zero matches; each placed pod
+                        that matches S claims its zone.
+- anti(S, host):        pods matching S get one fresh hostname each (pairwise
+                        separation); non-matching pods share a separate fresh
+                        hostname away from the providers.
+
+Pods with unsatisfiable rules get a sentinel domain no node can offer, so the
+packer counts and logs them unschedulable instead of mis-placing them.
+
+Because both backends consume the injected NodeSelectors, affinity support
+lands in the FFD packer and the TPU batch solver simultaneously.
 """
 
 from __future__ import annotations
@@ -17,12 +42,23 @@ import string
 from typing import Dict, List, Optional, Set, Tuple
 
 from karpenter_tpu.api import labels as lbl
-from karpenter_tpu.api.objects import Pod, TopologySpreadConstraint
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    NodeSelectorRequirement,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.api.requirements import Requirements
-from karpenter_tpu.api.objects import NodeSelectorRequirement
 from karpenter_tpu.kube.client import Cluster
 from karpenter_tpu.utils import pod as podutil
+
+# A domain no catalog offers: forces "no instance type satisfied" for pods
+# whose affinity rules cannot be met, keeping them visibly unschedulable.
+UNSATISFIABLE_DOMAIN = "unsatisfiable.karpenter.sh"
+
+SUPPORTED_AFFINITY_KEYS = (lbl.HOSTNAME, lbl.TOPOLOGY_ZONE)
 
 
 class TopologyGroup:
@@ -57,15 +93,48 @@ class TopologyGroup:
         return min_domain
 
 
+class AffinityGroup:
+    """Pods sharing one required pod (anti-)affinity term."""
+
+    def __init__(self, namespace: str, term: PodAffinityTerm, anti: bool):
+        self.namespace = namespace
+        self.term = term
+        self.anti = anti
+        self.pods: List[Pod] = []
+        # domain -> number of pods matching the term's selector there
+        self.match_counts: Dict[str, int] = {}
+
+    @property
+    def key(self) -> str:
+        return self.term.topology_key
+
+    def selector_matches(self, pod: Pod) -> bool:
+        if pod.metadata.namespace not in self.namespaces():
+            return False
+        sel = self.term.label_selector
+        return sel is None or sel.matches(pod.metadata.labels)
+
+    def namespaces(self) -> Set[str]:
+        return set(self.term.namespaces) if self.term.namespaces else {self.namespace}
+
+
+def _selector_key(sel: Optional[LabelSelector]) -> Tuple:
+    if sel is None:
+        return ()
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple((e.key, e.operator, tuple(e.values)) for e in sel.match_expressions),
+    )
+
+
 def _group_key(namespace: str, c: TopologySpreadConstraint) -> Tuple:
-    sel = c.label_selector
-    sel_key: Tuple = ()
-    if sel is not None:
-        sel_key = (
-            tuple(sorted(sel.match_labels.items())),
-            tuple((e.key, e.operator, tuple(e.values)) for e in sel.match_expressions),
-        )
-    return (namespace, c.max_skew, c.topology_key, c.when_unsatisfiable, sel_key)
+    return (namespace, c.max_skew, c.topology_key, c.when_unsatisfiable,
+            _selector_key(c.label_selector))
+
+
+def _affinity_key(namespace: str, term: PodAffinityTerm, anti: bool) -> Tuple:
+    ns = tuple(sorted(term.namespaces)) if term.namespaces else (namespace,)
+    return (anti, ns, term.topology_key, _selector_key(term.label_selector))
 
 
 class Topology:
@@ -73,12 +142,193 @@ class Topology:
         self.cluster = cluster
         self.rng = rng or random.Random()
 
+    # -- public ------------------------------------------------------------
     def inject(self, constraints: Constraints, pods: List[Pod]) -> None:
         """Write a topology-chosen domain into each pod's nodeSelector
-        (reference: topology.go:41-57). Mutates pods and, for hostname
-        spread, the constraints' requirements."""
+        (reference: topology.go:41-57). Affinity first — its choices narrow
+        what spread sees — then spread. Mutates pods and, for hostname
+        domains, the constraints' requirements."""
+        generated_hostnames: List[str] = []
+        self._inject_affinity(constraints, pods, generated_hostnames)
+        self._inject_spread(constraints, pods, generated_hostnames)
+        if generated_hostnames:
+            # one registration for the union: per-group adds would intersect
+            # per-key sets and empty the hostname domain
+            constraints.requirements = constraints.requirements.add(
+                NodeSelectorRequirement(
+                    key=lbl.HOSTNAME, operator="In", values=generated_hostnames
+                )
+            )
+
+    # -- pod (anti-)affinity ----------------------------------------------
+    def _inject_affinity(
+        self,
+        constraints: Constraints,
+        pods: List[Pod],
+        generated_hostnames: List[str],
+    ) -> None:
+        groups = self._affinity_groups(pods)
+        if not groups:
+            return
+        batch = list(pods)
+        # anti-affinity first: it is the more constrained rule (needs empty
+        # domains), and affinity groups can then adopt whatever domains the
+        # anti pass pinned instead of greedily seeding a conflicting one
+        groups.sort(key=lambda g: not g.anti)
+        for group in groups:
+            if group.key == lbl.TOPOLOGY_ZONE:
+                self._assign_zonal_affinity(constraints, group, batch)
+            elif group.key == lbl.HOSTNAME:
+                self._assign_hostname_affinity(group, batch, generated_hostnames)
+
+    def _affinity_groups(self, pods: List[Pod]) -> List[AffinityGroup]:
+        groups: Dict[Tuple, AffinityGroup] = {}
+        for pod in pods:
+            aff = pod.spec.affinity
+            if aff is None:
+                continue
+            terms: List[Tuple[PodAffinityTerm, bool]] = []
+            if aff.pod_affinity is not None:
+                terms += [(t, False) for t in aff.pod_affinity.required]
+            if aff.pod_anti_affinity is not None:
+                terms += [(t, True) for t in aff.pod_anti_affinity.required]
+            for term, anti in terms:
+                if term.topology_key not in SUPPORTED_AFFINITY_KEYS:
+                    continue
+                key = _affinity_key(pod.metadata.namespace, term, anti)
+                group = groups.get(key)
+                if group is None:
+                    group = groups[key] = AffinityGroup(pod.metadata.namespace, term, anti)
+                group.pods.append(pod)
+        return list(groups.values())
+
+    def _count_cluster_matches(self, group: AffinityGroup) -> None:
+        """Seed match counts from scheduled cluster pods, keyed by their
+        node's topology domain."""
+        for namespace in group.namespaces():
+            for p in self.cluster.list_pods_matching(namespace, group.term.label_selector):
+                if ignored_for_topology(p):
+                    continue
+                node = self.cluster.try_get("nodes", p.spec.node_name, namespace="")
+                if node is None:
+                    continue
+                domain = node.metadata.labels.get(group.key)
+                if domain is not None:
+                    group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+
+    def _allowed_domains(
+        self, constraints: Constraints, pod: Pod, key: str, domains: Set[str]
+    ) -> Set[str]:
+        allowed_set = constraints.requirements.merge(Requirements.from_pod(pod)).get(key)
+        return {d for d in domains if allowed_set.has(d)}
+
+    def _assign_zonal_affinity(
+        self, constraints: Constraints, group: AffinityGroup, batch: List[Pod]
+    ) -> None:
+        self._count_cluster_matches(group)
+        viable = constraints.requirements.zones()
+        if group.anti:
+            for pod in group.pods:
+                allowed = self._allowed_domains(constraints, pod, group.key, viable)
+                free = sorted(d for d in allowed if group.match_counts.get(d, 0) == 0)
+                domain = free[0] if free else UNSATISFIABLE_DOMAIN
+                _set_domain(pod, group.key, domain)
+                if domain != UNSATISFIABLE_DOMAIN and group.selector_matches(pod):
+                    group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+            return
+        # affinity: most-populated existing domain, else a seed the group
+        # itself (or a batch provider) will populate
+        for pod in group.pods:
+            allowed = self._allowed_domains(constraints, pod, group.key, viable)
+            populated = sorted(
+                (d for d in allowed if group.match_counts.get(d, 0) > 0),
+                key=lambda d: (-group.match_counts[d], d),
+            )
+            if populated:
+                domain = populated[0]
+            else:
+                provider, pinned = self._batch_provider(group, batch)
+                if provider is None or not allowed:
+                    domain = UNSATISFIABLE_DOMAIN
+                elif pinned is not None:
+                    # adopt the provider's already-pinned domain if this pod
+                    # may go there; else unsatisfiable
+                    domain = pinned if pinned in allowed else UNSATISFIABLE_DOMAIN
+                else:
+                    domain = sorted(allowed)[0]
+                if domain != UNSATISFIABLE_DOMAIN and provider is not pod:
+                    # ensure the provider actually lands there
+                    _set_domain(provider, group.key, domain)
+                    if group.selector_matches(provider):
+                        group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+            _set_domain(pod, group.key, domain)
+            if domain != UNSATISFIABLE_DOMAIN and group.selector_matches(pod):
+                group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+
+    def _assign_hostname_affinity(
+        self, group: AffinityGroup, batch: List[Pod], generated_hostnames: List[str]
+    ) -> None:
+        if group.anti:
+            shared_for_nonmatching: Optional[str] = None
+            for pod in group.pods:
+                if group.selector_matches(pod):
+                    # pairwise separation: a fresh node each
+                    domain = self._fresh_hostname(generated_hostnames)
+                else:
+                    # must only avoid the providers' nodes; share one
+                    if shared_for_nonmatching is None:
+                        shared_for_nonmatching = self._fresh_hostname(generated_hostnames)
+                    domain = shared_for_nonmatching
+                _set_domain(pod, group.key, domain)
+            return
+        # affinity: the whole group lands on one fresh node, provided the
+        # match can come from the group itself or another batch pod
+        provider, pinned = self._batch_provider(group, batch)
+        if provider is None:
+            for pod in group.pods:
+                _mark_unschedulable(pod)
+            return
+        shared = pinned if pinned is not None else self._fresh_hostname(generated_hostnames)
+        _set_domain(provider, group.key, shared)
+        for pod in group.pods:
+            _set_domain(pod, group.key, shared)
+
+    @staticmethod
+    def _batch_provider(
+        group: AffinityGroup, batch: List[Pod]
+    ) -> Tuple[Optional[Pod], Optional[str]]:
+        """A batch pod that satisfies the group's selector — preferring group
+        members (self-affinity), then unpinned batch pods, then batch pods
+        already pinned to a domain (returned so the group can adopt it)."""
+        pinned_candidate: Optional[Pod] = None
+        for pod in group.pods:
+            if group.selector_matches(pod):
+                return pod, pod.spec.node_selector.get(group.key)
+        for pod in batch:
+            if not group.selector_matches(pod):
+                continue
+            if group.key not in pod.spec.node_selector:
+                return pod, None
+            if pinned_candidate is None:
+                pinned_candidate = pod
+        if pinned_candidate is not None:
+            return pinned_candidate, pinned_candidate.spec.node_selector[group.key]
+        return None, None
+
+    def _fresh_hostname(self, generated_hostnames: List[str]) -> str:
+        name = "".join(self.rng.choices(string.ascii_lowercase + string.digits, k=8))
+        generated_hostnames.append(name)
+        return name
+
+    # -- topology spread ---------------------------------------------------
+    def _inject_spread(
+        self,
+        constraints: Constraints,
+        pods: List[Pod],
+        generated_hostnames: List[str],
+    ) -> None:
         for group in self._topology_groups(pods):
-            self._compute_current_topology(constraints, group)
+            self._compute_current_topology(constraints, group, generated_hostnames)
             for pod in group.pods:
                 allowed_set = (
                     constraints.requirements.merge(Requirements.from_pod(pod))
@@ -88,8 +338,20 @@ class Topology:
                 # come from the viable-zone registration. Either way the pod's
                 # own requirements may narrow them.
                 allowed = {d for d in group.spread if allowed_set.has(d)}
+                if group.constraint.topology_key == lbl.HOSTNAME:
+                    # generated hostnames are registered after injection, so
+                    # the base constraint cannot veto them yet
+                    allowed = {
+                        d for d in group.spread
+                        if d in generated_hostnames or allowed_set.has(d)
+                    }
+                    pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+                    if pinned is not None:
+                        allowed &= {pinned}
                 domain = group.next_domain(allowed)
-                pod.spec.node_selector = {**pod.spec.node_selector, group.constraint.topology_key: domain}
+                pod.spec.node_selector = {
+                    **pod.spec.node_selector, group.constraint.topology_key: domain
+                }
 
     def _topology_groups(self, pods: List[Pod]) -> List[TopologyGroup]:
         groups: Dict[Tuple, TopologyGroup] = {}
@@ -102,26 +364,33 @@ class Topology:
                     groups[key] = TopologyGroup(pod, constraint)
         return list(groups.values())
 
-    def _compute_current_topology(self, constraints: Constraints, group: TopologyGroup) -> None:
+    def _compute_current_topology(
+        self,
+        constraints: Constraints,
+        group: TopologyGroup,
+        generated_hostnames: List[str],
+    ) -> None:
         key = group.constraint.topology_key
         if key == lbl.HOSTNAME:
-            self._compute_hostname_topology(group, constraints)
+            self._compute_hostname_topology(group, generated_hostnames)
         elif key == lbl.TOPOLOGY_ZONE:
             self._compute_zonal_topology(constraints, group)
 
-    def _compute_hostname_topology(self, group: TopologyGroup, constraints: Constraints) -> None:
+    def _compute_hostname_topology(
+        self, group: TopologyGroup, generated_hostnames: List[str]
+    ) -> None:
         """Fresh nodes are empty, so the global hostname minimum is 0; we
         generate ceil(n/maxSkew) domains so skew cannot be violated
         (reference: topology.go:98-112)."""
         n_domains = math.ceil(len(group.pods) / max(group.constraint.max_skew, 1))
-        domains = [
-            "".join(self.rng.choices(string.ascii_lowercase + string.digits, k=8))
-            for _ in range(n_domains)
-        ]
+        domains = [self._fresh_hostname(generated_hostnames) for _ in range(n_domains)]
+        # pods already pinned to a hostname by affinity participate with that
+        # hostname as a registered domain
+        for pod in group.pods:
+            pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+            if pinned is not None:
+                group.register(pinned)
         group.register(*domains)
-        constraints.requirements = constraints.requirements.add(
-            NodeSelectorRequirement(key=lbl.HOSTNAME, operator="In", values=domains)
-        )
 
     def _compute_zonal_topology(self, constraints: Constraints, group: TopologyGroup) -> None:
         """Viable zones become the domains; existing matching cluster pods
@@ -140,6 +409,17 @@ class Topology:
             domain = node.metadata.labels.get(group.constraint.topology_key)
             if domain is not None:
                 group.increment(domain)
+
+
+def _set_domain(pod: Pod, key: str, domain: str) -> None:
+    pod.spec.node_selector = {**pod.spec.node_selector, key: domain}
+
+
+def _mark_unschedulable(pod: Pod) -> None:
+    """Pin the pod to a zone no offering can provide: zone feasibility is
+    enforced by the instance-type offering filter for every catalog, unlike
+    hostname, so this reliably drops (and logs) the pod at pack time."""
+    _set_domain(pod, lbl.TOPOLOGY_ZONE, UNSATISFIABLE_DOMAIN)
 
 
 def ignored_for_topology(p: Pod) -> bool:

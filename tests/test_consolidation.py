@@ -153,6 +153,39 @@ class TestExecution:
         assert controller.reconcile("default") is None
         assert len(cluster.nodes()) == 4
 
+    def test_anti_affinity_workload_can_consolidate(self):
+        """The candidates' own live pods must not block their re-pack: two
+        anti-affinity pods on two huge nodes consolidate onto two cheap nodes
+        (their old seats don't count as occupied zones)."""
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+        cluster, provider, provisioner, controller = build_env()
+        sel = {"app": "ha"}
+        for i, zone in enumerate(["test-zone-1", "test-zone-2"]):
+            node = make_node(
+                name=f"huge-{i}",
+                capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+                provisioner_name="default",
+                labels={lbl.INSTANCE_TYPE: "fake-it-19", lbl.TOPOLOGY_ZONE: zone,
+                        lbl.CAPACITY_TYPE: "on-demand"},
+                finalizers=[lbl.TERMINATION_FINALIZER],
+            )
+            cluster.create("nodes", node)
+            pod = make_pod(
+                name=f"ha-{i}", labels=sel, requests={"cpu": "0.5"},
+                node_name=node.metadata.name, unschedulable=False,
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels=sel),
+                        topology_key=lbl.TOPOLOGY_ZONE,
+                    )
+                ],
+            )
+            cluster.create("pods", pod)
+        plan = controller.plan(provisioner)
+        assert sum(len(v.pods) for v in plan.proposed) == 2  # both re-seated
+        assert plan.worthwhile
+
     def test_tpu_solver_consolidation(self):
         cluster, provider, provisioner, controller = build_env(solver="tpu")
         fragmented_cluster(cluster)
