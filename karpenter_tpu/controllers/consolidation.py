@@ -73,10 +73,12 @@ class ConsolidationController:
         cluster: Cluster,
         cloud_provider: CloudProvider,
         enabled: bool = True,
+        solver_service_address: Optional[str] = None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.enabled = enabled
+        self.solver_service_address = solver_service_address
 
     # -- planning ----------------------------------------------------------
     def plan(self, provisioner: Provisioner) -> ConsolidationPlan:
@@ -102,7 +104,7 @@ class ConsolidationController:
         for clone in clones:
             clone.spec.node_name = ""
         shadow = self._shadow_cluster(nodes, pods)
-        scheduler = Scheduler(shadow)
+        scheduler = Scheduler(shadow, solver_service_address=self.solver_service_address)
         plan.proposed = scheduler.solve(provisioner, catalog, clones) if pods else []
         plan.proposed_price = sum(
             v.instance_type_options[0].effective_price() for v in plan.proposed
@@ -111,18 +113,20 @@ class ConsolidationController:
 
     def _shadow_cluster(self, excluded_nodes: List[Node], excluded_pods: List[Pod]) -> Cluster:
         """The world as it will look once the candidates are gone: every
-        other node/pod plus the daemonsets (for overhead computation)."""
+        other node/pod plus the daemonsets (for overhead computation). The
+        shadow is read-only for the solve, so live objects are seeded as-is —
+        no O(cluster) deepcopy per planning tick."""
         shadow = Cluster(clock=self.cluster.clock)
         gone_nodes = {n.metadata.name for n in excluded_nodes}
         gone_pods = {(p.metadata.namespace, p.metadata.name) for p in excluded_pods}
         for node in self.cluster.nodes():
             if node.metadata.name not in gone_nodes:
-                shadow.create("nodes", copy.deepcopy(node))
+                shadow.seed("nodes", node)
         for pod in self.cluster.pods():
             if (pod.metadata.namespace, pod.metadata.name) not in gone_pods:
-                shadow.create("pods", copy.deepcopy(pod))
+                shadow.seed("pods", pod)
         for ds in self.cluster.daemonsets():
-            shadow.create("daemonsets", copy.deepcopy(ds))
+            shadow.seed("daemonsets", ds)
         return shadow
 
     def _candidates(self, provisioner: Provisioner) -> Tuple[List[Node], List[Pod]]:

@@ -57,11 +57,14 @@ class ProvisionerWorker:
         cloud_provider: CloudProvider,
         scheduler: Optional[Scheduler] = None,
         batcher: Optional[Batcher] = None,
+        solver_service_address: Optional[str] = None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud_provider = cloud_provider
-        self.scheduler = scheduler or Scheduler(cluster)
+        self.scheduler = scheduler or Scheduler(
+            cluster, solver_service_address=solver_service_address
+        )
         self.batcher = batcher or Batcher()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -199,11 +202,13 @@ class ProvisioningController:
         cloud_provider: CloudProvider,
         start_workers: bool = True,
         default_solver: str = SOLVER_FFD,
+        solver_service_address: Optional[str] = None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
         self.default_solver = default_solver
+        self.solver_service_address = solver_service_address
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -239,7 +244,10 @@ class ProvisioningController:
                 self.workers[provisioner.name].provisioner = enriched
                 return
             old = self.workers.pop(provisioner.name, None)
-            worker = ProvisionerWorker(enriched, self.cluster, self.cloud_provider)
+            worker = ProvisionerWorker(
+                enriched, self.cluster, self.cloud_provider,
+                solver_service_address=self.solver_service_address,
+            )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h
             if self.start_workers:

@@ -68,6 +68,14 @@ class Cluster:
     def watch(self, kind: str, fn: WatchFn) -> None:
         self._stores[kind].watchers.append(fn)
 
+    def seed(self, kind: str, obj) -> object:
+        """Insert an object WITHOUT mutating it or dispatching events — for
+        read-only shadow stores built from live objects (consolidation
+        planning); the live cluster remains the owner of the object."""
+        with self._lock:
+            self._stores[kind].objects[self._key(obj)] = obj
+        return obj
+
     def create(self, kind: str, obj) -> object:
         with self._lock:
             store = self._stores[kind]

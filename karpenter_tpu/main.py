@@ -112,13 +112,19 @@ def build_runtime(
         cloud_provider,
         start_workers=start_workers,
         default_solver=options.default_solver,
+        solver_service_address=options.solver_service_address or None,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity
     )
     termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
     node = NodeController(cluster)
-    consolidation = ConsolidationController(cluster, cloud_provider, enabled=consolidation_enabled)
+    consolidation = ConsolidationController(
+        cluster,
+        cloud_provider,
+        enabled=consolidation_enabled,
+        solver_service_address=options.solver_service_address or None,
+    )
     counter = CounterController(cluster)
     pvc = PVCController(cluster)
     metrics_node = NodeMetricsController(cluster)

@@ -64,9 +64,10 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
     ap.add_argument(
         "--consolidation",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
         default=opts.consolidation_enabled,
-        help="enable the consolidation (cost-optimal deprovisioning) controller",
+        help="enable the consolidation (cost-optimal deprovisioning) controller"
+        " (--no-consolidation overrides KARPENTER_CONSOLIDATION=true)",
     )
     ns = ap.parse_args(argv)
     out = Options(

@@ -19,17 +19,25 @@ from karpenter_tpu import metrics
 
 
 class Scheduler:
-    def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
+    def __init__(
+        self,
+        cluster: Cluster,
+        rng: Optional[random.Random] = None,
+        solver_service_address: Optional[str] = None,
+    ):
         self.cluster = cluster
         self.ffd = FFDScheduler(cluster, rng=rng)
         self._tpu = None  # built lazily: importing jax is not free
         self._rng = rng
+        self._service_address = solver_service_address
 
     def _tpu_scheduler(self):
         if self._tpu is None:
             from karpenter_tpu.solver.backend import TpuScheduler
 
-            self._tpu = TpuScheduler(self.cluster, rng=self._rng)
+            self._tpu = TpuScheduler(
+                self.cluster, rng=self._rng, service_address=self._service_address
+            )
         return self._tpu
 
     def solve(

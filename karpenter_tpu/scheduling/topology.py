@@ -255,7 +255,14 @@ class Topology:
                     # may go there; else unsatisfiable
                     domain = pinned if pinned in allowed else UNSATISFIABLE_DOMAIN
                 else:
-                    domain = sorted(allowed)[0]
+                    # seed a domain BOTH the consumer and the provider may
+                    # use — pinning the provider outside its own node
+                    # affinity would render it unschedulable
+                    provider_allowed = self._allowed_domains(
+                        constraints, provider, group.key, viable
+                    )
+                    joint = sorted(allowed & provider_allowed)
+                    domain = joint[0] if joint else UNSATISFIABLE_DOMAIN
                 if domain != UNSATISFIABLE_DOMAIN and provider is not pod:
                     # ensure the provider actually lands there
                     _set_domain(provider, group.key, domain)

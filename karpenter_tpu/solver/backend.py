@@ -31,10 +31,48 @@ logger = logging.getLogger("karpenter.solver")
 
 
 class TpuScheduler:
-    def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
+    def __init__(
+        self,
+        cluster: Cluster,
+        rng: Optional[random.Random] = None,
+        service_address: Optional[str] = None,
+    ):
         self.cluster = cluster
         self.topology = Topology(cluster, rng=rng)
         self._ffd_fallback = FFDScheduler(cluster, rng=rng)
+        # remote sidecar transport (SURVEY §5.8); None = in-process kernel
+        self.service_address = service_address
+        self._remote = None
+
+    def _pack(self, batch: enc.EncodedBatch):
+        """Run the packing kernel — on the sidecar when configured, with the
+        in-process kernel as the availability fallback."""
+        args = (
+            batch.pod_valid,
+            batch.pod_open_sig,
+            batch.pod_core,
+            batch.pod_host,
+            batch.pod_host_in_base,
+            batch.pod_open_host,
+            batch.pod_req,
+            batch.join_table,
+            batch.frontiers,
+            batch.daemon,
+        )
+        n_max = len(batch.pod_valid)
+        if self.service_address:
+            try:
+                if self._remote is None:
+                    from karpenter_tpu.solver.service import RemoteSolver
+
+                    self._remote = RemoteSolver(self.service_address)
+                return self._remote.pack(*args, n_max=n_max)
+            except Exception:
+                logger.exception(
+                    "solver service %s failed; using in-process kernel",
+                    self.service_address,
+                )
+        return kernel.pack(*args, n_max=n_max)
 
     def solve(
         self,
@@ -56,19 +94,7 @@ class TpuScheduler:
             logger.warning("falling back to FFD: %s", e)
             return self._ffd_fallback.solve_injected(constraints, instance_types, pods, daemon)
 
-        result = kernel.pack(
-            batch.pod_valid,
-            batch.pod_open_sig,
-            batch.pod_core,
-            batch.pod_host,
-            batch.pod_host_in_base,
-            batch.pod_open_host,
-            batch.pod_req,
-            batch.join_table,
-            batch.frontiers,
-            batch.daemon,
-            n_max=len(batch.pod_valid),
-        )
+        result = self._pack(batch)
         return self._decode(batch, result, constraints, instance_types)
 
     def _decode(
@@ -84,7 +110,7 @@ class TpuScheduler:
 
         assignment, node_sig, node_host, node_req, n_nodes_arr = jax.device_get(tuple(result))
         assignment = assignment[: batch.n_pods]
-        n_nodes = int(n_nodes_arr)
+        n_nodes = int(np.asarray(n_nodes_arr).reshape(-1)[0])
 
         unschedulable = int((assignment < 0).sum())
         if unschedulable:

@@ -1,0 +1,200 @@
+"""The solve service: gRPC transport to a JAX solver sidecar.
+
+SURVEY §5.8 / BASELINE north star: the reconcile loop ships the encoded solve
+request to a sidecar owning the TPU (host↔TPU over PCIe/ICI being the analog
+of the reference's in-process function call), selected per-process via
+``--solver-service-address``; the in-process packer remains the fallback.
+
+Wire format: **flat little-endian buffers, not protobuf message trees**
+(SURVEY hard-part #6 — 10k pods × 512 types must round-trip well under
+100ms). A message is::
+
+    magic "KTPU" | u16 version | u16 array count
+    per array: u8 dtype code | u8 ndim | u32 dims... | raw C-order bytes
+
+The RPC surface is one unary method ``/karpenter.solver.v1.Solver/Pack``
+registered through gRPC's generic handler with identity (bytes) serializers,
+so no generated stubs are needed. Request = the 10 ``kernel.pack`` inputs
+(+ n_max as a scalar array); response = the 5 ``PackResult`` arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+import threading
+from concurrent import futures
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("karpenter.solver.service")
+
+MAGIC = b"KTPU"
+VERSION = 1
+METHOD = "/karpenter.solver.v1.Solver/Pack"
+
+_DTYPES = {0: np.dtype(np.bool_), 1: np.dtype(np.int32), 2: np.dtype(np.float32)}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+# ---------------------------------------------------------------------------
+# flat buffer codec
+# ---------------------------------------------------------------------------
+
+
+def pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    parts: List[bytes] = [MAGIC, struct.pack("<HH", VERSION, len(arrays))]
+    for a in arrays:
+        # NOT ascontiguousarray: it promotes 0-d scalars to 1-d
+        a = np.asarray(a, order="C")
+        code = _DTYPE_CODES.get(a.dtype)
+        if code is None:
+            # normalize off-spec dtypes (e.g. int64 scalars, float64)
+            if np.issubdtype(a.dtype, np.floating):
+                a = a.astype(np.float32)
+            elif np.issubdtype(a.dtype, np.bool_):
+                a = a.astype(np.bool_)
+            else:
+                a = a.astype(np.int32)
+            code = _DTYPE_CODES[a.dtype]
+        parts.append(struct.pack("<BB", code, a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def unpack_arrays(data: bytes) -> List[np.ndarray]:
+    if data[:4] != MAGIC:
+        raise ValueError("bad magic")
+    version, count = struct.unpack_from("<HH", data, 4)
+    if version != VERSION:
+        raise ValueError(f"unsupported version {version}")
+    offset = 8
+    out: List[np.ndarray] = []
+    for _ in range(count):
+        code, ndim = struct.unpack_from("<BB", data, offset)
+        offset += 2
+        shape = struct.unpack_from(f"<{ndim}I", data, offset)
+        offset += 4 * ndim
+        dtype = _DTYPES[code]
+        n_items = int(np.prod(shape, dtype=np.int64))  # prod(()) == 1 → scalar
+        n_bytes = n_items * dtype.itemsize
+        arr = np.frombuffer(data, dtype=dtype, count=n_items, offset=offset).reshape(shape)
+        offset += n_bytes
+        out.append(arr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# server (the JAX/TPU sidecar)
+# ---------------------------------------------------------------------------
+
+
+class SolverService:
+    """Owns the jitted kernel; one Pack call = one batched solve."""
+
+    def solve_bytes(self, request: bytes) -> bytes:
+        import jax
+
+        from karpenter_tpu.solver import kernel
+
+        arrays = unpack_arrays(request)
+        *inputs, n_max_arr = arrays
+        n_max = int(n_max_arr.reshape(-1)[0])
+        result = kernel.pack(*inputs, n_max=n_max)
+        host = jax.device_get(tuple(result))
+        return pack_arrays([np.asarray(a) for a in host])
+
+
+def serve(address: str = "127.0.0.1:50051", max_workers: int = 4):
+    """Start the sidecar server; returns the grpc server object."""
+    import grpc
+
+    service = SolverService()
+
+    def handler_fn(method_name, unused_handler_call_details=None):
+        if method_name.method == METHOD:
+            return grpc.unary_unary_rpc_method_handler(
+                lambda request, ctx: service.solve_bytes(request),
+                request_deserializer=None,  # raw bytes in
+                response_serializer=None,  # raw bytes out
+            )
+        return None
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, handler_call_details):
+            return handler_fn(handler_call_details)
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ],
+    )
+    server.add_generic_rpc_handlers((Handler(),))
+    server.add_insecure_port(address)
+    server.start()
+    logger.info("solver service listening on %s", address)
+    return server
+
+
+# ---------------------------------------------------------------------------
+# client (lives in the controller process)
+# ---------------------------------------------------------------------------
+
+
+class RemoteSolver:
+    """Drop-in for ``kernel.pack``: ships the arrays to the sidecar and
+    returns the PackResult tuple as host numpy arrays."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        import grpc
+
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._call = self._channel.unary_unary(METHOD)
+
+    def pack(self, *inputs, n_max: int):
+        from karpenter_tpu.solver.kernel import PackResult
+
+        request = pack_arrays(
+            [np.asarray(a) for a in inputs] + [np.asarray([n_max], np.int32)]
+        )
+        response = self._call(request, timeout=self.timeout)
+        arrays = unpack_arrays(response)
+        return PackResult(*arrays)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Sidecar entrypoint: ``python -m karpenter_tpu.solver.service``."""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(prog="karpenter-solver-service")
+    ap.add_argument("--address", default="127.0.0.1:50051")
+    ap.add_argument("--max-workers", type=int, default=4)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = serve(args.address, args.max_workers)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop(grace=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -201,6 +201,26 @@ class TestMixedAffinityAntiAffinity:
         assert len(zones) == 1 and "test-zone-1" not in zones
 
 
+class TestProviderConstraintsRespected:
+    def test_provider_not_pinned_outside_its_own_node_affinity(self):
+        """Seeding a zone for an affinity group must respect the provider's
+        own zone constraints — the joint intersection wins."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        provider_pod = make_pod(
+            labels={"app": "web"}, requests={"cpu": "1"},
+            node_requirements=[
+                NodeSelectorRequirement(
+                    key=lbl.TOPOLOGY_ZONE, operator="In", values=["test-zone-3"]
+                )
+            ],
+        )
+        follower = make_pod(requests={"cpu": "1"}, pod_requirements=[affinity({"app": "web"})])
+        vnodes = solve([provider_pod, follower])
+        assert sum(len(v.pods) for v in vnodes) == 2  # both schedule
+        assert {zone_of(v) for v in vnodes} == {"test-zone-3"}
+
+
 class TestSolverParityOnAffinity:
     @pytest.mark.parametrize("n", [35, 70])
     def test_diverse_mix_schedules_on_both_backends(self, n):

@@ -1,0 +1,133 @@
+"""Solver service tests: the flat-buffer codec and a live in-process gRPC
+round trip of the packing kernel (SURVEY §5.8 — the reconcile-loop → JAX
+sidecar transport)."""
+
+import random
+import socket
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.solver.service import (
+    RemoteSolver,
+    pack_arrays,
+    serve,
+    unpack_arrays,
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestCodec:
+    def test_round_trip_preserves_arrays(self):
+        arrays = [
+            np.array([True, False, True]),
+            np.arange(12, dtype=np.int32).reshape(3, 4),
+            np.random.default_rng(0).random((2, 3, 4)).astype(np.float32),
+            np.array(7, dtype=np.int32),  # scalar
+            np.zeros((0,), dtype=np.float32),  # empty
+        ]
+        out = unpack_arrays(pack_arrays(arrays))
+        assert len(out) == len(arrays)
+        for a, b in zip(arrays, out):
+            assert a.dtype == b.dtype
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+
+    def test_off_spec_dtypes_normalized(self):
+        out = unpack_arrays(pack_arrays([np.array([1, 2], dtype=np.int64),
+                                         np.array([1.5], dtype=np.float64)]))
+        assert out[0].dtype == np.int32
+        assert out[1].dtype == np.float32
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            unpack_arrays(b"NOPE" + b"\x00" * 16)
+
+
+class TestRemoteSolve:
+    def test_grpc_round_trip_matches_local_kernel(self):
+        """Serve the kernel over gRPC in-process and verify the remote
+        PackResult is identical to the local one on a real encoded batch."""
+        import jax
+
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import encode as enc
+        from karpenter_tpu.solver import kernel
+        from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+        catalog = sorted(instance_types(16), key=lambda it: it.effective_price())
+        provisioner = make_provisioner(solver="tpu")
+        constraints = provisioner.spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        pods = sort_pods_ffd(diverse_pods(24, random.Random(3)))
+        cluster = Cluster()
+        Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+        daemon = daemon_overhead(cluster, constraints)
+        batch = enc.encode(constraints, catalog, pods, daemon)
+        args = (
+            batch.pod_valid, batch.pod_open_sig, batch.pod_core, batch.pod_host,
+            batch.pod_host_in_base, batch.pod_open_host, batch.pod_req,
+            batch.join_table, batch.frontiers, batch.daemon,
+        )
+        n_max = len(batch.pod_valid)
+        local = jax.device_get(tuple(kernel.pack(*args, n_max=n_max)))
+
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            client = RemoteSolver(address, timeout=30)
+            remote = client.pack(*args, n_max=n_max)
+            for l, r in zip(local, tuple(remote)):
+                np.testing.assert_array_equal(np.asarray(l), np.asarray(r))
+            client.close()
+        finally:
+            server.stop(grace=1)
+
+    def test_scheduler_uses_service_and_falls_back(self):
+        """TpuScheduler with a service address produces the same virtual
+        nodes; with a dead address it falls back to the in-process kernel."""
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        catalog = instance_types(8)
+        provisioner = make_provisioner(solver="tpu")
+        constraints = provisioner.spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            remote_sched = TpuScheduler(
+                Cluster(), rng=random.Random(0), service_address=address
+            )
+            vnodes = remote_sched.solve(constraints, catalog, pods)
+            assert sum(len(v.pods) for v in vnodes) == 4
+        finally:
+            server.stop(grace=1)
+
+        dead = TpuScheduler(
+            Cluster(), rng=random.Random(0),
+            service_address=f"127.0.0.1:{free_port()}",
+        )
+        dead._remote = None
+        vnodes = dead.solve(constraints, catalog, pods)
+        assert sum(len(v.pods) for v in vnodes) == 4  # fallback worked
