@@ -27,6 +27,7 @@ from karpenter_tpu.cloudprovider.requirements import filter_instance_types
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
 from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils import resources as res
 
 logger = logging.getLogger("karpenter.scheduling")
@@ -41,10 +42,15 @@ class VirtualNode:
     instance_type_options: List[InstanceType]
     pods: List[Pod] = field(default_factory=list)
     requests: Dict[str, float] = field(default_factory=dict)
+    used_host_ports: set = field(default_factory=set)
 
     def add(self, pod: Pod) -> Optional[str]:
         """Try to place the pod; returns an error string or None on success
-        (reference: node.go:46-66)."""
+        (reference: node.go:46-66, plus host-port conflict enforcement the
+        reference deferred — suite_test.go:1758)."""
+        ports = podutil.host_ports(pod)
+        if podutil.host_ports_conflict(ports, self.used_host_ports):
+            return f"host port(s) already claimed on node: {sorted(ports)}"
         pod_reqs = Requirements.from_pod(pod)
         if self.pods:
             errs = self.constraints.requirements.compatible(pod_reqs)
@@ -62,6 +68,7 @@ class VirtualNode:
         self.instance_type_options = instance_types
         self.requests = requests
         self.constraints.requirements = requirements
+        self.used_host_ports |= ports
         return None
 
 

@@ -150,6 +150,7 @@ class Topology:
         domains, the constraints' requirements."""
         generated_hostnames: List[str] = []
         self._inject_affinity(constraints, pods, generated_hostnames)
+        self._inject_host_ports(pods, generated_hostnames)
         self._inject_spread(constraints, pods, generated_hostnames)
         if generated_hostnames:
             # one registration for the union: per-group adds would intersect
@@ -326,6 +327,45 @@ class Topology:
         name = "".join(self.rng.choices(string.ascii_lowercase + string.digits, k=8))
         generated_hostnames.append(name)
         return name
+
+    # -- host ports --------------------------------------------------------
+    def _inject_host_ports(self, pods: List[Pod], generated_hostnames: List[str]) -> None:
+        """Host-port claims are per-node mutable state the tensor encoding
+        does not carry, so they become hostname pre-assignments like
+        anti-affinity: port-claiming pods are bucketed onto fresh hostnames
+        such that no bucket holds conflicting claims; pods whose other
+        selectors differ never share a bucket (a merged bucket must stay
+        jointly feasible). Pods already hostname-pinned (by affinity) keep
+        their pin; a conflict inside one pin is unsatisfiable."""
+        buckets: List[Tuple[str, set, Tuple]] = []  # (hostname, claims, selector key)
+        pinned_claims: Dict[str, set] = {}
+        for pod in pods:
+            claims = podutil.host_ports(pod)
+            if not claims:
+                continue
+            pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+            if pinned is not None:
+                existing = pinned_claims.setdefault(pinned, set())
+                if podutil.host_ports_conflict(claims, existing):
+                    _mark_unschedulable(pod)
+                else:
+                    existing |= claims
+                continue
+            selector_key = tuple(sorted(pod.spec.node_selector.items()))
+            placed = False
+            for hostname, bucket_claims, bucket_key in buckets:
+                if bucket_key != selector_key:
+                    continue
+                if podutil.host_ports_conflict(claims, bucket_claims):
+                    continue
+                bucket_claims |= claims
+                _set_domain(pod, lbl.HOSTNAME, hostname)
+                placed = True
+                break
+            if not placed:
+                hostname = self._fresh_hostname(generated_hostnames)
+                buckets.append((hostname, set(claims), selector_key))
+                _set_domain(pod, lbl.HOSTNAME, hostname)
 
     # -- topology spread ---------------------------------------------------
     def _inject_spread(

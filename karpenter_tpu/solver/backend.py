@@ -11,6 +11,7 @@ from __future__ import annotations
 import copy
 import logging
 import random
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -25,9 +26,15 @@ from karpenter_tpu.scheduling.topology import Topology
 from karpenter_tpu.solver import encode as enc
 from karpenter_tpu.solver import kernel
 from karpenter_tpu.solver.signature import SignatureOverflow
+from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils import resources as res
 
 logger = logging.getLogger("karpenter.solver")
+
+# Sidecar RPC budget: short deadline + an open circuit after failure so a
+# dead sidecar costs one bounded stall, not one per batch.
+REMOTE_SOLVE_TIMEOUT = 5.0
+REMOTE_BREAKER_SECONDS = 30.0
 
 
 class TpuScheduler:
@@ -43,6 +50,7 @@ class TpuScheduler:
         # remote sidecar transport (SURVEY §5.8); None = in-process kernel
         self.service_address = service_address
         self._remote = None
+        self._remote_down_until = 0.0  # circuit breaker after RPC failure
 
     def _pack(self, batch: enc.EncodedBatch):
         """Run the packing kernel — on the sidecar when configured, with the
@@ -60,17 +68,24 @@ class TpuScheduler:
             batch.daemon,
         )
         n_max = len(batch.pod_valid)
-        if self.service_address:
+        if self.service_address and time.monotonic() >= self._remote_down_until:
             try:
                 if self._remote is None:
                     from karpenter_tpu.solver.service import RemoteSolver
 
-                    self._remote = RemoteSolver(self.service_address)
-                return self._remote.pack(*args, n_max=n_max)
-            except Exception:
-                logger.exception(
-                    "solver service %s failed; using in-process kernel",
-                    self.service_address,
+                    self._remote = RemoteSolver(
+                        self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
+                    )
+                result = self._remote.pack(*args, n_max=n_max)
+                self._remote_down_until = 0.0
+                return result
+            except Exception as e:
+                # open the circuit: a dead sidecar must not stall every
+                # batch for a full RPC deadline
+                self._remote_down_until = time.monotonic() + REMOTE_BREAKER_SECONDS
+                logger.error(
+                    "solver service %s failed (%s); in-process kernel for %.0fs",
+                    self.service_address, e, REMOTE_BREAKER_SECONDS,
                 )
         return kernel.pack(*args, n_max=n_max)
 

@@ -52,6 +52,34 @@ def is_provisionable(pod: Pod) -> bool:
     )
 
 
+WILDCARD_HOST_IP = "0.0.0.0"
+
+
+def host_ports(pod: Pod):
+    """The (hostIP, hostPort, protocol) triples the pod claims on its node.
+    Conflicting claims cannot co-locate (the reference left this unenforced —
+    suite_test.go:1758 is skipped 'enable after scheduler is aware of
+    hostport usage'; this framework enforces it)."""
+    out = set()
+    for container in pod.spec.containers:
+        for port in container.ports:
+            if port.host_port:
+                out.add((port.host_ip or WILDCARD_HOST_IP, port.host_port, port.protocol or "TCP"))
+    return out
+
+
+def host_ports_conflict(a, b) -> bool:
+    """Kubelet semantics: same (port, protocol) conflicts when either side
+    binds the wildcard IP or the IPs are equal."""
+    for ip_a, port_a, proto_a in a:
+        for ip_b, port_b, proto_b in b:
+            if port_a != port_b or proto_a != proto_b:
+                continue
+            if ip_a == WILDCARD_HOST_IP or ip_b == WILDCARD_HOST_IP or ip_a == ip_b:
+                return True
+    return False
+
+
 def has_required_pod_affinity(pod: Pod) -> bool:
     aff = pod.spec.affinity
     return aff is not None and aff.pod_affinity is not None and bool(aff.pod_affinity.required)

@@ -1,0 +1,184 @@
+"""Scheduling parity suites: host ports (reference: suite_test.go:1756-1810),
+price optimality over the 1,344-type assorted catalog (reference:
+instance_selection_test.go), and binpacking behavior (reference:
+suite_test.go:1813+)."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import ContainerPort, NodeSelectorRequirement
+from karpenter_tpu.cloudprovider.fake import (
+    default_catalog,
+    instance_types,
+    instance_types_assorted,
+)
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from tests.factories import make_pod, make_provisioner
+
+
+def solve(pods, catalog, solver="ffd", rng=None):
+    provisioner = make_provisioner(solver=solver)
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    return Scheduler(Cluster(), rng=rng or random.Random(0)).solve(
+        provisioner, catalog, pods
+    )
+
+
+def with_port(pod, host_port=0, protocol="TCP", host_ip=""):
+    pod.spec.containers[0].ports.append(
+        ContainerPort(host_port=host_port, protocol=protocol, host_ip=host_ip)
+    )
+    return pod
+
+
+class TestHostPorts:
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_same_host_port_and_protocol_not_colocated(self, solver):
+        pods = [
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80)
+            for _ in range(2)
+        ]
+        vnodes = solve(pods, instance_types(5), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 2
+        assert len(vnodes) == 2  # split across nodes
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_same_port_different_protocol_colocated(self, solver):
+        pods = [
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80, protocol="TCP"),
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80, protocol="UDP"),
+        ]
+        vnodes = solve(pods, instance_types(5), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 2
+        assert len(vnodes) == 1
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_wildcard_ip_conflicts_with_specific_ip(self, solver):
+        """0.0.0.0 binds every interface: same port/protocol on a specific
+        IP must not co-locate with it (kubelet semantics)."""
+        pods = [
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80),  # wildcard
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80, host_ip="10.0.0.1"),
+        ]
+        vnodes = solve(pods, instance_types(5), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 2
+        assert len(vnodes) == 2
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_different_specific_ips_colocated(self, solver):
+        pods = [
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80, host_ip="10.0.0.1"),
+            with_port(make_pod(requests={"cpu": "0.5"}), host_port=80, host_ip="10.0.0.2"),
+        ]
+        vnodes = solve(pods, instance_types(5), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 2
+        assert len(vnodes) == 1
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_no_host_port_colocated(self, solver):
+        pods = [
+            with_port(make_pod(requests={"cpu": "0.5"}))  # containerPort only
+            for _ in range(2)
+        ]
+        vnodes = solve(pods, instance_types(5), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 2
+        assert len(vnodes) == 1
+
+
+class TestPriceOptimality:
+    """Always lands on the cheapest feasible type under every
+    arch/os/zone/capacity-type combination (reference:
+    instance_selection_test.go:37-70, shuffled assorted catalog)."""
+
+    @pytest.fixture(scope="class")
+    def catalog(self):
+        catalog = instance_types_assorted()
+        random.Random(5).shuffle(catalog)
+        return catalog
+
+    def cheapest_feasible(self, catalog, predicate):
+        return min(
+            (it for it in catalog if predicate(it)), key=lambda it: it.effective_price()
+        ).effective_price()
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_unconstrained_pod_gets_cheapest_type(self, catalog, solver):
+        vnodes = solve([make_pod(requests={"cpu": "0.9"})], catalog, solver=solver)
+        assert len(vnodes) == 1
+        chosen = vnodes[0].instance_type_options[0]
+        best = self.cheapest_feasible(catalog, lambda it: it.resources.get("cpu", 0) >= 1)
+        assert chosen.effective_price() == pytest.approx(best)
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    @pytest.mark.parametrize(
+        "key,value",
+        [
+            (lbl.ARCH, lbl.ARCH_ARM64),
+            (lbl.OS, "windows"),
+            (lbl.TOPOLOGY_ZONE, "test-zone-2"),
+            (lbl.CAPACITY_TYPE, lbl.CAPACITY_TYPE_SPOT),
+        ],
+    )
+    def test_constrained_pod_gets_cheapest_matching_type(self, catalog, solver, key, value):
+        pod = make_pod(
+            requests={"cpu": "0.9"},
+            node_requirements=[NodeSelectorRequirement(key=key, operator="In", values=[value])],
+        )
+        vnodes = solve([pod], catalog, solver=solver)
+        assert len(vnodes) == 1
+        chosen = vnodes[0].instance_type_options[0]
+
+        def feasible(it):
+            if it.resources.get("cpu", 0) < 1:
+                return False
+            if key == lbl.ARCH:
+                return it.architecture == value
+            if key == lbl.OS:
+                return value in it.operating_systems
+            if key == lbl.TOPOLOGY_ZONE:
+                return value in it.zones()
+            return value in it.capacity_types()
+
+        assert chosen.effective_price() == pytest.approx(
+            self.cheapest_feasible(catalog, feasible)
+        )
+
+
+class TestBinpacking:
+    """reference: suite_test.go:1813+ against the default fake catalog."""
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_small_pod_lands_on_smallest_instance(self, solver):
+        vnodes = solve([make_pod(requests={"memory": "100M"})], default_catalog(), solver=solver)
+        assert len(vnodes) == 1
+        assert vnodes[0].instance_type_options[0].name == "small-instance-type"
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_multiple_small_pods_share_smallest_instance(self, solver):
+        pods = [make_pod(requests={"memory": "10M"}) for _ in range(5)]
+        vnodes = solve(pods, default_catalog(), solver=solver)
+        assert len(vnodes) == 1
+        assert len(vnodes[0].pods) == 5
+        assert vnodes[0].instance_type_options[0].name == "small-instance-type"
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_new_node_opened_at_capacity(self, solver):
+        # default-instance-type allots 5 pods; 12 tiny pods need 3 nodes
+        pods = [make_pod(requests={"cpu": "0.01"}) for _ in range(12)]
+        vnodes = solve(pods, [default_catalog()[0]], solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 12
+        assert len(vnodes) == 3
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_gpu_pod_gets_gpu_instance(self, solver):
+        from karpenter_tpu.utils import resources as res
+
+        pod = make_pod(requests={"cpu": "0.5", res.NVIDIA_GPU: 1})
+        vnodes = solve([pod], default_catalog(), solver=solver)
+        assert len(vnodes) == 1
+        assert vnodes[0].instance_type_options[0].name == "nvidia-gpu-instance-type"
