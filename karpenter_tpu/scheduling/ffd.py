@@ -26,7 +26,11 @@ from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.cloudprovider.requirements import filter_instance_types
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
-from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.scheduling.topology import (
+    Topology,
+    restore_selectors,
+    snapshot_selectors,
+)
 from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils import resources as res
 
@@ -115,10 +119,13 @@ class FFDScheduler:
         pods = sort_pods_ffd(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
 
-        self.topology.inject(constraints, list(pods))
-
-        daemons = daemon_overhead(self.cluster, constraints)
-        return self.solve_injected(constraints, instance_types, pods, daemons)
+        saved = snapshot_selectors(pods)
+        try:
+            self.topology.inject(constraints, list(pods))
+            daemons = daemon_overhead(self.cluster, constraints)
+            return self.solve_injected(constraints, instance_types, pods, daemons)
+        finally:
+            restore_selectors(pods, saved)
 
     def solve_injected(
         self,

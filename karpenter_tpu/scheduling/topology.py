@@ -137,6 +137,20 @@ def _affinity_key(namespace: str, term: PodAffinityTerm, anti: bool) -> Tuple:
     return (anti, ns, term.topology_key, _selector_key(term.label_selector))
 
 
+def snapshot_selectors(pods: List[Pod]) -> List[Dict[str, str]]:
+    """The pods' nodeSelector dicts before injection. Injection always
+    replaces the dict (never mutates in place), so restoring the original
+    references undoes every injected decision — solving must not leave
+    stale domain pins on live pod objects (a retried pod would drag its
+    previous round's hostname/zone into the next solve)."""
+    return [p.spec.node_selector for p in pods]
+
+
+def restore_selectors(pods: List[Pod], saved: List[Dict[str, str]]) -> None:
+    for p, s in zip(pods, saved):
+        p.spec.node_selector = s
+
+
 class Topology:
     def __init__(self, cluster: Cluster, rng: Optional[random.Random] = None):
         self.cluster = cluster
@@ -220,8 +234,14 @@ class Topology:
     def _allowed_domains(
         self, constraints: Constraints, pod: Pod, key: str, domains: Set[str]
     ) -> Set[str]:
-        allowed_set = constraints.requirements.merge(Requirements.from_pod(pod)).get(key)
-        return {d for d in domains if allowed_set.has(d)}
+        """``domains`` is already constraint-viable, so only the pod's OWN
+        narrowing needs checking — merging the pod into the full (catalog-
+        sized) constraint requirements per pod made injection O(n·|catalog|)."""
+        pod_reqs = Requirements.from_pod(pod)
+        if not pod_reqs.has(key):
+            return set(domains)
+        pod_set = pod_reqs.get(key)
+        return {d for d in domains if pod_set.has(d)}
 
     def _assign_zonal_affinity(
         self, constraints: Constraints, group: AffinityGroup, batch: List[Pod]
@@ -343,7 +363,7 @@ class Topology:
             claims = podutil.host_ports(pod)
             if not claims:
                 continue
-            pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+            pinned = _pinned_hostname(pod)
             if pinned is not None:
                 existing = pinned_claims.setdefault(pinned, set())
                 if podutil.host_ports_conflict(claims, existing):
@@ -374,31 +394,38 @@ class Topology:
         pods: List[Pod],
         generated_hostnames: List[str],
     ) -> None:
+        # hostname-spread groups draw their fresh domains from one shared
+        # pool: spread only constrains skew WITHIN a group, so different
+        # groups may deliberately overlap on the same hostnames and the
+        # packer co-locates them when resources allow — materially fewer
+        # nodes than private per-group domains. Affinity/anti-affinity/port
+        # hostnames stay private (a spread pod could match their selectors).
+        hostname_pool: List[str] = []
         for group in self._topology_groups(pods):
-            self._compute_current_topology(constraints, group, generated_hostnames)
+            self._compute_current_topology(constraints, group, generated_hostnames, hostname_pool)
+            key = group.constraint.topology_key
+            if key == lbl.HOSTNAME and not any(
+                _pod_constrains(p, lbl.HOSTNAME) for p in group.pods
+            ):
+                # fast path: all-fresh domains, zero seed counts, no pinned
+                # pods → min-count assignment degenerates to round-robin
+                # (the general path is O(pods × domains) = O(n²/maxSkew))
+                domains = list(group.spread)  # pool order → cross-group overlap
+                for j, pod in enumerate(group.pods):
+                    domain = domains[j % len(domains)]
+                    group.spread[domain] += 1
+                    _set_domain(pod, key, domain)
+                continue
             for pod in group.pods:
-                allowed_set = (
-                    constraints.requirements.merge(Requirements.from_pod(pod))
-                    .get(group.constraint.topology_key)
-                )
-                # Hostname domains were layered into constraints; zone domains
-                # come from the viable-zone registration. Either way the pod's
-                # own requirements may narrow them.
-                allowed = {d for d in group.spread if allowed_set.has(d)}
-                if group.constraint.topology_key == lbl.HOSTNAME:
-                    # generated hostnames are registered after injection, so
-                    # the base constraint cannot veto them yet
-                    allowed = {
-                        d for d in group.spread
-                        if d in generated_hostnames or allowed_set.has(d)
-                    }
+                # the pod's own requirements may narrow the registered
+                # domains; registered domains are already constraint-viable
+                allowed = self._allowed_domains(constraints, pod, key, set(group.spread))
+                if key == lbl.HOSTNAME:
                     pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
                     if pinned is not None:
                         allowed &= {pinned}
                 domain = group.next_domain(allowed)
-                pod.spec.node_selector = {
-                    **pod.spec.node_selector, group.constraint.topology_key: domain
-                }
+                _set_domain(pod, key, domain)
 
     def _topology_groups(self, pods: List[Pod]) -> List[TopologyGroup]:
         groups: Dict[Tuple, TopologyGroup] = {}
@@ -416,28 +443,34 @@ class Topology:
         constraints: Constraints,
         group: TopologyGroup,
         generated_hostnames: List[str],
+        hostname_pool: List[str],
     ) -> None:
         key = group.constraint.topology_key
         if key == lbl.HOSTNAME:
-            self._compute_hostname_topology(group, generated_hostnames)
+            self._compute_hostname_topology(group, generated_hostnames, hostname_pool)
         elif key == lbl.TOPOLOGY_ZONE:
             self._compute_zonal_topology(constraints, group)
 
     def _compute_hostname_topology(
-        self, group: TopologyGroup, generated_hostnames: List[str]
+        self,
+        group: TopologyGroup,
+        generated_hostnames: List[str],
+        hostname_pool: List[str],
     ) -> None:
         """Fresh nodes are empty, so the global hostname minimum is 0; we
-        generate ceil(n/maxSkew) domains so skew cannot be violated
+        register ceil(n/maxSkew) domains — drawn from the shared pool so
+        groups overlap — and skew cannot be violated
         (reference: topology.go:98-112)."""
         n_domains = math.ceil(len(group.pods) / max(group.constraint.max_skew, 1))
-        domains = [self._fresh_hostname(generated_hostnames) for _ in range(n_domains)]
+        while len(hostname_pool) < n_domains:
+            hostname_pool.append(self._fresh_hostname(generated_hostnames))
         # pods already pinned to a hostname by affinity participate with that
         # hostname as a registered domain
         for pod in group.pods:
             pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
             if pinned is not None:
                 group.register(pinned)
-        group.register(*domains)
+        group.register(*hostname_pool[:n_domains])
 
     def _compute_zonal_topology(self, constraints: Constraints, group: TopologyGroup) -> None:
         """Viable zones become the domains; existing matching cluster pods
@@ -460,6 +493,39 @@ class Topology:
 
 def _set_domain(pod: Pod, key: str, domain: str) -> None:
     pod.spec.node_selector = {**pod.spec.node_selector, key: domain}
+
+
+def _pinned_hostname(pod: Pod) -> Optional[str]:
+    """The hostname the pod is already pinned to — by nodeSelector (domain
+    injection writes there) or by its own required node affinity."""
+    pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+    if pinned is not None:
+        return pinned
+    aff = pod.spec.affinity
+    if aff is None or aff.node_affinity is None:
+        return None
+    for term in aff.node_affinity.required:
+        for r in term.match_expressions:
+            if r.key == lbl.HOSTNAME and r.operator == "In" and len(r.values) == 1:
+                return r.values[0]
+    return None
+
+
+def _pod_constrains(pod: Pod, key: str) -> bool:
+    """Does the pod's own spec narrow this topology key (selector or node
+    affinity)? Cheap pre-check gating the spread fast path."""
+    if key in pod.spec.node_selector:
+        return True
+    aff = pod.spec.affinity
+    if aff is None or aff.node_affinity is None:
+        return False
+    for term in aff.node_affinity.required:
+        if any(r.key == key for r in term.match_expressions):
+            return True
+    for pref in aff.node_affinity.preferred:
+        if any(r.key == key for r in pref.preference.match_expressions):
+            return True
+    return False
 
 
 def _mark_unschedulable(pod: Pod) -> None:

@@ -22,7 +22,11 @@ from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
 from karpenter_tpu.scheduling.ffd import FFDScheduler, VirtualNode, daemon_overhead, sort_pods_ffd
-from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.scheduling.topology import (
+    Topology,
+    restore_selectors,
+    snapshot_selectors,
+)
 from karpenter_tpu.solver import encode as enc
 from karpenter_tpu.solver import kernel
 from karpenter_tpu.solver.signature import SignatureOverflow
@@ -52,9 +56,15 @@ class TpuScheduler:
         self._remote = None
         self._remote_down_until = 0.0  # circuit breaker after RPC failure
 
-    def _pack(self, batch: enc.EncodedBatch):
+    def _pack(self, batch: enc.EncodedBatch) -> kernel.PackResult:
         """Run the packing kernel — on the sidecar when configured, with the
-        in-process kernel as the availability fallback."""
+        in-process kernel as the availability fallback. Returns HOST numpy
+        arrays (one fused device→host transfer).
+
+        The node table starts at P/4 slots — per-scan-step cost is linear in
+        the table size, and real packings open far fewer nodes than pods —
+        and retries at full P on saturation (table full + unscheduled pods).
+        """
         args = (
             batch.pod_valid,
             batch.pod_open_sig,
@@ -67,7 +77,19 @@ class TpuScheduler:
             batch.frontiers,
             batch.daemon,
         )
-        n_max = len(batch.pod_valid)
+        p = len(batch.pod_valid)
+        n_max = max(256, p // 4)
+        while True:
+            result = self._pack_once(args, p, n_max)
+            saturated = int(result.n_nodes) == n_max and bool(
+                (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
+            )
+            if not saturated or n_max >= p:
+                return result
+            n_max = p
+
+    def _pack_once(self, args, p: int, n_max: int) -> kernel.PackResult:
+        r = args[6].shape[1]  # pod_req
         if self.service_address and time.monotonic() >= self._remote_down_until:
             try:
                 if self._remote is None:
@@ -87,7 +109,10 @@ class TpuScheduler:
                     "solver service %s failed (%s); in-process kernel for %.0fs",
                     self.service_address, e, REMOTE_BREAKER_SECONDS,
                 )
-        return kernel.pack(*args, n_max=n_max)
+        import jax
+
+        buf = jax.device_get(kernel.fuse_result(kernel.pack(*args, n_max=n_max)))
+        return kernel.split_result(buf, p, n_max, r)
 
     def solve(
         self,
@@ -100,17 +125,21 @@ class TpuScheduler:
         constraints = constraints.clone()
         pods = sort_pods_ffd(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
-        self.topology.inject(constraints, list(pods))
-        daemon = daemon_overhead(self.cluster, constraints)
-
+        saved = snapshot_selectors(pods)
         try:
-            batch = enc.encode(constraints, instance_types, pods, daemon)
-        except SignatureOverflow as e:
-            logger.warning("falling back to FFD: %s", e)
-            return self._ffd_fallback.solve_injected(constraints, instance_types, pods, daemon)
-
-        result = self._pack(batch)
-        return self._decode(batch, result, constraints, instance_types)
+            self.topology.inject(constraints, list(pods))
+            daemon = daemon_overhead(self.cluster, constraints)
+            try:
+                batch = enc.encode(constraints, instance_types, pods, daemon)
+            except SignatureOverflow as e:
+                logger.warning("falling back to FFD: %s", e)
+                return self._ffd_fallback.solve_injected(
+                    constraints, instance_types, pods, daemon
+                )
+            result = self._pack(batch)
+            return self._decode(batch, result, constraints, instance_types)
+        finally:
+            restore_selectors(pods, saved)
 
     def _decode(
         self,
@@ -119,11 +148,8 @@ class TpuScheduler:
         constraints: Constraints,
         instance_types: Sequence[InstanceType],
     ) -> List[VirtualNode]:
-        # single consolidated device→host transfer (the axon tunnel makes
-        # per-array fetches expensive)
-        import jax
-
-        assignment, node_sig, node_host, node_req, n_nodes_arr = jax.device_get(tuple(result))
+        # _pack already fused the device→host transfer; these are host arrays
+        assignment, node_sig, node_host, node_req, n_nodes_arr = result
         assignment = assignment[: batch.n_pods]
         n_nodes = int(np.asarray(n_nodes_arr).reshape(-1)[0])
 

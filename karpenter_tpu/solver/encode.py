@@ -38,12 +38,17 @@ FRONTIER_PAD = -1.0
 
 
 def _bucket(n: int, minimum: int = 64) -> int:
-    """Next power of two ≥ n (≥ minimum) — the shape-bucketing discipline
-    that keeps jit cache hits high across varying batch sizes."""
+    """Shape bucket ≥ n: powers of two up to 2048, then multiples of 2048 —
+    the scan cost is linear in the padded pod count, so pure pow2 buckets
+    waste up to 2× of it at large batches (10k pods → 16384). The 2048-step
+    ladder keeps the jit cache small; its padding overhead shrinks with
+    batch size (≤ 20% from ~10k pods up, larger below)."""
     b = minimum
-    while b < n:
+    while b < n and b < 2048:
         b *= 2
-    return b
+    if n <= b:
+        return b
+    return ((n + 2047) // 2048) * 2048
 
 
 @dataclass

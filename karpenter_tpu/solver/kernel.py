@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# pods unrolled per scan step (amortizes per-step dispatch latency)
+CHUNK = 8
+
+
 class PackResult(NamedTuple):
     assignment: jnp.ndarray  # [P] i32 node index, -1 = unschedulable/padding
     node_sig: jnp.ndarray  # [N] i32 final signature per node, -1 = unopened
@@ -78,7 +82,11 @@ def pack(
         open_req = daemon + req
         open_fits = jnp.any(jnp.all(open_req[None, :] <= frontiers[open_sig], axis=-1))
 
-        schedulable = valid & (any_ok | open_fits)
+        # node table full → cannot open; the caller detects saturation
+        # (n_nodes == n_max with unscheduled pods) and retries with a larger
+        # table, so a conservative n_max stays sound
+        can_open = open_fits & (count < node_sig.shape[0])
+        schedulable = valid & (any_ok | can_open)
         target = jnp.where(any_ok, first_ok, count)
 
         upd_sig = jnp.where(any_ok, j[first_ok], open_sig)
@@ -98,12 +106,61 @@ def pack(
         assignment = jnp.where(schedulable, target, -1).astype(jnp.int32)
         return (node_sig, node_host, node_req, count), assignment
 
-    (node_sig, node_host, node_req, count), assignment = lax.scan(
-        step,
-        (node_sig0, node_host0, node_req0, count0),
-        (pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base, pod_open_host, pod_req),
-    )
+    # Chunked scan: the per-step body is tiny, so a 10k-pod scan is dominated
+    # by per-step dispatch latency. Unrolling CHUNK pods inside each step
+    # (still strictly sequential — XLA fuses the unrolled bodies into one
+    # kernel per step) cuts the step count CHUNK×. P is always a multiple of
+    # CHUNK because encode buckets P to powers of two ≥ 64.
+    xs = (pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base,
+          pod_open_host, pod_req)
+    if P % CHUNK == 0 and P >= CHUNK:
+        xs_chunked = tuple(a.reshape((P // CHUNK, CHUNK) + a.shape[1:]) for a in xs)
+
+        def chunk_step(carry, chunk):
+            outs = []
+            for k in range(CHUNK):
+                carry, out = step(carry, tuple(a[k] for a in chunk))
+                outs.append(out)
+            return carry, jnp.stack(outs)
+
+        (node_sig, node_host, node_req, count), assignment = lax.scan(
+            chunk_step, (node_sig0, node_host0, node_req0, count0), xs_chunked
+        )
+        assignment = assignment.reshape(P)
+    else:
+        (node_sig, node_host, node_req, count), assignment = lax.scan(
+            step, (node_sig0, node_host0, node_req0, count0), xs
+        )
     return PackResult(assignment, node_sig, node_host, node_req, count)
+
+
+@jax.jit
+def fuse_result(result: PackResult) -> jnp.ndarray:
+    """Flatten the PackResult into ONE i32 buffer on device (f32 totals are
+    bitcast, not converted) so the host needs a single transfer — per-array
+    fetches each pay full round-trip latency on a tunneled TPU."""
+    parts = [
+        result.assignment.reshape(-1),
+        result.node_sig.reshape(-1),
+        result.node_host.reshape(-1),
+        lax.bitcast_convert_type(result.node_req, jnp.int32).reshape(-1),
+        result.n_nodes.reshape(-1).astype(jnp.int32),
+    ]
+    return jnp.concatenate(parts)
+
+
+def split_result(buf, p: int, n: int, r: int) -> PackResult:
+    """Host-side inverse of ``fuse_result`` (numpy): ``p`` pods scanned,
+    ``n`` node slots, ``r`` resource axes."""
+    import numpy as np
+
+    buf = np.asarray(buf)
+    assignment = buf[:p]
+    node_sig = buf[p : p + n]
+    node_host = buf[p + n : p + 2 * n]
+    node_req = buf[p + 2 * n : p + 2 * n + n * r].view(np.float32).reshape(n, r)
+    n_nodes = buf[p + 2 * n + n * r]
+    return PackResult(assignment, node_sig, node_host, node_req, n_nodes)
 
 
 @partial(jax.jit, static_argnames=())

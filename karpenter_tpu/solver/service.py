@@ -15,7 +15,8 @@ Wire format: **flat little-endian buffers, not protobuf message trees**
 The RPC surface is one unary method ``/karpenter.solver.v1.Solver/Pack``
 registered through gRPC's generic handler with identity (bytes) serializers,
 so no generated stubs are needed. Request = the 10 ``kernel.pack`` inputs
-(+ n_max as a scalar array); response = the 5 ``PackResult`` arrays.
+(+ n_max as a scalar array); response = ONE fused i32 buffer (see
+``kernel.fuse_result``) the client splits back into a ``PackResult``.
 """
 
 from __future__ import annotations
@@ -32,7 +33,9 @@ import numpy as np
 logger = logging.getLogger("karpenter.solver.service")
 
 MAGIC = b"KTPU"
-VERSION = 1
+# v2: response switched from 5 per-field arrays to one fused buffer — a
+# version skew must fail loudly, not degrade into a silent parse error
+VERSION = 2
 METHOD = "/karpenter.solver.v1.Solver/Pack"
 
 _DTYPES = {0: np.dtype(np.bool_), 1: np.dtype(np.int32), 2: np.dtype(np.float32)}
@@ -104,8 +107,10 @@ class SolverService:
         *inputs, n_max_arr = arrays
         n_max = int(n_max_arr.reshape(-1)[0])
         result = kernel.pack(*inputs, n_max=n_max)
-        host = jax.device_get(tuple(result))
-        return pack_arrays([np.asarray(a) for a in host])
+        # one fused device→host transfer on the sidecar too — per-array
+        # fetches each pay the full device round trip
+        buf = jax.device_get(kernel.fuse_result(result))
+        return pack_arrays([np.asarray(buf)])
 
 
 def serve(address: str = "127.0.0.1:50051", max_workers: int = 4):
@@ -150,11 +155,15 @@ class RemoteSolver:
     """Drop-in for ``kernel.pack``: ships the arrays to the sidecar and
     returns the PackResult tuple as host numpy arrays."""
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(self, address: str, timeout: float = 30.0, cold_timeout: float = 180.0):
         import grpc
 
         self.address = address
         self.timeout = timeout
+        # first call per (P, n_max) shape must cover the sidecar's XLA
+        # compile; later calls get the short deadline
+        self.cold_timeout = cold_timeout
+        self._warm_shapes = set()
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -165,14 +174,19 @@ class RemoteSolver:
         self._call = self._channel.unary_unary(METHOD)
 
     def pack(self, *inputs, n_max: int):
-        from karpenter_tpu.solver.kernel import PackResult
+        from karpenter_tpu.solver.kernel import split_result
 
         request = pack_arrays(
             [np.asarray(a) for a in inputs] + [np.asarray([n_max], np.int32)]
         )
-        response = self._call(request, timeout=self.timeout)
-        arrays = unpack_arrays(response)
-        return PackResult(*arrays)
+        p = len(inputs[0])
+        shape = (p, n_max)
+        timeout = self.timeout if shape in self._warm_shapes else self.cold_timeout
+        response = self._call(request, timeout=timeout)
+        self._warm_shapes.add(shape)
+        (buf,) = unpack_arrays(response)
+        r = inputs[6].shape[1]  # pod_req
+        return split_result(buf, p, n_max, r)
 
     def close(self) -> None:
         self._channel.close()
