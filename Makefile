@@ -1,0 +1,41 @@
+# Developer workflow — the reference's Makefile targets, adapted
+# (reference: Makefile:22-42 dev/ci/test/battletest/benchmark/deflake).
+
+PY ?= python
+TESTFLAGS ?= -q
+
+dev: test  ## everything a presubmit needs
+
+test:  ## unit + integration suites
+	$(PY) -m pytest tests/ -x $(TESTFLAGS)
+
+battletest:  ## randomized order + duration report (the -race analog)
+	$(PY) -m pytest tests/ $(TESTFLAGS) -p no:randomly --durations=10
+
+deflake:  ## run the suite 10x to shake out flakes (reference: Makefile:38-39)
+	for i in 1 2 3 4 5 6 7 8 9 10; do \
+		$(PY) -m pytest tests/ -x -q || exit 1; \
+	done
+
+benchmark:  ## headline solve benchmark (prints one JSON line)
+	$(PY) bench.py
+
+benchmark-grid:  ## the reference's full batch grid
+	$(PY) bench.py --grid
+
+benchmark-consolidation:  ## BASELINE config 5: 1k-node re-pack
+	$(PY) bench.py --consolidation 1000
+
+dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) __graft_entry__.py
+
+run:  ## start the controller process against the in-memory cluster
+	$(PY) -m karpenter_tpu.main
+
+solver-sidecar:  ## start the TPU solver sidecar
+	$(PY) -m karpenter_tpu.solver.service
+
+.PHONY: dev test battletest deflake benchmark benchmark-grid \
+	benchmark-consolidation dryrun-multichip run solver-sidecar
