@@ -9,8 +9,8 @@ dev: test  ## everything a presubmit needs
 test:  ## unit + integration suites
 	$(PY) -m pytest tests/ -x $(TESTFLAGS)
 
-battletest:  ## randomized order + duration report (the -race analog)
-	$(PY) -m pytest tests/ $(TESTFLAGS) -p no:randomly --durations=10
+battletest:  ## full suite without fail-fast + duration report (the -race analog)
+	$(PY) -m pytest tests/ $(TESTFLAGS) --durations=10
 
 deflake:  ## run the suite 10x to shake out flakes (reference: Makefile:38-39)
 	for i in 1 2 3 4 5 6 7 8 9 10; do \

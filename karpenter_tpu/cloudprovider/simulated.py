@@ -28,6 +28,7 @@ import itertools
 import json
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
@@ -43,6 +44,7 @@ from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.ttlcache import TTLCache
+from karpenter_tpu.utils.workqueue import TokenBucket
 
 logger = logging.getLogger("karpenter.simulated")
 
@@ -51,6 +53,14 @@ CACHE_TTL = 60.0
 INSTANCE_TYPES_TTL = 300.0
 UNAVAILABLE_OFFERINGS_TTL = 45.0  # reference: aws/instancetypes.go:41
 MAX_INSTANCE_TYPES = 20  # reference: aws/cloudprovider.go:57
+
+# fleet-call budget (reference: aws/instance.go:43-49)
+CREATE_FLEET_QPS = 2.0
+CREATE_FLEET_BURST = 100
+
+# DescribeInstances is eventually consistent after a fleet launch
+# (reference: aws/instance.go:84-91 retries 6x)
+DESCRIBE_RETRIES = 6
 
 DEFAULT_IMAGE_FAMILY = "standard"
 DEFAULT_SELECTOR = {"purpose": "nodes"}
@@ -501,6 +511,9 @@ class InstanceProvider:
         self.instance_types = instance_types
         self.subnets = subnets
         self.launch_templates = launch_templates
+        # client-side flow control on the fleet call
+        # (reference: aws/instance.go:43-49, 2 QPS / 100 burst)
+        self.fleet_limiter = TokenBucket(CREATE_FLEET_QPS, CREATE_FLEET_BURST)
 
     def create(self, config: SimProviderConfig, request: NodeRequest) -> Node:
         # GPU filter BEFORE the 20-type cap: a GPU-heavy prefix must not
@@ -529,6 +542,8 @@ class InstanceProvider:
             raise InsufficientCapacityError(
                 f"no launchable offering for capacity type {capacity_type}"
             )
+        if not self.fleet_limiter.take(timeout=60):
+            raise CloudAPIError("fleet request rate budget exhausted (2 QPS/100 burst)")
         instances, errors = self.api.create_fleet(capacity_type, overrides)
         for ct, itype, zone in errors:
             self.instance_types.unavailable.mark_unavailable(ct, itype, zone)
@@ -536,8 +551,25 @@ class InstanceProvider:
             raise InsufficientCapacityError(
                 f"fleet returned no instances ({len(errors)} unavailable pools)"
             )
-        instance = self.api.describe_instances([instances[0].id])[0]
+        instance = self._describe_with_retry(instances[0].id)
         return self._to_node(instance, options)
+
+    def _describe_with_retry(self, instance_id: str) -> SimInstance:
+        """DescribeInstances right after a launch is eventually consistent
+        (reference: aws/instance.go:84-91, 6 retries)."""
+        last_err: Optional[Exception] = None
+        for attempt in range(DESCRIBE_RETRIES):
+            try:
+                found = self.api.describe_instances([instance_id])
+                if found:
+                    return found[0]
+            except CloudAPIError as e:
+                last_err = e
+            if attempt < DESCRIBE_RETRIES - 1:  # no dead sleep before raising
+                time.sleep(min(0.05 * (2**attempt), 1.0))
+        raise CloudAPIError(
+            f"instance {instance_id} not visible after {DESCRIBE_RETRIES} retries"
+        ) from last_err
 
     def delete(self, node: Node) -> None:
         instance_id = node.spec.provider_id.rsplit("/", 1)[-1]

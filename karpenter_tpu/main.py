@@ -46,6 +46,7 @@ class Runtime:
     termination: TerminationController
     webhook: Webhook
     servers: list = None  # HTTP servers (metrics, health) when serving
+    elector: object = None  # LeaderElector when a lease is configured
 
     def stop(self) -> None:
         self.manager.stop()
@@ -53,6 +54,8 @@ class Runtime:
         self.termination.stop()
         for server in self.servers or []:
             server.shutdown()
+        if self.elector is not None:
+            self.elector.stop()
 
 
 def _serve_endpoints(runtime: Runtime) -> None:
@@ -170,8 +173,16 @@ def build_runtime(
 
 
 def run_controller_process(options: Optional[Options] = None, serve: bool = True) -> Runtime:
-    """The ``main()`` equivalent: build, start, and serve metrics/health."""
+    """The ``main()`` equivalent: build, wait for leadership when a lease is
+    configured, start, and serve metrics/health."""
     runtime = build_runtime(options)
+    if runtime.options.leader_election_lease:
+        from karpenter_tpu.utils.lease import FileLease, LeaderElector
+
+        runtime.elector = LeaderElector(FileLease(runtime.options.leader_election_lease))
+        runtime.elector.start()
+        logger.info("waiting for leadership (%s)", runtime.options.leader_election_lease)
+        runtime.elector.wait_for_leadership()
     runtime.manager.start()
     if serve:
         _serve_endpoints(runtime)

@@ -34,6 +34,11 @@ class Options:
     consolidation_enabled: bool = field(
         default_factory=lambda: _env("KARPENTER_CONSOLIDATION", "false").lower() == "true"
     )
+    # leader election: path to a shared lease file; empty = single-process,
+    # no election (reference: cmd/controller/main.go:84-85)
+    leader_election_lease: str = field(
+        default_factory=lambda: _env("LEADER_ELECTION_LEASE", "")
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -62,6 +67,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--cloud-provider", default=opts.cloud_provider)
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
+    ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
     ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
@@ -81,6 +87,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
         consolidation_enabled=ns.consolidation,
+        leader_election_lease=ns.leader_election_lease,
     )
     errs = out.validate()
     if errs:

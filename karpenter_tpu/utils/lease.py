@@ -1,0 +1,129 @@
+"""Leader election via a lease — the active/passive single-writer hook
+(reference: cmd/controller/main.go:84-85 ``karpenter-leader-election``).
+
+The in-memory deployment has one process, so the default lease is in-process;
+multi-process deployments back it with a shared file (one machine) or swap in
+a real coordination.k8s.io/Lease client. The contract is small: acquire
+(non-blocking), renew on a heartbeat, release on shutdown; holders that stop
+renewing lose the lease after the duration elapses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_INTERVAL = 5.0
+
+
+class FileLease:
+    """Advisory lease in a shared file: {holder, expiry}. Atomic via
+    write-to-temp + rename; stale leases are taken over after expiry."""
+
+    def __init__(
+        self,
+        path: str,
+        identity: Optional[str] = None,
+        duration: float = DEFAULT_LEASE_DURATION,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.path = path
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.duration = duration
+        self.clock = clock or time.time
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self, record: dict) -> None:
+        tmp = f"{self.path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, self.path)
+
+    def try_acquire(self) -> bool:
+        now = self.clock()
+        current = self._read()
+        if current and current["holder"] != self.identity and current["expiry"] > now:
+            return False
+        self._write({"holder": self.identity, "expiry": now + self.duration})
+        # re-read to detect a racing writer (last rename wins)
+        latest = self._read()
+        return bool(latest and latest["holder"] == self.identity)
+
+    def renew(self) -> bool:
+        now = self.clock()
+        current = self._read()
+        if (
+            not current
+            or current["holder"] != self.identity
+            or current["expiry"] <= now  # expired: a takeover may be racing
+        ):
+            return False
+        self._write({"holder": self.identity, "expiry": now + self.duration})
+        # re-read like try_acquire: a racing takeover's rename may have won
+        latest = self._read()
+        return bool(latest and latest["holder"] == self.identity)
+
+    def release(self) -> None:
+        current = self._read()
+        if current and current["holder"] == self.identity:
+            try:
+                os.remove(self.path)
+            except FileNotFoundError:
+                pass
+
+    def holder(self) -> Optional[str]:
+        current = self._read()
+        if current and current["expiry"] > self.clock():
+            return current["holder"]
+        return None
+
+
+class LeaderElector:
+    """Blocks followers until leadership is acquired, then renews on a
+    heartbeat; ``is_leader`` flips false if renewal fails (lost lease)."""
+
+    def __init__(self, lease: FileLease, renew_interval: float = DEFAULT_RENEW_INTERVAL):
+        self.lease = lease
+        self.renew_interval = renew_interval
+        self._leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True, name="leader-elector")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._leader.is_set():
+                if not self.lease.renew():
+                    self._leader.clear()
+            elif self.lease.try_acquire():
+                self._leader.set()
+            self._stop.wait(self.renew_interval)
+
+    def wait_for_leadership(self, timeout: Optional[float] = None) -> bool:
+        return self._leader.wait(timeout)
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._leader.is_set():
+            self.lease.release()
+            self._leader.clear()

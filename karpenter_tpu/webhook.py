@@ -56,3 +56,162 @@ class Webhook:
         self.default(provisioner)
         self.validate(provisioner)
         return provisioner
+
+
+# ---------------------------------------------------------------------------
+# The webhook as a process: HTTP admission endpoints — the second binary
+# (reference: cmd/webhook/main.go:46-94 serves /default-resource,
+# /validate-resource, /config-validation).
+# ---------------------------------------------------------------------------
+
+
+def serialize_provisioner(p: Provisioner) -> dict:
+    from karpenter_tpu.api.objects import NodeSelectorRequirement  # noqa: F401
+
+    c = p.spec.constraints
+    return {
+        "metadata": {"name": p.metadata.name},
+        "spec": {
+            "labels": dict(c.labels),
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect} for t in c.taints
+            ],
+            "requirements": [
+                {"key": r.key, "operator": r.operator, "values": list(r.values)}
+                for r in c.requirements.requirements
+            ],
+            "ttlSecondsAfterEmpty": p.spec.ttl_seconds_after_empty,
+            "ttlSecondsUntilExpired": p.spec.ttl_seconds_until_expired,
+            "limits": dict(p.spec.limits.resources) if p.spec.limits else None,
+            "solver": p.spec.solver,
+            "provider": c.provider,
+        },
+    }
+
+
+def deserialize_provisioner(doc: dict) -> Provisioner:
+    from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta, Taint
+    from karpenter_tpu.api.provisioner import (
+        Constraints,
+        Limits,
+        ProvisionerSpec,
+    )
+    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.utils import resources as res
+
+    spec = doc.get("spec", {})
+    limits = spec.get("limits")
+    return Provisioner(
+        metadata=ObjectMeta(name=doc.get("metadata", {}).get("name", "default"), namespace=""),
+        spec=ProvisionerSpec(
+            constraints=Constraints(
+                labels=dict(spec.get("labels", {})),
+                taints=[
+                    Taint(key=t.get("key", ""), value=t.get("value", ""),
+                          effect=t.get("effect", "NoSchedule"))
+                    for t in spec.get("taints", [])
+                ],
+                requirements=Requirements.new(
+                    *(
+                        NodeSelectorRequirement(
+                            key=r["key"], operator=r["operator"],
+                            values=list(r.get("values", [])),
+                        )
+                        for r in spec.get("requirements", [])
+                    )
+                ),
+                provider=spec.get("provider"),
+            ),
+            ttl_seconds_after_empty=spec.get("ttlSecondsAfterEmpty"),
+            ttl_seconds_until_expired=spec.get("ttlSecondsUntilExpired"),
+            # kubectl-style quantity strings ("1Gi") become floats here
+            limits=Limits(resources=res.parse_resource_list(limits)) if limits else None,
+            solver=spec.get("solver", ""),
+        ),
+    )
+
+
+def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
+    """Start the admission HTTP server; returns the server object.
+
+    POST /default-resource  → the defaulted provisioner document
+    POST /validate-resource → {"allowed": bool, "errors": [...]}
+    GET  /healthz           → 200
+    """
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self, code: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._respond(200, {"ok": True})
+            else:
+                self._respond(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                provisioner = deserialize_provisioner(doc)
+            except Exception as e:
+                self._respond(400, {"error": f"bad request: {e}"})
+                return
+            if self.path == "/default-resource":
+                try:
+                    webhook.default(provisioner)
+                except Exception as e:  # hook crash → clean admission failure
+                    self._respond(422, {"error": f"defaulting failed: {e}"})
+                    return
+                self._respond(200, serialize_provisioner(provisioner))
+            elif self.path == "/validate-resource":
+                try:
+                    webhook.validate(provisioner)
+                    self._respond(200, {"allowed": True, "errors": []})
+                except AdmissionError as e:
+                    self._respond(200, {"allowed": False, "errors": e.errors})
+                except Exception as e:  # hook crash → denial, not a dropped conn
+                    self._respond(200, {"allowed": False, "errors": [f"validation crashed: {e}"]})
+            else:
+                self._respond(404, {"error": "not found"})
+
+        def log_message(self, *args):
+            return
+
+    host, port = address.rsplit(":", 1)
+    server = HTTPServer((host, int(port)), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True, name="webhook").start()
+    return server
+
+
+def main(argv=None) -> None:
+    """Webhook process entrypoint: ``python -m karpenter_tpu.webhook``."""
+    import argparse
+    import time
+
+    from karpenter_tpu.cloudprovider import registry
+
+    ap = argparse.ArgumentParser(prog="karpenter-tpu-webhook")
+    ap.add_argument("--address", default="0.0.0.0:8443")
+    ap.add_argument("--cloud-provider", default="fake")
+    ap.add_argument("--default-solver", default=SOLVER_FFD)
+    args = ap.parse_args(argv)
+    provider = registry.new_cloud_provider(args.cloud_provider)
+    server = serve(Webhook(provider, default_solver=args.default_solver), args.address)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

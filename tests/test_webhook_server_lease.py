@@ -1,0 +1,141 @@
+"""Webhook HTTP admission server, leader-election lease, and fleet-path flow
+control (reference: cmd/webhook process, leader election main.go:84-85, and
+the CreateFleet rate budget instance.go:43-49)."""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+from karpenter_tpu.utils.lease import FileLease, LeaderElector
+from karpenter_tpu.webhook import (
+    Webhook,
+    deserialize_provisioner,
+    serialize_provisioner,
+    serve,
+)
+from tests.factories import make_provisioner
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def server():
+    address = f"127.0.0.1:{free_port()}"
+    webhook = Webhook(SimulatedCloudProvider(), default_solver="tpu")
+    srv = serve(webhook, address)
+    yield f"http://{address}"
+    srv.shutdown()
+
+
+class TestWebhookServer:
+    def test_round_trip_serialization(self):
+        prov = make_provisioner(
+            labels={"team": "a"}, ttl_after_empty=30, limits={"cpu": "100"}, solver="tpu"
+        )
+        doc = serialize_provisioner(prov)
+        back = deserialize_provisioner(doc)
+        assert back.spec.constraints.labels == {"team": "a"}
+        assert back.spec.ttl_seconds_after_empty == 30
+        assert back.spec.limits.resources == {"cpu": 100}
+        assert back.spec.solver == "tpu"
+
+    def test_default_resource_endpoint(self, server):
+        doc = serialize_provisioner(make_provisioner())
+        doc["spec"]["solver"] = ""
+        out = post(f"{server}/default-resource", doc)
+        assert out["spec"]["solver"] == "tpu"  # process default applied
+        keys = {r["key"] for r in out["spec"]["requirements"]}
+        assert "karpenter.sh/capacity-type" in keys  # vendor hook applied
+
+    def test_validate_resource_accepts_good_spec(self, server):
+        out = post(f"{server}/validate-resource", serialize_provisioner(make_provisioner()))
+        assert out["allowed"] is True
+
+    def test_validate_resource_rejects_bad_spec(self, server):
+        doc = serialize_provisioner(make_provisioner())
+        doc["spec"]["ttlSecondsAfterEmpty"] = -5
+        out = post(f"{server}/validate-resource", doc)
+        assert out["allowed"] is False
+        assert out["errors"]
+
+    def test_healthz(self, server):
+        with urllib.request.urlopen(f"{server}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+
+
+class TestLease:
+    def test_single_holder(self, tmp_path):
+        path = str(tmp_path / "lease")
+        a = FileLease(path, identity="a", duration=10)
+        b = FileLease(path, identity="b", duration=10)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.holder() == "a"
+
+    def test_takeover_after_expiry(self, tmp_path):
+        now = [100.0]
+        path = str(tmp_path / "lease")
+        a = FileLease(path, identity="a", duration=10, clock=lambda: now[0])
+        b = FileLease(path, identity="b", duration=10, clock=lambda: now[0])
+        assert a.try_acquire()
+        now[0] += 11  # a stopped renewing
+        assert b.try_acquire()
+        assert b.holder() == "b"
+        assert not a.renew()  # a lost it
+
+    def test_release(self, tmp_path):
+        path = str(tmp_path / "lease")
+        a = FileLease(path, identity="a")
+        assert a.try_acquire()
+        a.release()
+        assert a.holder() is None
+
+    def test_elector_acquires_and_releases(self, tmp_path):
+        path = str(tmp_path / "lease")
+        elector = LeaderElector(FileLease(path, identity="x"), renew_interval=0.05)
+        elector.start()
+        assert elector.wait_for_leadership(timeout=5)
+        assert elector.is_leader
+        elector.stop()
+        assert FileLease(path, identity="y").try_acquire()
+
+
+class TestFleetFlowControl:
+    def test_describe_retry_survives_transient_inconsistency(self):
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.cloudprovider.simulated import CloudAPIError
+        from karpenter_tpu.cloudprovider.types import NodeRequest
+
+        api = SimCloudAPI()
+        provider = SimulatedCloudProvider(api)
+        catalog = provider.get_instance_types()
+        c = Constraints()
+        provider.default(c)
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        # first describe fails (eventual consistency); the retry succeeds
+        api.inject_error("describe_instances", CloudAPIError("not yet visible"))
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        assert node.metadata.name.startswith("i-")
+
+    def test_fleet_limiter_wired(self):
+        provider = SimulatedCloudProvider(SimCloudAPI())
+        limiter = provider.instance_provider.fleet_limiter
+        assert limiter.qps == 2.0 and limiter.burst == 100
