@@ -63,7 +63,7 @@ def _serve_endpoints(runtime: Runtime) -> None:
     :health_probe_port (reference: cmd/controller/main.go:86-89,
     controllers/manager.go:54-59)."""
     import threading
-    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from prometheus_client import start_http_server
 
@@ -76,6 +76,8 @@ def _serve_endpoints(runtime: Runtime) -> None:
     manager = runtime.manager
 
     class HealthHandler(BaseHTTPRequestHandler):
+        timeout = 10  # a stalled probe client must not wedge the server
+
         def do_GET(self):  # noqa: N802
             if self.path in ("/healthz", "/readyz"):
                 ok = manager.healthz()
@@ -89,7 +91,8 @@ def _serve_endpoints(runtime: Runtime) -> None:
         def log_message(self, *args):  # silence per-request stderr noise
             return
 
-    health = HTTPServer(("0.0.0.0", runtime.options.health_probe_port), HealthHandler)
+    health = ThreadingHTTPServer(("0.0.0.0", runtime.options.health_probe_port), HealthHandler)
+    health.daemon_threads = True
     threading.Thread(target=health.serve_forever, daemon=True, name="healthz").start()
     runtime.servers = [metrics_server, health]
 
@@ -179,7 +182,16 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     if runtime.options.leader_election_lease:
         from karpenter_tpu.utils.lease import FileLease, LeaderElector
 
-        runtime.elector = LeaderElector(FileLease(runtime.options.leader_election_lease))
+        def on_lost() -> None:
+            # stop reconciling immediately; healthz flips 503 so the
+            # liveness probe restarts the process as a fresh follower
+            # (the reference exits on lost leadership)
+            logger.critical("lost leadership lease; stopping controllers")
+            runtime.manager.stop()
+
+        runtime.elector = LeaderElector(
+            FileLease(runtime.options.leader_election_lease), on_lost=on_lost
+        )
         runtime.elector.start()
         logger.info("waiting for leadership (%s)", runtime.options.leader_election_lease)
         runtime.elector.wait_for_leadership()

@@ -111,7 +111,9 @@ class TpuScheduler:
                 )
         import jax
 
-        buf = jax.device_get(kernel.fuse_result(kernel.pack(*args, n_max=n_max)))
+        from karpenter_tpu.solver.pallas_kernel import pack_best
+
+        buf = jax.device_get(kernel.fuse_result(pack_best(*args, n_max=n_max)))
         return kernel.split_result(buf, p, n_max, r)
 
     def solve(

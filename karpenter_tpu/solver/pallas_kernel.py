@@ -1,0 +1,267 @@
+"""Pallas TPU packing kernel: the whole first-fit scan in ONE kernel.
+
+The ``lax.scan`` kernel (kernel.py) dispatches ~P sequential HLO steps; at
+10k pods the per-step overhead dominates (hundreds of ms). Here the entire
+scan runs inside a single Pallas kernel with the node table resident in
+VMEM: the per-pod body is a handful of VPU ops over [*, N] tiles, and the
+pod loop is a blocked ``fori_loop`` — no per-step dispatch, no HBM round
+trips.
+
+Same contract and assignment-exact semantics as ``kernel.pack`` (the parity
+test runs both). TPU constraints shape the implementation:
+
+- dynamic VMEM indexing must be 128-aligned, so pods are processed in
+  128-wide blocks: the block loads once (aligned), per-pod values are
+  extracted in registers via lane-mask + sum, and the block's assignment
+  vector is stored once;
+- ``join_table[s, core]`` needs a dynamic scalar read, so the join table
+  lives in SMEM;
+- ``frontiers[j]`` gathers unroll over the small static signature axis as
+  masked selects;
+- node-state updates are full-vector masked writes (cheaper than dynamic
+  scatters on TPU).
+
+Layouts are transposed so the large axis rides the 128-lane dimension:
+pod scalars [6, P] i32, pod requests [R, P] f32, node requests [R, N] f32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from karpenter_tpu.solver.kernel import PackResult
+
+# pod scalar row indices in the packed [6, P] array
+_VALID, _OPEN_SIG, _CORE, _HOST, _HOST_IN_BASE, _OPEN_HOST = range(6)
+
+BIG = 2**30  # plain int: jnp constants would be captured tracers
+BLOCK = 128  # lane width; dynamic VMEM indexing must be BLOCK-aligned
+
+
+def _pack_kernel(
+    pod_scal_ref,  # [6, P] i32 (VMEM)
+    pod_req_ref,  # [R, P] f32 (VMEM)
+    join_ref,  # [S, C] i32 (SMEM — dynamic scalar reads)
+    frontiers_ref,  # [S, F, R] f32 (VMEM, static reads)
+    daemon_ref,  # [R, 1] f32
+    assignment_ref,  # [1, P] i32 out
+    node_sig_ref,  # [1, N] i32 out
+    node_host_ref,  # [1, N] i32 out
+    node_req_ref,  # [R, N] f32 out
+    count_ref,  # [1, 1] i32 out (SMEM)
+    *,
+    n_cap: int,  # logical node limit — N is lane-padded above it, and
+    #   opening must stop at the CALLER'S n_max or the saturation-retry
+    #   contract (n_nodes == n_max) breaks and assignments index past the
+    #   sliced node arrays
+):
+    P = pod_scal_ref.shape[1]
+    N = node_sig_ref.shape[1]
+    R = pod_req_ref.shape[0]
+    S = frontiers_ref.shape[0]
+    F = frontiers_ref.shape[1]
+
+    node_sig_ref[:] = jnp.full((1, N), -1, jnp.int32)
+    node_host_ref[:] = jnp.full((1, N), -1, jnp.int32)
+    node_req_ref[:] = jnp.zeros((R, N), jnp.float32)
+    node_lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    blk_lane = lax.broadcasted_iota(jnp.int32, (1, BLOCK), 1)
+    daemon = daemon_ref[:]  # [R, 1]
+
+    def block_body(b, count):
+        start = pl.multiple_of(b * BLOCK, BLOCK)
+        scal_blk = pod_scal_ref[:, pl.ds(start, BLOCK)]  # [6, BLOCK]
+        req_blk = pod_req_ref[:, pl.ds(start, BLOCK)]  # [R, BLOCK]
+
+        def pod_body(k, carry):
+            count, assign_vec = carry
+            at_k = blk_lane == k  # [1, BLOCK]
+
+            def pick(row):  # scalar pod attribute from the loaded block
+                return jnp.sum(jnp.where(at_k, scal_blk[row : row + 1, :], 0))
+
+            valid = pick(_VALID) != 0
+            open_sig = pick(_OPEN_SIG)
+            core = pick(_CORE)
+            host = pick(_HOST)
+            host_in_base = pick(_HOST_IN_BASE) != 0
+            open_host = pick(_OPEN_HOST)
+            req = jnp.sum(jnp.where(at_k, req_blk, 0.0), axis=1, keepdims=True)  # [R,1]
+
+            node_sig = node_sig_ref[:]  # [1, N]
+            node_host = node_host_ref[:]
+            node_req = node_req_ref[:]  # [R, N]
+            is_open = node_sig >= 0
+            new_req = node_req + req
+
+            # j = join_table[node_sig, core]; fits = ∃f: new_req ≤ frontiers[j,f]
+            j = jnp.full((1, N), -1, jnp.int32)
+            for s in range(S):
+                j = jnp.where(node_sig == s, join_ref[s, core], j)
+            fits = jnp.zeros((1, N), jnp.bool_)
+            open_fits = jnp.zeros((), jnp.bool_)
+            open_req = daemon + req
+            for s in range(S):
+                fit_s = jnp.zeros((1, N), jnp.bool_)
+                open_fit_s = jnp.zeros((), jnp.bool_)
+                for f in range(F):
+                    limit = frontiers_ref[s, f, :].reshape(R, 1)  # static index
+                    fit_s = fit_s | jnp.all(new_req <= limit, axis=0, keepdims=True)
+                    open_fit_s = open_fit_s | jnp.all(open_req <= limit)
+                fits = fits | ((j == s) & fit_s)
+                open_fits = open_fits | ((open_sig == s) & open_fit_s)
+
+            ok_host = (host < 0) | ((node_host == -1) & host_in_base) | (node_host == host)
+            ok = (j >= 0) & is_open & ok_host & fits  # [1, N]
+
+            any_ok = jnp.any(ok)
+            first_ok = jnp.min(jnp.where(ok, node_lane, BIG))
+
+            can_open = open_fits & (count < n_cap)
+            schedulable = valid & (any_ok | can_open)
+            target = jnp.where(any_ok, first_ok, count)
+            at_target = node_lane == target  # [1, N]
+
+            def extract(vec):  # [1, N] → scalar at target
+                return jnp.sum(jnp.where(at_target, vec, 0))
+
+            upd_sig = jnp.where(any_ok, extract(j), open_sig)
+            upd_host = jnp.where(
+                any_ok, jnp.where(host >= 0, host, extract(node_host)), open_host
+            )
+            req_target = jnp.sum(jnp.where(at_target, new_req, 0.0), axis=1, keepdims=True)
+            upd_req = jnp.where(any_ok, req_target, open_req)  # [R, 1]
+
+            write = schedulable & at_target
+            node_sig_ref[:] = jnp.where(write, upd_sig, node_sig)
+            node_host_ref[:] = jnp.where(write, upd_host, node_host)
+            node_req_ref[:] = jnp.where(write, upd_req, node_req)
+
+            assign_vec = jnp.where(
+                at_k, jnp.where(schedulable, target, -1), assign_vec
+            )
+            count = count + jnp.where(schedulable & ~any_ok, 1, 0).astype(jnp.int32)
+            return count, assign_vec
+
+        count, assign_vec = lax.fori_loop(
+            0, BLOCK, pod_body, (count, jnp.full((1, BLOCK), -1, jnp.int32))
+        )
+        assignment_ref[:, pl.ds(start, BLOCK)] = assign_vec
+        return count
+
+    count = lax.fori_loop(0, P // BLOCK, block_body, jnp.zeros((), jnp.int32))
+    count_ref[0, 0] = count
+
+
+@partial(jax.jit, static_argnames=("n_max",))
+def pack_pallas(
+    pod_valid,
+    pod_open_sig,
+    pod_core,
+    pod_host,
+    pod_host_in_base,
+    pod_open_host,
+    pod_req,
+    join_table,
+    frontiers,
+    daemon,
+    n_max: int,
+) -> PackResult:
+    """Same signature/results as ``kernel.pack``, executed as one Pallas
+    kernel. ``n_max`` is rounded up to a lane multiple internally; P must be
+    a multiple of 128 (encode's buckets are)."""
+    P, R = pod_req.shape
+    if P % BLOCK != 0:
+        raise ValueError(f"pallas pack needs P % {BLOCK} == 0, got {P}")
+    n = max(BLOCK, ((n_max + BLOCK - 1) // BLOCK) * BLOCK)
+    pod_scal = jnp.stack(
+        [
+            pod_valid.astype(jnp.int32),
+            pod_open_sig.astype(jnp.int32),
+            pod_core.astype(jnp.int32),
+            pod_host.astype(jnp.int32),
+            pod_host_in_base.astype(jnp.int32),
+            pod_open_host.astype(jnp.int32),
+        ]
+    )  # [6, P]
+    assignment, node_sig, node_host, node_req_t, count = pl.pallas_call(
+        partial(_pack_kernel, n_cap=n_max),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, P), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((R, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+    )(
+        pod_scal,
+        pod_req.T.astype(jnp.float32),  # [R, P]
+        join_table.astype(jnp.int32),
+        frontiers.astype(jnp.float32),
+        daemon.astype(jnp.float32).reshape(R, 1),
+    )
+    return PackResult(
+        assignment=assignment[0],
+        node_sig=node_sig[0, :n_max],
+        node_host=node_host[0, :n_max],
+        node_req=node_req_t[:, :n_max].T,
+        n_nodes=count[0, 0],
+    )
+
+
+def pallas_available() -> bool:
+    """Pallas TPU kernels need a real TPU backend (tests run on CPU with the
+    lax.scan kernel)."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# shapes (P, n_max) whose pallas compile/run failed — only those fall back,
+# one pathological batch must not degrade every other shape in the process
+_pallas_failed_shapes: set = set()
+
+
+def pack_best(*args, n_max: int) -> PackResult:
+    """The fastest available packing kernel: Pallas on TPU (≈4× the lax.scan
+    kernel at 10k pods), lax.scan elsewhere or for shapes Pallas failed on."""
+    from karpenter_tpu.solver import kernel as _k
+
+    P = args[6].shape[0]  # pod_req
+    shape = (P, n_max)
+    if (
+        shape not in _pallas_failed_shapes
+        and P % BLOCK == 0
+        and pallas_available()
+    ):
+        try:
+            return pack_pallas(*args, n_max=n_max)
+        except Exception:
+            import logging
+
+            logging.getLogger("karpenter.solver").exception(
+                "pallas kernel failed for shape %s; lax.scan for this shape", shape
+            )
+            _pallas_failed_shapes.add(shape)
+    return _k.pack(*args, n_max=n_max)

@@ -103,10 +103,12 @@ class SolverService:
 
         from karpenter_tpu.solver import kernel
 
+        from karpenter_tpu.solver.pallas_kernel import pack_best
+
         arrays = unpack_arrays(request)
         *inputs, n_max_arr = arrays
         n_max = int(n_max_arr.reshape(-1)[0])
-        result = kernel.pack(*inputs, n_max=n_max)
+        result = pack_best(*inputs, n_max=n_max)
         # one fused device→host transfer on the sidecar too — per-array
         # fetches each pay the full device round trip
         buf = jax.device_get(kernel.fuse_result(result))

@@ -10,6 +10,8 @@ renewing lose the lease after the duration elapses.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
 import threading
@@ -50,37 +52,50 @@ class FileLease:
             json.dump(record, f)
         os.replace(tmp, self.path)
 
+    @contextlib.contextmanager
+    def _locked(self):
+        """flock-serialized critical section: acquire/renew are
+        read-modify-write, and two racers interleaving around the atomic
+        rename could BOTH conclude they hold the lease (split brain)."""
+        lock_path = f"{self.path}.flock"
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
     def try_acquire(self) -> bool:
-        now = self.clock()
-        current = self._read()
-        if current and current["holder"] != self.identity and current["expiry"] > now:
-            return False
-        self._write({"holder": self.identity, "expiry": now + self.duration})
-        # re-read to detect a racing writer (last rename wins)
-        latest = self._read()
-        return bool(latest and latest["holder"] == self.identity)
+        with self._locked():
+            now = self.clock()
+            current = self._read()
+            if current and current["holder"] != self.identity and current["expiry"] > now:
+                return False
+            self._write({"holder": self.identity, "expiry": now + self.duration})
+            return True
 
     def renew(self) -> bool:
-        now = self.clock()
-        current = self._read()
-        if (
-            not current
-            or current["holder"] != self.identity
-            or current["expiry"] <= now  # expired: a takeover may be racing
-        ):
-            return False
-        self._write({"holder": self.identity, "expiry": now + self.duration})
-        # re-read like try_acquire: a racing takeover's rename may have won
-        latest = self._read()
-        return bool(latest and latest["holder"] == self.identity)
+        with self._locked():
+            now = self.clock()
+            current = self._read()
+            if (
+                not current
+                or current["holder"] != self.identity
+                or current["expiry"] <= now  # expired: takeover may have won
+            ):
+                return False
+            self._write({"holder": self.identity, "expiry": now + self.duration})
+            return True
 
     def release(self) -> None:
-        current = self._read()
-        if current and current["holder"] == self.identity:
-            try:
-                os.remove(self.path)
-            except FileNotFoundError:
-                pass
+        with self._locked():
+            current = self._read()
+            if current and current["holder"] == self.identity:
+                try:
+                    os.remove(self.path)
+                except FileNotFoundError:
+                    pass
 
     def holder(self) -> Optional[str]:
         current = self._read()
@@ -91,11 +106,19 @@ class FileLease:
 
 class LeaderElector:
     """Blocks followers until leadership is acquired, then renews on a
-    heartbeat; ``is_leader`` flips false if renewal fails (lost lease)."""
+    heartbeat; ``is_leader`` flips false if renewal fails (lost lease) and
+    the ``on_lost`` callback fires — a second active leader must never keep
+    mutating cloud state (the reference exits the process on lost lease)."""
 
-    def __init__(self, lease: FileLease, renew_interval: float = DEFAULT_RENEW_INTERVAL):
+    def __init__(
+        self,
+        lease: FileLease,
+        renew_interval: float = DEFAULT_RENEW_INTERVAL,
+        on_lost: Optional[Callable[[], None]] = None,
+    ):
         self.lease = lease
         self.renew_interval = renew_interval
+        self.on_lost = on_lost
         self._leader = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -109,6 +132,8 @@ class LeaderElector:
             if self._leader.is_set():
                 if not self.lease.renew():
                     self._leader.clear()
+                    if self.on_lost is not None:
+                        self.on_lost()
             elif self.lease.try_acquire():
                 self._leader.set()
             self._stop.wait(self.renew_interval)

@@ -140,9 +140,13 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
     """
     import json
     import threading
-    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # per-connection read deadline: a stalled client (short body vs its
+        # Content-Length) must not wedge a handler thread forever
+        timeout = 10
+
         def _respond(self, code: int, body: dict) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
@@ -187,7 +191,8 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
             return
 
     host, port = address.rsplit(":", 1)
-    server = HTTPServer((host, int(port)), Handler)
+    server = ThreadingHTTPServer((host, int(port)), Handler)
+    server.daemon_threads = True
     threading.Thread(target=server.serve_forever, daemon=True, name="webhook").start()
     return server
 

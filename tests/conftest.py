@@ -8,12 +8,14 @@ dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
 import os
 
 # Force CPU even under the axon TPU tunnel (its sitecustomize registers the
-# TPU backend whenever PALLAS_AXON_POOL_IPS is set).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# TPU backend whenever PALLAS_AXON_POOL_IPS is set). Set KARPENTER_TEST_TPU=1
+# to run against the real chip instead (enables the pallas parity tests).
+if os.environ.get("KARPENTER_TEST_TPU") != "1":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import sys
 

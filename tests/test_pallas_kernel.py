@@ -1,0 +1,63 @@
+"""Pallas packing kernel parity: identical PackResult to the lax.scan kernel
+on real encoded batches. Runs only on a TPU backend — the CI suite (CPU mesh)
+exercises the lax.scan path, which pack_best selects there."""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.solver.pallas_kernel import BLOCK, pack_best, pallas_available
+
+pytestmark = pytest.mark.skipif(
+    not pallas_available(), reason="pallas pack needs a TPU backend"
+)
+
+
+def encoded_batch(n_pods, seed=42):
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(50), key=lambda it: it.effective_price())
+    provisioner = make_provisioner(solver="tpu")
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cc = c.clone()
+    Topology(Cluster(), rng=random.Random(1)).inject(cc, pods)
+    daemon = daemon_overhead(Cluster(), cc)
+    batch = enc.encode(cc, catalog, pods, daemon)
+    return (
+        batch.pod_valid, batch.pod_open_sig, batch.pod_core, batch.pod_host,
+        batch.pod_host_in_base, batch.pod_open_host, batch.pod_req,
+        batch.join_table, batch.frontiers, batch.daemon,
+    )
+
+
+@pytest.mark.parametrize("n_pods,n_max", [(100, 128), (500, 256), (1500, 512)])
+def test_pallas_matches_lax_kernel(n_pods, n_max):
+    import jax
+
+    from karpenter_tpu.solver import kernel
+    from karpenter_tpu.solver.pallas_kernel import pack_pallas
+
+    args = encoded_batch(n_pods)
+    assert args[6].shape[0] % BLOCK == 0
+    ref = jax.device_get(tuple(kernel.pack(*args, n_max=n_max)))
+    out = jax.device_get(tuple(pack_pallas(*args, n_max=n_max)))
+    for name, a, b in zip(kernel.PackResult._fields, ref, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_pack_best_selects_a_working_kernel():
+    import jax
+
+    args = encoded_batch(200)
+    result = pack_best(*args, n_max=128)
+    n_nodes = int(np.asarray(jax.device_get(result.n_nodes)).reshape(-1)[0])
+    assert n_nodes > 0
