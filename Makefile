@@ -26,6 +26,9 @@ benchmark-grid:  ## the reference's full batch grid
 benchmark-consolidation:  ## BASELINE config 5: 1k-node re-pack
 	$(PY) bench.py --consolidation 1000
 
+benchmark-multi:  ## BASELINE config 4: concurrent provisioner batches on the mesh
+	$(PY) bench.py --multi 8 --pods 1250
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \

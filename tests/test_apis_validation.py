@@ -1,0 +1,123 @@
+"""CRD validation/defaulting semantics (mirrors
+pkg/apis/provisioning/v1alpha5/suite_test.go): TTLs, restricted labels and
+domains, taint shapes, requirement operators, limits arithmetic."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Taint
+from karpenter_tpu.api.provisioner import (
+    Limits,
+    default_provisioner,
+    validate_provisioner,
+)
+from tests.factories import make_provisioner
+
+
+def errs_of(provisioner):
+    return validate_provisioner(provisioner)
+
+
+class TestTTLValidation:
+    def test_negative_ttls_rejected(self):
+        assert errs_of(make_provisioner(ttl_after_empty=-1))
+        assert errs_of(make_provisioner(ttl_until_expired=-1))
+
+    def test_zero_and_positive_ttls_allowed(self):
+        assert not errs_of(make_provisioner(ttl_after_empty=0, ttl_until_expired=600))
+
+    def test_unset_ttls_allowed(self):
+        assert not errs_of(make_provisioner())
+
+
+class TestLabelValidation:
+    def test_well_known_labels_allowed(self):
+        assert not errs_of(make_provisioner(labels={lbl.TOPOLOGY_ZONE: "z1"}))
+
+    def test_restricted_domain_rejected(self):
+        assert errs_of(make_provisioner(labels={"kubernetes.io/hostname": "x"}))
+        assert errs_of(make_provisioner(labels={"karpenter.sh/custom": "x"}))
+        assert errs_of(make_provisioner(labels={"node.k8s.io/foo": "x"}))
+
+    def test_domain_exception_allowed(self):
+        assert not errs_of(make_provisioner(labels={"kops.k8s.io/instancegroup": "x"}))
+
+    def test_custom_domain_allowed(self):
+        assert not errs_of(make_provisioner(labels={"example.com/team": "infra"}))
+
+    def test_empty_label_value_rejected(self):
+        assert errs_of(make_provisioner(labels={"example.com/team": ""}))
+
+
+class TestTaintValidation:
+    def test_valid_taint(self):
+        assert not errs_of(
+            make_provisioner(taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        )
+
+    def test_empty_key_rejected(self):
+        assert errs_of(make_provisioner(taints=[Taint(key="", effect="NoSchedule")]))
+
+    def test_bad_effect_rejected(self):
+        assert errs_of(make_provisioner(taints=[Taint(key="k", effect="Sometimes")]))
+
+
+class TestRequirementValidation:
+    def test_provisioner_ops_limited(self):
+        # provisioners may use In/NotIn/Exists; DoesNotExist is pod-only
+        # (reference: provisioner_validation.go:30-31)
+        ok = make_provisioner(
+            requirements=[NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["z"])]
+        )
+        assert not errs_of(ok)
+        bad = make_provisioner(
+            requirements=[NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="DoesNotExist")]
+        )
+        assert errs_of(bad)
+
+    def test_unknown_operator_rejected(self):
+        assert errs_of(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="Gt", values=["3"])]
+            )
+        )
+
+    def test_restricted_requirement_key_rejected(self):
+        assert errs_of(
+            make_provisioner(
+                requirements=[
+                    NodeSelectorRequirement(key=lbl.HOSTNAME, operator="In", values=["n1"])
+                ]
+            )
+        )
+
+    def test_infeasible_intersection_rejected(self):
+        assert errs_of(
+            make_provisioner(
+                requirements=[
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["a"]),
+                    NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In", values=["b"]),
+                ]
+            )
+        )
+
+    def test_bad_solver_rejected(self):
+        assert errs_of(make_provisioner(solver="quantum"))
+
+
+class TestDefaults:
+    def test_solver_default_applied_once(self):
+        p = make_provisioner()
+        p.spec.solver = ""
+        default_provisioner(p, "tpu")
+        assert p.spec.solver == "tpu"
+        default_provisioner(p, "ffd")  # idempotent: explicit value wins
+        assert p.spec.solver == "tpu"
+
+
+class TestLimits:
+    def test_exceeded_by(self):
+        limits = Limits(resources={"cpu": 10.0})
+        assert limits.exceeded_by({"cpu": 10.0}) is not None  # at the limit
+        assert limits.exceeded_by({"cpu": 9.9}) is None
+        assert limits.exceeded_by({"memory": 1e12}) is None  # unlimited resource
