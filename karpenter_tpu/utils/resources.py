@@ -108,7 +108,25 @@ def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
 
 def requests_for_pods(*pods) -> ResourceList:
     """Total requests of the pods plus a `pods` count
-    (reference: resources.go:25-35)."""
+    (reference: resources.go:25-35).
+
+    The single-pod case is memoized on the pod object (keyed by the identity
+    of its containers list, which scheduling never mutates): a 10k-pod solve
+    calls this twice per pod (FFD sort + encode) and the repeated merges were
+    a top-3 profile entry."""
+    if len(pods) == 1:
+        pod = pods[0]
+        containers = pod.spec.containers
+        cached = getattr(pod, "_requests_memo", None)
+        if cached is not None and cached[0] is containers:
+            return dict(cached[1])
+        out = merge(*(c.requests for c in containers))
+        out[PODS] = out.get(PODS, 0.0) + 1.0
+        try:
+            pod._requests_memo = (containers, dict(out))
+        except AttributeError:
+            pass  # slotted/frozen pod types just skip the memo
+        return out
     out = merge(*(p.resource_requests() for p in pods))
     out[PODS] = out.get(PODS, 0.0) + float(len(pods))
     return out
