@@ -186,6 +186,51 @@ class TestExecution:
         assert sum(len(v.pods) for v in plan.proposed) == 2  # both re-seated
         assert plan.worthwhile
 
+    def test_consolidation_under_live_manager(self):
+        """The full async loop: consolidation reconciles via the manager,
+        migrates pods to cheaper capacity, and termination drains the old
+        nodes to completion."""
+        import time
+
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.main import build_runtime
+
+        runtime = build_runtime(
+            cloud_provider=FakeCloudProvider(instance_types(20)),
+            start_workers=True,
+            consolidation_enabled=True,
+        )
+        cluster = runtime.cluster
+        cluster.create("provisioners", make_provisioner())
+        fragmented_cluster(cluster)
+        runtime.manager.start()
+        try:
+            runtime.manager.enqueue("consolidation", "default")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                live = [
+                    n for n in cluster.nodes() if n.metadata.deletion_timestamp is None
+                ]
+                old_gone = all(
+                    cluster.try_get("nodes", f"big-{i}", namespace="") is None
+                    for i in range(4)
+                )
+                if len(live) < 4 and old_gone:
+                    break
+                time.sleep(0.05)
+            live = [n for n in cluster.nodes() if n.metadata.deletion_timestamp is None]
+            assert len(live) < 4  # consolidated
+            # termination finished draining every retired node
+            for i in range(4):
+                assert cluster.try_get("nodes", f"big-{i}", namespace="") is None
+            # every pod survived the migration, seated on a live node
+            live_names = {n.metadata.name for n in live}
+            pods = cluster.pods()
+            assert len(pods) == 4
+            assert all(p.spec.node_name in live_names for p in pods)
+        finally:
+            runtime.stop()
+
     def test_tpu_solver_consolidation(self):
         cluster, provider, provisioner, controller = build_env(solver="tpu")
         fragmented_cluster(cluster)

@@ -149,6 +149,101 @@ class TestPriceOptimality:
         )
 
 
+class TestWellKnownLabels:
+    """nodeSelector on every well-known label lands on a matching node
+    (reference: suite_test.go well-known-labels context)."""
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    @pytest.mark.parametrize(
+        "key,value,check",
+        [
+            (lbl.INSTANCE_TYPE, "fake-it-3", lambda it: it.name == "fake-it-3"),
+            (lbl.ARCH, lbl.ARCH_AMD64, lambda it: it.architecture == "amd64"),
+            (lbl.OS, "linux", lambda it: "linux" in it.operating_systems),
+            (lbl.CAPACITY_TYPE, "spot", lambda it: "spot" in it.capacity_types()),
+            (lbl.TOPOLOGY_ZONE, "test-zone-2", lambda it: "test-zone-2" in it.zones()),
+        ],
+    )
+    def test_selector_lands_on_matching_type(self, solver, key, value, check):
+        pod = make_pod(requests={"cpu": "0.5"}, node_selector={key: value})
+        vnodes = solve([pod], instance_types(10), solver=solver)
+        assert len(vnodes) == 1
+        chosen = vnodes[0].instance_type_options[0]
+        assert check(chosen)
+        assert vnodes[0].constraints.requirements.get(key).has(value)
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_beta_label_normalized(self, solver):
+        pod = make_pod(
+            requests={"cpu": "0.5"},
+            node_selector={"failure-domain.beta.kubernetes.io/zone": "test-zone-2"},
+        )
+        vnodes = solve([pod], instance_types(10), solver=solver)
+        assert len(vnodes) == 1
+        assert vnodes[0].constraints.requirements.zones() == {"test-zone-2"}
+
+
+class TestCombinedTopology:
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_zone_and_hostname_spread_together(self, solver):
+        """Pods with BOTH constraints satisfy both: ≤ maxSkew per zone and
+        one pod per hostname (reference: combined topology context)."""
+        from karpenter_tpu.testing.factories import hostname_spread, zone_spread
+
+        sel = {"app": "both"}
+        pods = [
+            make_pod(
+                labels=sel, requests={"cpu": "0.5"},
+                topology=[zone_spread(max_skew=1, labels=sel),
+                          hostname_spread(max_skew=1, labels=sel)],
+            )
+            for _ in range(6)
+        ]
+        vnodes = solve(pods, instance_types(10), solver=solver)
+        assert sum(len(v.pods) for v in vnodes) == 6
+        # hostname skew 1 → one pod per node
+        assert all(len(v.pods) == 1 for v in vnodes)
+        # zone skew ≤ 1 across the three zones
+        zone_counts = {}
+        for v in vnodes:
+            zones = v.constraints.requirements.zones()
+            assert len(zones) == 1
+            z = next(iter(zones))
+            zone_counts[z] = zone_counts.get(z, 0) + len(v.pods)
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+class TestPreferredNodeAffinity:
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_heaviest_preferred_term_folded_in(self, solver):
+        """The heaviest preferred term acts as a requirement at solve time
+        (reference: requirements.go:55-75; relaxation removes it on retry)."""
+        from karpenter_tpu.api.objects import NodeSelectorTerm, PreferredSchedulingTerm
+
+        pod = make_pod(
+            requests={"cpu": "0.5"},
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In",
+                                                values=["test-zone-1"])
+                    ]),
+                ),
+                PreferredSchedulingTerm(
+                    weight=50,
+                    preference=NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In",
+                                                values=["test-zone-3"])
+                    ]),
+                ),
+            ],
+        )
+        vnodes = solve([pod], instance_types(10), solver=solver)
+        assert len(vnodes) == 1
+        assert vnodes[0].constraints.requirements.zones() == {"test-zone-3"}
+
+
 class TestBinpacking:
     """reference: suite_test.go:1813+ against the default fake catalog."""
 
