@@ -258,6 +258,49 @@ def _tags_match(tags: Dict[str, str], selector: Dict[str, str]) -> bool:
 
 
 @dataclass
+class BlockDeviceMapping:
+    """reference: aws/apis/v1alpha1/provider.go BlockDeviceMappings."""
+
+    device_name: str = "/dev/xvda"
+    volume_size_gib: int = 20
+    volume_type: str = "gp3"
+    encrypted: bool = True
+    delete_on_termination: bool = True
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.device_name:
+            errs.append("blockDeviceMapping deviceName must not be empty")
+        if self.volume_size_gib <= 0:
+            errs.append(f"blockDeviceMapping volumeSize {self.volume_size_gib} must be positive")
+        if self.volume_type not in ("gp2", "gp3", "io1", "io2", "st1", "sc1", "standard"):
+            errs.append(f"blockDeviceMapping volumeType {self.volume_type} not recognized")
+        return errs
+
+
+@dataclass
+class MetadataOptions:
+    """Instance metadata service settings
+    (reference: aws/apis/v1alpha1/provider.go MetadataOptions)."""
+
+    http_endpoint: str = "enabled"
+    http_tokens: str = "required"  # IMDSv2 by default
+    http_put_response_hop_limit: int = 2
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.http_endpoint not in ("enabled", "disabled"):
+            errs.append(f"metadataOptions httpEndpoint {self.http_endpoint} invalid")
+        if self.http_tokens not in ("required", "optional"):
+            errs.append(f"metadataOptions httpTokens {self.http_tokens} invalid")
+        if not 1 <= self.http_put_response_hop_limit <= 64:
+            errs.append(
+                f"metadataOptions hopLimit {self.http_put_response_hop_limit} not in 1..64"
+            )
+        return errs
+
+
+@dataclass
 class SimProviderConfig:
     """The vendor block embedded in ``provisioner.spec.provider``."""
 
@@ -267,30 +310,103 @@ class SimProviderConfig:
     image_family: str = DEFAULT_IMAGE_FAMILY
     tags: Dict[str, str] = field(default_factory=dict)
     launch_template: str = ""  # bring-your-own template name
-    # presence flag: an explicitly-specified selector conflicts with
-    # launchTemplate even when it equals the default
+    block_device_mappings: List[BlockDeviceMapping] = field(default_factory=list)
+    metadata_options: MetadataOptions = field(default_factory=MetadataOptions)
+    # presence flags: explicitly-specified fields conflict with launchTemplate
+    # even when they equal the defaults
     security_group_selector_specified: bool = False
+    metadata_options_specified: bool = False
+    # malformed input collected at deserialize time so validate() can report
+    # field errors instead of the parse crashing reconcile/webhook paths
+    parse_errors: List[str] = field(default_factory=list)
 
     @staticmethod
     def deserialize(provider: Optional[Dict[str, Any]]) -> "SimProviderConfig":
-        """reference: aws/apis/v1alpha1/provider.go:195-210."""
+        """reference: aws/apis/v1alpha1/provider.go:195-210. Lenient: bad
+        field types become ``parse_errors`` surfaced by ``validate()``."""
         if not provider:
             return SimProviderConfig()
+        errors: List[str] = []
+
+        def build(cls, raw, key_map: Dict[str, str], label: str):
+            """Dataclass from present keys only — the dataclass defaults stay
+            the single source of truth for absent fields."""
+            kwargs = {}
+            if raw is None:
+                raw = {}
+            if not isinstance(raw, dict):
+                errors.append(f"{label} must be an object, got {type(raw).__name__}")
+                raw = {}
+            for doc_key, field_name in key_map.items():
+                if doc_key not in raw:
+                    continue
+                value = raw[doc_key]
+                target = cls.__dataclass_fields__[field_name].type
+                try:
+                    if target == "int":
+                        value = int(value)
+                    elif target == "bool":
+                        if isinstance(value, str):
+                            value = value.lower() == "true"
+                        else:
+                            value = bool(value)
+                    else:
+                        value = str(value)
+                except (TypeError, ValueError):
+                    errors.append(f"{label}.{doc_key}: invalid value {value!r}")
+                    continue
+                kwargs[field_name] = value
+            return cls(**kwargs)
+
+        bdms_raw = provider.get("blockDeviceMappings") or []
+        if not isinstance(bdms_raw, list):
+            errors.append("blockDeviceMappings must be a list")
+            bdms_raw = []
+        bdms = [
+            build(
+                BlockDeviceMapping,
+                b,
+                {
+                    "deviceName": "device_name",
+                    "volumeSize": "volume_size_gib",
+                    "volumeType": "volume_type",
+                    "encrypted": "encrypted",
+                    "deleteOnTermination": "delete_on_termination",
+                },
+                f"blockDeviceMappings[{i}]",
+            )
+            for i, b in enumerate(bdms_raw)
+        ]
+        metadata = build(
+            MetadataOptions,
+            provider.get("metadataOptions"),
+            {
+                "httpEndpoint": "http_endpoint",
+                "httpTokens": "http_tokens",
+                "httpPutResponseHopLimit": "http_put_response_hop_limit",
+            },
+            "metadataOptions",
+        )
         return SimProviderConfig(
-            instance_profile=provider.get("instanceProfile", ""),
-            subnet_selector=dict(provider.get("subnetSelector", DEFAULT_SELECTOR)),
+            instance_profile=str(provider.get("instanceProfile", "")),
+            # absent → default; explicitly empty/null → {} so validate rejects
+            subnet_selector=dict(provider.get("subnetSelector", DEFAULT_SELECTOR) or {}),
             security_group_selector=dict(
-                provider.get("securityGroupSelector", DEFAULT_SELECTOR)
+                provider.get("securityGroupSelector", DEFAULT_SELECTOR) or {}
             ),
-            image_family=provider.get("imageFamily", DEFAULT_IMAGE_FAMILY),
-            tags=dict(provider.get("tags", {})),
-            launch_template=provider.get("launchTemplate", ""),
+            image_family=str(provider.get("imageFamily", DEFAULT_IMAGE_FAMILY)),
+            tags=dict(provider.get("tags") or {}),
+            launch_template=str(provider.get("launchTemplate", "")),
+            block_device_mappings=bdms,
+            metadata_options=metadata,
             security_group_selector_specified="securityGroupSelector" in provider,
+            metadata_options_specified="metadataOptions" in provider,
+            parse_errors=errors,
         )
 
     def validate(self) -> List[str]:
         """reference: aws/apis/v1alpha1/provider_validation.go:41-226."""
-        errs = []
+        errs = list(self.parse_errors)
         if self.image_family not in IMAGE_FAMILIES:
             errs.append(f"imageFamily {self.image_family} not in {IMAGE_FAMILIES}")
         if self.launch_template and (
@@ -299,6 +415,12 @@ class SimProviderConfig:
         ):
             # a custom launch template brings its own security groups
             errs.append("may not specify both launchTemplate and securityGroupSelector")
+        if self.launch_template and self.block_device_mappings:
+            errs.append("may not specify both launchTemplate and blockDeviceMappings")
+        if self.launch_template and self.metadata_options_specified:
+            # BYO templates carry their own IMDS settings; silently dropping
+            # the user's would be worse than rejecting
+            errs.append("may not specify both launchTemplate and metadataOptions")
         for selector, name in ((self.subnet_selector, "subnetSelector"),
                                (self.security_group_selector, "securityGroupSelector")):
             if not selector:
@@ -306,6 +428,9 @@ class SimProviderConfig:
         for k in self.tags:
             if k.startswith(lbl.GROUP):
                 errs.append(f"tag {k} uses the restricted {lbl.GROUP} prefix")
+        for bdm in self.block_device_mappings:
+            errs.extend(bdm.validate())
+        errs.extend(self.metadata_options.validate())
         return errs
 
 
@@ -471,13 +596,30 @@ class LaunchTemplateProvider:
             return config.launch_template  # bring-your-own
         image = self._resolve_image(config.image_family, needs_gpu)
         groups = [g.id for g in self.security_groups.get(config)]
+        bdms = config.block_device_mappings or [BlockDeviceMapping()]
         data = {
             "image": image,
             "instance_profile": config.instance_profile,
             "security_groups": sorted(groups),
             "tags": dict(sorted(config.tags.items())),
-            "labels": dict(sorted(constraints.labels.items())),
-            "taints": sorted(f"{t.key}={t.value}:{t.effect}" for t in constraints.taints),
+            "labels": dict(_sorted_labels(constraints)),
+            "taints": _rendered_taints(constraints),
+            "block_device_mappings": [
+                {
+                    "device_name": b.device_name,
+                    "volume_size_gib": b.volume_size_gib,
+                    "volume_type": b.volume_type,
+                    "encrypted": b.encrypted,
+                    "delete_on_termination": b.delete_on_termination,
+                }
+                for b in bdms
+            ],
+            "metadata_options": {
+                "http_endpoint": config.metadata_options.http_endpoint,
+                "http_tokens": config.metadata_options.http_tokens,
+                "http_put_response_hop_limit": config.metadata_options.http_put_response_hop_limit,
+            },
+            "user_data": bootstrap_user_data(config.image_family, constraints),
         }
         name = "karpenter-lt-" + hashlib.sha256(
             json.dumps(data, sort_keys=True).encode()
@@ -495,6 +637,47 @@ class LaunchTemplateProvider:
         if needs_gpu:
             return f"img-{family}-gpu-v1"
         return f"img-{family}-v1"
+
+
+def _sorted_labels(constraints: Constraints):
+    return sorted(constraints.labels.items())
+
+
+def _rendered_taints(constraints: Constraints) -> List[str]:
+    """One rendering shared by the template hash and the bootstrap payload —
+    they must never disagree."""
+    return sorted(f"{t.key}={t.value}:{t.effect}" for t in constraints.taints)
+
+
+def bootstrap_user_data(image_family: str, constraints: Constraints) -> str:
+    """Node bootstrap payload: kubelet register-time labels/taints and
+    cluster DNS, per image family — the reference's bootstrap-script
+    generator shapes the same arguments (amifamily/bootstrap/
+    eksbootstrap.go:32; Bottlerocket uses TOML instead of shell).
+    ``standard``/``gpu`` render a shell bootstrap; ``minimal`` renders a
+    TOML settings file (the Bottlerocket analog)."""
+    labels = ",".join(f"{k}={v}" for k, v in _sorted_labels(constraints))
+    taints = ",".join(_rendered_taints(constraints))
+    dns = ""
+    if constraints.kubelet_configuration and constraints.kubelet_configuration.cluster_dns:
+        dns = constraints.kubelet_configuration.cluster_dns[0]
+    if image_family == "minimal":
+        lines = ["[settings.kubernetes]"]
+        if labels:
+            lines.append(f'node-labels = "{labels}"')
+        if taints:
+            lines.append(f'node-taints = "{taints}"')
+        if dns:
+            lines.append(f'cluster-dns-ip = "{dns}"')
+        return "\n".join(lines)
+    args = ["/etc/bootstrap.sh"]
+    if labels:
+        args.append(f"--node-labels={labels}")
+    if taints:
+        args.append(f"--register-with-taints={taints}")
+    if dns:
+        args.append(f"--cluster-dns={dns}")
+    return " ".join(args)
 
 
 class InstanceProvider:

@@ -221,6 +221,110 @@ class TestLaunchTemplates:
         assert api.launch_templates == {}  # nothing created
 
 
+class TestLaunchTemplateContents:
+    def test_user_data_carries_labels_taints_dns(self, env):
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.api.provisioner import KubeletConfiguration
+
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        c.labels = {"team": "infra"}
+        c.taints = [Taint(key="dedicated", value="gpu", effect="NoSchedule")]
+        c.kubelet_configuration = KubeletConfiguration(cluster_dns=["10.0.0.10"])
+        provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        data = next(iter(api.launch_templates.values()))
+        ud = data["user_data"]
+        assert "--node-labels=team=infra" in ud
+        assert "--register-with-taints=dedicated=gpu:NoSchedule" in ud
+        assert "--cluster-dns=10.0.0.10" in ud
+
+    def test_minimal_family_renders_toml(self, env):
+        api, provider, _ = env
+        cfg = {"imageFamily": "minimal"}
+        c, catalog = constraints_for(provider, provider_cfg=cfg)
+        c.provider = cfg
+        c.labels = {"team": "infra"}
+        provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        data = next(iter(api.launch_templates.values()))
+        assert data["user_data"].startswith("[settings.kubernetes]")
+        assert 'node-labels = "team=infra"' in data["user_data"]
+
+    def test_block_device_mappings_and_metadata_options(self, env):
+        api, provider, _ = env
+        cfg = {
+            "blockDeviceMappings": [
+                {"deviceName": "/dev/xvdb", "volumeSize": 100, "volumeType": "gp3"}
+            ],
+            "metadataOptions": {"httpTokens": "optional"},
+        }
+        c, catalog = constraints_for(provider, provider_cfg=cfg)
+        c.provider = cfg
+        provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        data = next(iter(api.launch_templates.values()))
+        assert data["block_device_mappings"][0]["volume_size_gib"] == 100
+        assert data["metadata_options"]["http_tokens"] == "optional"
+
+    def test_bad_bdm_and_metadata_rejected(self, env):
+        _, provider, _ = env
+        from karpenter_tpu.api.provisioner import Constraints
+
+        errs = provider.validate(
+            Constraints(provider={"blockDeviceMappings": [{"volumeSize": -1}]})
+        )
+        assert any("volumeSize" in e for e in errs)
+        errs = provider.validate(
+            Constraints(provider={"metadataOptions": {"httpTokens": "never"}})
+        )
+        assert any("httpTokens" in e for e in errs)
+
+    def test_malformed_provider_yields_errors_not_crash(self, env):
+        _, provider, _ = env
+        from karpenter_tpu.api.provisioner import Constraints
+
+        errs = provider.validate(
+            Constraints(provider={"blockDeviceMappings": [{"volumeSize": "100Gi"}]})
+        )
+        assert any("volumeSize" in e for e in errs)
+        # YAML 'metadataOptions:' with no body deserializes to None
+        errs = provider.validate(Constraints(provider={"metadataOptions": None}))
+        assert errs == []  # empty object = defaults, no crash
+        errs = provider.validate(Constraints(provider={"blockDeviceMappings": "nope"}))
+        assert any("must be a list" in e for e in errs)
+
+    def test_encrypted_false_string_respected(self, env):
+        from karpenter_tpu.cloudprovider.simulated import SimProviderConfig
+
+        cfg = SimProviderConfig.deserialize(
+            {"blockDeviceMappings": [{"encrypted": "false"}]}
+        )
+        assert cfg.block_device_mappings[0].encrypted is False
+
+    def test_byo_lt_conflicts_with_metadata_options(self, env):
+        _, provider, _ = env
+        from karpenter_tpu.api.provisioner import Constraints
+
+        errs = provider.validate(
+            Constraints(
+                provider={"launchTemplate": "mine", "metadataOptions": {"httpTokens": "optional"}}
+            )
+        )
+        assert any("metadataOptions" in e for e in errs)
+
+    def test_byo_lt_conflicts_with_bdms(self, env):
+        _, provider, _ = env
+        from karpenter_tpu.api.provisioner import Constraints
+
+        errs = provider.validate(
+            Constraints(
+                provider={
+                    "launchTemplate": "mine",
+                    "blockDeviceMappings": [{"deviceName": "/dev/xvda"}],
+                }
+            )
+        )
+        assert any("blockDeviceMappings" in e for e in errs)
+
+
 class TestValidationDefaults:
     def test_defaults_applied(self, env):
         _, provider, _ = env
