@@ -109,11 +109,14 @@ class TpuScheduler:
                     "solver service %s failed (%s); in-process kernel for %.0fs",
                     self.service_address, e, REMOTE_BREAKER_SECONDS,
                 )
-        import jax
-
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
-        buf = jax.device_get(kernel.fuse_result(pack_best(*args, n_max=n_max)))
+        result = pack_best(*args, n_max=n_max)
+        if isinstance(result.assignment, np.ndarray):
+            return result  # native CPU packer: already host arrays
+        import jax
+
+        buf = jax.device_get(kernel.fuse_result(result))
         return kernel.split_result(buf, p, n_max, r)
 
     def solve(

@@ -1,0 +1,163 @@
+"""ctypes binding for the native (C++) first-fit packer.
+
+The shared library is compiled from ``native/ffd_pack.cpp`` on first use
+(g++ is part of the toolchain; pybind11 is not, hence ctypes). Same contract
+as ``kernel.pack``; used by ``pack_best`` when no TPU backend is present —
+the in-process CPU path runs native instead of a 10k-step XLA scan.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.solver.kernel import PackResult
+
+logger = logging.getLogger("karpenter.solver.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "ffd_pack.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libffd_pack.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+_build_thread: Optional[threading.Thread] = None
+
+
+def _build_and_load() -> None:
+    global _lib, _load_failed
+    try:
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            # compile to a unique temp path and atomically rename: concurrent
+            # processes sharing the checkout must never dlopen a half-written
+            # library (last writer wins, every rename is a complete file)
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, _LIB)
+        lib = ctypes.CDLL(_LIB)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ffd_pack.restype = ctypes.c_int32
+        lib.ffd_pack.argtypes = [
+            u8p, i32p, i32p, i32p, u8p, i32p, f32p, i32p, f32p, f32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p, i32p, f32p,
+        ]
+        with _lock:
+            _lib = lib
+    except Exception:
+        logger.exception("native packer unavailable; using JAX kernel")
+        with _lock:
+            _load_failed = True
+
+
+def _kick_build() -> None:
+    """Start the (one-time) background build; never blocks the caller —
+    a first solve must not wait out a g++ compile."""
+    global _build_thread
+    with _lock:
+        if _lib is not None or _load_failed or (
+            _build_thread is not None and _build_thread.is_alive()
+        ):
+            return
+        _build_thread = threading.Thread(
+            target=_build_and_load, daemon=True, name="ffd-pack-build"
+        )
+        _build_thread.start()
+
+
+def native_available(wait: Optional[float] = None) -> bool:
+    """Non-blocking by default: kicks the background build and reports
+    whether the library is loaded NOW. Pass ``wait`` seconds to block for
+    the build (tests do)."""
+    _kick_build()
+    if wait is not None:
+        thread = _build_thread
+        if thread is not None:
+            thread.join(timeout=wait)
+    with _lock:
+        return _lib is not None
+
+
+def _ensure_lib() -> Optional[ctypes.CDLL]:
+    _kick_build()
+    with _lock:
+        return _lib
+
+
+def pack_native(
+    pod_valid,
+    pod_open_sig,
+    pod_core,
+    pod_host,
+    pod_host_in_base,
+    pod_open_host,
+    pod_req,
+    join_table,
+    frontiers,
+    daemon,
+    n_max: int,
+) -> PackResult:
+    """Same signature/results as ``kernel.pack``, on the CPU in native code."""
+    lib = _ensure_lib()
+    if lib is None:
+        raise RuntimeError("native packer unavailable")
+
+    def as_np(a, dtype):
+        return np.ascontiguousarray(np.asarray(a), dtype=dtype)
+
+    valid = as_np(pod_valid, np.uint8)
+    open_sig = as_np(pod_open_sig, np.int32)
+    core = as_np(pod_core, np.int32)
+    host = as_np(pod_host, np.int32)
+    host_in_base = as_np(pod_host_in_base, np.uint8)
+    open_host = as_np(pod_open_host, np.int32)
+    req = as_np(pod_req, np.float32)
+    join = as_np(join_table, np.int32)
+    fr = as_np(frontiers, np.float32)
+    dm = as_np(daemon, np.float32)
+
+    P, R = req.shape
+    S, F, _ = fr.shape
+    C = join.shape[1]
+    assignment = np.empty(P, np.int32)
+    node_sig = np.empty(n_max, np.int32)
+    node_host = np.empty(n_max, np.int32)
+    node_req = np.empty((n_max, R), np.float32)
+
+    def ptr(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    count = lib.ffd_pack(
+        ptr(valid, ctypes.c_uint8), ptr(open_sig, ctypes.c_int32),
+        ptr(core, ctypes.c_int32), ptr(host, ctypes.c_int32),
+        ptr(host_in_base, ctypes.c_uint8), ptr(open_host, ctypes.c_int32),
+        ptr(req, ctypes.c_float), ptr(join, ctypes.c_int32),
+        ptr(fr, ctypes.c_float), ptr(dm, ctypes.c_float),
+        P, R, S, C, F, n_max,
+        ptr(assignment, ctypes.c_int32), ptr(node_sig, ctypes.c_int32),
+        ptr(node_host, ctypes.c_int32), ptr(node_req, ctypes.c_float),
+    )
+    if count < 0:
+        raise RuntimeError(f"native packer error {count}")
+    return PackResult(
+        assignment=assignment,
+        node_sig=node_sig,
+        node_host=node_host,
+        node_req=node_req,
+        n_nodes=np.int32(count),
+    )

@@ -16,6 +16,12 @@ if os.environ.get("KARPENTER_TEST_TPU") != "1":
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    # The env var alone is NOT enough: the axon plugin's sitecustomize runs
+    # at interpreter startup (before conftest) and registers the TPU backend
+    # regardless; jax.config still wins if no backend was initialized yet.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import sys
 
