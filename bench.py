@@ -190,7 +190,28 @@ def main():
                     help="bench the consolidation re-pack of N live nodes instead")
     ap.add_argument("--multi", type=int, metavar="N_PROVISIONERS", default=0,
                     help="bench N provisioners' batches solved concurrently on the mesh")
+    ap.add_argument("--profile", metavar="OUT", default="",
+                    help="write cProfile stats for one solve (the pprof-harness analog, "
+                         "reference: scheduling_benchmark_test.go:76-108)")
     args = ap.parse_args()
+
+    if args.profile:
+        import cProfile
+
+        catalog = instance_types(400)
+        provisioner = make_provisioner(solver=args.solver)
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = diverse_pods(args.pods, random.Random(42))
+        scheduler = Scheduler(Cluster(), rng=random.Random(1))
+        scheduler.solve(provisioner, catalog, pods)  # warm
+        cProfile.runctx(
+            "scheduler.solve(provisioner, catalog, pods)",
+            globals(), locals(), filename=args.profile,
+        )
+        print(f"# wrote cProfile stats to {args.profile} "
+              f"(inspect: python -m pstats {args.profile})", file=sys.stderr)
+        return
 
     if args.multi:
         r = bench_multi_provisioner(args.multi, args.pods, max(args.iters, 2))

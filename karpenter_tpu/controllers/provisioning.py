@@ -109,12 +109,18 @@ class ProvisionerWorker:
             nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
             # parallel launch per virtual node (reference: provisioner.go:113)
             with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
-                list(pool.map(self._launch, nodes))
+                launched = list(pool.map(self._launch, nodes))
+            if any(launched):  # only actual creations count as a scale event
+                live = self.cluster.try_get("provisioners", self.provisioner.name, namespace="")
+                if live is not None:
+                    live.status.last_scale_time = self.cluster.clock()
+                    self.cluster.update("provisioners", live)
             return nodes
         finally:
             self.batcher.flush()
 
-    def _launch(self, vnode: VirtualNode) -> None:
+    def _launch(self, vnode: VirtualNode) -> bool:
+        """Returns whether a node was actually created."""
         try:
             # fresh limits check against live status (reference:
             # provisioner.go:138-144 re-reads the provisioner)
@@ -124,7 +130,7 @@ class ProvisionerWorker:
                 err = prov.spec.limits.exceeded_by(prov.status.resources)
                 if err:
                     logger.info("skipping launch: %s", err)
-                    return
+                    return False
             start = time.perf_counter()
             node = self.cloud_provider.create(
                 NodeRequest(
@@ -155,8 +161,10 @@ class ProvisionerWorker:
                 # (reference: provisioner.go:155-164)
                 pass
             self._bind(vnode.pods, node.metadata.name)
+            return True
         except Exception:
             logger.exception("launching node")
+            return False
 
     def _bind(self, pods: List[Pod], node_name: str) -> None:
         start = time.perf_counter()

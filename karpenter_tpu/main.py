@@ -47,6 +47,7 @@ class Runtime:
     webhook: Webhook
     servers: list = None  # HTTP servers (metrics, health) when serving
     elector: object = None  # LeaderElector when a lease is configured
+    log_watcher: object = None  # LogLevelWatcher when a config file is set
 
     def stop(self) -> None:
         self.manager.stop()
@@ -56,6 +57,8 @@ class Runtime:
             server.shutdown()
         if self.elector is not None:
             self.elector.stop()
+        if self.log_watcher is not None:
+            self.log_watcher.stop()
 
 
 def _serve_endpoints(runtime: Runtime) -> None:
@@ -179,6 +182,12 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     """The ``main()`` equivalent: build, wait for leadership when a lease is
     configured, start, and serve metrics/health."""
     runtime = build_runtime(options)
+    from karpenter_tpu.logging_config import LogLevelWatcher, setup_logging
+
+    setup_logging(runtime.options.log_level)
+    if runtime.options.log_config_file:
+        runtime.log_watcher = LogLevelWatcher(runtime.options.log_config_file)
+        runtime.log_watcher.start()
     if runtime.options.leader_election_lease:
         from karpenter_tpu.utils.lease import FileLease, LeaderElector
 

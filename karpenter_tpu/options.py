@@ -39,6 +39,10 @@ class Options:
     leader_election_lease: str = field(
         default_factory=lambda: _env("LEADER_ELECTION_LEASE", "")
     )
+    # live log-level reload source (the mounted config-logging key); empty =
+    # static level from LOG_LEVEL
+    log_config_file: str = field(default_factory=lambda: _env("LOG_CONFIG_FILE", ""))
+    log_level: str = field(default_factory=lambda: _env("LOG_LEVEL", "info"))
 
     def validate(self) -> List[str]:
         errs = []
@@ -52,6 +56,11 @@ class Options:
             errs.append("kube client burst must be positive")
         if self.default_solver not in ("ffd", "tpu"):
             errs.append(f"solver must be ffd|tpu, got {self.default_solver}")
+        from karpenter_tpu.logging_config import validate_log_config
+
+        err = validate_log_config(self.log_level)
+        if err:
+            errs.append(err)
         return errs
 
 
@@ -68,6 +77,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
     ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
+    ap.add_argument("--log-config-file", default=opts.log_config_file)
+    ap.add_argument("--log-level", default=opts.log_level)
     ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
@@ -88,6 +99,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         solver_service_address=ns.solver_service_address,
         consolidation_enabled=ns.consolidation,
         leader_election_lease=ns.leader_election_lease,
+        log_config_file=ns.log_config_file,
+        log_level=ns.log_level,
     )
     errs = out.validate()
     if errs:

@@ -27,6 +27,7 @@ pod scalars [6, P] i32, pod requests [R, P] f32, node requests [R, N] f32.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import jax
@@ -36,6 +37,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from karpenter_tpu.solver.kernel import PackResult
+
+logger = logging.getLogger("karpenter.solver")
 
 # pod scalar row indices in the packed [6, P] array
 _VALID, _OPEN_SIG, _CORE, _HOST, _HOST_IN_BASE, _OPEN_HOST = range(6)
@@ -260,9 +263,7 @@ def pack_best(*args, n_max: int) -> PackResult:
         try:
             return pack_pallas(*args, n_max=n_max)
         except Exception:
-            import logging
-
-            logging.getLogger("karpenter.solver").exception(
+            logger.exception(
                 "pallas kernel failed for shape %s; lax.scan for this shape", shape
             )
             _pallas_failed_shapes.add(shape)
@@ -273,9 +274,5 @@ def pack_best(*args, n_max: int) -> PackResult:
             try:
                 return native.pack_native(*args, n_max=n_max)
             except Exception:
-                import logging
-
-                logging.getLogger("karpenter.solver").exception(
-                    "native packer failed; lax.scan fallback"
-                )
+                logger.exception("native packer failed; lax.scan fallback")
     return _k.pack(*args, n_max=n_max)

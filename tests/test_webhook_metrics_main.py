@@ -146,6 +146,54 @@ class TestOptionsRegistry:
             registry.new_cloud_provider("gcp")
 
 
+class TestLoggingConfig:
+    def test_setup_and_validate(self):
+        from karpenter_tpu.logging_config import (
+            apply_log_level,
+            setup_logging,
+            validate_log_config,
+        )
+        import logging
+
+        setup_logging("info")
+        assert logging.getLogger("karpenter").level == logging.INFO
+        assert apply_log_level("debug")
+        assert logging.getLogger("karpenter").level == logging.DEBUG
+        assert not apply_log_level("loud")
+        assert validate_log_config("warning") is None
+        assert validate_log_config("loud")
+        apply_log_level("info")
+
+    def test_watcher_reloads_live(self, tmp_path):
+        import logging
+        import time as _t
+
+        from karpenter_tpu.logging_config import LogLevelWatcher, setup_logging
+
+        setup_logging("info")
+        path = tmp_path / "loglevel"
+        path.write_text("warning")
+        watcher = LogLevelWatcher(str(path), interval=0.05)
+        watcher.start()
+        try:
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline and logging.getLogger("karpenter").level != logging.WARNING:
+                _t.sleep(0.02)
+            assert logging.getLogger("karpenter").level == logging.WARNING
+            path.write_text("debug")
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline and logging.getLogger("karpenter").level != logging.DEBUG:
+                _t.sleep(0.02)
+            assert logging.getLogger("karpenter").level == logging.DEBUG
+        finally:
+            watcher.stop()
+            logging.getLogger("karpenter").setLevel(logging.INFO)
+
+    def test_bad_log_level_rejected_at_startup(self):
+        with pytest.raises(SystemExit):
+            parse_args(["--log-level", "loud"])
+
+
 class TestServedEndpoints:
     def test_metrics_and_healthz_served(self):
         import socket
