@@ -14,7 +14,7 @@ from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.kube.client import Cluster
 
 POD_GAUGE_LABELS = [
-    "name", "namespace", "node", "provisioner", "zone", "arch",
+    "name", "namespace", "owner", "node", "provisioner", "zone", "arch",
     "capacity_type", "instance_type", "phase",
 ]
 
@@ -39,9 +39,14 @@ class PodMetricsController:
             node = self.cluster.try_get("nodes", pod.spec.node_name, namespace="")
             if node is not None:
                 node_labels = node.metadata.labels
+        owner = ""
+        if pod.metadata.owner_references:
+            ref = pod.metadata.owner_references[0]
+            owner = f"{ref.kind}/{ref.name}" if ref.kind else ref.name
         return {
             "name": pod.metadata.name,
             "namespace": pod.metadata.namespace,
+            "owner": owner,
             "node": pod.spec.node_name,
             "provisioner": node_labels.get(lbl.PROVISIONER_NAME_LABEL, ""),
             "zone": node_labels.get(lbl.TOPOLOGY_ZONE, ""),

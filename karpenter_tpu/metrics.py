@@ -93,7 +93,8 @@ NODES_GAUGE = NODES_ALLOCATABLE
 PODS_STATE_GAUGE = Gauge(
     "karpenter_pods_state",
     "Pod state is the current state of pods.",
-    ["name", "namespace", "node", "provisioner", "zone", "arch", "capacity_type", "instance_type", "phase"],
+    ["name", "namespace", "owner", "node", "provisioner", "zone", "arch",
+     "capacity_type", "instance_type", "phase"],
     registry=REGISTRY,
 )
 
