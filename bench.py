@@ -180,6 +180,107 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
     }
 
 
+def bench_config(config: int, iters: int):
+    """Run one of BASELINE.json's five configs and emit its JSON line."""
+    from karpenter_tpu.api import labels as lbl
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        NodeSelectorRequirement,
+        PodAffinityTerm,
+        Taint,
+        Toleration,
+    )
+    from karpenter_tpu.testing import make_pod, zone_spread
+
+    if config == 1:
+        # Single Provisioner, 100 pods, cpu+mem only (FFD baseline)
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="ffd")
+        pods = [
+            make_pod(requests={"cpu": "0.5", "memory": "512Mi"}) for _ in range(100)
+        ]
+        label = "config-1: 100 pods cpu+mem, ffd"
+    elif config == 2:
+        # nodeSelector + taint/toleration filter, 1k pods × 50 types
+        catalog = instance_types(50)
+        provisioner = make_provisioner(
+            solver="tpu", taints=[Taint(key="dedicated", value="team", effect="NoSchedule")]
+        )
+        rng = random.Random(2)
+        pods = [
+            make_pod(
+                requests={"cpu": f"{rng.choice([0.25, 0.5, 1])}"},
+                node_selector={lbl.TOPOLOGY_ZONE: rng.choice(
+                    ["test-zone-1", "test-zone-2", "test-zone-3"])},
+                tolerations=[Toleration(key="dedicated", value="team")],
+            )
+            for _ in range(1000)
+        ]
+        label = "config-2: 1k pods x 50 types, selectors+taints, tpu"
+    elif config == 3:
+        # podAffinity/antiAffinity + topologySpread across 3 AZs
+        rng = random.Random(3)
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="tpu")
+        pods = []
+        for i in range(333):
+            sel = {"app": f"g{i % 5}"}
+            pods.append(make_pod(labels=sel, requests={"cpu": "0.5"},
+                                 pod_requirements=[PodAffinityTerm(
+                                     label_selector=LabelSelector(match_labels=sel),
+                                     topology_key=lbl.TOPOLOGY_ZONE)]))
+            pods.append(make_pod(labels=sel, requests={"cpu": "0.5"},
+                                 pod_anti_requirements=[PodAffinityTerm(
+                                     label_selector=LabelSelector(match_labels={"app": f"solo{i}"}),
+                                     topology_key=lbl.TOPOLOGY_ZONE)]))
+            pods.append(make_pod(labels=sel, requests={"cpu": "0.5"},
+                                 topology=[zone_spread(max_skew=1, labels=sel)]))
+        label = "config-3: affinity/anti-affinity + zone spread, tpu"
+    elif config == 4:
+        # Multi-Provisioner sharding, 10k pods × 400 types
+        r = bench_multi_provisioner(8, 1250, iters)
+        return {
+            "metric": "BASELINE config-4: multi-provisioner 10k pods x 400 types",
+            "value": round(r["pods_per_sec"], 1),
+            "unit": "pods/sec",
+            "vs_baseline": round(r["pods_per_sec"] / BASELINE_PODS_PER_SEC, 2),
+            **{k: v for k, v in r.items() if k != "pods_per_sec"},
+        }
+    elif config == 5:
+        r = bench_consolidation(1000, iters, "tpu")
+        return {
+            "metric": "BASELINE config-5: consolidation re-pack of 1k nodes",
+            "value": round(r["repack_s"] * 1e3, 1),
+            "unit": "ms/re-pack",
+            "vs_baseline": round((r["pods"] / max(r["repack_s"], 1e-9)) / BASELINE_PODS_PER_SEC, 2),
+            **{k: v for k, v in r.items() if k != "repack_s"},
+        }
+    else:
+        raise SystemExit(f"unknown config {config}")
+
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    scheduler = Scheduler(Cluster(), rng=random.Random(1))
+    nodes = scheduler.solve(provisioner, catalog, pods)  # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        nodes = scheduler.solve(provisioner, catalog, pods)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    scheduled = sum(len(n.pods) for n in nodes)
+    return {
+        "metric": f"BASELINE {label}",
+        "value": round(scheduled / best, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round((scheduled / best) / BASELINE_PODS_PER_SEC, 2),
+        "scheduled": scheduled,
+        "pods": len(pods),
+        "nodes": len(nodes),
+        "best_s": round(best, 4),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=2000)
@@ -190,6 +291,10 @@ def main():
                     help="bench the consolidation re-pack of N live nodes instead")
     ap.add_argument("--multi", type=int, metavar="N_PROVISIONERS", default=0,
                     help="bench N provisioners' batches solved concurrently on the mesh")
+    ap.add_argument("--config", type=int, default=0, metavar="1..5",
+                    help="run one of BASELINE.json's five configs")
+    ap.add_argument("--all-configs", action="store_true",
+                    help="run all five BASELINE configs (one JSON line each)")
     ap.add_argument("--profile", metavar="OUT", default="",
                     help="write cProfile stats for one solve (the pprof-harness analog, "
                          "reference: scheduling_benchmark_test.go:76-108)")
@@ -211,6 +316,14 @@ def main():
         )
         print(f"# wrote cProfile stats to {args.profile} "
               f"(inspect: python -m pstats {args.profile})", file=sys.stderr)
+        return
+
+    if args.all_configs:
+        for cfg in (1, 2, 3, 4, 5):
+            print(json.dumps(bench_config(cfg, max(args.iters, 2))))
+        return
+    if args.config:
+        print(json.dumps(bench_config(args.config, max(args.iters, 2))))
         return
 
     if args.multi:
