@@ -168,22 +168,27 @@ class TpuScheduler:
             if a >= 0:
                 pods_by_node.setdefault(int(a), []).append(batch.pods[i])
 
-        sig_masks = {s.sig_id: s.type_mask for s in batch.table.signatures}
+        sig_masks = {s.sig_id: np.asarray(s.type_mask, bool) for s in batch.table.signatures}
         scales = res.axis_scales(batch.axes)
         axis_names = res.RESOURCE_AXES + batch.axes
+        live = sorted(pods_by_node)
+        # surviving types for ALL nodes in one batched comparison
+        # (signature-compatible ∧ fit the node total) — the per-node [T, R]
+        # scan was the decode hot spot at 1k+ nodes
+        if live:
+            totals = node_req[np.asarray(live, np.int64)]  # [L, R]
+            fit_all = np.all(
+                batch.usable[None, :, :] >= totals[:, None, :], axis=-1
+            )  # [L, T]
+            mask_all = np.stack(
+                [sig_masks[int(node_sig[n])] for n in live]
+            )  # [L, T]
+            ok_all = fit_all & mask_all
         nodes: List[VirtualNode] = []
-        for n in range(n_nodes):
-            if n not in pods_by_node:
-                continue
+        for row, n in enumerate(live):
             sig = batch.table.signatures[int(node_sig[n])]
             total = node_req[n]
-            # surviving types: signature-compatible ∧ fit the node total
-            fit = np.all(batch.usable >= total[None, :], axis=-1)
-            surviving = [
-                it
-                for it, m, f in zip(instance_types, sig_masks[sig.sig_id], fit)
-                if m and f
-            ]
+            surviving = [instance_types[t] for t in np.nonzero(ok_all[row])[0]]
             node_constraints = constraints.clone()
             reqs = sig.requirements
             h = int(node_host[n])
