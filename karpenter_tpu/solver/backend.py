@@ -162,13 +162,14 @@ class TpuScheduler:
         if unschedulable:
             logger.error("Failed to schedule %d pods", unschedulable)
 
-        # group pods per node (order-preserving, like FFD append order)
+        # group pods per node (order-preserving, like FFD append order);
+        # indices ≥ n_nodes would be out of the kernel contract — skip them
+        # like the old range(n_nodes) loop did rather than crash decode
         pods_by_node: Dict[int, List[Pod]] = {}
         for i, a in enumerate(assignment):
-            if a >= 0:
+            if 0 <= a < n_nodes:
                 pods_by_node.setdefault(int(a), []).append(batch.pods[i])
 
-        sig_masks = {s.sig_id: np.asarray(s.type_mask, bool) for s in batch.table.signatures}
         scales = res.axis_scales(batch.axes)
         axis_names = res.RESOURCE_AXES + batch.axes
         live = sorted(pods_by_node)
@@ -176,13 +177,15 @@ class TpuScheduler:
         # (signature-compatible ∧ fit the node total) — the per-node [T, R]
         # scan was the decode hot spot at 1k+ nodes
         if live:
-            totals = node_req[np.asarray(live, np.int64)]  # [L, R]
+            live_idx = np.asarray(live, np.int64)
+            totals = node_req[live_idx]  # [L, R]
             fit_all = np.all(
                 batch.usable[None, :, :] >= totals[:, None, :], axis=-1
             )  # [L, T]
-            mask_all = np.stack(
-                [sig_masks[int(node_sig[n])] for n in live]
-            )  # [L, T]
+            mask_arr = np.stack(
+                [s.type_mask for s in batch.table.signatures]
+            )  # [S, T]
+            mask_all = mask_arr[np.asarray(node_sig)[live_idx]]  # [L, T]
             ok_all = fit_all & mask_all
         nodes: List[VirtualNode] = []
         for row, n in enumerate(live):
