@@ -73,6 +73,23 @@ class ProvisionerWorker:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        if self.provisioner.spec.solver == SOLVER_TPU:
+            # pre-compile the solver for this catalog's dimensions so the
+            # first real batch doesn't pay the multi-second XLA compile
+            threading.Thread(target=self._warmup, daemon=True).start()
+
+    def _warmup(self) -> None:
+        try:
+            from karpenter_tpu.testing.factories import make_pod
+
+            instance_types = self.cloud_provider.get_instance_types(
+                self.provisioner.spec.constraints.provider
+            )
+            pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(4)]
+            self.scheduler.solve(self.provisioner, instance_types, pods)
+            logger.debug("solver warmed for provisioner %s", self.provisioner.name)
+        except Exception:
+            logger.exception("solver warmup failed (first batch will compile)")
 
     def stop(self) -> None:
         self._stop.set()
