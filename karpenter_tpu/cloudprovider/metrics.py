@@ -1,0 +1,78 @@
+"""Latency decorator for cloud providers.
+
+Mirrors ``pkg/cloudprovider/metrics/cloudprovider.go:37-93``: every
+``CloudProvider`` method is wrapped in a duration histogram labeled
+{controller, method, provider}. The controller label comes from a
+contextvar the manager sets around each reconcile — the analog of the
+reference's context injection (``utils/injection/injection.go:72-84``).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Any, Dict, List, Optional
+
+from karpenter_tpu import metrics
+from karpenter_tpu.api.objects import Node
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
+
+# Which controller's reconcile (or worker loop) is currently executing.
+reconciling_controller: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "reconciling_controller", default=""
+)
+
+
+class MeteredCloudProvider(CloudProvider):
+    """Wraps a provider so Create/Delete/GetInstanceTypes are all observed
+    (reference: metrics/cloudprovider.go:66-93; replaces the round-1 inline
+    timing that only covered create)."""
+
+    def __init__(self, delegate: CloudProvider):
+        self.delegate = delegate
+
+    def _observe(self, method: str, start: float) -> None:
+        metrics.CLOUDPROVIDER_DURATION.labels(
+            controller=reconciling_controller.get(),
+            method=method,
+            provider=self.delegate.name(),
+        ).observe(time.perf_counter() - start)
+
+    def create(self, request: NodeRequest) -> Node:
+        start = time.perf_counter()
+        try:
+            return self.delegate.create(request)
+        finally:
+            self._observe("create", start)
+
+    def delete(self, node: Node) -> None:
+        start = time.perf_counter()
+        try:
+            return self.delegate.delete(node)
+        finally:
+            self._observe("delete", start)
+
+    def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
+        start = time.perf_counter()
+        try:
+            return self.delegate.get_instance_types(provider)
+        finally:
+            self._observe("get_instance_types", start)
+
+    # webhook hooks + name pass through unmetered, as in the reference
+    def default(self, constraints: Constraints) -> None:
+        return self.delegate.default(constraints)
+
+    def validate(self, constraints: Constraints) -> List[str]:
+        return self.delegate.validate(constraints)
+
+    def name(self) -> str:
+        return self.delegate.name()
+
+
+def decorate(provider: CloudProvider) -> CloudProvider:
+    """Idempotent wrap (reference: metrics.Decorate)."""
+    if isinstance(provider, MeteredCloudProvider):
+        return provider
+    return MeteredCloudProvider(provider)

@@ -126,9 +126,15 @@ class Manager:
 
     @staticmethod
     def _call(reg: _Registration, key) -> Optional[float]:
-        if isinstance(key, tuple):
-            return reg.reconcile(*key)
-        return reg.reconcile(key)
+        from karpenter_tpu.cloudprovider.metrics import reconciling_controller
+
+        token = reconciling_controller.set(reg.name)
+        try:
+            if isinstance(key, tuple):
+                return reg.reconcile(*key)
+            return reg.reconcile(key)
+        finally:
+            reconciling_controller.reset(token)
 
     # -- synchronous drive (test harness) ----------------------------------
     def reconcile_now(self, controller: str, key) -> Optional[float]:

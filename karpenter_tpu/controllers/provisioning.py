@@ -21,6 +21,7 @@ from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.api.provisioner import (
     SOLVER_FFD,
+    SOLVER_TPU,
     Provisioner,
     default_provisioner,
     validate_provisioner,
@@ -68,6 +69,9 @@ class ProvisionerWorker:
         self.batcher = batcher or Batcher()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # set once the TPU solver warmup finished (success or failure) —
+        # observable so tests can assert the warmup path actually runs
+        self.warmed = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -80,7 +84,10 @@ class ProvisionerWorker:
 
     def _warmup(self) -> None:
         try:
+            from karpenter_tpu.cloudprovider.metrics import reconciling_controller
             from karpenter_tpu.testing.factories import make_pod
+
+            reconciling_controller.set("provisioning")
 
             instance_types = self.cloud_provider.get_instance_types(
                 self.provisioner.spec.constraints.provider
@@ -90,6 +97,8 @@ class ProvisionerWorker:
             logger.debug("solver warmed for provisioner %s", self.provisioner.name)
         except Exception:
             logger.exception("solver warmup failed (first batch will compile)")
+        finally:
+            self.warmed.set()
 
     def stop(self) -> None:
         self._stop.set()
@@ -98,6 +107,9 @@ class ProvisionerWorker:
             self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        from karpenter_tpu.cloudprovider.metrics import reconciling_controller
+
+        reconciling_controller.set("provisioning")
         while not self._stop.is_set():
             try:
                 self.provision_once()
@@ -138,6 +150,10 @@ class ProvisionerWorker:
 
     def _launch(self, vnode: VirtualNode) -> bool:
         """Returns whether a node was actually created."""
+        from karpenter_tpu.cloudprovider.metrics import reconciling_controller
+
+        # executor threads don't inherit the worker's context
+        reconciling_controller.set("provisioning")
         try:
             # fresh limits check against live status (reference:
             # provisioner.go:138-144 re-reads the provisioner)
@@ -148,17 +164,12 @@ class ProvisionerWorker:
                 if err:
                     logger.info("skipping launch: %s", err)
                     return False
-            start = time.perf_counter()
             node = self.cloud_provider.create(
                 NodeRequest(
                     template=vnode.constraints,
                     instance_type_options=vnode.instance_type_options,
                 )
             )
-            metrics.CLOUDPROVIDER_DURATION.labels(
-                controller="provisioning", method="create",
-                provider=self.cloud_provider.name(),
-            ).observe(time.perf_counter() - start)
             # merge the constraint template into the returned node: labels,
             # taints (incl. not-ready), finalizer (reference:
             # provisioner.go:152-160 + constraints.go:69-105)

@@ -14,6 +14,7 @@ import logging
 from dataclasses import dataclass
 from typing import Optional
 
+from karpenter_tpu.cloudprovider import metrics as cpmetrics
 from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.cloudprovider.types import CloudProvider
 from karpenter_tpu.controllers.consolidation import ConsolidationController
@@ -114,6 +115,9 @@ def build_runtime(
         consolidation_enabled = options.consolidation_enabled
     cluster = cluster or Cluster()
     cloud_provider = cloud_provider or registry.new_cloud_provider(options.cloud_provider)
+    # latency histograms on every provider method
+    # (reference: cmd/controller/main.go:81 → metrics/cloudprovider.go:66)
+    cloud_provider = cpmetrics.decorate(cloud_provider)
 
     manager = Manager(cluster)
     provisioning = ProvisioningController(

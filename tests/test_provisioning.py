@@ -110,6 +110,62 @@ class TestProvisioning:
         controller.stop()
 
 
+class TestThreadedWorkers:
+    """The production path: start_workers=True runs the real worker thread,
+    batcher window, and (for solver=tpu) the warmup thread — the exact path
+    that round 1 shipped broken (NameError on SOLVER_TPU at start())."""
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_start_workers_end_to_end(self, solver):
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(10))
+        controller = ProvisioningController(cluster, provider, start_workers=True)
+        prov = make_provisioner(solver=solver)
+        cluster.create("provisioners", prov)
+        try:
+            controller.apply(prov)  # crashes here pre-fix when solver == tpu
+            worker = controller.workers[prov.name]
+            worker.batcher.idle_duration = 0.05
+            assert worker._thread is not None and worker._thread.is_alive()
+            pods = [make_pod(requests={"cpu": "1"}) for _ in range(3)]
+            gates = []
+            for p in pods:
+                cluster.create("pods", p)
+                gates.append(worker.add(p))
+            # the selection reconciler blocks on the gate; do the same
+            for g in gates:
+                assert g.wait(timeout=30), "batch gate never flushed"
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                bound = [p for p in cluster.pods() if p.spec.node_name]
+                if len(bound) == len(pods):
+                    break
+                time.sleep(0.02)
+            assert len([p for p in cluster.pods() if p.spec.node_name]) == len(pods)
+            assert len(cluster.nodes()) >= 1
+        finally:
+            controller.stop()
+        assert not worker._thread.is_alive()
+
+    def test_tpu_worker_warmup_compiles_solver(self):
+        """The warmup thread must complete without raising (it logs on
+        failure); verify it actually ran a solve by waiting for it."""
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(10))
+        controller = ProvisioningController(cluster, provider, start_workers=True)
+        prov = make_provisioner(solver="tpu")
+        cluster.create("provisioners", prov)
+        try:
+            controller.apply(prov)
+            worker = controller.workers[prov.name]
+            deadline = time.time() + 60
+            while time.time() < deadline and not worker.warmed.is_set():
+                time.sleep(0.05)
+            assert worker.warmed.is_set(), "warmup never completed"
+        finally:
+            controller.stop()
+
+
 class TestBatcher:
     def test_window_closes_on_idle(self):
         b = Batcher(idle_duration=0.05, max_duration=5.0)

@@ -26,6 +26,44 @@ def scrape() -> str:
     return generate_latest(metrics.REGISTRY).decode()
 
 
+class TestCloudProviderMetricsDecorator:
+    """All provider methods observed, not just create
+    (reference: pkg/cloudprovider/metrics/cloudprovider.go:37-93)."""
+
+    def test_all_methods_observed(self):
+        from karpenter_tpu.cloudprovider import metrics as cpmetrics
+
+        provider = cpmetrics.decorate(FakeCloudProvider(instance_types(3)))
+        assert cpmetrics.decorate(provider) is provider  # idempotent
+        cpmetrics.reconciling_controller.set("provisioning")
+        types = provider.get_instance_types(None)
+        from karpenter_tpu.cloudprovider.types import NodeRequest
+
+        prov = make_provisioner()
+        node = provider.create(
+            NodeRequest(template=prov.spec.constraints, instance_type_options=types)
+        )
+        provider.delete(node)
+        out = scrape()
+        for method in ("create", "delete", "get_instance_types"):
+            assert (
+                f'karpenter_cloudprovider_duration_seconds_count{{controller="provisioning",'
+                f'method="{method}",provider="fake"}}' in out
+            ), f"{method} not observed: {out}"
+
+    def test_manager_sets_controller_label(self):
+        from karpenter_tpu.cloudprovider import metrics as cpmetrics
+        from karpenter_tpu.controllers.manager import Manager
+
+        seen = []
+        manager = Manager(Cluster())
+        manager.register("termination", lambda key: seen.append(
+            cpmetrics.reconciling_controller.get()
+        ))
+        manager.reconcile_now("termination", "some-node")
+        assert seen == ["termination"]
+
+
 class TestWebhook:
     def test_defaulting_applies_vendor_hook(self):
         webhook = Webhook(SimulatedCloudProvider())
