@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from karpenter_tpu import metrics
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import NodeSelectorRequirement, Pod
 from karpenter_tpu.api.provisioner import Constraints
@@ -65,18 +66,7 @@ class TpuScheduler:
         the table size, and real packings open far fewer nodes than pods —
         and retries at full P on saturation (table full + unscheduled pods).
         """
-        args = (
-            batch.pod_valid,
-            batch.pod_open_sig,
-            batch.pod_core,
-            batch.pod_host,
-            batch.pod_host_in_base,
-            batch.pod_open_host,
-            batch.pod_req,
-            batch.join_table,
-            batch.frontiers,
-            batch.daemon,
-        )
+        args = batch.pack_args()
         p = len(batch.pod_valid)
         n_max = max(256, p // 4)
         while True:
@@ -99,12 +89,18 @@ class TpuScheduler:
                         self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
                     )
                 result = self._remote.pack(*args, n_max=n_max)
+                # unconditional: the gauge is process-global per address, and
+                # another scheduler instance (worker hot-swap, second
+                # provisioner) may have set it
+                metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(0)
                 self._remote_down_until = 0.0
                 return result
             except Exception as e:
                 # open the circuit: a dead sidecar must not stall every
                 # batch for a full RPC deadline
                 self._remote_down_until = time.monotonic() + REMOTE_BREAKER_SECONDS
+                metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(1)
+                metrics.SOLVER_BREAKER_TRIPS.labels(address=self.service_address).inc()
                 logger.error(
                     "solver service %s failed (%s); in-process kernel for %.0fs",
                     self.service_address, e, REMOTE_BREAKER_SECONDS,

@@ -75,6 +75,23 @@ class EncodedBatch:
     axes: List[str]
     usable: np.ndarray  # [T, R]
 
+    def pack_args(self) -> tuple:
+        """The canonical positional argument order of ``kernel.pack`` — the
+        single definition of the wire/call contract (backend, sidecar warmup,
+        and the driver entry all build this tuple)."""
+        return (
+            self.pod_valid,
+            self.pod_open_sig,
+            self.pod_core,
+            self.pod_host,
+            self.pod_host_in_base,
+            self.pod_open_host,
+            self.pod_req,
+            self.join_table,
+            self.frontiers,
+            self.daemon,
+        )
+
 
 def usable_capacity(
     instance_types: Sequence[InstanceType], extra_axes: Sequence[str]

@@ -131,3 +131,77 @@ class TestRemoteSolve:
         dead._remote = None
         vnodes = dead.solve(constraints, catalog, pods)
         assert sum(len(v.pods) for v in vnodes) == 4  # fallback worked
+
+
+class TestHealth:
+    def test_grpc_and_http_health_flip_on_readiness(self):
+        """Readiness is gated on the warmup solve; a not-yet-warm sidecar
+        reports NOT_SERVING / 503, a warmed one SERVING / 200."""
+        import urllib.request
+
+        address = f"127.0.0.1:{free_port()}"
+        hport = free_port()
+        server = serve(address, health_port=hport, warmup=True)
+        try:
+            client = RemoteSolver(address, timeout=5)
+            # liveness is up immediately
+            assert (
+                urllib.request.urlopen(f"http://127.0.0.1:{hport}/healthz").status == 200
+            )
+            server.solver_service.ready.wait(timeout=120)
+            assert server.solver_service.ready.is_set(), "warmup never finished"
+            assert client.health() is True
+            assert (
+                urllib.request.urlopen(f"http://127.0.0.1:{hport}/readyz").status == 200
+            )
+            client.close()
+        finally:
+            server.health_server.shutdown()
+            server.stop(grace=1)
+
+    def test_unready_sidecar_reports_not_serving(self):
+        import urllib.error
+        import urllib.request
+
+        address = f"127.0.0.1:{free_port()}"
+        hport = free_port()
+        server = serve(address, health_port=hport)
+        server.solver_service.ready.clear()  # simulate still-warming
+        try:
+            client = RemoteSolver(address, timeout=5)
+            assert client.health() is False
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://127.0.0.1:{hport}/readyz")
+            assert ei.value.code == 503
+            client.close()
+        finally:
+            server.health_server.shutdown()
+            server.stop(grace=1)
+
+    def test_dead_sidecar_health_false_and_breaker_metric(self):
+        """A dead sidecar flips client health to False, and the breaker
+        trip is scrapeable (VERDICT r1 weak #7)."""
+        from prometheus_client import generate_latest
+
+        from karpenter_tpu import metrics
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        address = f"127.0.0.1:{free_port()}"
+        client = RemoteSolver(address, timeout=2)
+        assert client.health() is False
+        client.close()
+
+        catalog = instance_types(4)
+        constraints = make_provisioner(solver="tpu").spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), service_address=address)
+        sched.solve(constraints, catalog, [make_pod(requests={"cpu": "1"})])
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert f'karpenter_solver_breaker_open{{address="{address}"}} 1.0' in out
+        assert f'karpenter_solver_breaker_trips_total{{address="{address}"}} 1.0' in out
