@@ -31,12 +31,15 @@ BASELINE_PODS_PER_SEC = 250.0  # reference's enforced CPU floor
 
 
 def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
+    from karpenter_tpu.scheduling.oracle import classify_drops
+
     catalog = instance_types(400)
     provisioner = make_provisioner(solver=solver)
     c = provisioner.spec.constraints
     c.requirements = c.requirements.merge(catalog_requirements(catalog))
     pods = diverse_pods(n_pods, random.Random(42))
-    scheduler = Scheduler(Cluster(), rng=random.Random(1))
+    cluster = Cluster()
+    scheduler = Scheduler(cluster, rng=random.Random(1))
 
     # warmup (compile)
     nodes = scheduler.solve(provisioner, catalog, pods)
@@ -49,12 +52,18 @@ def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
         times.append(time.perf_counter() - t0)
     scheduled = sum(len(n.pods) for n in nodes)
     best = min(times)
+    # every drop must be oracle-certified unsatisfiable (scheduling/oracle.py)
+    verdict = classify_drops(
+        cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
+    )
     return {
         "pods_per_sec": scheduled / best,
         "mean_s": statistics.mean(times),
         "p99_s": sorted(times)[min(len(times) - 1, max(math.ceil(0.99 * len(times)) - 1, 0))],
         "nodes": len(nodes),
         "scheduled": scheduled,
+        "unschedulable_expected": verdict["dropped"] - len(verdict["unexplained"]),
+        "unexplained": len(verdict["unexplained"]),
     }
 
 
@@ -377,6 +386,8 @@ def main():
                 "scheduled_pods": r["scheduled"],
                 "mean_solve_s": round(r["mean_s"], 4),
                 "p99_solve_s": round(r["p99_s"], 4),
+                "unschedulable_expected": r["unschedulable_expected"],
+                "unexplained": r["unexplained"],
             }
         )
     )

@@ -249,13 +249,56 @@ class Topology:
         self._count_cluster_matches(group)
         viable = constraints.requirements.zones()
         if group.anti:
-            for pod in group.pods:
+            # Selector-matching members claim a zone each (pairwise
+            # separation); non-matching members only need SOME zone free of
+            # matchers. Placing a matcher in every clean zone would strand
+            # the whole non-matching cohort — trading one matcher for N
+            # non-matchers is never a win — so one clean zone is reserved
+            # for them. This keeps drops to the provable minimum:
+            # max(m - (clean - 1), 0) matchers (see scheduling/oracle.py).
+            matching = [p for p in group.pods if group.selector_matches(p)]
+            nonmatching = [p for p in group.pods if not group.selector_matches(p)]
+            reserved: Optional[str] = None
+            if nonmatching and matching:
+                clean = sorted(
+                    d for d in viable if group.match_counts.get(d, 0) == 0
+                )
+                # reserve the clean zone usable by the most non-matchers;
+                # break ties toward the zone the fewest matchers are pinned
+                # to — reserving a matcher's only allowed zone would drop a
+                # placeable matcher
+                matcher_allowed = [
+                    self._allowed_domains(constraints, p, group.key, viable)
+                    for p in matching
+                ]
+                best = None
+                for d in clean:
+                    n_ok = sum(
+                        1
+                        for p in nonmatching
+                        if d in self._allowed_domains(constraints, p, group.key, {d})
+                    )
+                    m_only = sum(1 for a in matcher_allowed if a == {d})
+                    if n_ok and (best is None or (n_ok, -m_only) > (best[0], -best[1])):
+                        best = (n_ok, m_only, d)
+                if best is not None:
+                    reserved = best[2]
+            for pod in matching:
+                allowed = self._allowed_domains(constraints, pod, group.key, viable)
+                free = sorted(
+                    d
+                    for d in allowed
+                    if group.match_counts.get(d, 0) == 0 and d != reserved
+                )
+                domain = free[0] if free else UNSATISFIABLE_DOMAIN
+                _set_domain(pod, group.key, domain)
+                if domain != UNSATISFIABLE_DOMAIN:
+                    group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+            for pod in nonmatching:
                 allowed = self._allowed_domains(constraints, pod, group.key, viable)
                 free = sorted(d for d in allowed if group.match_counts.get(d, 0) == 0)
                 domain = free[0] if free else UNSATISFIABLE_DOMAIN
                 _set_domain(pod, group.key, domain)
-                if domain != UNSATISFIABLE_DOMAIN and group.selector_matches(pod):
-                    group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
             return
         # affinity: most-populated existing domain, else a seed the group
         # itself (or a batch provider) will populate
