@@ -30,7 +30,31 @@ from karpenter_tpu.testing import diverse_pods, make_provisioner
 BASELINE_PODS_PER_SEC = 250.0  # reference's enforced CPU floor
 
 
-def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
+def measure_rtt_floor(samples: int = 5) -> float:
+    """Round-trip floor of the accelerator transport: a trivial dispatch +
+    fetch, perturbed per iteration so the tunneled backend can't dedupe.
+    Under axon this is ~110ms of pure tunnel latency that a locally-attached
+    chip does not pay; bench reports it so the solve latency can be judged
+    against the BASELINE target (<100ms on an attached TPU v5e)."""
+    import jax
+    import numpy as np
+
+    x = np.zeros(8, np.float32)
+    f = jax.jit(lambda a: a + 1)
+    jax.device_get(f(x))  # compile
+    rtts = []
+    for i in range(samples):
+        t0 = time.perf_counter()
+        jax.device_get(f(x + (i + 1) * 1e-6))
+        rtts.append(time.perf_counter() - t0)
+    return min(rtts)
+
+
+def _p99(times):
+    return sorted(times)[min(len(times) - 1, max(math.ceil(0.99 * len(times)) - 1, 0))]
+
+
+def bench_once(n_pods: int, iters: int, solver: str = "tpu", breakdown: bool = False):
     from karpenter_tpu.scheduling.oracle import classify_drops
 
     catalog = instance_types(400)
@@ -46,25 +70,46 @@ def bench_once(n_pods: int, iters: int, solver: str = "tpu"):
     assert nodes, "benchmark scenario must schedule"
 
     times = []
+    profiles = []
     for _ in range(iters):
         t0 = time.perf_counter()
         nodes = scheduler.solve(provisioner, catalog, pods)
         times.append(time.perf_counter() - t0)
+        prof = getattr(scheduler._tpu, "last_profile", None)
+        if prof:
+            profiles.append(dict(prof))
     scheduled = sum(len(n.pods) for n in nodes)
     best = min(times)
     # every drop must be oracle-certified unsatisfiable (scheduling/oracle.py)
     verdict = classify_drops(
         cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
     )
-    return {
+    out = {
         "pods_per_sec": scheduled / best,
         "mean_s": statistics.mean(times),
-        "p99_s": sorted(times)[min(len(times) - 1, max(math.ceil(0.99 * len(times)) - 1, 0))],
+        "p99_s": _p99(times),
         "nodes": len(nodes),
         "scheduled": scheduled,
         "unschedulable_expected": verdict["dropped"] - len(verdict["unexplained"]),
         "unexplained": len(verdict["unexplained"]),
     }
+    if breakdown and profiles:
+        rtt = measure_rtt_floor()
+        dispatches = max(int(p.get("pack_dispatches", 1)) for p in profiles)
+        stages = {
+            k: round(statistics.median(p[k] for p in profiles) * 1e3, 1)
+            for k in profiles[0]
+            if k.endswith("_s")
+        }
+        out["breakdown_ms"] = stages
+        out["pack_dispatches"] = dispatches
+        out["transport_rtt_floor_ms"] = round(rtt * 1e3, 1)
+        # what an attached chip would see: the tunnel RTT is pure transport,
+        # paid once per kernel dispatch (saturation retries pay it again)
+        adj = rtt * dispatches
+        out["p99_minus_rtt_s"] = round(max(_p99(times) - adj, 0.0), 4)
+        out["mean_minus_rtt_s"] = round(max(statistics.mean(times) - adj, 0.0), 4)
+    return out
 
 
 def bench_consolidation(n_nodes: int, iters: int, solver: str = "tpu"):
@@ -145,9 +190,7 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
                   "pod_host_in_base", "pod_open_host", "pod_req",
                   "join_table", "frontiers", "daemon")
     )
-    sig_type_mask = np.stack(
-        [np.stack([s.type_mask for s in b.table.signatures]) for b in batches]
-    )
+    sig_type_mask = np.stack([b.type_mask_matrix() for b in batches])
     prices = np.array([it.effective_price() for it in catalog], np.float32)
     mesh = make_solver_mesh()
     n_max = max(256, len(batches[0].pod_valid) // 4)
@@ -292,7 +335,7 @@ def bench_config(config: int, iters: int):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--pods", type=int, default=2000)
+    ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--solver", default="tpu", choices=["tpu", "ffd"])
     ap.add_argument("--grid", action="store_true", help="run the reference's full batch grid")
@@ -374,23 +417,23 @@ def main():
                 file=sys.stderr,
             )
 
-    r = bench_once(args.pods, args.iters, args.solver)
-    print(
-        json.dumps(
-            {
-                "metric": f"pods-scheduled/sec ({args.pods} pods x 400 instance types, {args.solver} solver)",
-                "value": round(r["pods_per_sec"], 1),
-                "unit": "pods/sec",
-                "vs_baseline": round(r["pods_per_sec"] / BASELINE_PODS_PER_SEC, 2),
-                "nodes": r["nodes"],
-                "scheduled_pods": r["scheduled"],
-                "mean_solve_s": round(r["mean_s"], 4),
-                "p99_solve_s": round(r["p99_s"], 4),
-                "unschedulable_expected": r["unschedulable_expected"],
-                "unexplained": r["unexplained"],
-            }
-        )
-    )
+    r = bench_once(args.pods, args.iters, args.solver, breakdown=args.solver == "tpu")
+    line = {
+        "metric": f"pods-scheduled/sec ({args.pods} pods x 400 instance types, {args.solver} solver)",
+        "value": round(r["pods_per_sec"], 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(r["pods_per_sec"] / BASELINE_PODS_PER_SEC, 2),
+        "nodes": r["nodes"],
+        "scheduled_pods": r["scheduled"],
+        "mean_solve_s": round(r["mean_s"], 4),
+        "p99_solve_s": round(r["p99_s"], 4),
+        "unschedulable_expected": r["unschedulable_expected"],
+        "unexplained": r["unexplained"],
+    }
+    for k in ("breakdown_ms", "transport_rtt_floor_ms", "p99_minus_rtt_s", "mean_minus_rtt_s"):
+        if k in r:
+            line[k] = r[k]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

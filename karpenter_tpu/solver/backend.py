@@ -11,6 +11,7 @@ from __future__ import annotations
 import copy
 import logging
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -56,6 +57,14 @@ class TpuScheduler:
         self.service_address = service_address
         self._remote = None
         self._remote_down_until = 0.0  # circuit breaker after RPC failure
+        # solve-invariant encode state (signature table, capacity matrix),
+        # reused across this worker's batches; the lock covers the rare
+        # concurrent solve (warmup thread vs first real batch)
+        self._encode_cache = enc.EncodeCache()
+        self._solve_lock = threading.Lock()
+        # per-stage timings of the most recent solve (bench surfaces these
+        # as the latency breakdown the <100ms target is judged against)
+        self.last_profile: Dict[str, float] = {}
 
     def _pack(self, batch: enc.EncodedBatch) -> kernel.PackResult:
         """Run the packing kernel — on the sidecar when configured, with the
@@ -69,7 +78,9 @@ class TpuScheduler:
         args = batch.pack_args()
         p = len(batch.pod_valid)
         n_max = max(256, p // 4)
+        self.last_profile["pack_dispatches"] = 0
         while True:
+            self.last_profile["pack_dispatches"] += 1
             result = self._pack_once(args, p, n_max)
             saturated = int(result.n_nodes) == n_max and bool(
                 (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
@@ -123,24 +134,55 @@ class TpuScheduler:
     ) -> List[VirtualNode]:
         if not pods:
             return []
+        prof = {}
+        t0 = time.perf_counter()
         constraints = constraints.clone()
         pods = sort_pods_ffd(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
         saved = snapshot_selectors(pods)
+        prof["sort_s"] = time.perf_counter() - t0
         try:
-            self.topology.inject(constraints, list(pods))
-            daemon = daemon_overhead(self.cluster, constraints)
-            try:
-                batch = enc.encode(constraints, instance_types, pods, daemon)
-            except SignatureOverflow as e:
-                logger.warning("falling back to FFD: %s", e)
-                return self._ffd_fallback.solve_injected(
-                    constraints, instance_types, pods, daemon
-                )
-            result = self._pack(batch)
-            return self._decode(batch, result, constraints, instance_types)
+            with self._solve_lock:
+                # published under the lock: a concurrent warmup solve must
+                # not clobber the profile observers read
+                self.last_profile = prof
+                t0 = time.perf_counter()
+                self.topology.inject(constraints, list(pods))
+                daemon = daemon_overhead(self.cluster, constraints)
+                prof["inject_s"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                try:
+                    batch = self._encode_retry(constraints, instance_types, pods, daemon)
+                except SignatureOverflow as e:
+                    logger.warning("falling back to FFD: %s", e)
+                    return self._ffd_fallback.solve_injected(
+                        constraints, instance_types, pods, daemon
+                    )
+                prof["encode_s"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                result = self._pack(batch)
+                prof["pack_fetch_s"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                nodes = self._decode(batch, result, constraints, instance_types)
+                prof["decode_s"] = time.perf_counter() - t0
+                return nodes
         finally:
             restore_selectors(pods, saved)
+
+    def _encode_retry(self, constraints, instance_types, pods, daemon) -> enc.EncodedBatch:
+        """Encode with the reusable cache; a cached table accumulates
+        signatures across batches, so an overflow may be an accumulation
+        artifact — drop the cache and retry fresh before declaring the
+        batch itself too diverse."""
+        try:
+            return enc.encode(
+                constraints, instance_types, pods, daemon, cache=self._encode_cache
+            )
+        except SignatureOverflow:
+            self._encode_cache.clear()
+            return enc.encode(
+                constraints, instance_types, pods, daemon, cache=self._encode_cache
+            )
 
     def _decode(
         self,
@@ -178,14 +220,12 @@ class TpuScheduler:
             fit_all = np.all(
                 batch.usable[None, :, :] >= totals[:, None, :], axis=-1
             )  # [L, T]
-            mask_arr = np.stack(
-                [s.type_mask for s in batch.table.signatures]
-            )  # [S, T]
+            mask_arr = batch.type_mask_matrix()  # [S_local, T]
             mask_all = mask_arr[np.asarray(node_sig)[live_idx]]  # [L, T]
             ok_all = fit_all & mask_all
         nodes: List[VirtualNode] = []
         for row, n in enumerate(live):
-            sig = batch.table.signatures[int(node_sig[n])]
+            sig = batch.signatures[int(node_sig[n])]
             total = node_req[n]
             surviving = [instance_types[t] for t in np.nonzero(ok_all[row])[0]]
             node_constraints = constraints.clone()

@@ -15,6 +15,7 @@ the join table and frontiers here.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -70,10 +71,19 @@ class EncodedBatch:
     daemon: np.ndarray  # [R] f32
     # host context
     table: SignatureTable
+    signatures: List  # local (batch-scoped) Signature list; kernel sig ids index it
     cores: List[Core]
     hostnames: List[str]
     axes: List[str]
     usable: np.ndarray  # [T, R]
+
+    def type_mask_matrix(self) -> np.ndarray:
+        """[S_local, T] stacked signature→type masks for THIS batch's
+        signature space (what the kernel's sig ids index)."""
+        m = getattr(self, "_mask_matrix", None)
+        if m is None:
+            m = self._mask_matrix = np.stack([s.type_mask for s in self.signatures])
+        return m
 
     def pack_args(self) -> tuple:
         """The canonical positional argument order of ``kernel.pack`` — the
@@ -108,11 +118,72 @@ def usable_capacity(
     return out
 
 
+class EncodeCache:
+    """Per-scheduler reuse of solve-invariant encode state.
+
+    The signature table (type masks, Pareto frontiers, join closure) and the
+    usable-capacity matrix depend only on (hostname-free constraints,
+    catalog, resource axes) — stable across a provisioner's batches until
+    the catalog changes — yet round 1 rebuilt them every solve (~40ms of the
+    10k-pod latency budget). Keyed by a semantic catalog fingerprint, NOT
+    object identity (providers build fresh InstanceType objects per
+    get_instance_types call), with small-LRU eviction so a drifting catalog
+    cannot grow the cache unboundedly. Owned by one scheduler (one worker
+    thread), not shared."""
+
+    MAX_ENTRIES = 4
+
+    def __init__(self):
+        self.tables: "OrderedDict[Tuple, Tuple[np.ndarray, SignatureTable]]" = OrderedDict()
+
+    def get(self, key: Tuple):
+        hit = self.tables.get(key)
+        if hit is not None:
+            self.tables.move_to_end(key)
+        return hit
+
+    def put(self, key: Tuple, value) -> None:
+        self.tables[key] = value
+        self.tables.move_to_end(key)
+        while len(self.tables) > self.MAX_ENTRIES:
+            self.tables.popitem(last=False)
+
+    def clear(self) -> None:
+        self.tables.clear()
+
+
+def catalog_fingerprint(instance_types: Sequence[InstanceType]) -> Tuple:
+    """Order-sensitive semantic identity of a catalog — every field that
+    feeds type compatibility or the usable-capacity matrix."""
+    return tuple(
+        (
+            it.name,
+            it.architecture,
+            tuple(sorted(it.operating_systems)),
+            tuple(sorted((o.capacity_type, o.zone) for o in it.offerings)),
+            tuple(sorted(it.resources.items())),
+            tuple(sorted(it.overhead.items())),
+            it.price,
+        )
+        for it in instance_types
+    )
+
+
+def _table_key(constraints: Constraints, instance_types, axes) -> Tuple:
+    reqs = tuple(
+        (r.key, r.operator, tuple(r.values))
+        for r in constraints.requirements.requirements
+        if r.key != lbl.HOSTNAME
+    )
+    return (reqs, catalog_fingerprint(instance_types), tuple(axes))
+
+
 def encode(
     constraints: Constraints,
     instance_types: Sequence[InstanceType],
     pods: Sequence[Pod],
     daemon: Dict[str, float],
+    cache: Optional[EncodeCache] = None,
 ) -> EncodedBatch:
     """Build the dense solve request. ``instance_types`` must already be
     price-sorted and ``pods`` FFD-sorted; topology decisions must already be
@@ -122,15 +193,24 @@ def encode(
     # resource axes: reserved + any extended resources in play (pod requests
     # via the memoized accessor — a fresh resource_requests() per pod was a
     # measurable slice of encode at 10k pods)
+    pod_requests = [res.requests_for_pods(p) for p in pods]  # reused in the loop
     extras = res.collect_extra_axes(
         [it.resources for it in instance_types]
         + [it.overhead for it in instance_types]
-        + [res.requests_for_pods(p) for p in pods]
+        + pod_requests
         + [daemon]
     )
     axes = extras  # extra axis names appended after the reserved block
-    usable = usable_capacity(instance_types, axes)
-    table = SignatureTable(constraints, instance_types, usable, axes)
+    key = _table_key(constraints, instance_types, axes) if cache is not None else None
+    cached = cache.get(key) if cache is not None else None
+    if cached is not None:
+        usable, table = cached
+        table.set_base(constraints)
+    else:
+        usable = usable_capacity(instance_types, axes)
+        table = SignatureTable(constraints, instance_types, usable, axes)
+        if cache is not None:
+            cache.put(key, (usable, table))
 
     # canonicalize pods; intern cores + hostnames
     cores: List[Core] = []
@@ -169,7 +249,7 @@ def encode(
             # when the base domains exclude h (set intersects to ∅ — later
             # hostname pods can never match, reference requirements.go:175)
             pod_open_host[i] = hid if (in_base or not base_has_hostname) else -2
-        requests = res.requests_for_pods(pod)
+        requests = pod_requests[i]
         rkey = tuple(sorted(requests.items()))
         vec = req_cache.get(rkey)
         if vec is None:
@@ -177,29 +257,48 @@ def encode(
             req_cache[rkey] = vec
         pod_req[i] = vec
 
-    # signature closure: process every signature against every core until no
-    # new signatures appear (table.join interns joined signatures, growing
-    # table.signatures; raises SignatureOverflow past the cap)
-    open_sig_by_core = np.array([table.open_signature(c) for c in cores], np.int32)
-    processed = 0
-    while processed < len(table.signatures):
-        sid = processed
-        processed += 1
-        for core in cores:
-            table.join(sid, core)
+    # signature closure over THIS batch's cores, scoped to the reachable
+    # set and re-indexed densely: a cached table accumulates signatures and
+    # joins from earlier batches, and emitting arrays sized (or indexed) by
+    # the accumulated closure would both crash on foreign cores and grow
+    # the kernel input without bound
+    open_sig_global = [table.open_signature(c) for c in cores]
+    order: List[int] = []
+    local: Dict[int, int] = {}
 
-    S = len(table.signatures)
+    def visit(sid: int) -> None:
+        if sid >= 0 and sid not in local:
+            local[sid] = len(order)
+            order.append(sid)
+
+    visit(0)
+    for sid in open_sig_global:
+        visit(sid)
+    i = 0
+    while i < len(order):
+        sid = order[i]
+        i += 1
+        for core in cores:
+            visit(table.join(sid, core))
+
+    signatures = [table.signatures[sid] for sid in order]
+    S = len(signatures)
     C = max(len(cores), 1)  # gathers need a non-empty core axis
     join_table = np.full((S, C), -1, np.int32)
-    for (sid, core), out in table._join_cache.items():
-        join_table[sid, core_ids[core]] = out
+    for li, sid in enumerate(order):
+        for cid, core in enumerate(cores):
+            out = table._join_cache.get((sid, core), -1)
+            if out >= 0:
+                join_table[li, cid] = local[out]
 
-    f_max = max((len(s.frontier) for s in table.signatures), default=1) or 1
+    f_max = max((len(s.frontier) for s in signatures), default=1) or 1
     R = usable.shape[1]
     frontiers = np.full((S, f_max, R), FRONTIER_PAD, np.float32)
-    for s in table.signatures:
+    for li, s in enumerate(signatures):
         if len(s.frontier):
-            frontiers[s.sig_id, : len(s.frontier)] = s.frontier
+            frontiers[li, : len(s.frontier)] = s.frontier
+
+    open_sig_by_core = np.array([local[s] for s in open_sig_global] or [0], np.int32)
 
     daemon_vec = res.to_scaled_vector(daemon, axes)
 
@@ -224,6 +323,7 @@ def encode(
         frontiers=frontiers,
         daemon=daemon_vec,
         table=table,
+        signatures=signatures,
         cores=cores,
         hostnames=hostnames,
         axes=axes,

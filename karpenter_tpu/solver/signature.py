@@ -131,9 +131,25 @@ class SignatureTable:
         self._open_cache: Dict[Core, int] = {}  # core -> sig id of base⊕core
         self._join_cache: Dict[Tuple[int, Core], int] = {}
         self._core_reqs: Dict[Core, Requirements] = {}
+        self._mask_matrix: Optional[np.ndarray] = None
         # signature 0 is the base itself
         self._base_hostnames = base.requirements.get(lbl.HOSTNAME)
         self._intern(self._strip_hostname(base.requirements))
+
+    def set_base(self, base: Constraints) -> None:
+        """Refresh the per-solve hostname state on a table reused across
+        solves (topology injection registers fresh generated hostnames into
+        the constraints every batch; signatures themselves are
+        hostname-free, so they stay valid)."""
+        self.base = base
+        self._base_hostnames = base.requirements.get(lbl.HOSTNAME)
+
+    def type_mask_matrix(self) -> np.ndarray:
+        """[S, T] stacked signature→type compatibility, cached until the
+        closure grows — re-stacking per decode was a hot spot."""
+        if self._mask_matrix is None or self._mask_matrix.shape[0] != len(self.signatures):
+            self._mask_matrix = np.stack([s.type_mask for s in self.signatures])
+        return self._mask_matrix
 
     # hostname is carried separately by the kernel; keep it out of signatures
     def _strip_hostname(self, reqs: Requirements) -> Requirements:

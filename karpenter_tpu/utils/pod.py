@@ -59,12 +59,23 @@ def host_ports(pod: Pod):
     """The (hostIP, hostPort, protocol) triples the pod claims on its node.
     Conflicting claims cannot co-locate (the reference left this unenforced —
     suite_test.go:1758 is skipped 'enable after scheduler is aware of
-    hostport usage'; this framework enforces it)."""
+    hostport usage'; this framework enforces it).
+
+    Memoized on the pod (containers are never mutated by scheduling) — this
+    runs for every pod of every solve."""
+    containers = pod.spec.containers
+    cached = getattr(pod, "_host_ports_memo", None)
+    if cached is not None and cached[0] is containers:
+        return set(cached[1])
     out = set()
-    for container in pod.spec.containers:
+    for container in containers:
         for port in container.ports:
             if port.host_port:
                 out.add((port.host_ip or WILDCARD_HOST_IP, port.host_port, port.protocol or "TCP"))
+    try:
+        pod._host_ports_memo = (containers, frozenset(out))
+    except AttributeError:
+        pass
     return out
 
 

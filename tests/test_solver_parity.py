@@ -220,3 +220,101 @@ class TestRandomizedParity:
                     )
                 )
         assert_parity(*both_solve(pods, catalog, seed=seed))
+
+
+class TestEncodeCache:
+    """Solve-invariant encode state reused across a worker's batches
+    (signature table, capacity matrix) — scoped per batch so accumulated
+    closure state never leaks into the kernel input."""
+
+    def _setup(self):
+        import random
+
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from tests.factories import make_provisioner
+
+        catalog = instance_types(20)
+        c0 = make_provisioner(solver="tpu").spec.constraints
+        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
+        return catalog, c0, TpuScheduler(Cluster(), rng=random.Random(0))
+
+    def test_mixed_core_batches_share_one_table(self):
+        """Batches with different pod constraint cores must reuse the cached
+        table without crashing (round-2 review repro) and still match FFD."""
+        import random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.ffd import FFDScheduler
+        from tests.factories import make_pod
+
+        catalog, c0, sched = self._setup()
+        ffd = FFDScheduler(Cluster(), rng=random.Random(0))
+        batches = [
+            [make_pod(requests={"cpu": "1"}, node_selector={"team": "a"}) for _ in range(3)],
+            [make_pod(requests={"cpu": "1"}) for _ in range(3)],
+            [make_pod(requests={"cpu": "1"}, node_selector={"team": "b"}) for _ in range(2)]
+            + [make_pod(requests={"cpu": "1"})],
+        ]
+        for pods in batches:
+            v_tpu = sched.solve(c0.clone(), catalog, pods)
+            v_ffd = ffd.solve(c0.clone(), catalog, pods)
+            a = sorted(
+                (sorted(p.key for p in v.pods), v.instance_type_options[0].name)
+                for v in v_tpu
+            )
+            b = sorted(
+                (sorted(p.key for p in v.pods), v.instance_type_options[0].name)
+                for v in v_ffd
+            )
+            assert a == b
+        assert len(sched._encode_cache.tables) == 1  # one table, reused
+
+    def test_fingerprint_hits_across_fresh_catalog_objects(self):
+        """Providers rebuild InstanceType objects per call; the cache must
+        key on catalog semantics, not object identity."""
+        import copy
+
+        from tests.factories import make_pod
+
+        catalog, c0, sched = self._setup()
+        sched.solve(c0.clone(), catalog, [make_pod(requests={"cpu": "1"})])
+        fresh = copy.deepcopy(catalog)  # same semantics, all-new objects
+        sched.solve(c0.clone(), fresh, [make_pod(requests={"cpu": "1"})])
+        assert len(sched._encode_cache.tables) == 1
+
+    def test_lru_bounds_entries(self):
+        from karpenter_tpu.solver.encode import EncodeCache
+
+        cache = EncodeCache()
+        for i in range(EncodeCache.MAX_ENTRIES + 3):
+            cache.put(("k", i), (None, None))
+        assert len(cache.tables) == EncodeCache.MAX_ENTRIES
+
+    def test_batch_arrays_scoped_to_batch_cores(self):
+        """After a diverse batch grows the table, a simple batch's emitted
+        arrays must not inherit the accumulated signature axis."""
+        from tests.factories import make_pod
+
+        catalog, c0, sched = self._setup()
+        diverse = [
+            make_pod(requests={"cpu": "1"}, node_selector={"team": t})
+            for t in ("a", "b", "c", "d")
+        ]
+        sched.solve(c0.clone(), catalog, diverse)
+        from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import encode as enc
+
+        pods = sort_pods_ffd([make_pod(requests={"cpu": "1"})])
+        c = c0.clone()
+        Topology(sched.cluster).inject(c, list(pods))
+        batch = enc.encode(
+            c, sorted(catalog, key=lambda it: it.effective_price()), pods,
+            daemon_overhead(sched.cluster, c), cache=sched._encode_cache,
+        )
+        # base + the plain pod's open signature only
+        assert len(batch.signatures) <= 2
+        assert batch.join_table.shape[0] == len(batch.signatures)
