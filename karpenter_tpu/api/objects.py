@@ -281,3 +281,17 @@ class PodDisruptionBudget:
     selector: Optional[LabelSelector] = None
     min_available: Optional[int] = None
     max_unavailable: Optional[int] = None
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease — cluster-scoped leader election
+    (reference: cmd/controller/main.go:84-85 LeaderElection id
+    ``karpenter-leader-election``)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0

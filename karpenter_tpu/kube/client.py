@@ -12,6 +12,7 @@ synchronous callbacks dispatched outside the store lock.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -39,6 +40,23 @@ class NotFound(Exception):
     pass
 
 
+def resolve_pdb_threshold(value, total: int, round_up: bool) -> Optional[int]:
+    """PDB minAvailable/maxUnavailable accept ints or percentages
+    ("50%"); percentages resolve against the matching-pod count
+    (k8s intstr.GetValueFromIntOrPercent: minAvailable rounds up,
+    maxUnavailable rounds down — both the conservative direction)."""
+    if value is None:
+        return None
+    if isinstance(value, int):
+        return value
+    s = str(value).strip()
+    if s.endswith("%"):
+        pct = float(s[:-1]) / 100.0
+        exact = total * pct
+        return math.ceil(exact) if round_up else math.floor(exact)
+    return int(s)
+
+
 class _Store:
     def __init__(self):
         self.objects: Dict[Tuple[str, str], object] = {}  # (namespace, name) -> obj
@@ -49,7 +67,7 @@ class Cluster:
     """Typed object store: pods, nodes, daemonsets, provisioners, PVCs, PVs,
     storage classes, PDBs."""
 
-    KINDS = ("pods", "nodes", "daemonsets", "provisioners", "pvcs", "pvs", "storageclasses", "pdbs")
+    KINDS = ("pods", "nodes", "daemonsets", "provisioners", "pvcs", "pvs", "storageclasses", "pdbs", "leases")
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
         self._lock = threading.RLock()
@@ -212,9 +230,11 @@ class Cluster:
                     if pdb.selector is None or pdb.selector.matches(p.metadata.labels)
                 ]
                 healthy = [p for p in matching if p.metadata.deletion_timestamp is None]
-                if pdb.min_available is not None and len(healthy) - 1 < pdb.min_available:
+                min_avail = resolve_pdb_threshold(pdb.min_available, len(matching), round_up=True)
+                max_unavail = resolve_pdb_threshold(pdb.max_unavailable, len(matching), round_up=False)
+                if min_avail is not None and len(healthy) - 1 < min_avail:
                     return False
-                if pdb.max_unavailable is not None and (len(matching) - (len(healthy) - 1)) > pdb.max_unavailable:
+                if max_unavail is not None and (len(matching) - (len(healthy) - 1)) > max_unavail:
                     return False
             key = self._key(pod)
             if pod.metadata.finalizers:

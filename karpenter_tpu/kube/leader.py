@@ -1,0 +1,133 @@
+"""Cluster-scoped leader election over a coordination.k8s.io/v1 Lease.
+
+Replaces the single-host flock lease for multi-host deployments
+(reference: cmd/controller/main.go:84-85, lease id
+``karpenter-leader-election``). Same contract as ``utils.lease.FileLease``
+so ``LeaderElector`` drives either: ``try_acquire`` (non-blocking),
+``renew`` on heartbeat, ``release`` on shutdown. Safety against split
+brain comes from apiserver optimistic concurrency — a stale
+resourceVersion update returns 409 Conflict, and the loser backs off.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import uuid
+from typing import Optional
+
+logger = logging.getLogger("karpenter.kube.leader")
+
+from karpenter_tpu.api.objects import Lease, ObjectMeta
+from karpenter_tpu.kube.client import Cluster, Conflict, NotFound
+
+DEFAULT_LEASE_NAME = "karpenter-leader-election"
+DEFAULT_LEASE_NAMESPACE = "kube-system"
+
+
+class KubeLease:
+    def __init__(
+        self,
+        cluster: Cluster,
+        name: str = DEFAULT_LEASE_NAME,
+        namespace: str = DEFAULT_LEASE_NAMESPACE,
+        identity: Optional[str] = None,
+        duration: float = 15.0,
+    ):
+        self.cluster = cluster
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        # leaseDurationSeconds is an integer ≥ 1 on the wire
+        self.duration = max(1, int(round(duration)))
+
+    def _now(self) -> float:
+        return self.cluster.clock()
+
+    def _get(self) -> Optional[Lease]:
+        getter = getattr(self.cluster, "get_live", None)
+        if getter is not None:
+            try:
+                return getter("leases", self.name, namespace=self.namespace)
+            except NotFound:
+                return None
+        return self.cluster.try_get("leases", self.name, namespace=self.namespace)
+
+    def _expired(self, lease: Lease) -> bool:
+        renew = lease.renew_time or lease.acquire_time or 0.0
+        return renew + lease.lease_duration_seconds <= self._now()
+
+    def try_acquire(self) -> bool:
+        try:
+            return self._try_acquire()
+        except Exception:
+            # transport blips and unexpected apiserver errors must read as
+            # "not acquired", never kill the elector thread (split brain)
+            logger.exception("lease acquire failed; retrying on next tick")
+            return False
+
+    def _try_acquire(self) -> bool:
+        now = self._now()
+        current = self._get()
+        if current is None:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                holder_identity=self.identity,
+                lease_duration_seconds=self.duration,
+                acquire_time=now,
+                renew_time=now,
+                lease_transitions=0,
+            )
+            try:
+                self.cluster.create("leases", lease)
+                return True
+            except Conflict:
+                return False  # racer created it first
+        if (
+            current.holder_identity == self.identity
+            or not current.holder_identity  # released
+            or self._expired(current)
+        ):
+            if current.holder_identity != self.identity:
+                current.lease_transitions += 1
+                current.acquire_time = now
+            current.holder_identity = self.identity
+            current.renew_time = now
+            try:
+                self.cluster.update("leases", current)
+                return True
+            except (Conflict, NotFound):
+                return False  # a racer's update landed first
+        return False
+
+    def renew(self) -> bool:
+        try:
+            current = self._get()
+            if current is None or current.holder_identity != self.identity or self._expired(current):
+                return False
+            current.renew_time = self._now()
+            self.cluster.update("leases", current)
+            return True
+        except Exception:
+            # failed renewal reads as lost leadership — the safe direction
+            logger.exception("lease renew failed; treating as lost")
+            return False
+
+    def release(self) -> None:
+        try:
+            current = self._get()
+            if current is not None and current.holder_identity == self.identity:
+                current.holder_identity = ""
+                current.renew_time = None
+                self.cluster.update("leases", current)
+        except Exception:
+            logger.exception("lease release failed (expires on its own)")
+
+    def holder(self) -> Optional[str]:
+        try:
+            current = self._get()
+        except Exception:
+            return None
+        if current is None or not current.holder_identity or self._expired(current):
+            return None
+        return current.holder_identity

@@ -60,6 +60,8 @@ class Runtime:
             self.elector.stop()
         if self.log_watcher is not None:
             self.log_watcher.stop()
+        if hasattr(self.cluster, "stop"):
+            self.cluster.stop()
 
 
 def _serve_endpoints(runtime: Runtime) -> None:
@@ -101,6 +103,24 @@ def _serve_endpoints(runtime: Runtime) -> None:
     runtime.servers = [metrics_server, health]
 
 
+def _build_cluster(options: Options) -> Cluster:
+    """In-memory store by default; a real apiserver when configured
+    (reference: cmd/controller/main.go:68-70 rate-limited kube client)."""
+    if not options.kube_api_server:
+        return Cluster()
+    from karpenter_tpu.kube.apiserver import ApiCluster
+
+    if options.kube_api_server == "in-cluster":
+        return ApiCluster.from_env(
+            qps=options.kube_client_qps, burst=options.kube_client_burst
+        )
+    return ApiCluster(
+        options.kube_api_server,
+        qps=options.kube_client_qps,
+        burst=options.kube_client_burst,
+    )
+
+
 def build_runtime(
     options: Optional[Options] = None,
     cluster: Optional[Cluster] = None,
@@ -113,7 +133,8 @@ def build_runtime(
     options = options or Options()
     if consolidation_enabled is None:
         consolidation_enabled = options.consolidation_enabled
-    cluster = cluster or Cluster()
+    if cluster is None:
+        cluster = _build_cluster(options)
     cloud_provider = cloud_provider or registry.new_cloud_provider(options.cloud_provider)
     # latency histograms on every provider method
     # (reference: cmd/controller/main.go:81 → metrics/cloudprovider.go:66)
@@ -192,6 +213,12 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     if runtime.options.log_config_file:
         runtime.log_watcher = LogLevelWatcher(runtime.options.log_config_file)
         runtime.log_watcher.start()
+    from karpenter_tpu.kube.apiserver import ApiCluster
+
+    if isinstance(runtime.cluster, ApiCluster):
+        runtime.cluster.start()
+        if not runtime.cluster.wait_for_sync(60):
+            raise RuntimeError("apiserver cache never synced")
     if runtime.options.leader_election_lease:
         from karpenter_tpu.utils.lease import FileLease, LeaderElector
 
@@ -202,11 +229,35 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
             logger.critical("lost leadership lease; stopping controllers")
             runtime.manager.stop()
 
-        runtime.elector = LeaderElector(
-            FileLease(runtime.options.leader_election_lease), on_lost=on_lost
-        )
+        spec = runtime.options.leader_election_lease
+        if spec.startswith("kube:"):
+            # cluster-scoped Lease object: kube:<namespace>/<name> (a bare
+            # kube:<name> lands in kube-system)
+            # (reference: cmd/controller/main.go:84-85)
+            from karpenter_tpu.kube.leader import KubeLease
+
+            if not isinstance(runtime.cluster, ApiCluster):
+                # an in-memory store is per-process: every replica would
+                # elect itself — silent split brain
+                raise ValueError(
+                    "kube: leader election requires --kube-api-server "
+                    "(the in-memory cluster cannot coordinate replicas)"
+                )
+            ns_name = spec[len("kube:"):]
+            if "/" in ns_name:
+                namespace, _, name = ns_name.partition("/")
+            else:
+                namespace, name = "kube-system", ns_name
+            lease = KubeLease(
+                runtime.cluster,
+                name=name or "karpenter-leader-election",
+                namespace=namespace or "kube-system",
+            )
+        else:
+            lease = FileLease(spec)
+        runtime.elector = LeaderElector(lease, on_lost=on_lost)
         runtime.elector.start()
-        logger.info("waiting for leadership (%s)", runtime.options.leader_election_lease)
+        logger.info("waiting for leadership (%s)", spec)
         runtime.elector.wait_for_leadership()
     runtime.manager.start()
     if serve:

@@ -26,6 +26,9 @@ class Options:
     kube_client_qps: float = field(default_factory=lambda: float(_env("KUBE_CLIENT_QPS", "200")))
     kube_client_burst: int = field(default_factory=lambda: int(_env("KUBE_CLIENT_BURST", "300")))
     cloud_provider: str = field(default_factory=lambda: _env("CLOUD_PROVIDER", "fake"))
+    # apiserver URL backing the Cluster; "" = in-memory store,
+    # "in-cluster" = service-account config from the pod environment
+    kube_api_server: str = field(default_factory=lambda: _env("KUBE_API_SERVER", ""))
     # solver knobs (new in this framework)
     default_solver: str = field(default_factory=lambda: _env("KARPENTER_SOLVER", "ffd"))
     solver_service_address: str = field(
@@ -74,6 +77,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--kube-client-qps", type=float, default=opts.kube_client_qps)
     ap.add_argument("--kube-client-burst", type=int, default=opts.kube_client_burst)
     ap.add_argument("--cloud-provider", default=opts.cloud_provider)
+    ap.add_argument("--kube-api-server", default=opts.kube_api_server,
+                    help="apiserver URL ('' = in-memory store, 'in-cluster' = pod env)")
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
     ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
@@ -95,6 +100,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         kube_client_qps=ns.kube_client_qps,
         kube_client_burst=ns.kube_client_burst,
         cloud_provider=ns.cloud_provider,
+        kube_api_server=ns.kube_api_server,
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
         consolidation_enabled=ns.consolidation,

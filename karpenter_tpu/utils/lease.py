@@ -13,11 +13,14 @@ from __future__ import annotations
 import contextlib
 import fcntl
 import json
+import logging
 import os
 import threading
 import time
 import uuid
 from typing import Callable, Optional
+
+logger = logging.getLogger("karpenter.lease")
 
 DEFAULT_LEASE_DURATION = 15.0
 DEFAULT_RENEW_INTERVAL = 5.0
@@ -129,13 +132,23 @@ class LeaderElector:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            if self._leader.is_set():
-                if not self.lease.renew():
+            try:
+                if self._leader.is_set():
+                    if not self.lease.renew():
+                        self._leader.clear()
+                        if self.on_lost is not None:
+                            self.on_lost()
+                elif self.lease.try_acquire():
+                    self._leader.set()
+            except Exception:
+                # a lease backend that raises must not kill the elector
+                # thread: a dead elector with is_leader stuck True is the
+                # split-brain case election exists to prevent
+                logger.exception("lease operation failed")
+                if self._leader.is_set():
                     self._leader.clear()
                     if self.on_lost is not None:
                         self.on_lost()
-            elif self.lease.try_acquire():
-                self._leader.set()
             self._stop.wait(self.renew_interval)
 
     def wait_for_leadership(self, timeout: Optional[float] = None) -> bool:
