@@ -1,0 +1,32 @@
+# Multi-stage image for all three entrypoints — controller, webhook, and
+# TPU solver sidecar — selected by command (deploy/*.yaml set it)
+# (reference: the ko-built controller/webhook images, Makefile:22-42).
+
+# Stage 1: compile the native CPU packer
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src/native
+COPY native/ffd_pack.cpp .
+RUN g++ -O3 -shared -fPIC -o libffd_pack.so ffd_pack.cpp
+
+# Stage 2: runtime
+FROM python:3.12-slim
+# jax[tpu] pulls libtpu for real chips; CPU-only environments still work
+# (JAX_PLATFORMS=cpu). grpcio serves the solver transport; cryptography
+# self-manages the webhook serving cert.
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    grpcio prometheus-client cryptography numpy \
+    || pip install --no-cache-dir jax grpcio prometheus-client cryptography numpy
+WORKDIR /app
+COPY karpenter_tpu/ karpenter_tpu/
+# the ctypes loader resolves <root>/native/libffd_pack.so relative to the
+# package (solver/native.py); ship source + prebuilt so no g++ is needed
+COPY native/ffd_pack.cpp native/
+COPY --from=build /src/native/libffd_pack.so native/
+ENV PYTHONPATH=/app
+ENV PYTHONUNBUFFERED=1
+USER 65532:65532
+# default: the controller; webhook/solver Deployments override command
+CMD ["python", "-m", "karpenter_tpu.main"]

@@ -34,6 +34,23 @@ dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) __graft_entry__.py
 
+image:  ## build the container image (controller + webhook + solver entrypoints)
+	docker build -t karpenter-tpu:latest .
+
+chart:  ## render the chart (helm-compatible templates; no helm needed)
+	$(PY) hack/render_chart.py charts/karpenter-tpu
+
+apply:  ## render + apply the chart to the current cluster
+	$(PY) hack/render_chart.py charts/karpenter-tpu | kubectl apply -f -
+
+webhook-certs:  ## generate CA+serving cert into CERTS_DIR and print install steps
+	$(PY) hack/gen_webhook_certs.py $(or $(CERTS_DIR),webhook-certs)
+
+webhook-cabundle:  ## inject a generated CA into deploy/webhook.yaml (CA=path/to/ca.crt)
+	$(PY) -c 'import sys; from karpenter_tpu.kube.certs import ca_bundle_b64; \
+		m = open("deploy/webhook.yaml").read(); \
+		sys.stdout.write(m.replace("$${CA_BUNDLE}", ca_bundle_b64("$(CA)")))'
+
 run:  ## start the controller process against the in-memory cluster
 	$(PY) -m karpenter_tpu.main
 
@@ -41,4 +58,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test battletest deflake benchmark benchmark-grid \
-	benchmark-consolidation dryrun-multichip run solver-sidecar
+	benchmark-consolidation dryrun-multichip run solver-sidecar \
+	image chart apply webhook-cabundle

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Generate the webhook CA + serving cert and print the install steps.
+
+The serving cert is mounted from the ``karpenter-tpu-webhook-certs``
+Secret (deploy/webhook.yaml), so pod restarts never mint a new CA — the
+``caBundle`` registered in the webhook configurations stays valid for the
+CA's lifetime. Usage::
+
+    python hack/gen_webhook_certs.py [certs-dir]
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+from karpenter_tpu.kube.certs import ca_bundle_b64, ensure_serving_cert  # noqa: E402
+
+SERVICE = "karpenter-tpu-webhook"
+NAMESPACE = "karpenter"
+
+
+def main() -> int:
+    cert_dir = sys.argv[1] if len(sys.argv) > 1 else "webhook-certs"
+    dns = [
+        SERVICE,
+        f"{SERVICE}.{NAMESPACE}",
+        f"{SERVICE}.{NAMESPACE}.svc",
+        f"{SERVICE}.{NAMESPACE}.svc.cluster.local",
+    ]
+    cert, key, ca = ensure_serving_cert(cert_dir, dns)
+    print(f"# certs ready in {cert_dir}/ (CA reused if already present)")
+    print("# 1. store the serving cert as the Secret the Deployment mounts:")
+    print(
+        f"kubectl -n {NAMESPACE} create secret generic {SERVICE}-certs "
+        f"--from-file=tls.crt={cert} --from-file=tls.key={key} "
+        f"--from-file=ca.crt={ca} --dry-run=client -o yaml | kubectl apply -f -"
+    )
+    print("# 2. register the webhooks with the CA bundle:")
+    print(
+        f"python -c 'import sys; m=open(\"deploy/webhook.yaml\").read(); "
+        f"sys.stdout.write(m.replace(\"${{CA_BUNDLE}}\", \"{ca_bundle_b64(ca)[:12]}...\"))'"
+        f"  # (or: make webhook-cabundle CA={ca} | kubectl apply -f -)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Minimal chart renderer for CI (helm-compatible template subset).
+
+Supports exactly the constructs charts/karpenter-tpu/templates use:
+``{{ .Values.dotted.path }}`` substitution (scalars inline; mappings as
+flow-style YAML) and whole-line ``{{- if .Values.flag }} / {{- end }}``
+boolean gates. Real deployments can use helm directly — the templates stay
+inside helm's syntax — this exists so `make chart` verifies rendering
+without a helm binary.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+try:
+    import yaml  # type: ignore
+except ImportError:
+    yaml = None
+
+
+def load_values(path: Path) -> dict:
+    if yaml is not None:
+        return yaml.safe_load(path.read_text())
+    raise SystemExit("pyyaml required")
+
+
+def lookup(values: dict, dotted: str):
+    cur = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(f"missing value: .Values.{dotted}")
+        cur = cur[part]
+    return cur
+
+
+def fmt(value) -> str:
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, dict):
+        # flow-style mapping, valid inline YAML
+        inner = ", ".join(f"{k!r}: {fmt(v)}" for k, v in value.items())
+        return "{" + inner + "}"
+    return str(value)
+
+
+def render(template: str, values: dict) -> str:
+    out_lines = []
+    skip_depth = 0
+    for line in template.splitlines():
+        m_if = re.match(r"\s*\{\{-? if \.Values\.([\w.]+) \}\}\s*$", line)
+        m_end = re.match(r"\s*\{\{-? end \}\}\s*$", line)
+        if m_if:
+            if skip_depth or not lookup(values, m_if.group(1)):
+                skip_depth += 1
+            continue
+        if m_end:
+            if skip_depth:
+                skip_depth -= 1
+            continue
+        if skip_depth:
+            continue
+        line = re.sub(
+            r"\{\{ \.Values\.([\w.]+) \}\}",
+            lambda m: fmt(lookup(values, m.group(1))),
+            line,
+        )
+        out_lines.append(line)
+    return "\n".join(out_lines) + "\n"
+
+
+def main() -> int:
+    chart = Path(sys.argv[1] if len(sys.argv) > 1 else "charts/karpenter-tpu")
+    values = load_values(chart / "values.yaml")
+    docs = []
+    for crd in sorted((chart / "crds").glob("*.yaml")):
+        docs.append(crd.read_text())
+    for tpl in sorted((chart / "templates").glob("*.yaml")):
+        rendered = render(tpl.read_text(), values)
+        if rendered.strip():
+            docs.append(rendered)
+    out = "\n---\n".join(docs)
+    if yaml is not None:  # validate every rendered document parses
+        for doc in out.split("\n---\n"):
+            for parsed in yaml.safe_load_all(doc):
+                pass
+    sys.stdout.write(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

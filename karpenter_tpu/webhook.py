@@ -14,7 +14,7 @@ webhook's job is fast feedback at ``kubectl apply`` time.
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from karpenter_tpu.api.provisioner import (
     SOLVER_FFD,
@@ -131,14 +131,89 @@ def deserialize_provisioner(doc: dict) -> Provisioner:
     )
 
 
-def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
-    """Start the admission HTTP server; returns the server object.
+def admission_review_response(webhook: Webhook, review: dict, path: str) -> dict:
+    """Handle one admission.k8s.io/v1 AdmissionReview for ``path``
+    (/default-resource mutates, /validate-resource validates).
 
-    POST /default-resource  → the defaulted provisioner document
-    POST /validate-resource → {"allowed": bool, "errors": [...]}
+    Mutating response: a JSONPatch ``add`` on /spec (add upserts — a
+    metadata-only Provisioner has no /spec for ``replace`` to target).
+    Validating response: allowed or denied with a Status message.
+    (reference: the knative admission plumbing behind
+    cmd/webhook/main.go:66-84.)
+    """
+    import base64
+    import json
+
+    from karpenter_tpu.kube import serde
+
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+
+    def deny(errors: List[str]) -> dict:
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": uid,
+                "allowed": False,
+                "status": {"code": 400, "message": "; ".join(errors)},
+            },
+        }
+
+    try:
+        provisioner = serde.from_wire("provisioners", request.get("object") or {})
+    except Exception as e:
+        return deny([f"undecodable object: {e}"])
+    if path == "/default-resource":
+        try:
+            webhook.default(provisioner)
+        except Exception as e:
+            return deny([f"defaulting failed: {e}"])
+        patched = serde.to_wire("provisioners", provisioner)
+        patch = [{"op": "add", "path": "/spec", "value": patched.get("spec", {})}]
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": uid,
+                "allowed": True,
+                "patchType": "JSONPatch",
+                "patch": base64.b64encode(json.dumps(patch).encode()).decode(),
+            },
+        }
+    try:
+        webhook.validate(provisioner)
+    except AdmissionError as e:
+        return deny(e.errors)
+    except Exception as e:
+        return deny([f"validation crashed: {e}"])
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": {"uid": uid, "allowed": True},
+    }
+
+
+def serve(
+    webhook: Webhook,
+    address: str = "0.0.0.0:8443",
+    tls_cert: Optional[str] = None,
+    tls_key: Optional[str] = None,
+):
+    """Start the admission server; returns the server object.
+
+    With ``tls_cert``/``tls_key`` the server speaks HTTPS — what an
+    apiserver requires of a webhook (reference: cmd/webhook/main.go:46
+    self-managed cert via knative certificates).
+
+    POST /default-resource  → AdmissionReview with a JSONPatch, or (plain
+                              provisioner doc in) the defaulted document
+    POST /validate-resource → AdmissionReview allow/deny, or
+                              {"allowed": bool, "errors": [...]}
     GET  /healthz           → 200
     """
     import json
+    import ssl
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -165,6 +240,17 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
             length = int(self.headers.get("Content-Length", 0))
             try:
                 doc = json.loads(self.rfile.read(length) or b"{}")
+            except Exception as e:
+                self._respond(400, {"error": f"bad request: {e}"})
+                return
+            if self.path not in ("/default-resource", "/validate-resource"):
+                self._respond(404, {"error": "not found"})
+                return
+            if doc.get("kind") == "AdmissionReview":
+                self._respond(200, admission_review_response(webhook, doc, self.path))
+                return
+            # bespoke (non-AdmissionReview) protocol for direct callers
+            try:
                 provisioner = deserialize_provisioner(doc)
             except Exception as e:
                 self._respond(400, {"error": f"bad request: {e}"})
@@ -176,7 +262,7 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
                     self._respond(422, {"error": f"defaulting failed: {e}"})
                     return
                 self._respond(200, serialize_provisioner(provisioner))
-            elif self.path == "/validate-resource":
+            else:
                 try:
                     webhook.validate(provisioner)
                     self._respond(200, {"allowed": True, "errors": []})
@@ -184,8 +270,6 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
                     self._respond(200, {"allowed": False, "errors": e.errors})
                 except Exception as e:  # hook crash → denial, not a dropped conn
                     self._respond(200, {"allowed": False, "errors": [f"validation crashed: {e}"]})
-            else:
-                self._respond(404, {"error": "not found"})
 
         def log_message(self, *args):
             return
@@ -193,6 +277,16 @@ def serve(webhook: Webhook, address: str = "0.0.0.0:8443"):
     host, port = address.rsplit(":", 1)
     server = ThreadingHTTPServer((host, int(port)), Handler)
     server.daemon_threads = True
+    if tls_cert and tls_key:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key)
+        # handshake deferred to the per-connection handler thread (with its
+        # 10s timeout): with the default handshake-on-accept, one idle
+        # client would block accept() — and with failurePolicy: Fail that
+        # stalls every Provisioner write in the cluster
+        server.socket = ctx.wrap_socket(
+            server.socket, server_side=True, do_handshake_on_connect=False
+        )
     threading.Thread(target=server.serve_forever, daemon=True, name="webhook").start()
     return server
 
@@ -208,9 +302,31 @@ def main(argv=None) -> None:
     ap.add_argument("--address", default="0.0.0.0:8443")
     ap.add_argument("--cloud-provider", default="fake")
     ap.add_argument("--default-solver", default=SOLVER_FFD)
+    ap.add_argument("--cert-dir", default="/tmp/karpenter-webhook-certs",
+                    help="serving cert dir; cert is self-generated when absent")
+    ap.add_argument("--service-name", default="karpenter-tpu-webhook")
+    ap.add_argument("--service-namespace", default="karpenter")
+    ap.add_argument("--no-tls", action="store_true", help="plain HTTP (dev only)")
     args = ap.parse_args(argv)
     provider = registry.new_cloud_provider(args.cloud_provider)
-    server = serve(Webhook(provider, default_solver=args.default_solver), args.address)
+    tls_cert = tls_key = None
+    if not args.no_tls:
+        from karpenter_tpu.kube.certs import ensure_serving_cert
+
+        dns = [
+            args.service_name,
+            f"{args.service_name}.{args.service_namespace}",
+            f"{args.service_name}.{args.service_namespace}.svc",
+            f"{args.service_name}.{args.service_namespace}.svc.cluster.local",
+        ]
+        tls_cert, tls_key, ca_path = ensure_serving_cert(args.cert_dir, dns)
+        print(f"serving cert ready; caBundle at {ca_path}")
+    server = serve(
+        Webhook(provider, default_solver=args.default_solver),
+        args.address,
+        tls_cert=tls_cert,
+        tls_key=tls_key,
+    )
     try:
         while True:
             time.sleep(3600)
