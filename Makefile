@@ -47,7 +47,7 @@ webhook-certs:  ## generate CA+serving cert into CERTS_DIR and print install ste
 	$(PY) hack/gen_webhook_certs.py $(or $(CERTS_DIR),webhook-certs)
 
 webhook-cabundle:  ## inject a generated CA into deploy/webhook.yaml (CA=path/to/ca.crt)
-	$(PY) -c 'import sys; from karpenter_tpu.kube.certs import ca_bundle_b64; \
+	@$(PY) -c 'import sys; from karpenter_tpu.kube.certs import ca_bundle_b64; \
 		m = open("deploy/webhook.yaml").read(); \
 		sys.stdout.write(m.replace("$${CA_BUNDLE}", ca_bundle_b64("$(CA)")))'
 
@@ -59,4 +59,4 @@ solver-sidecar:  ## start the TPU solver sidecar
 
 .PHONY: dev test battletest deflake benchmark benchmark-grid \
 	benchmark-consolidation dryrun-multichip run solver-sidecar \
-	image chart apply webhook-cabundle
+	image chart apply webhook-certs webhook-cabundle

@@ -37,12 +37,8 @@ def main() -> int:
         f"--from-file=tls.crt={cert} --from-file=tls.key={key} "
         f"--from-file=ca.crt={ca} --dry-run=client -o yaml | kubectl apply -f -"
     )
-    print("# 2. register the webhooks with the CA bundle:")
-    print(
-        f"python -c 'import sys; m=open(\"deploy/webhook.yaml\").read(); "
-        f"sys.stdout.write(m.replace(\"${{CA_BUNDLE}}\", \"{ca_bundle_b64(ca)[:12]}...\"))'"
-        f"  # (or: make webhook-cabundle CA={ca} | kubectl apply -f -)"
-    )
+    print("# 2. register the webhooks with the CA bundle injected:")
+    print(f"make webhook-cabundle CA={ca} | kubectl apply -f -")
     return 0
 
 

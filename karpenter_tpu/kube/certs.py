@@ -115,38 +115,61 @@ def generate(cert_dir: str, dns_names: List[str]) -> Tuple[str, str, str]:
     return cert_path, key_path, ca_path
 
 
-def _needs_rotation(cert_path: str, dns_names: List[str]) -> bool:
+def _expiring(pem_path: str) -> bool:
     try:
-        with open(cert_path, "rb") as f:
+        with open(pem_path, "rb") as f:
             cert = x509.load_pem_x509_certificate(f.read())
     except (OSError, ValueError):
         return True
     now = datetime.datetime.now(datetime.timezone.utc)
-    if cert.not_valid_after_utc - now < datetime.timedelta(days=ROTATE_BEFORE_DAYS):
+    return cert.not_valid_after_utc - now < datetime.timedelta(days=ROTATE_BEFORE_DAYS)
+
+
+def _needs_rotation(cert_path: str, ca_path: str, dns_names: List[str]) -> bool:
+    # the CA's own expiry matters as much as the leaf's: a re-signed leaf
+    # can outlive a reused CA, and an expired CA in the registered caBundle
+    # fails every apiserver handshake with nothing else prompting rotation
+    if _expiring(cert_path) or _expiring(ca_path):
         return True
     try:
+        with open(cert_path, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
         sans = cert.extensions.get_extension_for_class(
             x509.SubjectAlternativeName
         ).value.get_values_for_type(x509.DNSName)
-    except x509.ExtensionNotFound:
+    except (OSError, ValueError, x509.ExtensionNotFound):
         return True
     return set(sans) != set(dns_names)
 
 
 def ensure_serving_cert(cert_dir: str, dns_names: List[str]) -> Tuple[str, str, str]:
     """Idempotent: reuse a valid existing cert, else (re)generate.
-    Returns (cert_path, key_path, ca_path)."""
+    Returns (cert_path, key_path, ca_path).
+
+    On a read-only cert dir (Secret volume) that needs rotation, the
+    existing cert is served with a loud warning — a soon-to-expire cert
+    beats a crash loop that (failurePolicy: Fail) blocks every
+    Provisioner write; rotation there is `make webhook-certs` + Secret
+    update, outside the pod."""
     cert_path = os.path.join(cert_dir, "tls.crt")
     key_path = os.path.join(cert_dir, "tls.key")
     ca_path = os.path.join(cert_dir, "ca.crt")
-    if (
-        os.path.exists(cert_path)
-        and os.path.exists(key_path)
-        and os.path.exists(ca_path)
-        and not _needs_rotation(cert_path, dns_names)
-    ):
+    have_all = all(os.path.exists(p) for p in (cert_path, key_path, ca_path))
+    if have_all and not _needs_rotation(cert_path, ca_path, dns_names):
         return cert_path, key_path, ca_path
-    return generate(cert_dir, dns_names)
+    try:
+        return generate(cert_dir, dns_names)
+    except OSError:
+        if have_all:
+            import logging
+
+            logging.getLogger("karpenter.webhook").warning(
+                "cert dir %s is not writable and the cert needs rotation; "
+                "serving the existing cert — regenerate the Secret with "
+                "`make webhook-certs`", cert_dir,
+            )
+            return cert_path, key_path, ca_path
+        raise
 
 
 def ca_bundle_b64(ca_path: str) -> str:

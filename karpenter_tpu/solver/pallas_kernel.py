@@ -245,6 +245,15 @@ def pallas_available() -> bool:
 # one pathological batch must not degrade every other shape in the process
 _pallas_failed_shapes: set = set()
 
+# The kernel unrolls the signature × frontier loops (S × F masked selects
+# per pod step), so Mosaic compile time scales with S·F. Measured on a
+# TPU v5e (P=1024, F=8): S=16 → 2.9s, S=64 → 6.1s, S=128 → 14.1s,
+# S=256 → 38.3s — ~2.5× per doubling, extrapolating to ~2min at the
+# S=512 closure cap. Beyond this budget the first solve of a new shape
+# would blow the latency target on compile alone, so constraint-diverse
+# batches take the lax.scan kernel (XLA gathers: compile-invariant in S).
+PALLAS_UNROLL_BUDGET = 1024  # max S*F (≈14s one-time compile)
+
 
 def pack_best(*args, n_max: int) -> PackResult:
     """The fastest available packing kernel per platform: Pallas on TPU
@@ -254,10 +263,12 @@ def pack_best(*args, n_max: int) -> PackResult:
     from karpenter_tpu.solver import kernel as _k
 
     P = args[6].shape[0]  # pod_req
+    S, F = args[8].shape[0], args[8].shape[1]  # frontiers
     shape = (P, n_max)
     if (
         shape not in _pallas_failed_shapes
         and P % BLOCK == 0
+        and S * F <= PALLAS_UNROLL_BUDGET
         and pallas_available()
     ):
         try:

@@ -333,3 +333,20 @@ class TestChartAndPackaging:
         import ssl
         ctx = ssl.create_default_context(cafile=ca2)
         ctx.load_verify_locations(ca2)  # no exception = CA parses
+
+    def test_readonly_cert_dir_serves_existing_instead_of_crashing(self, tmp_path):
+        """A Secret-mounted (read-only) cert dir that hits the rotation
+        window must serve the existing cert, not crash-loop the webhook."""
+        import os
+
+        from karpenter_tpu.kube.certs import ensure_serving_cert
+
+        d = tmp_path / "certs"
+        ensure_serving_cert(str(d), ["localhost"])
+        os.chmod(d, 0o555)  # secret volumes are read-only
+        try:
+            # force the rotation path via a SAN change
+            cert, key, ca = ensure_serving_cert(str(d), ["changed-name"])
+            assert os.path.exists(cert) and os.path.exists(ca)
+        finally:
+            os.chmod(d, 0o755)
