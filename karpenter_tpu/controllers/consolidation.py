@@ -9,11 +9,19 @@ price against what is currently running.
 
 Plan: collect the provisioner's consolidatable nodes (ready, not deleting,
 no do-not-evict pods) and their reschedulable pods, re-solve in one batch,
-price both sides. Execute: launch the replacement nodes, migrate pods onto
-them (direct rebind — the same bind authority the provisioner already
-exercises for pending pods; a real-apiserver backend would evict and let the
-workload controller recreate), then delete the now-empty old nodes so the
-termination controller reclaims the instances.
+price both sides. Execute has two migration modes:
+
+- ``bind``: launch replacements and rebind pods directly — valid only where
+  the store permits rebinding (the in-memory cluster; a real apiserver
+  rejects Binding a pod that already has a nodeName);
+- ``evict`` (auto-selected for ``ApiCluster``): delete the old nodes — the
+  termination controller cordons/drains them (PDB-respecting evictions),
+  workload controllers recreate the pods, and the recreated pending pods
+  flow through the NORMAL provisioning path, whose solver launches the
+  same cost-optimal capacity the plan priced. No replacements are
+  pre-launched: this framework (like the reference) never packs pods onto
+  existing nodes itself — that is the kube-scheduler's job — so a
+  pre-launched node would sit empty while the provisioner built another.
 """
 
 from __future__ import annotations
@@ -74,11 +82,20 @@ class ConsolidationController:
         cloud_provider: CloudProvider,
         enabled: bool = True,
         solver_service_address: Optional[str] = None,
+        migration: Optional[str] = None,  # "bind" | "evict" | None = auto
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.enabled = enabled
         self.solver_service_address = solver_service_address
+        if migration is None:
+            from karpenter_tpu.kube.apiserver import ApiCluster
+
+            # a real apiserver rejects rebinding a running pod
+            migration = "evict" if isinstance(cluster, ApiCluster) else "bind"
+        if migration not in ("bind", "evict"):
+            raise ValueError(f"migration must be bind|evict, got {migration}")
+        self.migration = migration
 
     # -- planning ----------------------------------------------------------
     def plan(self, provisioner: Provisioner) -> ConsolidationPlan:
@@ -164,43 +181,54 @@ class ConsolidationController:
 
     # -- execution ---------------------------------------------------------
     def execute(self, plan: ConsolidationPlan) -> List[Node]:
-        """Launch the new world, migrate pods, retire the old world."""
+        """Retire the old world; build the new one per the migration mode
+        (bind: launch + rebind here; evict: the provisioning path rebuilds
+        from the recreated pending pods)."""
         launched: List[Node] = []
-        for vnode in plan.proposed:
-            node = self.cloud_provider.create(
-                NodeRequest(
-                    template=vnode.constraints,
-                    instance_type_options=vnode.instance_type_options,
+        if self.migration == "bind":
+            for vnode in plan.proposed:
+                node = self.cloud_provider.create(
+                    NodeRequest(
+                        template=vnode.constraints,
+                        instance_type_options=vnode.instance_type_options,
+                    )
                 )
-            )
-            template = vnode.constraints.to_node()
-            node.metadata.labels = {**template.metadata.labels, **node.metadata.labels}
-            node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] = plan.provisioner.name
-            node.metadata.finalizers = list(
-                set(node.metadata.finalizers) | set(template.metadata.finalizers)
-            )
-            # replacement nodes are immediately schedulable: consolidation
-            # binds directly, so the not-ready scheduler fence is unnecessary
-            node.spec.taints = [
-                t for t in template.spec.taints if t.key != lbl.NOT_READY_TAINT_KEY
-            ]
-            try:
-                self.cluster.create("nodes", node)
-            except Conflict:
-                pass
-            launched.append(node)
-            for pod in vnode.pods:
-                live = self.cluster.try_get("pods", pod.metadata.name, pod.metadata.namespace)
-                if live is not None:
-                    self.cluster.bind(live, node.metadata.name)
+                template = vnode.constraints.to_node()
+                node.metadata.labels = {**template.metadata.labels, **node.metadata.labels}
+                node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] = plan.provisioner.name
+                node.metadata.finalizers = list(
+                    set(node.metadata.finalizers) | set(template.metadata.finalizers)
+                )
+                # replacement nodes are immediately schedulable:
+                # consolidation binds directly, so the not-ready scheduler
+                # fence is unnecessary
+                node.spec.taints = [
+                    t for t in template.spec.taints if t.key != lbl.NOT_READY_TAINT_KEY
+                ]
+                try:
+                    self.cluster.create("nodes", node)
+                except Conflict:
+                    pass
+                launched.append(node)
+                for pod in vnode.pods:
+                    live = self.cluster.try_get(
+                        "pods", pod.metadata.name, pod.metadata.namespace
+                    )
+                    if live is not None:
+                        self.cluster.bind(live, node.metadata.name)
+        # retire the old world: deletion hands the nodes to the termination
+        # controller, whose cordon/drain evicts the remaining pods with PDB
+        # respect (in evict mode that IS the migration — workload
+        # controllers recreate, and the pending recreations drive the
+        # provisioner to launch the plan's cost-optimal capacity)
         for old in plan.nodes:
             try:
                 self.cluster.delete("nodes", old.metadata.name, namespace="")
             except Exception:
                 logger.exception("retiring node %s", old.metadata.name)
         logger.info(
-            "consolidated %d nodes -> %d nodes, price %.3f -> %.3f (saved %.3f)",
-            len(plan.nodes), len(launched),
+            "consolidating %d nodes -> %d planned (%s migration), price %.3f -> %.3f (saving %.3f)",
+            len(plan.nodes), len(plan.proposed), self.migration,
             plan.current_price, plan.proposed_price, plan.savings,
         )
         return launched

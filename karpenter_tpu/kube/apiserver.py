@@ -430,7 +430,17 @@ class ApiCluster(Cluster):
                 "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
             },
         )
-        if status not in (200, 201):
+        if status == 409:
+            # idempotent retry: a lost response followed by a re-bind to the
+            # SAME node already achieved the goal; anything else is a real
+            # conflict
+            try:
+                live = self.get_live("pods", pod.metadata.name, pod.metadata.namespace)
+            except NotFound:
+                live = None
+            if live is None or live.spec.node_name != node_name:
+                _raise_for(status, str(doc))
+        elif status not in (200, 201):
             _raise_for(status, str(doc))
         pod.spec.node_name = node_name
         self._cache_put("pods", pod)

@@ -328,6 +328,14 @@ class TestApiServer:
         if pod is None:
             return handler._send_json(404, _status(404, "NotFound", f"pod {req.name}"))
         node_name = (doc.get("target") or {}).get("name", "")
+        if pod.spec.node_name:
+            # real apiserver semantics: Binding an already-assigned pod is
+            # 409 even to the same node — migration must evict and let the
+            # workload recreate
+            return handler._send_json(
+                409,
+                _status(409, "Conflict", f"pod {req.name} is already assigned to {pod.spec.node_name}"),
+            )
         self.cluster.bind(pod, node_name)
         handler._send_json(201, _status(201, "Created", "bound"))
 
