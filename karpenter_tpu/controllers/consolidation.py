@@ -88,13 +88,20 @@ class ConsolidationController:
         self.cloud_provider = cloud_provider
         self.enabled = enabled
         self.solver_service_address = solver_service_address
-        if migration is None:
-            from karpenter_tpu.kube.apiserver import ApiCluster
+        from karpenter_tpu.kube.apiserver import ApiCluster
 
+        if migration is None:
             # a real apiserver rejects rebinding a running pod
             migration = "evict" if isinstance(cluster, ApiCluster) else "bind"
         if migration not in ("bind", "evict"):
             raise ValueError(f"migration must be bind|evict, got {migration}")
+        if migration == "bind" and isinstance(cluster, ApiCluster):
+            # would fail mid-execute on the first rebind (409), leaking the
+            # already-launched replacements next to the old capacity
+            raise ValueError(
+                "bind migration cannot work against a real apiserver "
+                "(Binding an assigned pod is rejected); use evict"
+            )
         self.migration = migration
 
     # -- planning ----------------------------------------------------------
@@ -174,6 +181,14 @@ class ConsolidationController:
                 p.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true"
                 for p in node_pods
             ):
+                continue
+            if self.migration == "evict" and any(
+                not p.metadata.owner_references for p in node_pods
+            ):
+                # voluntary disruption must not destroy workloads: an
+                # ownerless pod has no controller to recreate it after the
+                # drain, so its node is not a candidate (bind mode migrates
+                # the pod itself and has no such constraint)
                 continue
             nodes.append(node)
             pods.extend(node_pods)

@@ -440,7 +440,13 @@ class ApiCluster(Cluster):
                 live = None
             if live is None or live.spec.node_name != node_name:
                 _raise_for(status, str(doc))
-        elif status not in (200, 201):
+            # cache the server's (fresher) view, not the caller's stale copy
+            pod.spec.node_name = node_name
+            pod.metadata.resource_version = live.metadata.resource_version
+            self._cache_put("pods", live)
+            self._notify("pods", "MODIFIED", live)
+            return
+        if status not in (200, 201):
             _raise_for(status, str(doc))
         pod.spec.node_name = node_name
         self._cache_put("pods", pod)

