@@ -18,7 +18,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.kube.client import Cluster, Conflict
 from karpenter_tpu.utils.workqueue import RateLimitingQueue, ShutDown
 
 logger = logging.getLogger("karpenter.manager")
@@ -36,6 +36,7 @@ class _Registration:
         self.concurrency = concurrency
         self.queue = RateLimitingQueue()
         self.threads: List[threading.Thread] = []
+        self.conflicts: Dict = {}  # key -> consecutive Conflict count
 
 
 class Manager:
@@ -106,6 +107,9 @@ class Manager:
     readyz = healthz
 
     # -- worker loop -------------------------------------------------------
+    CONFLICT_REQUEUE = 0.2  # optimistic-concurrency retry, not backoff
+    CONFLICT_RETRY_CAP = 5  # then it's a real problem: back off + log
+
     def _worker(self, reg: _Registration, queue) -> None:
         while True:
             try:
@@ -114,11 +118,31 @@ class Manager:
                 return
             try:
                 requeue_after = self._call(reg, key)
+            except Conflict:
+                # a stale-resourceVersion write is the normal outcome of
+                # optimistic concurrency against an apiserver: requeue
+                # promptly (the next reconcile reads the fresher cache).
+                # Bounded — a key that conflicts every time (broken watch,
+                # fighting writers) must surface and back off, not hot-loop.
+                count = reg.conflicts.get(key, 0) + 1
+                reg.conflicts[key] = count
+                queue.done(key)
+                if count >= self.CONFLICT_RETRY_CAP:
+                    logger.warning(
+                        "%s: reconcile %r conflicted %d times; backing off",
+                        reg.name, key, count,
+                    )
+                    queue.add_rate_limited(key)
+                else:
+                    logger.debug("%s: reconcile %r conflicted; requeueing", reg.name, key)
+                    queue.add_after(key, self.CONFLICT_REQUEUE)
+                continue
             except Exception:
                 logger.exception("%s: reconcile %r failed", reg.name, key)
                 queue.done(key)
                 queue.add_rate_limited(key)
                 continue
+            reg.conflicts.pop(key, None)
             queue.forget(key)
             queue.done(key)
             if requeue_after is not None:

@@ -115,8 +115,14 @@ class Terminator:
     def cordon(self, node: Node) -> None:
         if node.spec.unschedulable:
             return
-        node.spec.unschedulable = True
-        self.cluster.update("nodes", node)
+        # precise merge-patch (the reference's single-patch idiom): a
+        # full-object PUT from the informer cache races other writers'
+        # resourceVersions, and mutating the cached object BEFORE a write
+        # that might fail would make the early-return above lie forever
+        self.cluster.merge_patch(
+            "nodes", node.metadata.name, {"spec": {"unschedulable": True}},
+            namespace=node.metadata.namespace,
+        )
         logger.info("Cordoned node %s", node.metadata.name)
 
     def drain(self, node: Node) -> bool:

@@ -133,6 +133,33 @@ class Cluster:
         self._notify(kind, "MODIFIED", obj)
         return obj
 
+    def merge_patch(self, kind: str, name: str, patch: dict, namespace: str = "default"):
+        """RFC 7386 merge patch in Kubernetes wire shape — the reference's
+        single-patch-per-reconcile idiom (node/controller.go:106-115), so
+        controllers patch uniformly against this store and ``ApiCluster``.
+        Identity-preserving: the stored object is updated in place (watchers
+        and tests hold references to it)."""
+        import dataclasses
+
+        from karpenter_tpu.kube import serde
+
+        with self._lock:
+            obj = self._stores[kind].objects.get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            merged_doc = serde.json_merge(serde.to_wire(kind, obj), patch)
+            fresh = serde.from_wire(kind, merged_doc)
+            fresh.metadata.namespace = obj.metadata.namespace
+            fresh.metadata.uid = obj.metadata.uid
+            fresh.metadata.creation_timestamp = obj.metadata.creation_timestamp
+            fresh.metadata.deletion_timestamp = obj.metadata.deletion_timestamp
+            for f in dataclasses.fields(obj):
+                setattr(obj, f.name, getattr(fresh, f.name))
+            self._version += 1
+            obj.metadata.resource_version = self._version
+        self._notify(kind, "MODIFIED", obj)
+        return obj
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Delete with finalizer semantics: objects carrying finalizers only
         get a deletion timestamp; removal happens when finalizers clear.

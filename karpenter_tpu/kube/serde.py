@@ -779,6 +779,21 @@ _FROM = {
 }
 
 
+def json_merge(target, patch):
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge(out.get(k), v)
+    return out
+
+
 def to_wire(kind: str, obj) -> Dict[str, Any]:
     api_version, k8s_kind, namespaced = KIND_INFO[kind]
     doc = {"apiVersion": api_version, "kind": k8s_kind}

@@ -46,19 +46,7 @@ PLURALS: Dict[str, str] = {
 }
 
 
-def merge_patch(target, patch):
-    """RFC 7386 JSON merge patch."""
-    if not isinstance(patch, dict):
-        return patch
-    if not isinstance(target, dict):
-        target = {}
-    out = dict(target)
-    for k, v in patch.items():
-        if v is None:
-            out.pop(k, None)
-        else:
-            out[k] = merge_patch(out.get(k), v)
-    return out
+from karpenter_tpu.kube.serde import json_merge as merge_patch  # shared RFC 7386 impl
 
 
 def _status(code: int, reason: str, message: str) -> dict:

@@ -94,7 +94,7 @@ class TestRestSurface:
         seen = []
         b.watch("pods", lambda e, o: seen.append((e, o.metadata.name)))
         a.create("pods", make_pod(name="w1", requests={"cpu": "1"}))
-        deadline = time.time() + 5
+        deadline = time.time() + 15
         while time.time() < deadline and not any(n == "w1" for _, n in seen):
             time.sleep(0.02)
         assert any(n == "w1" for _, n in seen)
@@ -149,8 +149,10 @@ class TestKubeLeaderElection:
     def test_two_contenders_one_leader(self, env):
         a = env.connect()
         b = env.connect()
-        la = KubeLease(a, identity="a", duration=15)
-        lb = KubeLease(b, identity="b", duration=15)
+        # generous duration: a loaded CI box must not expire the lease
+        # between acquire and renew
+        la = KubeLease(a, identity="a", duration=120)
+        lb = KubeLease(b, identity="b", duration=120)
         first = la.try_acquire()
         assert first is True
         assert lb.try_acquire() is False  # held and unexpired
@@ -192,7 +194,7 @@ class TestFullRuntime:
         rt.manager.start()
         try:
             kubectl.create("provisioners", make_provisioner())
-            deadline = time.time() + 10
+            deadline = time.time() + 30
             while time.time() < deadline and "default" not in rt.provisioning.workers:
                 time.sleep(0.05)
             assert "default" in rt.provisioning.workers
@@ -200,7 +202,7 @@ class TestFullRuntime:
 
             for i in range(3):
                 kubectl.create("pods", make_pod(name=f"app-{i}", requests={"cpu": "1"}))
-            deadline = time.time() + 30
+            deadline = time.time() + 60
             while time.time() < deadline:
                 bound = [p for p in env.cluster.pods() if p.spec.node_name]
                 if len(bound) == 3:
@@ -214,7 +216,7 @@ class TestFullRuntime:
             # mark ready so the drain path treats it as a live node
             name = nodes[0].metadata.name
             kubectl.delete("nodes", name, namespace="")
-            deadline = time.time() + 30
+            deadline = time.time() + 60
             while time.time() < deadline and env.cluster.try_get("nodes", name, namespace="") is not None:
                 time.sleep(0.05)
             assert env.cluster.try_get("nodes", name, namespace="") is None
@@ -326,7 +328,7 @@ class TestConsolidationOverApiserver:
         kubectl.watch("pods", recreate)
         try:
             kubectl.create("provisioners", make_provisioner())
-            deadline = time.time() + 10
+            deadline = time.time() + 30
             while time.time() < deadline and "default" not in rt.provisioning.workers:
                 time.sleep(0.05)
 
