@@ -74,12 +74,20 @@ class Cluster:
         self._stores: Dict[str, _Store] = {k: _Store() for k in self.KINDS}
         self._version = 0
         self.clock = clock or time.time
+        # spec.nodeName index generation: bumped on every pod event (all
+        # mutation paths — including the apiserver backend's watch/cache
+        # writes — funnel through _notify), invalidating the lazy index
+        self._pod_index_gen = 0
+        self._pods_by_node_cache: Tuple[int, Dict[str, List[Pod]]] = (-1, {})
 
     # -- generic helpers ---------------------------------------------------
     def _key(self, obj) -> Tuple[str, str]:
         return (obj.metadata.namespace, obj.metadata.name)
 
     def _notify(self, kind: str, event: str, obj) -> None:
+        if kind == "pods":
+            with self._lock:  # += is load/add/store; racing bumps can merge
+                self._pod_index_gen += 1
         for w in list(self._stores[kind].watchers):
             w(event, obj)
 
@@ -89,9 +97,16 @@ class Cluster:
     def seed(self, kind: str, obj) -> object:
         """Insert an object WITHOUT mutating it or dispatching events — for
         read-only shadow stores built from live objects (consolidation
-        planning); the live cluster remains the owner of the object."""
+        planning); the live cluster remains the owner of the object.
+
+        A shadow's ``pods_on_node`` index reflects seed-time state: in-place
+        mutations by the OWNING cluster (bind/merge_patch) bump only the
+        owner's index generation, so use a shadow within one planning pass,
+        not as a long-lived view."""
         with self._lock:
             self._stores[kind].objects[self._key(obj)] = obj
+            if kind == "pods":
+                self._pod_index_gen += 1  # no events, but the index must see it
         return obj
 
     def create(self, kind: str, obj) -> object:
@@ -225,9 +240,20 @@ class Cluster:
         return [p for p in pods if selector.matches(p.metadata.labels)]
 
     def pods_on_node(self, node_name: str) -> List[Pod]:
-        """The `spec.nodeName` field-index equivalent
-        (reference: manager.go:39)."""
-        return [p for p in self.pods() if p.spec.node_name == node_name]
+        """The `spec.nodeName` field-index equivalent (reference:
+        manager.go:39). Lazily rebuilt once per pod event and shared across
+        queries — the node/termination/metrics controllers ask per node, so
+        a per-call linear scan was O(nodes × pods) per reconcile sweep."""
+        gen = self._pod_index_gen
+        cached_gen, index = self._pods_by_node_cache
+        if cached_gen != gen:
+            index = {}
+            with self._lock:
+                for p in self._stores["pods"].objects.values():
+                    if p.spec.node_name:
+                        index.setdefault(p.spec.node_name, []).append(p)
+            self._pods_by_node_cache = (gen, index)
+        return list(index.get(node_name, []))
 
     # -- subresources ------------------------------------------------------
     def bind(self, pod: Pod, node_name: str) -> None:

@@ -283,3 +283,34 @@ class TestInMemoryMergePatch:
             "nodes", "n", {"metadata": {"labels": {"a": None}}}, namespace=""
         )
         assert out.metadata.labels == {"b": "2"}
+
+
+class TestPodsOnNodeIndex:
+    def test_index_tracks_bind_evict_delete(self):
+        from karpenter_tpu.kube.client import Cluster
+        from tests.factories import make_pod
+
+        cluster = Cluster()
+        a = make_pod(name="a", requests={"cpu": "1"})
+        b = make_pod(name="b", requests={"cpu": "1"}, node_name="n1", unschedulable=False)
+        cluster.create("pods", a)
+        cluster.create("pods", b)
+        assert [p.metadata.name for p in cluster.pods_on_node("n1")] == ["b"]
+        cluster.bind(a, "n1")
+        assert sorted(p.metadata.name for p in cluster.pods_on_node("n1")) == ["a", "b"]
+        cluster.evict(b)
+        assert [p.metadata.name for p in cluster.pods_on_node("n1")] == ["a"]
+        cluster.delete("pods", "a")
+        assert cluster.pods_on_node("n1") == []
+
+    def test_index_sees_seeded_shadow_pods(self):
+        from karpenter_tpu.kube.client import Cluster
+        from tests.factories import make_pod
+
+        live = Cluster()
+        pod = make_pod(name="x", requests={"cpu": "1"}, node_name="n", unschedulable=False)
+        live.create("pods", pod)
+        shadow = Cluster()
+        assert shadow.pods_on_node("n") == []  # cold index
+        shadow.seed("pods", pod)
+        assert [p.metadata.name for p in shadow.pods_on_node("n")] == ["x"]
