@@ -1,0 +1,184 @@
+"""Simulated GKE provider: TPU podslice node pools.
+
+A second vendor implementation beside the AWS-architecture simulated
+provider (``simulated.py``): the machine-family catalog of a GKE cluster
+with TPU v5e podslice node pools, so the framework schedules the workload
+class it is itself built for — pods requesting ``google.com/tpu`` land on
+``ct5lp-hightpu-*`` slices with the GKE TPU topology labels, flowing the
+extended resource through the whole solve stack (encode extra axes,
+signature frontiers, kernels, oracle).
+
+Mirrors the vendor-layer shape the reference prescribes
+(SURVEY §2.6: provider shell, instance-type provider, launch path,
+defaulting/validation hooks); the cloud API is the in-process double, like
+``SimCloudAPI``. GKE naming sources are the public machine families
+(e2/n2/c3) and TPU podslice types (ct5lp-hightpu-{1,4,8}t; multi-host
+slices appear as their per-host shapes with topology labels).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, PodCondition
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.utils import resources as res
+
+TPU_RESOURCE = "google.com/tpu"
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+ZONES = ("us-central2-a", "us-central2-b", "us-central2-c")
+CAPACITY_TYPES = ("on-demand", "spot")
+
+_GIB = 1024 ** 3
+
+
+# v5e podslice topology by chips-per-host — derived at label time so ANY
+# catalog (custom, serde round-tripped) gets correct topology labels
+TPU_TOPOLOGY_BY_CHIPS = {1: "1x1", 4: "2x2", 8: "2x4"}
+
+
+def _machine(name: str, cpu: float, mem_gib: float, price: float,
+             tpu_chips: int = 0) -> InstanceType:
+    resources: Dict[str, float] = {
+        res.CPU: cpu,
+        res.MEMORY: mem_gib * _GIB,
+        res.PODS: 110.0,
+    }
+    if tpu_chips:
+        resources[TPU_RESOURCE] = float(tpu_chips)
+    return InstanceType(
+        name=name,
+        offerings=[
+            Offering(capacity_type=ct, zone=z)
+            for ct, z in itertools.product(CAPACITY_TYPES, ZONES)
+        ],
+        architecture="amd64",
+        operating_systems=frozenset({"linux"}),
+        resources=resources,
+        # GKE-style system reserve: flat kubelet/OS slice of the machine
+        overhead={res.CPU: min(0.25, cpu * 0.06), res.MEMORY: 0.5 * _GIB},
+        price=price,
+    )
+
+
+def gke_catalog() -> List[InstanceType]:
+    """General-purpose machine families plus TPU v5e podslice hosts."""
+    catalog: List[InstanceType] = []
+    for family, per_cpu_mem, base in (("e2", 4, 0.031), ("n2", 4, 0.048), ("c3", 4, 0.056)):
+        for cpus in (2, 4, 8, 16, 32, 48):
+            catalog.append(
+                _machine(
+                    f"{family}-standard-{cpus}", cpus, cpus * per_cpu_mem,
+                    price=round(base * cpus, 4),
+                )
+            )
+    # TPU v5e podslice host shapes (topology derives from chip count)
+    for name, cpus, mem, chips, price in (
+        ("ct5lp-hightpu-1t", 24, 48, 1, 1.2),
+        ("ct5lp-hightpu-4t", 112, 192, 4, 4.8),
+        ("ct5lp-hightpu-8t", 224, 384, 8, 9.6),
+    ):
+        catalog.append(_machine(name, cpus, mem, price, tpu_chips=chips))
+    return catalog
+
+
+class GkeCloudProvider(CloudProvider):
+    """In-process GKE double with the vendor hooks the webhook installs
+    (reference vendor-layer shape: SURVEY §2.6)."""
+
+    def __init__(self, catalog: Optional[List[InstanceType]] = None):
+        self._catalog = catalog or gke_catalog()
+        self._counter = itertools.count(1)
+        self._lock = threading.Lock()
+        self.create_calls: List[NodeRequest] = []
+        self.delete_calls: List[str] = []
+
+    # -- catalog -----------------------------------------------------------
+    def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
+        return list(self._catalog)
+
+    # -- launch ------------------------------------------------------------
+    def create(self, request: NodeRequest) -> Node:
+        with self._lock:
+            self.create_calls.append(request)
+            n = next(self._counter)
+        if not request.instance_type_options:
+            raise ValueError("no instance type options")
+        it = request.instance_type_options[0]  # cheapest (solver sorts)
+        reqs = request.template.requirements
+        offering = next(
+            (
+                o
+                for o in it.offerings
+                if (not reqs.has(lbl.TOPOLOGY_ZONE) or reqs.get(lbl.TOPOLOGY_ZONE).has(o.zone))
+                and (
+                    not reqs.has(lbl.CAPACITY_TYPE)
+                    or reqs.get(lbl.CAPACITY_TYPE).has(o.capacity_type)
+                )
+            ),
+            None,
+        )
+        if offering is None:
+            # launching a node whose labels contradict the certified
+            # requirements would poison downstream controllers — fail loudly
+            raise ValueError(
+                f"no offering of {it.name} satisfies the request's "
+                f"zone/capacity-type requirements"
+            )
+        labels = {
+            lbl.INSTANCE_TYPE: it.name,
+            lbl.TOPOLOGY_ZONE: offering.zone,
+            lbl.CAPACITY_TYPE: offering.capacity_type,
+            lbl.ARCH: it.architecture,
+            lbl.OS: "linux",
+        }
+        chips = int(it.resources.get(TPU_RESOURCE, 0))
+        if chips:
+            labels[GKE_TPU_ACCELERATOR_LABEL] = "tpu-v5-lite-podslice"
+            labels[GKE_TPU_TOPOLOGY_LABEL] = TPU_TOPOLOGY_BY_CHIPS.get(chips, f"1x{chips}")
+        allocatable = {
+            k: v - it.overhead.get(k, 0.0) for k, v in it.resources.items()
+        }
+        return Node(
+            metadata=ObjectMeta(name=f"gke-node-{n}", namespace="", labels=labels),
+            spec=NodeSpec(provider_id=f"gce://sim-project/{offering.zone}/gke-node-{n}"),
+            status=NodeStatus(
+                capacity=dict(it.resources),
+                allocatable=allocatable,
+                conditions=[PodCondition(type="Ready", status="True")],
+            ),
+        )
+
+    def delete(self, node: Node) -> None:
+        with self._lock:
+            self.delete_calls.append(node.metadata.name)
+
+    # -- webhook hooks -----------------------------------------------------
+    def default(self, constraints: Constraints) -> None:
+        """Default capacity type to on-demand (GKE: no spot unless asked),
+        like the reference's vendor defaulting (provider_defaults.go:26-56)."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        if not constraints.requirements.has(lbl.CAPACITY_TYPE):
+            constraints.requirements = constraints.requirements.add(
+                NodeSelectorRequirement(
+                    key=lbl.CAPACITY_TYPE, operator="In", values=["on-demand"]
+                )
+            )
+
+    def validate(self, constraints: Constraints) -> List[str]:
+        errs: List[str] = []
+        provider = constraints.provider or {}
+        for key in provider:
+            if key not in ("project", "network", "subnetwork", "serviceAccount", "tags"):
+                errs.append(f"unknown GKE provider field {key!r}")
+        return errs
+
+    def name(self) -> str:
+        return "gke"

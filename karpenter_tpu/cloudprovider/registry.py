@@ -29,10 +29,12 @@ def new_cloud_provider(name: str = "fake", **kwargs) -> CloudProvider:
 
 def _register_builtins() -> None:
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.cloudprovider.gke import GkeCloudProvider
     from karpenter_tpu.cloudprovider.simulated import SimulatedCloudProvider
 
     register("fake", FakeCloudProvider)
     register("simulated", SimulatedCloudProvider)
+    register("gke", GkeCloudProvider)
 
 
 _register_builtins()

@@ -74,20 +74,40 @@ class Cluster:
         self._stores: Dict[str, _Store] = {k: _Store() for k in self.KINDS}
         self._version = 0
         self.clock = clock or time.time
-        # spec.nodeName index generation: bumped on every pod event (all
-        # mutation paths — including the apiserver backend's watch/cache
-        # writes — funnel through _notify), invalidating the lazy index
-        self._pod_index_gen = 0
-        self._pods_by_node_cache: Tuple[int, Dict[str, List[Pod]]] = (-1, {})
+        # spec.nodeName index, maintained incrementally on every pod event
+        # (all mutation paths — including the apiserver backend's
+        # watch/cache writes — funnel through _notify; seed() indexes
+        # directly). Incremental upkeep keeps drain sweeps O(pods-moved),
+        # not O(nodes × pods) re-scans.
+        self._pods_by_node: Dict[str, Dict[Tuple[str, str], Pod]] = {}
+        self._pod_node_of: Dict[Tuple[str, str], str] = {}
 
     # -- generic helpers ---------------------------------------------------
     def _key(self, obj) -> Tuple[str, str]:
         return (obj.metadata.namespace, obj.metadata.name)
 
+    def _index_pod(self, event: str, pod: Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            old = self._pod_node_of.get(key)
+            if event == "DELETED":
+                if old is not None:
+                    self._pods_by_node.get(old, {}).pop(key, None)
+                    self._pod_node_of.pop(key, None)
+                return
+            new = pod.spec.node_name or None
+            if old is not None and old != new:
+                self._pods_by_node.get(old, {}).pop(key, None)
+                self._pod_node_of.pop(key, None)
+            if new is not None:
+                self._pod_node_of[key] = new
+                # keep the LATEST object (the apiserver backend replaces
+                # pod objects on watch events)
+                self._pods_by_node.setdefault(new, {})[key] = pod
+
     def _notify(self, kind: str, event: str, obj) -> None:
         if kind == "pods":
-            with self._lock:  # += is load/add/store; racing bumps can merge
-                self._pod_index_gen += 1
+            self._index_pod(event, obj)
         for w in list(self._stores[kind].watchers):
             w(event, obj)
 
@@ -105,8 +125,8 @@ class Cluster:
         not as a long-lived view."""
         with self._lock:
             self._stores[kind].objects[self._key(obj)] = obj
-            if kind == "pods":
-                self._pod_index_gen += 1  # no events, but the index must see it
+        if kind == "pods":
+            self._index_pod("ADDED", obj)  # no events, but the index must see it
         return obj
 
     def create(self, kind: str, obj) -> object:
@@ -241,19 +261,11 @@ class Cluster:
 
     def pods_on_node(self, node_name: str) -> List[Pod]:
         """The `spec.nodeName` field-index equivalent (reference:
-        manager.go:39). Lazily rebuilt once per pod event and shared across
-        queries — the node/termination/metrics controllers ask per node, so
-        a per-call linear scan was O(nodes × pods) per reconcile sweep."""
-        gen = self._pod_index_gen
-        cached_gen, index = self._pods_by_node_cache
-        if cached_gen != gen:
-            index = {}
-            with self._lock:
-                for p in self._stores["pods"].objects.values():
-                    if p.spec.node_name:
-                        index.setdefault(p.spec.node_name, []).append(p)
-            self._pods_by_node_cache = (gen, index)
-        return list(index.get(node_name, []))
+        manager.go:39): incrementally maintained, so the per-node queries
+        the node/termination/metrics controllers issue are O(pods on that
+        node) instead of a full-store scan each."""
+        with self._lock:
+            return list(self._pods_by_node.get(node_name, {}).values())
 
     # -- subresources ------------------------------------------------------
     def bind(self, pod: Pod, node_name: str) -> None:

@@ -1,0 +1,172 @@
+"""GKE TPU-podslice provider: google.com/tpu extended resources flowing
+through the full solve stack (encode extra axes → kernels → decode →
+launch), plus the vendor hook surface (SURVEY §2.6 vendor-layer shape)."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.gke import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    GkeCloudProvider,
+    gke_catalog,
+)
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.cloudprovider.types import NodeRequest
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from tests.factories import make_pod, make_provisioner
+
+
+def solve(pods, solver):
+    catalog = gke_catalog()
+    provisioner = make_provisioner(solver=solver)
+    c = provisioner.spec.constraints
+    GkeCloudProvider().default(c)
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    return Scheduler(Cluster(), rng=random.Random(0)).solve(provisioner, catalog, pods)
+
+
+class TestGkeCatalog:
+    def test_registry_builds_gke(self):
+        from karpenter_tpu.cloudprovider import registry
+
+        provider = registry.new_cloud_provider("gke")
+        assert provider.name() == "gke"
+        names = {it.name for it in provider.get_instance_types()}
+        assert "ct5lp-hightpu-4t" in names and "e2-standard-2" in names
+
+    def test_tpu_types_carry_chips(self):
+        by_name = {it.name: it for it in gke_catalog()}
+        assert by_name["ct5lp-hightpu-1t"].resources[TPU_RESOURCE] == 1.0
+        assert by_name["ct5lp-hightpu-8t"].resources[TPU_RESOURCE] == 8.0
+        assert TPU_RESOURCE not in by_name["n2-standard-8"].resources
+
+
+class TestTpuScheduling:
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_tpu_pod_lands_on_cheapest_fitting_slice(self, solver):
+        vnodes = solve([make_pod(name="solo", requests={"cpu": "8", TPU_RESOURCE: "4"})], solver)
+        assert len(vnodes) == 1
+        # 4 chips fit the 4t slice (cheapest TPU type that satisfies)
+        assert vnodes[0].instance_type_options[0].name == "ct5lp-hightpu-4t"
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_cpu_only_batch_never_buys_tpu_hosts(self, solver):
+        vnodes = solve(
+            [make_pod(name=f"web-{i}", requests={"cpu": "2"}) for i in range(6)], solver
+        )
+        assert sum(len(v.pods) for v in vnodes) == 6
+        for v in vnodes:
+            assert v.instance_type_options[0].name.startswith("e2-")
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_first_fit_packs_chip_requests_onto_one_slice(self, solver):
+        """Two 4-chip pods pack onto one node whose surviving cheapest
+        type is the 8-chip slice (first-fit prefers the open node when any
+        type still satisfies the running total)."""
+        pods = [
+            make_pod(name=f"train-{i}", requests={"cpu": "8", TPU_RESOURCE: "4"})
+            for i in range(2)
+        ]
+        vnodes = solve(pods, solver)
+        assert len(vnodes) == 1 and len(vnodes[0].pods) == 2
+        assert vnodes[0].instance_type_options[0].name == "ct5lp-hightpu-8t"
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_chip_capacity_packs_and_splits(self, solver):
+        # 3 pods x 4 chips: one 8t host takes two, the third opens another
+        pods = [
+            make_pod(name=f"t-{i}", requests={"cpu": "4", TPU_RESOURCE: "4"},
+                     node_selector={lbl.INSTANCE_TYPE: "ct5lp-hightpu-8t"})
+            for i in range(3)
+        ]
+        vnodes = solve(pods, solver)
+        assert sum(len(v.pods) for v in vnodes) == 3
+        sizes = sorted(len(v.pods) for v in vnodes)
+        assert sizes == [1, 2]
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_oversized_tpu_request_certified_unschedulable(self, solver):
+        from karpenter_tpu.scheduling import oracle
+
+        catalog = gke_catalog()
+        provisioner = make_provisioner(solver=solver)
+        c = provisioner.spec.constraints
+        GkeCloudProvider().default(c)
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        cluster = Cluster()
+        pods = [make_pod(name="huge", requests={TPU_RESOURCE: "16"})]
+        vnodes = Scheduler(cluster, rng=random.Random(0)).solve(provisioner, catalog, pods)
+        assert sum(len(v.pods) for v in vnodes) == 0
+        verdict = oracle.classify_drops(
+            cluster, c, catalog, pods, [p for v in vnodes for p in v.pods]
+        )
+        assert verdict["expected"] == {oracle.NO_CAPACITY: 1}
+        assert verdict["unexplained"] == []
+
+
+class TestGkeLaunch:
+    def test_launched_tpu_node_carries_gke_labels(self):
+        provider = GkeCloudProvider()
+        catalog = sorted(provider.get_instance_types(), key=lambda it: it.effective_price())
+        tpu_types = [it for it in catalog if it.resources.get(TPU_RESOURCE)]
+        prov = make_provisioner()
+        provider.default(prov.spec.constraints)
+        c = prov.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        node = provider.create(NodeRequest(template=c, instance_type_options=tpu_types))
+        assert node.metadata.labels[GKE_TPU_ACCELERATOR_LABEL] == "tpu-v5-lite-podslice"
+        assert node.metadata.labels[GKE_TPU_TOPOLOGY_LABEL] in ("1x1", "2x2", "2x4")
+        assert node.spec.provider_id.startswith("gce://")
+        assert node.status.allocatable[TPU_RESOURCE] == node.status.capacity[TPU_RESOURCE]
+
+    def test_defaulting_and_validation_hooks(self):
+        provider = GkeCloudProvider()
+        prov = make_provisioner()
+        provider.default(prov.spec.constraints)
+        assert prov.spec.constraints.requirements.get(lbl.CAPACITY_TYPE).has("on-demand")
+        prov.spec.constraints.provider = {"project": "p", "bogus": 1}
+        errs = provider.validate(prov.spec.constraints)
+        assert errs and "bogus" in errs[0]
+
+    def test_end_to_end_tpu_provisioning(self):
+        """Pending TPU pods → worker → GKE provider → bound on a podslice."""
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+
+        cluster = Cluster()
+        provider = GkeCloudProvider()
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        prov = make_provisioner(solver="tpu")
+        cluster.create("provisioners", prov)
+        controller.apply(cluster.get("provisioners", "default", namespace=""))
+        worker = controller.workers["default"]
+        pods = [make_pod(requests={"cpu": "4", TPU_RESOURCE: "4"}) for _ in range(2)]
+        for p in pods:
+            cluster.create("pods", p)
+            worker.batcher.add(p)
+        worker.batcher.idle_duration = 0.05
+        vnodes = worker.provision_once()
+        controller.stop()
+        assert sum(len(v.pods) for v in vnodes) == 2
+        nodes = cluster.nodes()
+        assert all(GKE_TPU_ACCELERATOR_LABEL in n.metadata.labels for n in nodes)
+        for p in cluster.pods():
+            assert p.spec.node_name.startswith("gke-node-")
+
+    def test_unsatisfiable_offering_raises(self):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        provider = GkeCloudProvider()
+        catalog = provider.get_instance_types()
+        prov = make_provisioner()
+        c = prov.spec.constraints
+        c.requirements = c.requirements.add(
+            NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In",
+                                    values=["us-central2-z"])
+        )
+        with pytest.raises(ValueError, match="no offering"):
+            provider.create(NodeRequest(template=c, instance_type_options=catalog))
