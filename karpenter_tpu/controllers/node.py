@@ -8,6 +8,7 @@ sub-reconcilers' requested times (controller.go:42-116, ``result.Min``).
 
 from __future__ import annotations
 
+import copy
 import logging
 from datetime import datetime, timezone
 from typing import List, Optional
@@ -151,16 +152,21 @@ class NodeController:
         self.finalizer = Finalizer()
 
     def reconcile(self, name: str) -> Optional[float]:
-        node = self.cluster.try_get("nodes", name, namespace="")
-        if node is None or node.metadata.deletion_timestamp is not None:
+        live = self.cluster.try_get("nodes", name, namespace="")
+        if live is None or live.metadata.deletion_timestamp is not None:
             return None
-        provisioner_name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+        provisioner_name = live.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
         if provisioner_name is None:
             return None
         provisioner = self.cluster.try_get("provisioners", provisioner_name, namespace="")
         if provisioner is None:
             return None
-        before = _snapshot(node)
+        # sub-reconcilers run over a DEEP COPY (reference:
+        # node/controller.go:62-116): mutating the shared informer-cache
+        # object before a write that can fail would leave the cache
+        # diverged from the server with nothing re-driving the patch
+        node = copy.deepcopy(live)
+        before = _snapshot(live)
         results: List[Optional[float]] = []
         for sub in (self.initialization, self.expiration, self.emptiness, self.finalizer):
             results.append(sub.reconcile(provisioner, node))
@@ -171,8 +177,37 @@ class NodeController:
                 or self.cluster.try_get("nodes", name, namespace="") is None
             ):
                 return None
-        if _snapshot(node) != before:
-            self.cluster.update("nodes", node)
+        after = _snapshot(node)
+        if after != before:
+            # ONE merge patch with exactly the changed fields (reference:
+            # node/controller.go:106-115) — a full-object PUT from the
+            # informer cache races other writers' resourceVersions
+            from karpenter_tpu.kube.serde import taint_to_wire
+
+            patch: dict = {}
+            if after[0] != before[0]:
+                # arrays replace wholesale under RFC 7386
+                patch.setdefault("spec", {})["taints"] = [
+                    taint_to_wire(t) for t in node.spec.taints
+                ]
+            if after[1] != before[1]:
+                # maps merge per key: send only added/changed keys, plus
+                # nulls for removals — re-asserting unchanged keys would
+                # clobber concurrent writers with cached values
+                old = dict(before[1])
+                annotations = {
+                    k: v for k, v in node.metadata.annotations.items()
+                    if old.get(k) != v
+                }
+                for key in old:
+                    if key not in node.metadata.annotations:
+                        annotations[key] = None  # merge-patch delete
+                patch.setdefault("metadata", {})["annotations"] = annotations
+            if after[2] != before[2]:
+                patch.setdefault("metadata", {})["finalizers"] = list(
+                    node.metadata.finalizers
+                )
+            self.cluster.merge_patch("nodes", name, patch, namespace="")
         return result_min(*results)
 
     def register(self, manager) -> None:

@@ -229,8 +229,11 @@ def _selector_from_wire(d: Optional[Dict[str, Any]]) -> Optional[LabelSelector]:
     )
 
 
-def _taint_to_wire(t: Taint) -> Dict[str, Any]:
+def taint_to_wire(t: Taint) -> Dict[str, Any]:
     return _drop_none({"key": t.key, "value": t.value or None, "effect": t.effect})
+
+
+_taint_to_wire = taint_to_wire  # internal alias
 
 
 def _taint_from_wire(d: Dict[str, Any]) -> Taint:

@@ -428,3 +428,54 @@ class TestConsolidationOverApiserver:
         consolidation = ConsolidationController(c, provider, enabled=True)
         plan = consolidation.plan(c.get("provisioners", "default", namespace=""))
         assert plan.nodes == []  # the bare pod pins its node
+
+
+class TestNodeLifecycleOverApiserver:
+    def test_ready_node_loses_startup_taint_via_merge_patch(self, env):
+        """The node controller's single merge patch (not a full-object PUT)
+        lands the not-ready taint removal + emptiness annotation on the
+        server with no resourceVersion races."""
+        from karpenter_tpu.api.objects import PodCondition
+        from karpenter_tpu.controllers.node import NodeController
+
+        c = env.connect()
+        c.create("provisioners", make_provisioner(ttl_after_empty=600))
+        node = make_node(
+            name="young", provisioner_name="default", capacity={"cpu": "4"},
+        )
+        from karpenter_tpu.api.objects import Taint
+
+        node.spec.taints = [Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        node.status.conditions = [PodCondition(type="Ready", status="True")]
+        c.create("nodes", node)
+        controller = NodeController(c)
+        controller.reconcile("young")
+        server_node = env.cluster.get("nodes", "young", namespace="")
+        assert all(t.key != lbl.NOT_READY_TAINT_KEY for t in server_node.spec.taints)
+        # empty node got the emptiness clock annotation
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in server_node.metadata.annotations
+        # and the termination finalizer was ensured
+        assert lbl.TERMINATION_FINALIZER in server_node.metadata.finalizers
+
+    def test_emptiness_annotation_removed_when_pod_lands(self, env):
+        from karpenter_tpu.api.objects import PodCondition
+        from karpenter_tpu.controllers.node import NodeController
+
+        c = env.connect()
+        c.create("provisioners", make_provisioner(ttl_after_empty=600))
+        node = make_node(name="busy", provisioner_name="default", capacity={"cpu": "4"})
+        node.status.conditions = [PodCondition(type="Ready", status="True")]
+        c.create("nodes", node)
+        controller = NodeController(c)
+        controller.reconcile("busy")
+        assert (
+            lbl.EMPTINESS_TIMESTAMP_ANNOTATION
+            in env.cluster.get("nodes", "busy", namespace="").metadata.annotations
+        )
+        c.create("pods", make_pod(name="tenant", requests={"cpu": "1"},
+                                  node_name="busy", unschedulable=False))
+        controller.reconcile("busy")
+        assert (
+            lbl.EMPTINESS_TIMESTAMP_ANNOTATION
+            not in env.cluster.get("nodes", "busy", namespace="").metadata.annotations
+        )

@@ -187,3 +187,75 @@ class TestController:
         node = karpenter_node(cluster, ready=True)
         requeue = controller.reconcile(node.metadata.name)
         assert requeue == pytest.approx(30.0)  # emptiness sooner than expiry
+
+
+class TestMergePatchDiscipline:
+    def test_failed_patch_does_not_poison_cache(self):
+        """Sub-reconcilers run on a copy: if the merge patch fails, the
+        cached node is untouched and the retry still sees the divergence
+        (round-2 review finding)."""
+        from karpenter_tpu.api.objects import PodCondition, Taint
+        from karpenter_tpu.controllers.node import NodeController
+        from tests.factories import make_node, make_provisioner
+
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner())
+        node = make_node(name="n", provisioner_name="default", capacity={"cpu": "4"})
+        node.spec.taints = [Taint(key=lbl.NOT_READY_TAINT_KEY, effect="NoSchedule")]
+        node.status.conditions = [PodCondition(type="Ready", status="True")]
+        cluster.create("nodes", node)
+
+        controller = NodeController(cluster)
+        boom = {"n": 1}
+        real_patch = cluster.merge_patch
+
+        def flaky_patch(kind, name, patch, namespace="default"):
+            if boom.pop("n", None):
+                raise RuntimeError("transient apiserver error")
+            return real_patch(kind, name, patch, namespace=namespace)
+
+        cluster.merge_patch = flaky_patch
+        try:
+            with pytest.raises(RuntimeError):
+                controller.reconcile("n")
+            # the cached object kept the taint (no pre-write mutation)
+            cached = cluster.get("nodes", "n", namespace="")
+            assert any(t.key == lbl.NOT_READY_TAINT_KEY for t in cached.spec.taints)
+            # the retry converges
+            controller.reconcile("n")
+            cached = cluster.get("nodes", "n", namespace="")
+            assert all(t.key != lbl.NOT_READY_TAINT_KEY for t in cached.spec.taints)
+        finally:
+            cluster.merge_patch = real_patch
+
+    def test_annotation_patch_sends_only_changes(self):
+        """The annotations patch must not re-assert unchanged keys (stale
+        cache values would clobber concurrent writers)."""
+        from karpenter_tpu.api.objects import PodCondition
+        from karpenter_tpu.controllers.node import NodeController
+        from tests.factories import make_node, make_provisioner
+
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner(ttl_after_empty=600))
+        node = make_node(name="n", provisioner_name="default", capacity={"cpu": "4"})
+        node.metadata.annotations["unrelated.io/key"] = "theirs"
+        node.status.conditions = [PodCondition(type="Ready", status="True")]
+        cluster.create("nodes", node)
+
+        controller = NodeController(cluster)
+        patches = []
+        real_patch = cluster.merge_patch
+
+        def spy(kind, name, patch, namespace="default"):
+            patches.append(patch)
+            return real_patch(kind, name, patch, namespace=namespace)
+
+        cluster.merge_patch = spy
+        try:
+            controller.reconcile("n")
+        finally:
+            cluster.merge_patch = real_patch
+        (patch,) = patches
+        sent = patch.get("metadata", {}).get("annotations", {})
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in sent
+        assert "unrelated.io/key" not in sent  # unchanged keys stay out
