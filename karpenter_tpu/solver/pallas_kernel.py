@@ -256,8 +256,10 @@ PALLAS_UNROLL_BUDGET = 1024  # max S*F (≈14s one-time compile)
 
 
 def pallas_shape_eligible(P: int, S: int, F: int) -> bool:
-    """Whether a batch shape may take a Pallas kernel at all — the shared
-    gate for pack_best and the sharded multi-solve."""
+    """Whether a batch shape may take the v1 (unrolled) Pallas kernel —
+    used by pack_best and the sharded multi-solve. Shapes past the unroll
+    budget are served by the v2 matmul-gather kernel in pack_best; the
+    sharded multi-solve keeps the vmapped lax.scan for them."""
     return P % BLOCK == 0 and S * F <= PALLAS_UNROLL_BUDGET and pallas_available()
 
 
@@ -270,15 +272,40 @@ def pack_best(*args, n_max: int) -> PackResult:
 
     P = args[6].shape[0]  # pod_req
     S, F = args[8].shape[0], args[8].shape[1]  # frontiers
+    C = args[7].shape[1]  # join_table
     shape = (P, n_max)
+    v1_tried = False
     if shape not in _pallas_failed_shapes and pallas_shape_eligible(P, S, F):
+        v1_tried = True
         try:
             return pack_pallas(*args, n_max=n_max)
         except Exception:
             logger.exception(
-                "pallas kernel failed for shape %s; lax.scan for this shape", shape
+                "pallas kernel failed for shape %s; trying alternatives", shape
             )
             _pallas_failed_shapes.add(shape)
+    # when v1 is unavailable (unroll budget exceeded, or its compile failed
+    # for this shape): the v2 kernel (signature gathers as MXU matmuls over
+    # a one-hot state; compile O(F), independent of S) keeps the batch on
+    # the TPU path
+    v2_shape = ("v2", P, n_max)
+    if (
+        v2_shape not in _pallas_failed_shapes
+        and not (v1_tried and shape not in _pallas_failed_shapes)
+        and P % BLOCK == 0
+        and pallas_available()
+    ):
+        from karpenter_tpu.solver import pallas_kernel_v2 as v2
+
+        if v2.v2_vmem_ok(S, n_max, C, F * args[6].shape[1]):
+            try:
+                return v2.pack_pallas_v2(*args, n_max=n_max)
+            except Exception:
+                logger.exception(
+                    "pallas v2 kernel failed for shape %s; lax.scan for this shape",
+                    v2_shape,
+                )
+                _pallas_failed_shapes.add(v2_shape)
     if not pallas_available():
         from karpenter_tpu.solver import native
 

@@ -1,0 +1,323 @@
+"""Pallas packing kernel v2: signature gathers as MXU matmuls.
+
+The v1 kernel (pallas_kernel.py) unrolls the S×F signature/frontier loops
+per pod step, so Mosaic compile time scales with S·F (measured ~2.5× per S
+doubling; ~2min at the S=512 closure cap) — constraint-diverse batches fall
+back to lax.scan and pay ~500ms at 8k pods.
+
+Here compile size is O(F), independent of S. The trick: keep each node's
+signature as a ONE-HOT column of a ``[S, N]`` f32 state matrix, and
+precompute per-core join tables outside the kernel:
+
+- ``frontJ[c, f·R+r, s]  = frontiers[join[s, c], f, r]`` (``BIG`` where the
+  join is incompatible) — so the joined-signature fit limits for every node
+  are one matmul: ``limits = frontJ[core] @ onehot_sig`` → ``[F·R, N]``;
+- ``compatJ[c, s] = join[s, c] >= 0`` — joinability is
+  ``compatJ[core] @ onehot_sig`` → ``[1, N]``;
+- ``jvals[c, s] = join[s, c]`` — the joined signature id, extracted only at
+  the chosen target node.
+
+Per pod the body is three small matmuls (MXU), vector compares (VPU), and
+masked state writes — no dynamic VMEM indexing, no S-unrolled selects.
+``frontJ[core]`` is a dynamic *leading-axis* read of a tile-aligned
+``[F·R, S]`` slice, which Mosaic supports.
+
+Semantics are assignment-identical to ``kernel.pack`` (parity-tested on
+chip). VMEM sizing: the one-hot state is ``S_pad × N_pad`` f32 — the caller
+gates on an estimate (``v2_vmem_ok``).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from karpenter_tpu.solver.kernel import PackResult
+from karpenter_tpu.solver.pallas_kernel import (  # shared contract with v1
+    _CORE,
+    _HOST,
+    _HOST_IN_BASE,
+    _OPEN_HOST,
+    _OPEN_SIG,
+    _VALID,
+    BIG,
+    BLOCK,
+)
+
+logger = logging.getLogger("karpenter.solver")
+
+NEG = -1e30  # "incompatible" frontier limit: nothing fits
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _pack_kernel_v2(
+    pod_scal_ref,   # [6, P] i32
+    pod_req_ref,    # [R, P] f32
+    front_j_ref,    # [C, FR, S_pad] f32 — joined-frontier limits per core
+    compat_j_ref,   # [C, 8, S_pad] f32 — row 0: join[s,c] >= 0 (1.0/0.0)
+    jvals_ref,      # [C, 8, S_pad] f32 — row 0: join[s,c] (as f32)
+    open_fits_ref,  # [1, P] i32 — precomputed: daemon+req fits open_sig's frontier
+    daemon_ref,     # [R, 1] f32
+    assignment_ref, # [1, P] i32 out
+    node_sig_ref,   # [1, N] i32 out
+    node_host_ref,  # [1, N] i32 out
+    node_req_ref,   # [R, N] f32 out
+    count_ref,      # [1, 1] i32 out (SMEM)
+    sig_onehot_ref, # [S_pad, N] f32 scratch — node signature one-hot state
+    *,
+    n_cap: int,
+    F: int,
+    R: int,
+):
+    P = pod_scal_ref.shape[1]
+    N = node_sig_ref.shape[1]
+    S_pad = sig_onehot_ref.shape[0]
+    FR = F * R
+
+    node_sig_ref[:] = jnp.full((1, N), -1, jnp.int32)
+    node_host_ref[:] = jnp.full((1, N), -1, jnp.int32)
+    node_req_ref[:] = jnp.zeros((R, N), jnp.float32)
+    sig_onehot_ref[:] = jnp.zeros((S_pad, N), jnp.float32)
+
+    node_lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    blk_lane = lax.broadcasted_iota(jnp.int32, (1, BLOCK), 1)
+    sig_iota = lax.broadcasted_iota(jnp.int32, (S_pad, 1), 0)
+    daemon = daemon_ref[:]  # [R, 1]
+
+    def block_body(b, count):
+        start = pl.multiple_of(b * BLOCK, BLOCK)
+        scal_blk = pod_scal_ref[:, pl.ds(start, BLOCK)]  # [6, BLOCK]
+        req_blk = pod_req_ref[:, pl.ds(start, BLOCK)]    # [R, BLOCK]
+        openfit_blk = open_fits_ref[:, pl.ds(start, BLOCK)]  # [1, BLOCK]
+
+        def pod_body(k, carry):
+            count, assign_vec = carry
+            at_k = blk_lane == k
+
+            def pick(row):
+                return jnp.sum(jnp.where(at_k, scal_blk[row : row + 1, :], 0))
+
+            valid = pick(_VALID) != 0
+            open_sig = pick(_OPEN_SIG)
+            core = pick(_CORE)
+            host = pick(_HOST)
+            host_in_base = pick(_HOST_IN_BASE) != 0
+            open_host = pick(_OPEN_HOST)
+            open_ok = jnp.sum(jnp.where(at_k, openfit_blk, 0)) != 0
+            req = jnp.sum(jnp.where(at_k, req_blk, 0.0), axis=1, keepdims=True)  # [R,1]
+
+            node_sig = node_sig_ref[:]
+            node_host = node_host_ref[:]
+            node_req = node_req_ref[:]
+            onehot = sig_onehot_ref[:]  # [S_pad, N]
+            is_open = node_sig >= 0
+            new_req = node_req + req  # [R, N]
+
+            # per-core tables for THIS pod's core (dynamic leading index of
+            # tile-aligned slices)
+            front_c = front_j_ref[core]    # [FR, S_pad]
+            compat_c = compat_j_ref[core]  # [8, S_pad]
+            jvals_c = jvals_ref[core]      # [8, S_pad]
+
+            # joined-frontier limits for every node: [FR, N]. HIGHEST
+            # precision is load-bearing: the TPU MXU's default bf16 passes
+            # would round the gathered limits and flip fit comparisons.
+            limits_join = jnp.dot(
+                front_c, onehot, preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )
+            ok_row = jnp.dot(
+                compat_c[0:1, :], onehot, preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )
+            j_row = jnp.dot(
+                jvals_c[0:1, :], onehot, preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )
+
+            # fits = ∃f ∀r: new_req[r] ≤ limits_join[f·R+r]
+            fits = jnp.zeros((1, N), jnp.bool_)
+            for f in range(F):
+                fit_f = jnp.ones((1, N), jnp.bool_)
+                for r in range(R):
+                    fit_f = fit_f & (new_req[r : r + 1, :] <= limits_join[f * R + r : f * R + r + 1, :])
+                fits = fits | fit_f
+
+            ok_host = (host < 0) | ((node_host == -1) & host_in_base) | (node_host == host)
+            ok = (ok_row > 0.5) & is_open & ok_host & fits
+
+            any_ok = jnp.any(ok)
+            first_ok = jnp.min(jnp.where(ok, node_lane, BIG))
+            can_open = open_ok & (count < n_cap)
+            schedulable = valid & (any_ok | can_open)
+            target = jnp.where(any_ok, first_ok, count)
+            at_target = node_lane == target  # [1, N]
+
+            def extract(vec):
+                return jnp.sum(jnp.where(at_target, vec, 0))
+
+            def extractf(vec):
+                return jnp.sum(jnp.where(at_target, vec, 0.0))
+
+            j_target = jnp.round(extractf(j_row)).astype(jnp.int32)
+            upd_sig = jnp.where(any_ok, j_target, open_sig)
+            upd_host = jnp.where(
+                any_ok, jnp.where(host >= 0, host, extract(node_host)), open_host
+            )
+            open_req = daemon + req
+            req_target = jnp.sum(jnp.where(at_target, new_req, 0.0), axis=1, keepdims=True)
+            upd_req = jnp.where(any_ok, req_target, open_req)  # [R, 1]
+
+            # the node's NEW signature as a one-hot column
+            upd_onehot = (sig_iota == upd_sig).astype(jnp.float32)  # [S_pad, 1]
+
+            write = schedulable & at_target
+            node_sig_ref[:] = jnp.where(write, upd_sig, node_sig)
+            node_host_ref[:] = jnp.where(write, upd_host, node_host)
+            node_req_ref[:] = jnp.where(write, upd_req, node_req)
+            sig_onehot_ref[:] = jnp.where(write, upd_onehot, onehot)
+
+            assign_vec = jnp.where(at_k, jnp.where(schedulable, target, -1), assign_vec)
+            count = count + jnp.where(schedulable & ~any_ok, 1, 0).astype(jnp.int32)
+            return count, assign_vec
+
+        count, assign_vec = lax.fori_loop(
+            0, BLOCK, pod_body, (count, jnp.full((1, BLOCK), -1, jnp.int32))
+        )
+        assignment_ref[:, pl.ds(start, BLOCK)] = assign_vec
+        return count
+
+    count = lax.fori_loop(0, P // BLOCK, block_body, jnp.zeros((), jnp.int32))
+    count_ref[0, 0] = count
+
+
+def _precompute(join_table: np.ndarray, frontiers: np.ndarray):
+    """Host-side per-core tables. join_table [S, C] i32; frontiers [S, F, R]."""
+    S, C = join_table.shape
+    F, R = frontiers.shape[1], frontiers.shape[2]
+    FR = F * R
+    S_pad = _pad_to(max(S, 8), 128)  # lane axis of the per-core tables
+    C_pad = max(C, 1)
+
+    flat = frontiers.reshape(S, FR).astype(np.float32)
+
+    front_j = np.full((C_pad, _pad_to(FR, 8), S_pad), NEG, np.float32)
+    compat_j = np.zeros((C_pad, 8, S_pad), np.float32)
+    jvals = np.zeros((C_pad, 8, S_pad), np.float32)
+    for c in range(C):
+        j = join_table[:, c]  # [S]
+        ok = j >= 0
+        compat_j[c, 0, :S] = ok.astype(np.float32)
+        jvals[c, 0, :S] = np.where(ok, j, 0).astype(np.float32)
+        gathered = np.where(ok[:, None], flat[np.clip(j, 0, S - 1)], NEG)  # [S, FR]
+        front_j[c, :FR, :S] = gathered.T
+    return front_j, compat_j, jvals, S_pad
+
+
+def _open_fits_host(pod_open_sig, pod_req, frontiers, daemon):
+    """[P] precomputed: does daemon+req fit ANY frontier of the pod's open
+    signature? (Independent of node state — hoisted out of the kernel.)"""
+    need = pod_req.astype(np.float32) + daemon.astype(np.float32)[None, :]  # [P, R]
+    limits = frontiers[np.asarray(pod_open_sig)]  # [P, F, R]
+    return np.any(np.all(need[:, None, :] <= limits, axis=-1), axis=-1)
+
+
+@partial(jax.jit, static_argnames=("n_max", "F", "R"))
+def _pack_v2_call(
+    pod_scal, pod_req_t, front_j, compat_j, jvals, open_fits,
+    daemon, n_max: int, F: int, R: int,
+):
+    P = pod_scal.shape[1]
+    S_pad = front_j.shape[2]
+    n = max(BLOCK, _pad_to(n_max, BLOCK))
+    return pl.pallas_call(
+        partial(_pack_kernel_v2, n_cap=n_max, F=F, R=R),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, P), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((R, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((S_pad, n), jnp.float32),
+        ],
+    )(pod_scal, pod_req_t, front_j, compat_j, jvals, open_fits, daemon)
+
+
+def v2_vmem_ok(S: int, n_max: int, C: int, FR: int) -> bool:
+    """Rough VMEM budget: one-hot state + per-core tables must fit.
+
+    Threshold calibrated on a v5e: (S=256, n=512) ≈ 1.8MB compiles in ~7s
+    and runs at the transport floor; (S=512, n=2048) ≈ 7.1MB consistently
+    fails remote compile. 6MB keeps the proven region with headroom (the
+    runtime fallback memoizes any residual failure per shape)."""
+    S_pad = _pad_to(max(S, 8), 128)
+    n = max(BLOCK, _pad_to(n_max, BLOCK))
+    state = S_pad * n * 4  # sig one-hot
+    tables = C * (_pad_to(FR, 8) + 16) * S_pad * 4
+    return state + tables < 6 * 1024 * 1024
+
+
+def pack_pallas_v2(
+    pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base,
+    pod_open_host, pod_req, join_table, frontiers, daemon, n_max: int,
+) -> PackResult:
+    """Same contract as ``kernel.pack``; compile cost independent of S."""
+    pod_req = np.asarray(pod_req, np.float32)
+    join_table = np.asarray(join_table)
+    frontiers = np.asarray(frontiers, np.float32)
+    daemon_np = np.asarray(daemon, np.float32)
+    P, R = pod_req.shape
+    F = frontiers.shape[1]
+    if P % BLOCK != 0:
+        raise ValueError(f"pallas v2 needs P % {BLOCK} == 0, got {P}")
+    front_j, compat_j, jvals, S_pad = _precompute(join_table, frontiers)
+    open_fits = _open_fits_host(pod_open_sig, pod_req, frontiers, daemon_np)
+    pod_scal = np.stack(
+        [
+            np.asarray(pod_valid).astype(np.int32),
+            np.asarray(pod_open_sig).astype(np.int32),
+            np.asarray(pod_core).astype(np.int32),
+            np.asarray(pod_host).astype(np.int32),
+            np.asarray(pod_host_in_base).astype(np.int32),
+            np.asarray(pod_open_host).astype(np.int32),
+        ]
+    )
+    assignment, node_sig, node_host, node_req_t, count = _pack_v2_call(
+        pod_scal,
+        pod_req.T,
+        front_j,
+        compat_j,
+        jvals,
+        open_fits.reshape(1, P).astype(np.int32),
+        daemon_np.reshape(R, 1),
+        n_max=n_max,
+        F=F,
+        R=R,
+    )
+    return PackResult(
+        assignment=assignment[0],
+        node_sig=node_sig[0, :n_max],
+        node_host=node_host[0, :n_max],
+        node_req=node_req_t[:, :n_max].T,
+        n_nodes=count[0, 0],
+    )

@@ -102,28 +102,59 @@ def test_pallas_high_signature_diversity_compiles_bounded():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
 
 
-def test_unroll_budget_routes_diverse_batches_to_lax():
-    """Past the measured compile budget (S*F > 1024) pack_best must NOT
-    attempt the pallas kernel — a ~2min Mosaic compile at S=512 would blow
-    the solve latency — and the lax.scan path must handle the batch."""
+def test_unroll_budget_routes_diverse_batches_to_v2():
+    """Past the v1 compile budget (S*F > 1024) pack_best must not attempt
+    the unrolled kernel — a ~2min Mosaic compile at S=512 would blow the
+    solve latency — and must serve the batch with the v2 (matmul-gather)
+    kernel, parity-exact with lax.scan."""
     import jax
 
+    from karpenter_tpu.solver import kernel
     from karpenter_tpu.solver import pallas_kernel as pk
+    from karpenter_tpu.solver import pallas_kernel_v2 as v2mod
 
     args = synth_batch(P=256, S=256, C=8, F=8, seed=4)
     assert 256 * 8 > pk.PALLAS_UNROLL_BUDGET
-    calls = []
-    orig = pk.pack_pallas
+    v1_calls, v2_calls = [], []
+    orig_v1, orig_v2 = pk.pack_pallas, v2mod.pack_pallas_v2
 
-    def spy(*a, **kw):
-        calls.append(1)
-        return orig(*a, **kw)
+    def spy_v1(*a, **kw):
+        v1_calls.append(1)
+        return orig_v1(*a, **kw)
 
-    pk.pack_pallas = spy
+    def spy_v2(*a, **kw):
+        v2_calls.append(1)
+        return orig_v2(*a, **kw)
+
+    pk.pack_pallas = spy_v1
+    v2mod.pack_pallas_v2 = spy_v2
     try:
         result = pack_best(*args, n_max=128)
     finally:
-        pk.pack_pallas = orig
-    assert calls == []  # pallas was never attempted
-    n_nodes = int(np.asarray(jax.device_get(result.n_nodes)).reshape(-1)[0])
-    assert n_nodes > 0
+        pk.pack_pallas = orig_v1
+        v2mod.pack_pallas_v2 = orig_v2
+    assert v1_calls == []  # the unrolled kernel was never attempted
+    assert v2_calls == [1]
+    # and v2 SUCCEEDED — a swallowed failure would fall back to lax.scan
+    # and make the parity check below compare lax.scan with itself
+    assert ("v2", 256, 128) not in pk._pallas_failed_shapes
+    ref = jax.device_get(tuple(kernel.pack(*args, n_max=128)))
+    out = jax.device_get(tuple(result))
+    for name, a, b in zip(kernel.PackResult._fields, ref, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_v2_parity_on_real_encoded_batch():
+    """The v2 kernel must match lax.scan on a genuine encoded batch (not
+    just synthetic tables): hostnames, daemon overhead, topology pins."""
+    import jax
+
+    from karpenter_tpu.solver import kernel
+    from karpenter_tpu.solver.pallas_kernel_v2 import pack_pallas_v2
+
+    args = encoded_batch(300, seed=9)
+    n_max = 256
+    ref = jax.device_get(tuple(kernel.pack(*args, n_max=n_max)))
+    out = jax.device_get(tuple(pack_pallas_v2(*args, n_max=n_max)))
+    for name, a, b in zip(kernel.PackResult._fields, ref, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
