@@ -355,6 +355,22 @@ class TestConsolidationOverApiserver:
                 )
                 kubectl.create("pods", pod)
 
+            # the controller's informer cache learns of kubectl's writes via
+            # its watch stream — wait for it before planning (production
+            # plans on watch-driven reconciles, so this race is test-only)
+            deadline = time.time() + 15
+            while time.time() < deadline and (
+                len([n for n in controller_cluster.nodes() if n.metadata.name.startswith("old-")]) < 2
+                or len([p for p in controller_cluster.pods() if p.metadata.name.startswith("w-")]) < 2
+            ):
+                time.sleep(0.05)
+            assert (
+                len([n for n in controller_cluster.nodes() if n.metadata.name.startswith("old-")]) == 2
+            ), "controller cache never saw the nodes"
+            assert (
+                len([p for p in controller_cluster.pods() if p.metadata.name.startswith("w-")]) == 2
+            ), "controller cache never saw the pods"
+
             consolidation = ConsolidationController(
                 controller_cluster, provider, enabled=True
             )
