@@ -57,6 +57,17 @@ WATCH_RECONNECT_DELAY = 1.0
 # idle watch reads give up and reconnect after this long, so a stop() or a
 # silently-dead connection never wedges a watch thread indefinitely
 WATCH_READ_TIMEOUT = 60.0
+# server-side watch timeout: below WATCH_READ_TIMEOUT so an idle stream ends
+# with a clean EOF (resumable from the last RV) rather than a socket timeout
+WATCH_TIMEOUT_SECONDS = 45
+
+# Kinds the informer plane watches by default: everything EXCEPT leases.
+# Leader election reads its Lease with uncached get_live (kube/leader.py), so
+# a lease informer is dead weight — it would churn on every node-heartbeat
+# lease cluster-wide AND requires list/watch RBAC the shipped manifests
+# deliberately do not grant (deploy/rbac.yaml grants leases get/create/update
+# only); watching it 403s forever and fails wait_for_sync.
+WATCH_KINDS = tuple(k for k in Cluster.KINDS if k != "leases")
 
 
 class ApiError(Exception):
@@ -107,7 +118,7 @@ class ApiCluster(Cluster):
                 self._ssl_ctx.check_hostname = False
                 self._ssl_ctx.verify_mode = ssl.CERT_NONE
         self._bucket = TokenBucket(qps, burst)
-        self._watch_kinds = tuple(kinds) if kinds is not None else self.KINDS
+        self._watch_kinds = tuple(kinds) if kinds is not None else WATCH_KINDS
         self._stop = threading.Event()
         self._threads: list = []
         self._watch_conns: Dict[str, object] = {}
@@ -212,15 +223,24 @@ class ApiCluster(Cluster):
 
     # -- informer loop -----------------------------------------------------
     def _watch_loop(self, kind: str) -> None:
+        """List once, then watch forever — resuming each reconnect from the
+        last-seen event resourceVersion. Re-listing happens only when the
+        server says the RV is too old (410 Gone / ERROR event) or on a
+        transport error, never on routine idle stream ends: client-go resyncs
+        on the order of hours, and a full re-LIST dispatches MODIFIED for
+        every cached object, requeueing every controller key."""
+        rv: Optional[str] = None
         while not self._stop.is_set():
             try:
-                rv = self._relist(kind)
-                self._synced[kind].set()
-                self._stream(kind, rv)
+                if rv is None:
+                    rv = self._relist(kind)
+                    self._synced[kind].set()
+                rv = self._stream(kind, rv)
             except Exception as e:
                 if self._stop.is_set():
                     return
                 logger.debug("watch %s disconnected (%s); re-listing", kind, e)
+                rv = None  # unknown delta state: resync with a full list
                 self._stop.wait(WATCH_RECONNECT_DELAY)
 
     def _relist(self, kind: str) -> str:
@@ -249,7 +269,13 @@ class ApiCluster(Cluster):
             store = self._stores[kind]
             for key, obj in fresh.items():
                 current = store.objects.get(key)
-                if current is not None and current.metadata.resource_version > obj.metadata.resource_version:
+                # rv 0 = unparseable/opaque RV: ordering is unknowable, so
+                # last-write-wins (never silently freeze the cache)
+                if (
+                    current is not None
+                    and obj.metadata.resource_version > 0
+                    and current.metadata.resource_version > obj.metadata.resource_version
+                ):
                     continue  # cache holds a newer (locally-written) view
                 store.objects[key] = obj
                 notify_fresh.append(obj)
@@ -265,27 +291,35 @@ class ApiCluster(Cluster):
             self._notify(kind, "DELETED", obj)
         return rv
 
-    def _stream(self, kind: str, rv: str) -> None:
-        """Consume one watch stream until disconnect. A finite read timeout
-        (idle watches reconnect) plus connection tracking keeps ``stop()``
-        from leaving threads blocked in reads forever."""
+    def _stream(self, kind: str, rv: str) -> Optional[str]:
+        """Consume one watch stream until disconnect. Returns the
+        resourceVersion to resume the next watch from (each event — and
+        BOOKMARK events, which exist for exactly this — advances it), or
+        ``None`` when the server declared the RV too old (410 Gone / ERROR
+        event) and the caller must re-list. A finite read timeout (idle
+        watches reconnect) plus connection tracking keeps ``stop()`` from
+        leaving threads blocked in reads forever."""
         conn = self._connect(timeout=WATCH_READ_TIMEOUT)
         self._watch_conns[kind] = conn
         try:
             path = self._path(
-                kind, None, query=f"watch=true&resourceVersion={rv}&allowWatchBookmarks=true"
+                kind, None,
+                query=(
+                    f"watch=true&resourceVersion={rv}&allowWatchBookmarks=true"
+                    f"&timeoutSeconds={WATCH_TIMEOUT_SECONDS}"
+                ),
             )
             conn.request("GET", path, headers=self._headers())
             resp = conn.getresponse()
             if resp.status == 410:
-                return  # too-old resourceVersion: caller re-lists
+                return None  # too-old resourceVersion: caller re-lists
             if resp.status != 200:
                 raise ApiError(resp.status, resp.read().decode(errors="replace"))
             buf = b""
             while not self._stop.is_set():
                 chunk = resp.read1(65536)
                 if not chunk:
-                    return
+                    return rv  # clean EOF (server timeout): resume from rv
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
@@ -293,14 +327,20 @@ class ApiCluster(Cluster):
                         continue
                     event = json.loads(line)
                     etype = event.get("type")
+                    obj_rv = ((event.get("object") or {}).get("metadata") or {}).get(
+                        "resourceVersion"
+                    )
+                    if obj_rv:
+                        rv = str(obj_rv)
                     if etype == "BOOKMARK":
                         continue
                     if etype == "ERROR":
-                        return  # 410 Gone mid-stream: re-list
+                        return None  # 410 Gone mid-stream: re-list
                     obj = serde.from_wire(kind, event.get("object") or {})
                     self._apply_event(kind, etype, obj)
+            return rv
         except socket.timeout:
-            return  # idle past the read timeout: reconnect freshly
+            return rv  # idle past the read timeout: resume from rv
         finally:
             self._watch_conns.pop(kind, None)
             conn.close()
@@ -315,7 +355,13 @@ class ApiCluster(Cluster):
                 store.objects.pop(key, None)
             else:
                 current = store.objects.get(key)
-                if current is not None and current.metadata.resource_version >= obj.metadata.resource_version:
+                # rv 0 = opaque/unparseable RV: accept (last-write-wins) —
+                # dropping on 0 >= 0 would freeze the cache permanently
+                if (
+                    current is not None
+                    and obj.metadata.resource_version > 0
+                    and current.metadata.resource_version >= obj.metadata.resource_version
+                ):
                     return  # our own write already applied a newer view
                 store.objects[key] = obj
         self._notify(kind, etype, obj)

@@ -40,20 +40,19 @@ class NotFound(Exception):
     pass
 
 
-def resolve_pdb_threshold(value, total: int, round_up: bool) -> Optional[int]:
+def resolve_pdb_threshold(value, total: int) -> Optional[int]:
     """PDB minAvailable/maxUnavailable accept ints or percentages
-    ("50%"); percentages resolve against the matching-pod count
-    (k8s intstr.GetValueFromIntOrPercent: minAvailable rounds up,
-    maxUnavailable rounds down — both the conservative direction)."""
+    ("50%"); percentages resolve against the matching-pod count. The
+    disruption controller resolves BOTH with roundUp=true
+    (intstr.GetScaledValueFromIntOrPercent): maxUnavailable "50%" of 3
+    pods allows 2 evictions, not 1."""
     if value is None:
         return None
     if isinstance(value, int):
         return value
     s = str(value).strip()
     if s.endswith("%"):
-        pct = float(s[:-1]) / 100.0
-        exact = total * pct
-        return math.ceil(exact) if round_up else math.floor(exact)
+        return math.ceil(total * float(s[:-1]) / 100.0)
     return int(s)
 
 
@@ -295,8 +294,8 @@ class Cluster:
                     if pdb.selector is None or pdb.selector.matches(p.metadata.labels)
                 ]
                 healthy = [p for p in matching if p.metadata.deletion_timestamp is None]
-                min_avail = resolve_pdb_threshold(pdb.min_available, len(matching), round_up=True)
-                max_unavail = resolve_pdb_threshold(pdb.max_unavailable, len(matching), round_up=False)
+                min_avail = resolve_pdb_threshold(pdb.min_available, len(matching))
+                max_unavail = resolve_pdb_threshold(pdb.max_unavailable, len(matching))
                 if min_avail is not None and len(healthy) - 1 < min_avail:
                     return False
                 if max_unavail is not None and (len(matching) - (len(healthy) - 1)) > max_unavail:

@@ -159,7 +159,17 @@ def meta_to_wire(m: ObjectMeta, namespaced: bool = True) -> Dict[str, Any]:
 
 
 def meta_from_wire(doc: Dict[str, Any]) -> ObjectMeta:
+    # k8s documents resourceVersions as opaque strings; etcd's happen to be
+    # numeric, and the cache-freshness guards use numeric ordering as a
+    # best-effort heuristic. A non-numeric RV (proxy, alternative storage)
+    # must degrade to 0 — which the guards treat as "unknown: always
+    # accept" (last-write-wins), see ApiCluster._apply_event — rather than
+    # raise and kill the watch loop's event processing.
     rv = doc.get("resourceVersion") or 0
+    try:
+        rv = int(rv)
+    except (TypeError, ValueError):
+        rv = 0
     return ObjectMeta(
         name=doc.get("name", ""),
         namespace=doc.get("namespace", "default"),
@@ -177,7 +187,7 @@ def meta_from_wire(doc: Dict[str, Any]) -> ObjectMeta:
         uid=doc.get("uid", "") or "",
         creation_timestamp=parse_ts(doc.get("creationTimestamp")) or 0.0,
         deletion_timestamp=parse_ts(doc.get("deletionTimestamp")),
-        resource_version=int(rv),
+        resource_version=rv,
     )
 
 

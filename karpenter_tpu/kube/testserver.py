@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -266,6 +267,13 @@ class TestApiServer:
             since = int(req.query.get("resourceVersion", ["0"])[0] or 0)
         except ValueError:
             since = 0
+        try:
+            # real apiserver semantics: the stream ends with a clean EOF
+            # after timeoutSeconds, and the client resumes from the last RV
+            timeout_s = float(req.query.get("timeoutSeconds", ["0"])[0] or 0)
+        except ValueError:
+            timeout_s = 0.0
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
         with self._watch_lock:
             # replay-then-register atomically: nothing between `since` and
             # "now" may be dropped, nothing live may jump the backlog
@@ -273,6 +281,8 @@ class TestApiServer:
                 if seq > since:
                     q.put(ev)
             self._watch_queues[req.kind].append(q)
+        last_rv = since  # highest RV delivered on THIS stream; bookmarks
+        # must never advance the client past an undelivered event
         try:
             handler.send_response(200)
             handler.send_header("Content-Type", "application/json")
@@ -284,13 +294,23 @@ class TestApiServer:
                 handler.wfile.flush()
 
             while True:
+                if deadline is not None and time.monotonic() >= deadline:
+                    # terminal chunk: clean EOF the client resumes from
+                    handler.wfile.write(b"0\r\n\r\n")
+                    handler.wfile.flush()
+                    return
                 try:
                     event = q.get(timeout=1.0)
                 except queue.Empty:
                     # heartbeat bookmark keeps half-open connections honest
+                    # and advances the client's resume RV like a real
+                    # apiserver's allowWatchBookmarks
                     send_chunk(
                         json.dumps(
-                            {"type": "BOOKMARK", "object": {"metadata": {}}}
+                            {
+                                "type": "BOOKMARK",
+                                "object": {"metadata": {"resourceVersion": str(last_rv)}},
+                            }
                         ).encode()
                         + b"\n"
                     )
@@ -299,6 +319,10 @@ class TestApiServer:
                     meta = (event["object"].get("metadata") or {})
                     if meta.get("namespace", "default") != req.namespace:
                         continue
+                try:
+                    last_rv = int((event["object"].get("metadata") or {}).get("resourceVersion") or last_rv)
+                except (TypeError, ValueError):
+                    pass
                 send_chunk(json.dumps(event).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass

@@ -247,6 +247,63 @@ class TestReviewRegressions:
         assert c.evict(pods[2]) is True
         assert c.evict(pods[3]) is False
 
+    def test_maxunavailable_percentage_rounds_up(self, env):
+        # the disruption controller resolves maxUnavailable with
+        # roundUp=true: 50% of 3 pods allows 2 evictions, not 1
+        c = env.connect()
+        env.cluster.create(
+            "pdbs",
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="mu"),
+                selector=LabelSelector(match_labels={"app": "m"}),
+                max_unavailable="50%",
+            ),
+        )
+        pods = [make_pod(name=f"mu{i}", labels={"app": "m"}) for i in range(3)]
+        for p in pods:
+            # finalizers keep evicted pods present-but-unhealthy, so the
+            # budget is charged against a stable matching count
+            p.metadata.finalizers = ["test/hold"]
+            c.create("pods", p)
+        assert c.evict(pods[0]) is True
+        # 2 of 3 disrupted ≤ ceil(1.5)=2 — round-DOWN would forbid this
+        assert c.evict(pods[1]) is True
+        assert c.evict(pods[2]) is False  # 3 of 3 disrupted > 2
+
+    def test_watch_resumes_from_rv_without_relist(self, env, monkeypatch):
+        # an idle stream end (server timeoutSeconds) must NOT trigger a full
+        # re-list — the watch resumes from the last-seen resourceVersion and
+        # later events still arrive (client-go behavior; ADVICE r2)
+        monkeypatch.setattr("karpenter_tpu.kube.apiserver.WATCH_TIMEOUT_SECONDS", 1)
+        relists = []
+        orig = ApiCluster._relist
+
+        def counting_relist(self, kind):
+            relists.append(kind)
+            return orig(self, kind)
+
+        monkeypatch.setattr(ApiCluster, "_relist", counting_relist)
+        c = env.connect()
+        baseline = len(relists)
+        # outlive at least two server-side stream timeouts
+        time.sleep(2.5)
+        env.cluster.create("pods", make_pod(name="after-resume"))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if c.try_get("pods", "after-resume") is not None:
+                break
+            time.sleep(0.05)
+        assert c.try_get("pods", "after-resume") is not None
+        assert len(relists) == baseline  # resumed, never re-listed
+
+    def test_default_watch_kinds_exclude_leases(self, env):
+        # the shipped RBAC grants leases get/create/update only — a lease
+        # informer would 403 forever and fail wait_for_sync (ADVICE r2 high);
+        # leader election reads its Lease with uncached get_live instead
+        c = env.connect()
+        assert "leases" not in c._watch_kinds
+        assert set(c._watch_kinds) < set(Cluster.KINDS)
+
     def test_kube_lease_requires_apiserver_cluster(self):
         from karpenter_tpu.main import run_controller_process
         from karpenter_tpu.options import Options
