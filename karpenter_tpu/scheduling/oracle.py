@@ -37,7 +37,7 @@ from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
-from karpenter_tpu.scheduling.topology import Topology, ignored_for_topology
+from karpenter_tpu.scheduling.topology import DomainPlan, Topology, ignored_for_topology
 from karpenter_tpu.utils import resources as res
 
 ANTI_ZONE_EXHAUSTED = "anti-affinity-zone-exhausted"
@@ -65,6 +65,8 @@ def expected_unschedulable(
     budgets: List[Dict[str, object]] = []
     topo = Topology(cluster)
     batch = list(pods)
+    # the oracle reasons about PRE-injection state: an empty plan
+    plan = DomainPlan(batch)
     viable = constraints.requirements.zones()
 
     for group in topo._affinity_groups(batch):
@@ -75,7 +77,7 @@ def expected_unschedulable(
             # individually impossible and doesn't consume group capacity
             members = []
             for p in group.pods:
-                if topo._allowed_domains(constraints, p, group.key, viable):
+                if topo._allowed_domains(p, group.key, viable, plan):
                     members.append(p)
                 else:
                     exact[p.key] = PIN_NO_VIABLE_ZONE
@@ -90,7 +92,7 @@ def expected_unschedulable(
             # reserved only when some non-matcher can actually use a clean
             # zone, mirroring the injection (topology.py)
             reserve = bool(matching) and any(
-                topo._allowed_domains(constraints, p, group.key, set(clean))
+                topo._allowed_domains(p, group.key, set(clean), plan)
                 for p in nonmatching
             )
             capacity = len(clean) - (1 if reserve else 0)
@@ -108,7 +110,7 @@ def expected_unschedulable(
             # only — from scheduled cluster pods (hostname affinity targets
             # a fresh node, so only batch pods can provide the match:
             # topology.py _assign_hostname_affinity)
-            provider, _ = Topology._batch_provider(group, batch)
+            provider, _ = Topology._batch_provider(group, batch, plan)
             if provider is not None:
                 continue
             if group.key == lbl.TOPOLOGY_ZONE and _cluster_has_match(cluster, group):

@@ -5,9 +5,9 @@ Spread mirrors ``pkg/controllers/provisioning/scheduling/topology.go`` +
 ``topologygroup.go``: pods are grouped by equivalent (namespace, constraint);
 existing matching pods are counted per domain from the live cluster (zones:
 viable zones from requirements; hostnames: ``ceil(len(pods)/maxSkew)`` fresh
-generated names); then each pod gets the current min-count domain written into
-its nodeSelector, turning TopologySpreadConstraints into just-in-time
-NodeSelectors the packing core understands natively.
+generated names); then each pod gets the current min-count domain assigned,
+turning TopologySpreadConstraints into just-in-time NodeSelectors the packing
+core understands natively.
 
 Pod affinity/anti-affinity is NEW capability (BASELINE config 3; the
 reference rejects it at selection, selection/controller.go:145-150, with its
@@ -30,35 +30,115 @@ sequentially against membership counters:
 Pods with unsatisfiable rules get a sentinel domain no node can offer, so the
 packer counts and logs them unschedulable instead of mis-placing them.
 
-Because both backends consume the injected NodeSelectors, affinity support
-lands in the FFD packer and the TPU batch solver simultaneously.
+Decisions are recorded in a ``DomainPlan`` — NOT written into the pods'
+nodeSelectors. The TPU encode consumes the plan directly (zero pod mutation
+on the hot path); the FFD packer calls ``plan.materialize`` to get the
+classic just-in-time NodeSelector form, so affinity support lands in both
+backends from the same decision logic.
 """
 
 from __future__ import annotations
 
 import math
 import random
-import string
 from typing import Dict, List, Optional, Set, Tuple
 
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import (
-    LabelSelector,
     NodeSelectorRequirement,
     Pod,
     PodAffinityTerm,
     TopologySpreadConstraint,
 )
 from karpenter_tpu.api.provisioner import Constraints
-from karpenter_tpu.api.requirements import Requirements
 from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.statics import (
+    SUPPORTED_AFFINITY_KEYS as SUPPORTED_AFFINITY_KEYS_STATICS,
+    PodStatics,
+    satisfies,
+    statics,
+)
 from karpenter_tpu.utils import pod as podutil
 
 # A domain no catalog offers: forces "no instance type satisfied" for pods
 # whose affinity rules cannot be met, keeping them visibly unschedulable.
 UNSATISFIABLE_DOMAIN = "unsatisfiable.karpenter.sh"
 
-SUPPORTED_AFFINITY_KEYS = (lbl.HOSTNAME, lbl.TOPOLOGY_ZONE)
+# re-exported from statics (the grouping pass that enforces it lives there)
+SUPPORTED_AFFINITY_KEYS = SUPPORTED_AFFINITY_KEYS_STATICS
+
+
+class DomainPlan:
+    """Per-pod injected topology decisions, keyed by pod identity.
+
+    Reads fall back to the pod's own (raw) nodeSelector, so plan-aware code
+    sees exactly the view the old selector-mutation flow produced, without
+    touching the pods. ``materialize`` applies the decisions as selector
+    overlays for the FFD path (callers snapshot/restore around it)."""
+
+    __slots__ = ("by_pod", "ztokens", "_pods", "sts")
+
+    # canonical NON-hostname decision tuples, interned PROCESS-WIDE so the
+    # encode can memo per (pod template, decisions) on object identity
+    # across solves — hostname decisions are excluded because the canonical
+    # core never contains the hostname key (the kernel carries it as an int
+    # field). Clear-safe: live plans keep their canonical objects alive.
+    _tok_intern: Dict[Tuple, Tuple] = {}
+
+    def __init__(self, pods: List[Pod]):
+        self.by_pod: Dict[int, Dict[str, str]] = {}
+        self.ztokens: Dict[int, Tuple] = {}
+        self._pods = pods  # keeps ids stable for the plan's lifetime
+        self.sts: Optional[List] = None  # statics parallel to `pods`, set by inject_plan
+
+    def decision(self, pod: Pod, key: str) -> Optional[str]:
+        d = self.by_pod.get(id(pod))
+        return None if d is None else d.get(key)
+
+    def get(self, pod: Pod, key: str) -> Optional[str]:
+        v = self.decision(pod, key)
+        return v if v is not None else pod.spec.node_selector.get(key)
+
+    def set(self, pod: Pod, key: str, domain: str) -> None:
+        pid = id(pod)
+        d = self.by_pod.get(pid)
+        if d is None:
+            d = self.by_pod[pid] = {}
+        d[key] = domain
+        if key != lbl.HOSTNAME:
+            self.ztokens.pop(pid, None)  # token rebuilt lazily on read
+
+    def zone_token(self, pod: Pod) -> Tuple:
+        """Canonical interned tuple of this pod's non-hostname decisions —
+        built lazily (most reads happen once, in encode) and interned so
+        consumers can memo on object identity."""
+        pid = id(pod)
+        tok = self.ztokens.get(pid)
+        if tok is None:
+            d = self.by_pod.get(pid)
+            if not d:
+                return ()
+            if len(d) == 1:  # the overwhelmingly common single decision
+                ((k, v),) = d.items()
+                items = () if k == lbl.HOSTNAME else ((k, v),)
+            else:
+                items = tuple(sorted((k, v) for k, v in d.items() if k != lbl.HOSTNAME))
+            intern = DomainPlan._tok_intern
+            if len(intern) > (1 << 20):
+                intern.clear()
+            tok = self.ztokens[pid] = intern.setdefault(items, items)
+        return tok
+
+    def items(self, pod: Pod) -> Optional[Dict[str, str]]:
+        return self.by_pod.get(id(pod))
+
+    def materialize(self, pods: List[Pod]) -> None:
+        """Write decisions into the pods' nodeSelectors (always replacing
+        the dict, never mutating in place, so snapshot/restore works)."""
+        for p in pods:
+            d = self.by_pod.get(id(p))
+            if d:
+                p.spec.node_selector = {**p.spec.node_selector, **d}
 
 
 class TopologyGroup:
@@ -68,6 +148,7 @@ class TopologyGroup:
     def __init__(self, pod: Pod, constraint: TopologySpreadConstraint):
         self.constraint = constraint
         self.pods: List[Pod] = [pod]
+        self.sts: List[PodStatics] = []
         self.spread: Dict[str, int] = {}
 
     def register(self, *domains: str) -> None:
@@ -78,13 +159,14 @@ class TopologyGroup:
         if domain in self.spread:
             self.spread[domain] += 1
 
-    def next_domain(self, allowed: Set[str]) -> str:
-        """Argmin over allowed registered domains; ties broken toward the
-        later-iterated key like the reference's `<=` comparison."""
+    def next_domain(self, allowed: Optional[Set[str]]) -> str:
+        """Argmin over allowed registered domains (``None`` = all of them,
+        no membership test); ties broken toward the later-iterated key like
+        the reference's `<=` comparison."""
         min_domain = ""
         min_count = None
         for domain, count in self.spread.items():
-            if domain not in allowed:
+            if allowed is not None and domain not in allowed:
                 continue
             if min_count is None or count <= min_count:
                 min_domain = domain
@@ -101,64 +183,34 @@ class AffinityGroup:
         self.term = term
         self.anti = anti
         self.pods: List[Pod] = []
+        self.sts: List[PodStatics] = []  # parallel to pods
         # domain -> number of pods matching the term's selector there
         self.match_counts: Dict[str, int] = {}
+        self._namespaces = (
+            set(term.namespaces) if term.namespaces else {namespace}
+        )
+        self._match_memo: Dict[Tuple, bool] = {}
 
     @property
     def key(self) -> str:
         return self.term.topology_key
 
-    def selector_matches(self, pod: Pod) -> bool:
-        if pod.metadata.namespace not in self.namespaces():
+    def selector_matches(self, pod: Pod, st: Optional[PodStatics] = None) -> bool:
+        if pod.metadata.namespace not in self._namespaces:
             return False
         sel = self.term.label_selector
-        return sel is None or sel.matches(pod.metadata.labels)
+        if sel is None:
+            return True
+        # memoized by label set: a group's pods share few distinct label
+        # maps, and this runs O(pods × groups) per solve
+        lk = (st or statics(pod)).labels_key
+        hit = self._match_memo.get(lk)
+        if hit is None:
+            hit = self._match_memo[lk] = sel.matches(pod.metadata.labels)
+        return hit
 
     def namespaces(self) -> Set[str]:
-        return set(self.term.namespaces) if self.term.namespaces else {self.namespace}
-
-
-def _selector_key(sel: Optional[LabelSelector]) -> Tuple:
-    if sel is None:
-        return ()
-    # memoized on the selector object — selectors are immutable in practice
-    # and this runs per pod per solve
-    cached = getattr(sel, "_canon_key", None)
-    if cached is not None:
-        return cached
-    key = (
-        tuple(sorted(sel.match_labels.items())),
-        tuple((e.key, e.operator, tuple(e.values)) for e in sel.match_expressions),
-    )
-    try:
-        sel._canon_key = key
-    except AttributeError:
-        pass
-    return key
-
-
-def _group_key(namespace: str, c: TopologySpreadConstraint) -> Tuple:
-    return (namespace, c.max_skew, c.topology_key, c.when_unsatisfiable,
-            _selector_key(c.label_selector))
-
-
-def _affinity_key(namespace: str, term: PodAffinityTerm, anti: bool) -> Tuple:
-    ns = tuple(sorted(term.namespaces)) if term.namespaces else (namespace,)
-    return (anti, ns, term.topology_key, _selector_key(term.label_selector))
-
-
-def snapshot_selectors(pods: List[Pod]) -> List[Dict[str, str]]:
-    """The pods' nodeSelector dicts before injection. Injection always
-    replaces the dict (never mutates in place), so restoring the original
-    references undoes every injected decision — solving must not leave
-    stale domain pins on live pod objects (a retried pod would drag its
-    previous round's hostname/zone into the next solve)."""
-    return [p.spec.node_selector for p in pods]
-
-
-def restore_selectors(pods: List[Pod], saved: List[Dict[str, str]]) -> None:
-    for p, s in zip(pods, saved):
-        p.spec.node_selector = s
+        return self._namespaces
 
 
 class Topology:
@@ -167,15 +219,33 @@ class Topology:
         self.rng = rng or random.Random()
 
     # -- public ------------------------------------------------------------
-    def inject(self, constraints: Constraints, pods: List[Pod]) -> None:
-        """Write a topology-chosen domain into each pod's nodeSelector
-        (reference: topology.go:41-57). Affinity first — its choices narrow
-        what spread sees — then spread. Mutates pods and, for hostname
-        domains, the constraints' requirements."""
+    def inject(self, constraints: Constraints, pods: List[Pod]) -> DomainPlan:
+        """Legacy mutating form: compute the plan, then write each pod's
+        chosen domains into its nodeSelector (reference: topology.go:41-57).
+        Callers snapshot/restore selectors around solves."""
+        plan = self.inject_plan(constraints, pods)
+        plan.materialize(pods)
+        return plan
+
+    def inject_plan(
+        self,
+        constraints: Constraints,
+        pods: List[Pod],
+        sts: Optional[List[PodStatics]] = None,
+    ) -> DomainPlan:
+        """Compute a topology decision per pod WITHOUT mutating the pods.
+        Affinity first — its choices narrow what spread sees — then host
+        ports, then spread. Hostname domains are registered into the
+        constraints' requirements. ``sts`` lets the caller share one
+        statics pass across sort → inject → encode."""
+        plan = DomainPlan(pods)
+        if sts is None:
+            sts = [statics(p) for p in pods]  # ONE statics pass for the solve
+        plan.sts = sts
         generated_hostnames: List[str] = []
-        self._inject_affinity(constraints, pods, generated_hostnames)
-        self._inject_host_ports(pods, generated_hostnames)
-        self._inject_spread(constraints, pods, generated_hostnames)
+        self._inject_affinity(constraints, pods, sts, generated_hostnames, plan)
+        self._inject_host_ports(pods, sts, generated_hostnames, plan)
+        self._inject_spread(constraints, pods, sts, generated_hostnames, plan)
         if generated_hostnames:
             # one registration for the union: per-group adds would intersect
             # per-key sets and empty the hostname domain
@@ -184,15 +254,18 @@ class Topology:
                     key=lbl.HOSTNAME, operator="In", values=generated_hostnames
                 )
             )
+        return plan
 
     # -- pod (anti-)affinity ----------------------------------------------
     def _inject_affinity(
         self,
         constraints: Constraints,
         pods: List[Pod],
+        sts: List[PodStatics],
         generated_hostnames: List[str],
+        plan: DomainPlan,
     ) -> None:
-        groups = self._affinity_groups(pods)
+        groups = self._affinity_groups(pods, sts)
         if not groups:
             return
         batch = list(pods)
@@ -202,29 +275,23 @@ class Topology:
         groups.sort(key=lambda g: not g.anti)
         for group in groups:
             if group.key == lbl.TOPOLOGY_ZONE:
-                self._assign_zonal_affinity(constraints, group, batch)
+                self._assign_zonal_affinity(constraints, group, batch, plan)
             elif group.key == lbl.HOSTNAME:
-                self._assign_hostname_affinity(group, batch, generated_hostnames)
+                self._assign_hostname_affinity(group, batch, generated_hostnames, plan)
 
-    def _affinity_groups(self, pods: List[Pod]) -> List[AffinityGroup]:
+    def _affinity_groups(
+        self, pods: List[Pod], sts: Optional[List[PodStatics]] = None
+    ) -> List[AffinityGroup]:
+        if sts is None:
+            sts = [statics(p) for p in pods]
         groups: Dict[Tuple, AffinityGroup] = {}
-        for pod in pods:
-            aff = pod.spec.affinity
-            if aff is None:
-                continue
-            terms: List[Tuple[PodAffinityTerm, bool]] = []
-            if aff.pod_affinity is not None:
-                terms += [(t, False) for t in aff.pod_affinity.required]
-            if aff.pod_anti_affinity is not None:
-                terms += [(t, True) for t in aff.pod_anti_affinity.required]
-            for term, anti in terms:
-                if term.topology_key not in SUPPORTED_AFFINITY_KEYS:
-                    continue
-                key = _affinity_key(pod.metadata.namespace, term, anti)
+        for pod, st in zip(pods, sts):
+            for key, term, anti in st.aff_terms:
                 group = groups.get(key)
                 if group is None:
                     group = groups[key] = AffinityGroup(pod.metadata.namespace, term, anti)
                 group.pods.append(pod)
+                group.sts.append(st)
         return list(groups.values())
 
     def _count_cluster_matches(self, group: AffinityGroup) -> None:
@@ -241,30 +308,51 @@ class Topology:
                 if domain is not None:
                     group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
 
+    @staticmethod
+    def _narrowed(
+        st: PodStatics, pin: Optional[str], key: str, domains: Set[str]
+    ) -> Optional[Set[str]]:
+        """The subset of ``domains`` this pod may take — or ``None`` meaning
+        "all of them" (the overwhelmingly common case, returned without
+        copying the domain set). ``pin`` is a domain an earlier injection
+        pass already chose (the plan-aware form of re-reading the mutated
+        selector); ``domains`` is already constraint-viable, so only the
+        pod's OWN narrowing needs checking."""
+        entries = st.key_entries.get(key)
+        if pin is None and not entries:
+            return None
+        out = set()
+        for d in domains:
+            if pin is not None and d != pin:
+                continue
+            if entries and not satisfies(entries, d):
+                continue
+            out.add(d)
+        return out
+
+    @staticmethod
     def _allowed_domains(
-        self, constraints: Constraints, pod: Pod, key: str, domains: Set[str]
+        pod: Pod, key: str, domains: Set[str], plan: DomainPlan
     ) -> Set[str]:
-        """``domains`` is already constraint-viable, so only the pod's OWN
-        narrowing needs checking — merging the pod into the full (catalog-
-        sized) constraint requirements per pod made injection O(n·|catalog|)."""
-        # fast path: a pod with no selector and no node affinity narrows
-        # nothing — building its Requirements per call dominated injection
-        # at 10k pods (most benchmark pods are unconstrained)
-        if not pod.spec.node_selector and (
-            pod.spec.affinity is None or pod.spec.affinity.node_affinity is None
-        ):
-            return set(domains)
-        pod_reqs = Requirements.from_pod(pod)
-        if not pod_reqs.has(key):
-            return set(domains)
-        pod_set = pod_reqs.get(key)
-        return {d for d in domains if pod_set.has(d)}
+        """Compat form of ``_narrowed`` returning a real set (oracle and
+        slow paths)."""
+        out = Topology._narrowed(
+            statics(pod), plan.decision(pod, key), key, domains
+        )
+        return set(domains) if out is None else out
 
     def _assign_zonal_affinity(
-        self, constraints: Constraints, group: AffinityGroup, batch: List[Pod]
+        self,
+        constraints: Constraints,
+        group: AffinityGroup,
+        batch: List[Pod],
+        plan: DomainPlan,
     ) -> None:
         self._count_cluster_matches(group)
         viable = constraints.requirements.zones()
+        key = group.key
+        members = list(zip(group.pods, group.sts))
+        pins = [plan.decision(p, key) for p, _ in members]
         if group.anti:
             # Selector-matching members claim a zone each (pairwise
             # separation); non-matching members only need SOME zone free of
@@ -273,8 +361,14 @@ class Topology:
             # non-matchers is never a win — so one clean zone is reserved
             # for them. This keeps drops to the provable minimum:
             # max(m - (clean - 1), 0) matchers (see scheduling/oracle.py).
-            matching = [p for p in group.pods if group.selector_matches(p)]
-            nonmatching = [p for p in group.pods if not group.selector_matches(p)]
+            matching = [
+                (p, st, pin) for (p, st), pin in zip(members, pins)
+                if group.selector_matches(p, st)
+            ]
+            nonmatching = [
+                (p, st, pin) for (p, st), pin in zip(members, pins)
+                if not group.selector_matches(p, st)
+            ]
             reserved: Optional[str] = None
             if nonmatching and matching:
                 clean = sorted(
@@ -285,81 +379,136 @@ class Topology:
                 # to — reserving a matcher's only allowed zone would drop a
                 # placeable matcher
                 matcher_allowed = [
-                    self._allowed_domains(constraints, p, group.key, viable)
-                    for p in matching
+                    self._narrowed(st, pin, key, viable)
+                    for _, st, pin in matching
                 ]
                 best = None
                 for d in clean:
                     n_ok = sum(
                         1
-                        for p in nonmatching
-                        if d in self._allowed_domains(constraints, p, group.key, {d})
+                        for _, st, pin in nonmatching
+                        if self._narrowed(st, pin, key, {d}) in (None, {d})
                     )
                     m_only = sum(1 for a in matcher_allowed if a == {d})
                     if n_ok and (best is None or (n_ok, -m_only) > (best[0], -best[1])):
                         best = (n_ok, m_only, d)
                 if best is not None:
                     reserved = best[2]
-            for pod in matching:
-                allowed = self._allowed_domains(constraints, pod, group.key, viable)
-                free = sorted(
-                    d
-                    for d in allowed
-                    if group.match_counts.get(d, 0) == 0 and d != reserved
-                )
-                domain = free[0] if free else UNSATISFIABLE_DOMAIN
-                _set_domain(pod, group.key, domain)
+            # amortized claim: unrestricted matchers take zones off one
+            # shared sorted free list instead of re-sorting per pod
+            free_list = sorted(
+                d for d in viable
+                if group.match_counts.get(d, 0) == 0 and d != reserved
+            )
+            for pod, st, pin in matching:
+                allowed = self._narrowed(st, pin, key, viable)
+                if allowed is None:
+                    domain = free_list[0] if free_list else UNSATISFIABLE_DOMAIN
+                else:
+                    free = sorted(
+                        d
+                        for d in allowed
+                        if group.match_counts.get(d, 0) == 0 and d != reserved
+                    )
+                    domain = free[0] if free else UNSATISFIABLE_DOMAIN
+                plan.set(pod, key, domain)
                 if domain != UNSATISFIABLE_DOMAIN:
                     group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
-            for pod in nonmatching:
-                allowed = self._allowed_domains(constraints, pod, group.key, viable)
-                free = sorted(d for d in allowed if group.match_counts.get(d, 0) == 0)
-                domain = free[0] if free else UNSATISFIABLE_DOMAIN
-                _set_domain(pod, group.key, domain)
+                    if free_list and free_list[0] == domain:
+                        free_list.pop(0)
+                    elif domain in free_list:
+                        free_list.remove(domain)
+            # non-matchers never increment counts, so they all resolve to
+            # the same first free zone — computed once for the unrestricted
+            free_nm = sorted(d for d in viable if group.match_counts.get(d, 0) == 0)
+            shared_nm = free_nm[0] if free_nm else UNSATISFIABLE_DOMAIN
+            for pod, st, pin in nonmatching:
+                allowed = self._narrowed(st, pin, key, viable)
+                if allowed is None:
+                    domain = shared_nm
+                else:
+                    free = sorted(d for d in allowed if group.match_counts.get(d, 0) == 0)
+                    domain = free[0] if free else UNSATISFIABLE_DOMAIN
+                plan.set(pod, key, domain)
             return
         # affinity: most-populated existing domain, else a seed the group
-        # itself (or a batch provider) will populate
-        for pod in group.pods:
-            allowed = self._allowed_domains(constraints, pod, group.key, viable)
-            populated = sorted(
-                (d for d in allowed if group.match_counts.get(d, 0) > 0),
-                key=lambda d: (-group.match_counts[d], d),
-            )
-            if populated:
-                domain = populated[0]
+        # itself (or a batch provider) will populate. The argmax is
+        # recomputed only when the counts' argmax can change (a provider
+        # seed or a first placement), not per pod.
+        populated_domain: Optional[str] = None
+        populated_dirty = True
+        for pod, st in members:
+            # the pin must be read LIVE, not from the pre-loop snapshot: a
+            # provider seeded earlier in THIS loop (plan.set below) must see
+            # its own pin when its iteration comes, or it gets re-assigned
+            # away from the consumer that adopted it
+            pin = plan.decision(pod, key)
+            allowed = self._narrowed(st, pin, key, viable)
+            if populated_dirty:
+                populated = sorted(
+                    (d for d in viable if group.match_counts.get(d, 0) > 0),
+                    key=lambda d: (-group.match_counts[d], d),
+                )
+                populated_domain = populated[0] if populated else None
+                populated_dirty = False
+            if allowed is None and populated_domain is not None:
+                # placing here only strengthens the argmax — no recompute
+                domain = populated_domain
+            elif allowed is not None and any(
+                group.match_counts.get(d, 0) > 0 for d in allowed
+            ):
+                # narrowed pod: argmax over ITS allowed populated domains
+                acceptable = sorted(
+                    (d for d in allowed if group.match_counts.get(d, 0) > 0),
+                    key=lambda d: (-group.match_counts[d], d),
+                )
+                domain = acceptable[0]
             else:
-                provider, pinned = self._batch_provider(group, batch)
-                if provider is None or not allowed:
+                provider, pinned = self._batch_provider(group, batch, plan)
+                if provider is None or (allowed is not None and not allowed):
                     domain = UNSATISFIABLE_DOMAIN
                 elif pinned is not None:
                     # adopt the provider's already-pinned domain if this pod
                     # may go there; else unsatisfiable
-                    domain = pinned if pinned in allowed else UNSATISFIABLE_DOMAIN
+                    domain = (
+                        pinned
+                        if (allowed is None or pinned in allowed) and pinned in viable
+                        else UNSATISFIABLE_DOMAIN
+                    )
                 else:
                     # seed a domain BOTH the consumer and the provider may
                     # use — pinning the provider outside its own node
                     # affinity would render it unschedulable
                     provider_allowed = self._allowed_domains(
-                        constraints, provider, group.key, viable
+                        provider, key, viable, plan
                     )
-                    joint = sorted(allowed & provider_allowed)
+                    joint = sorted(
+                        (viable if allowed is None else allowed) & provider_allowed
+                    )
                     domain = joint[0] if joint else UNSATISFIABLE_DOMAIN
                 if domain != UNSATISFIABLE_DOMAIN and provider is not pod:
                     # ensure the provider actually lands there
-                    _set_domain(provider, group.key, domain)
+                    plan.set(provider, key, domain)
                     if group.selector_matches(provider):
                         group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
-            _set_domain(pod, group.key, domain)
-            if domain != UNSATISFIABLE_DOMAIN and group.selector_matches(pod):
+                        populated_dirty = True
+            plan.set(pod, key, domain)
+            if domain != UNSATISFIABLE_DOMAIN and group.selector_matches(pod, st):
                 group.match_counts[domain] = group.match_counts.get(domain, 0) + 1
+                if domain != populated_domain:
+                    populated_dirty = True
 
     def _assign_hostname_affinity(
-        self, group: AffinityGroup, batch: List[Pod], generated_hostnames: List[str]
+        self,
+        group: AffinityGroup,
+        batch: List[Pod],
+        generated_hostnames: List[str],
+        plan: DomainPlan,
     ) -> None:
         if group.anti:
             shared_for_nonmatching: Optional[str] = None
-            for pod in group.pods:
-                if group.selector_matches(pod):
+            for pod, st in zip(group.pods, group.sts):
+                if group.selector_matches(pod, st):
                     # pairwise separation: a fresh node each
                     domain = self._fresh_hostname(generated_hostnames)
                 else:
@@ -367,23 +516,23 @@ class Topology:
                     if shared_for_nonmatching is None:
                         shared_for_nonmatching = self._fresh_hostname(generated_hostnames)
                     domain = shared_for_nonmatching
-                _set_domain(pod, group.key, domain)
+                plan.set(pod, group.key, domain)
             return
         # affinity: the whole group lands on one fresh node, provided the
         # match can come from the group itself or another batch pod
-        provider, pinned = self._batch_provider(group, batch)
+        provider, pinned = self._batch_provider(group, batch, plan)
         if provider is None:
             for pod in group.pods:
-                _mark_unschedulable(pod)
+                _mark_unschedulable(pod, plan)
             return
         shared = pinned if pinned is not None else self._fresh_hostname(generated_hostnames)
-        _set_domain(provider, group.key, shared)
+        plan.set(provider, group.key, shared)
         for pod in group.pods:
-            _set_domain(pod, group.key, shared)
+            plan.set(pod, group.key, shared)
 
     @staticmethod
     def _batch_provider(
-        group: AffinityGroup, batch: List[Pod]
+        group: AffinityGroup, batch: List[Pod], plan: DomainPlan
     ) -> Tuple[Optional[Pod], Optional[str]]:
         """A batch pod that satisfies the group's selector — preferring group
         members (self-affinity), then unpinned batch pods, then batch pods
@@ -391,25 +540,35 @@ class Topology:
         pinned_candidate: Optional[Pod] = None
         for pod in group.pods:
             if group.selector_matches(pod):
-                return pod, pod.spec.node_selector.get(group.key)
+                return pod, plan.get(pod, group.key)
         for pod in batch:
             if not group.selector_matches(pod):
                 continue
-            if group.key not in pod.spec.node_selector:
+            pinned = plan.get(pod, group.key)
+            if pinned is None:
                 return pod, None
             if pinned_candidate is None:
                 pinned_candidate = pod
         if pinned_candidate is not None:
-            return pinned_candidate, pinned_candidate.spec.node_selector[group.key]
+            return pinned_candidate, plan.get(pinned_candidate, group.key)
         return None, None
 
     def _fresh_hostname(self, generated_hostnames: List[str]) -> str:
-        name = "".join(self.rng.choices(string.ascii_lowercase + string.digits, k=8))
+        # 40 random bits as base-32 hex-ish text: same entropy class as the
+        # old 8-char alphanumeric draw at ~1/4 the cost (a host-spread batch
+        # generates thousands of these per solve)
+        name = f"h{self.rng.getrandbits(40):010x}"
         generated_hostnames.append(name)
         return name
 
     # -- host ports --------------------------------------------------------
-    def _inject_host_ports(self, pods: List[Pod], generated_hostnames: List[str]) -> None:
+    def _inject_host_ports(
+        self,
+        pods: List[Pod],
+        sts: List[PodStatics],
+        generated_hostnames: List[str],
+        plan: DomainPlan,
+    ) -> None:
         """Host-port claims are per-node mutable state the tensor encoding
         does not carry, so they become hostname pre-assignments like
         anti-affinity: port-claiming pods are bucketed onto fresh hostnames
@@ -419,19 +578,22 @@ class Topology:
         their pin; a conflict inside one pin is unsatisfiable."""
         buckets: List[Tuple[str, set, Tuple]] = []  # (hostname, claims, selector key)
         pinned_claims: Dict[str, set] = {}
-        for pod in pods:
-            claims = podutil.host_ports(pod)
+        for pod, st in zip(pods, sts):
+            claims = st.host_ports
             if not claims:
                 continue
-            pinned = _pinned_hostname(pod)
+            pinned = _pinned_hostname(pod, plan, st)
             if pinned is not None:
                 existing = pinned_claims.setdefault(pinned, set())
                 if podutil.host_ports_conflict(claims, existing):
-                    _mark_unschedulable(pod)
+                    _mark_unschedulable(pod, plan)
                 else:
                     existing |= claims
                 continue
-            selector_key = tuple(sorted(pod.spec.node_selector.items()))
+            dec = plan.items(pod)
+            selector_key = tuple(
+                sorted(({**dict(st.sel_raw), **dec} if dec else dict(st.sel_raw)).items())
+            )
             placed = False
             for hostname, bucket_claims, bucket_key in buckets:
                 if bucket_key != selector_key:
@@ -439,20 +601,22 @@ class Topology:
                 if podutil.host_ports_conflict(claims, bucket_claims):
                     continue
                 bucket_claims |= claims
-                _set_domain(pod, lbl.HOSTNAME, hostname)
+                plan.set(pod, lbl.HOSTNAME, hostname)
                 placed = True
                 break
             if not placed:
                 hostname = self._fresh_hostname(generated_hostnames)
                 buckets.append((hostname, set(claims), selector_key))
-                _set_domain(pod, lbl.HOSTNAME, hostname)
+                plan.set(pod, lbl.HOSTNAME, hostname)
 
     # -- topology spread ---------------------------------------------------
     def _inject_spread(
         self,
         constraints: Constraints,
         pods: List[Pod],
+        sts: List[PodStatics],
         generated_hostnames: List[str],
+        plan: DomainPlan,
     ) -> None:
         # hostname-spread groups draw their fresh domains from one shared
         # pool: spread only constrains skew WITHIN a group, so different
@@ -461,11 +625,14 @@ class Topology:
         # nodes than private per-group domains. Affinity/anti-affinity/port
         # hostnames stay private (a spread pod could match their selectors).
         hostname_pool: List[str] = []
-        for group in self._topology_groups(pods):
-            self._compute_current_topology(constraints, group, generated_hostnames, hostname_pool)
+        for group in self._topology_groups(pods, sts):
+            self._compute_current_topology(
+                constraints, group, generated_hostnames, hostname_pool, plan
+            )
             key = group.constraint.topology_key
             if key == lbl.HOSTNAME and not any(
-                _pod_constrains(p, lbl.HOSTNAME) for p in group.pods
+                _pod_constrains(p, lbl.HOSTNAME, plan, st)
+                for p, st in zip(group.pods, group.sts)
             ):
                 # fast path: all-fresh domains, zero seed counts, no pinned
                 # pods → min-count assignment degenerates to round-robin
@@ -474,28 +641,40 @@ class Topology:
                 for j, pod in enumerate(group.pods):
                     domain = domains[j % len(domains)]
                     group.spread[domain] += 1
-                    _set_domain(pod, key, domain)
+                    plan.set(pod, key, domain)
                 continue
-            for pod in group.pods:
+            registered = group.spread.keys()
+            for pod, st in zip(group.pods, group.sts):
                 # the pod's own requirements may narrow the registered
                 # domains; registered domains are already constraint-viable
-                allowed = self._allowed_domains(constraints, pod, key, set(group.spread))
+                allowed = self._narrowed(
+                    st, plan.decision(pod, key), key, registered
+                )
                 if key == lbl.HOSTNAME:
-                    pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+                    pinned = plan.get(pod, lbl.HOSTNAME)
                     if pinned is not None:
-                        allowed &= {pinned}
+                        allowed = (
+                            {pinned}
+                            if allowed is None
+                            else (allowed & {pinned})
+                        )
                 domain = group.next_domain(allowed)
-                _set_domain(pod, key, domain)
+                plan.set(pod, key, domain)
 
-    def _topology_groups(self, pods: List[Pod]) -> List[TopologyGroup]:
+    def _topology_groups(
+        self, pods: List[Pod], sts: Optional[List[PodStatics]] = None
+    ) -> List[TopologyGroup]:
+        if sts is None:
+            sts = [statics(p) for p in pods]
         groups: Dict[Tuple, TopologyGroup] = {}
-        for pod in pods:
-            for constraint in pod.spec.topology_spread_constraints:
-                key = _group_key(pod.metadata.namespace, constraint)
-                if key in groups:
-                    groups[key].pods.append(pod)
-                else:
-                    groups[key] = TopologyGroup(pod, constraint)
+        for pod, st in zip(pods, sts):
+            for key, constraint in st.spreads:
+                g = groups.get(key)
+                if g is None:
+                    g = groups[key] = TopologyGroup(pod, constraint)
+                    g.pods.pop()  # ctor added the pod; re-add with its st
+                g.pods.append(pod)
+                g.sts.append(st)
         return list(groups.values())
 
     def _compute_current_topology(
@@ -504,10 +683,11 @@ class Topology:
         group: TopologyGroup,
         generated_hostnames: List[str],
         hostname_pool: List[str],
+        plan: DomainPlan,
     ) -> None:
         key = group.constraint.topology_key
         if key == lbl.HOSTNAME:
-            self._compute_hostname_topology(group, generated_hostnames, hostname_pool)
+            self._compute_hostname_topology(group, generated_hostnames, hostname_pool, plan)
         elif key == lbl.TOPOLOGY_ZONE:
             self._compute_zonal_topology(constraints, group)
 
@@ -516,6 +696,7 @@ class Topology:
         group: TopologyGroup,
         generated_hostnames: List[str],
         hostname_pool: List[str],
+        plan: DomainPlan,
     ) -> None:
         """Fresh nodes are empty, so the global hostname minimum is 0; we
         register ceil(n/maxSkew) domains — drawn from the shared pool so
@@ -527,7 +708,7 @@ class Topology:
         # pods already pinned to a hostname by affinity participate with that
         # hostname as a registered domain
         for pod in group.pods:
-            pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+            pinned = plan.get(pod, lbl.HOSTNAME)
             if pinned is not None:
                 group.register(pinned)
         group.register(*hostname_pool[:n_domains])
@@ -551,48 +732,46 @@ class Topology:
                 group.increment(domain)
 
 
-def _set_domain(pod: Pod, key: str, domain: str) -> None:
-    pod.spec.node_selector = {**pod.spec.node_selector, key: domain}
+def snapshot_selectors(pods: List[Pod]) -> List[Dict[str, str]]:
+    """The pods' nodeSelector dicts before materialization. Materialization
+    always replaces the dict (never mutates in place), so restoring the
+    original references undoes every injected decision — solving must not
+    leave stale domain pins on live pod objects (a retried pod would drag
+    its previous round's hostname/zone into the next solve)."""
+    return [p.spec.node_selector for p in pods]
 
 
-def _pinned_hostname(pod: Pod) -> Optional[str]:
-    """The hostname the pod is already pinned to — by nodeSelector (domain
-    injection writes there) or by its own required node affinity."""
-    pinned = pod.spec.node_selector.get(lbl.HOSTNAME)
+def restore_selectors(pods: List[Pod], saved: List[Dict[str, str]]) -> None:
+    for p, s in zip(pods, saved):
+        p.spec.node_selector = s
+
+
+def _pinned_hostname(
+    pod: Pod, plan: DomainPlan, st: Optional[PodStatics] = None
+) -> Optional[str]:
+    """The hostname the pod is already pinned to — by an injected decision,
+    its own nodeSelector, or its own required node affinity."""
+    pinned = plan.get(pod, lbl.HOSTNAME)
     if pinned is not None:
         return pinned
-    aff = pod.spec.affinity
-    if aff is None or aff.node_affinity is None:
-        return None
-    for term in aff.node_affinity.required:
-        for r in term.match_expressions:
-            if r.key == lbl.HOSTNAME and r.operator == "In" and len(r.values) == 1:
-                return r.values[0]
-    return None
+    return (st or statics(pod)).pinned_aff_hostname
 
 
-def _pod_constrains(pod: Pod, key: str) -> bool:
-    """Does the pod's own spec narrow this topology key (selector or node
-    affinity)? Cheap pre-check gating the spread fast path."""
-    if key in pod.spec.node_selector:
+def _pod_constrains(
+    pod: Pod, key: str, plan: DomainPlan, st: Optional[PodStatics] = None
+) -> bool:
+    """Does the pod's own spec — or an earlier injection pass — narrow this
+    topology key? Cheap pre-check gating the spread fast path."""
+    if plan.decision(pod, key) is not None:
         return True
-    aff = pod.spec.affinity
-    if aff is None or aff.node_affinity is None:
-        return False
-    for term in aff.node_affinity.required:
-        if any(r.key == key for r in term.match_expressions):
-            return True
-    for pref in aff.node_affinity.preferred:
-        if any(r.key == key for r in pref.preference.match_expressions):
-            return True
-    return False
+    return key in (st or statics(pod)).constrains
 
 
-def _mark_unschedulable(pod: Pod) -> None:
+def _mark_unschedulable(pod: Pod, plan: DomainPlan) -> None:
     """Pin the pod to a zone no offering can provide: zone feasibility is
     enforced by the instance-type offering filter for every catalog, unlike
     hostname, so this reliably drops (and logs) the pod at pack time."""
-    _set_domain(pod, lbl.TOPOLOGY_ZONE, UNSATISFIABLE_DOMAIN)
+    plan.set(pod, lbl.TOPOLOGY_ZONE, UNSATISFIABLE_DOMAIN)
 
 
 def ignored_for_topology(p: Pod) -> bool:

@@ -23,7 +23,12 @@ from karpenter_tpu.api.objects import NodeSelectorRequirement, Pod
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
-from karpenter_tpu.scheduling.ffd import FFDScheduler, VirtualNode, daemon_overhead, sort_pods_ffd
+from karpenter_tpu.scheduling.ffd import (
+    FFDScheduler,
+    VirtualNode,
+    daemon_overhead,
+    sort_pods_ffd_with_statics,
+)
 from karpenter_tpu.scheduling.topology import (
     Topology,
     restore_selectors,
@@ -41,6 +46,10 @@ logger = logging.getLogger("karpenter.solver")
 # dead sidecar costs one bounded stall, not one per batch.
 REMOTE_SOLVE_TIMEOUT = 5.0
 REMOTE_BREAKER_SECONDS = 30.0
+
+# (P, S, F, n_max) whose fused compile/run failed — those shapes take the
+# unfused ladder from then on (mirrors pallas_kernel._pallas_failed_shapes)
+_fused_failed_shapes: set = set()
 
 
 class TpuScheduler:
@@ -61,33 +70,112 @@ class TpuScheduler:
         # reused across this worker's batches; the lock covers the rare
         # concurrent solve (warmup thread vs first real batch)
         self._encode_cache = enc.EncodeCache()
+        # device-resident solve invariants for the fused dispatch
+        self._device_cache = None
         self._solve_lock = threading.Lock()
         # per-stage timings of the most recent solve (bench surfaces these
         # as the latency breakdown the <100ms target is judged against)
         self.last_profile: Dict[str, float] = {}
 
-    def _pack(self, batch: enc.EncodedBatch) -> kernel.PackResult:
-        """Run the packing kernel — on the sidecar when configured, with the
-        in-process kernel as the availability fallback. Returns HOST numpy
-        arrays (one fused device→host transfer).
+    def _pack(self, batch: enc.EncodedBatch):
+        """Run the packing kernel — on the sidecar when configured, the
+        fused single-dispatch device path when eligible, and the in-process
+        kernel ladder otherwise. Returns ``(PackResult, typemask-or-None)``
+        with HOST numpy arrays (one device→host transfer).
 
-        The node table starts at P/4 slots — per-scan-step cost is linear in
-        the table size, and real packings open far fewer nodes than pods —
-        and retries at full P on saturation (table full + unscheduled pods).
-        """
-        args = batch.pack_args()
+        The node table starts small (512 slots — per-pod kernel cost is
+        linear in the table size, and real packings open far fewer nodes
+        than pods) and retries at full P on saturation (table full with
+        unscheduled pods)."""
         p = len(batch.pod_valid)
-        n_max = max(256, p // 4)
+        n_max = min(p, 512) if self._fused_eligible(batch) else max(256, p // 4)
         self.last_profile["pack_dispatches"] = 0
+        args = None
         while True:
             self.last_profile["pack_dispatches"] += 1
-            result = self._pack_once(args, p, n_max)
+            result = typemask = None
+            if self._fused_eligible(batch):
+                try:
+                    result, typemask = self._pack_fused(batch, n_max)
+                except Exception:
+                    # same containment contract as pack_best: one
+                    # pathological shape must not crash the batch or degrade
+                    # other shapes — record it and take the unfused ladder
+                    # (which has its own v1→v2→scan fallbacks)
+                    shape = self._fused_shape(batch, n_max)
+                    logger.exception(
+                        "fused solve failed for shape %s; unfused ladder", shape
+                    )
+                    _fused_failed_shapes.add(shape)
+            if result is None:
+                if args is None:
+                    args = batch.pack_args()
+                result, typemask = self._pack_once(args, p, n_max), None
             saturated = int(result.n_nodes) == n_max and bool(
                 (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
             )
             if not saturated or n_max >= p:
-                return result
+                return result, typemask
             n_max = p
+
+    @staticmethod
+    def _fused_shape(batch: enc.EncodedBatch, n_max: int) -> tuple:
+        return (
+            len(batch.pod_valid), batch.frontiers.shape[0],
+            batch.frontiers.shape[1], n_max,
+        )
+
+    def _fused_eligible(self, batch: enc.EncodedBatch) -> bool:
+        """The fused single-dispatch path serves exactly the shapes the v1
+        Pallas kernel serves (TPU, lane-aligned P, S·F within the unroll
+        budget) whose interned ids fit the compact i16 upload. A configured
+        sidecar takes precedence (its own process owns the device), and a
+        shape whose fused compile/run already failed stays on the unfused
+        ladder."""
+        if self.service_address and time.monotonic() >= self._remote_down_until:
+            return False
+        from karpenter_tpu.solver import fused
+        from karpenter_tpu.solver.pallas_kernel import pallas_shape_eligible
+
+        P = len(batch.pod_valid)
+        S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
+        if any(s[:3] == (P, S, F) for s in _fused_failed_shapes):
+            return False
+        return pallas_shape_eligible(P, S, F) and fused.ids_fit(batch)
+
+    def _pack_fused(self, batch: enc.EncodedBatch, n_max: int):
+        """One compact upload + one dispatch + one fetch (solver/fused.py);
+        join table, frontiers, daemon, type masks and usable capacities ride
+        the device-resident invariants cache."""
+        import jax
+
+        from karpenter_tpu.solver import fused
+
+        if self._device_cache is None:
+            self._device_cache = fused.DeviceInvariants()
+        join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
+        pod_tab = fused.pack_pod_table(batch)
+        # bucket U so a drifting unique-request count doesn't recompile
+        uniq = batch.uniq_req
+        u_pad = 16
+        while u_pad < uniq.shape[0]:
+            u_pad *= 2
+        if u_pad != uniq.shape[0]:
+            uniq = np.vstack(
+                [uniq, np.zeros((u_pad - uniq.shape[0], uniq.shape[1]), np.float32)]
+            )
+        from karpenter_tpu.solver.pallas_kernel import pallas_available
+
+        buf = jax.device_get(
+            fused.fused_solve(
+                pod_tab, uniq, join_d, front_d, daemon_d, mask_d, usable_d,
+                n_max=n_max, kernel="pallas" if pallas_available() else "scan",
+            )
+        )
+        return fused.split_fused(
+            buf, len(batch.pod_valid), n_max, batch.usable.shape[1],
+            batch.usable.shape[0],
+        )
 
     def _pack_once(self, args, p: int, n_max: int) -> kernel.PackResult:
         r = args[6].shape[1]  # pod_req
@@ -137,57 +225,66 @@ class TpuScheduler:
         prof = {}
         t0 = time.perf_counter()
         constraints = constraints.clone()
-        pods = sort_pods_ffd(pods)
+        pods, sts = sort_pods_ffd_with_statics(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
-        saved = snapshot_selectors(pods)
         prof["sort_s"] = time.perf_counter() - t0
-        try:
-            with self._solve_lock:
-                # published under the lock: a concurrent warmup solve must
-                # not clobber the profile observers read
-                self.last_profile = prof
-                t0 = time.perf_counter()
-                self.topology.inject(constraints, list(pods))
-                daemon = daemon_overhead(self.cluster, constraints)
-                prof["inject_s"] = time.perf_counter() - t0
-                t0 = time.perf_counter()
+        with self._solve_lock:
+            # published under the lock: a concurrent warmup solve must
+            # not clobber the profile observers read
+            self.last_profile = prof
+            t0 = time.perf_counter()
+            # decision-plan injection: topology choices land in the plan,
+            # NOT in the pods' nodeSelectors — the TPU path never mutates
+            # (and never restores) pod objects. `pods` is already this
+            # solve's own sorted list; passing it (not a copy) lets encode
+            # reuse the plan's statics pass (plan._pods identity check).
+            plan = self.topology.inject_plan(constraints, pods, sts=sts)
+            daemon = daemon_overhead(self.cluster, constraints)
+            prof["inject_s"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            try:
+                batch = self._encode_retry(constraints, instance_types, pods, daemon, plan)
+            except SignatureOverflow as e:
+                logger.warning("falling back to FFD: %s", e)
+                saved = snapshot_selectors(pods)
                 try:
-                    batch = self._encode_retry(constraints, instance_types, pods, daemon)
-                except SignatureOverflow as e:
-                    logger.warning("falling back to FFD: %s", e)
+                    plan.materialize(list(pods))
                     return self._ffd_fallback.solve_injected(
                         constraints, instance_types, pods, daemon
                     )
-                prof["encode_s"] = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                result = self._pack(batch)
-                prof["pack_fetch_s"] = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                nodes = self._decode(batch, result, constraints, instance_types)
-                prof["decode_s"] = time.perf_counter() - t0
-                return nodes
-        finally:
-            restore_selectors(pods, saved)
+                finally:
+                    restore_selectors(pods, saved)
+            prof["encode_s"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            result, typemask = self._pack(batch)
+            prof["pack_fetch_s"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            nodes = self._decode(batch, result, typemask, constraints, instance_types)
+            prof["decode_s"] = time.perf_counter() - t0
+            return nodes
 
-    def _encode_retry(self, constraints, instance_types, pods, daemon) -> enc.EncodedBatch:
+    def _encode_retry(self, constraints, instance_types, pods, daemon, plan) -> enc.EncodedBatch:
         """Encode with the reusable cache; a cached table accumulates
         signatures across batches, so an overflow may be an accumulation
         artifact — drop the cache and retry fresh before declaring the
         batch itself too diverse."""
         try:
             return enc.encode(
-                constraints, instance_types, pods, daemon, cache=self._encode_cache
+                constraints, instance_types, pods, daemon, cache=self._encode_cache,
+                plan=plan,
             )
         except SignatureOverflow:
             self._encode_cache.clear()
             return enc.encode(
-                constraints, instance_types, pods, daemon, cache=self._encode_cache
+                constraints, instance_types, pods, daemon, cache=self._encode_cache,
+                plan=plan,
             )
 
     def _decode(
         self,
         batch: enc.EncodedBatch,
         result,
+        typemask,  # [N, T] bool from the fused dispatch, or None
         constraints: Constraints,
         instance_types: Sequence[InstanceType],
     ) -> List[VirtualNode]:
@@ -218,23 +315,28 @@ class TpuScheduler:
         scales = res.axis_scales(batch.axes)
         axis_names = res.RESOURCE_AXES + batch.axes
         live = sorted(pods_by_node)
-        # surviving types for ALL nodes in one batched comparison
+        # surviving types for ALL nodes: the fused dispatch computed the
+        # [N, T] mask on device; otherwise one batched host comparison
         # (signature-compatible ∧ fit the node total) — the per-node [T, R]
         # scan was the decode hot spot at 1k+ nodes
         if live:
             live_idx = np.asarray(live, np.int64)
-            totals = node_req[live_idx]  # [L, R]
-            fit_all = np.all(
-                batch.usable[None, :, :] >= totals[:, None, :], axis=-1
-            )  # [L, T]
-            mask_arr = batch.type_mask_matrix()  # [S_local, T]
-            mask_all = mask_arr[np.asarray(node_sig)[live_idx]]  # [L, T]
-            ok_all = fit_all & mask_all
+            if typemask is not None:
+                ok_all = typemask[live_idx]
+            else:
+                totals = node_req[live_idx]  # [L, R]
+                fit_all = np.all(
+                    batch.usable[None, :, :] >= totals[:, None, :], axis=-1
+                )  # [L, T]
+                mask_arr = batch.type_mask_matrix()  # [S_local, T]
+                mask_all = mask_arr[np.asarray(node_sig)[live_idx]]  # [L, T]
+                ok_all = fit_all & mask_all
+            types_arr = np.array(instance_types, dtype=object)
         nodes: List[VirtualNode] = []
         for row, n in enumerate(live):
             sig = batch.signatures[int(node_sig[n])]
             total = node_req[n]
-            surviving = [instance_types[t] for t in np.nonzero(ok_all[row])[0]]
+            surviving = list(types_arr[ok_all[row]])
             node_constraints = constraints.clone()
             reqs = sig.requirements
             h = int(node_host[n])

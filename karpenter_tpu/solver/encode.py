@@ -76,6 +76,12 @@ class EncodedBatch:
     hostnames: List[str]
     axes: List[str]
     usable: np.ndarray  # [T, R]
+    # compact transfer form: pod_req row i == uniq_req[pod_req_id[i]]; the
+    # fused TPU dispatch ships only the unique vectors + per-pod ids (a 10k
+    # batch has dozens of distinct request shapes, not 10k). The final
+    # uniq_req row is all-zero and backs the padding pods.
+    pod_req_id: np.ndarray = None  # [P] i32
+    uniq_req: np.ndarray = None  # [U+1, R] f32
 
     def type_mask_matrix(self) -> np.ndarray:
         """[S_local, T] stacked signature→type masks for THIS batch's
@@ -184,22 +190,51 @@ def encode(
     pods: Sequence[Pod],
     daemon: Dict[str, float],
     cache: Optional[EncodeCache] = None,
+    plan=None,
 ) -> EncodedBatch:
     """Build the dense solve request. ``instance_types`` must already be
-    price-sorted and ``pods`` FFD-sorted; topology decisions must already be
-    injected (both shared with the FFD path). Raises SignatureOverflow when
+    price-sorted and ``pods`` FFD-sorted. Raises SignatureOverflow when
     constraint diversity exceeds the closure cap (caller falls back to FFD).
+
+    Two input modes: with ``plan`` (a ``topology.DomainPlan``), topology
+    decisions are overlaid from the plan onto each pod's memoized statics —
+    zero pod mutation, the hot path. Without it, decisions must already be
+    materialized into the pods' nodeSelectors (legacy callers re-parse each
+    pod's spec).
     """
-    # resource axes: reserved + any extended resources in play (pod requests
-    # via the memoized accessor — a fresh resource_requests() per pod was a
-    # measurable slice of encode at 10k pods)
-    pod_requests = [res.requests_for_pods(p) for p in pods]  # reused in the loop
-    extras = res.collect_extra_axes(
-        [it.resources for it in instance_types]
-        + [it.overhead for it in instance_types]
-        + pod_requests
-        + [daemon]
-    )
+    from karpenter_tpu.scheduling.statics import merged_core, statics
+
+    # resource axes: reserved + any extended resources in play
+    if plan is not None:
+        # inject_plan already paid the statics pass over this exact list
+        if plan.sts is not None and plan._pods is pods:
+            sts = plan.sts
+        else:
+            sts = [statics(p) for p in pods]
+        pod_extras = set()
+        for st in sts:
+            if st.extra_res:
+                pod_extras |= st.extra_res
+        extras = sorted(
+            pod_extras
+            | set(
+                res.collect_extra_axes(
+                    [it.resources for it in instance_types]
+                    + [it.overhead for it in instance_types]
+                    + [daemon]
+                )
+            )
+        )
+        pod_requests = None
+    else:
+        sts = None
+        pod_requests = [res.requests_for_pods(p) for p in pods]
+        extras = res.collect_extra_axes(
+            [it.resources for it in instance_types]
+            + [it.overhead for it in instance_types]
+            + pod_requests
+            + [daemon]
+        )
     axes = extras  # extra axis names appended after the reserved block
     key = _table_key(constraints, instance_types, axes) if cache is not None else None
     cached = cache.get(key) if cache is not None else None
@@ -212,50 +247,131 @@ def encode(
         if cache is not None:
             cache.put(key, (usable, table))
 
-    # canonicalize pods; intern cores + hostnames
+    # canonicalize pods; intern cores + hostnames + request vectors.
+    # Plain python lists + one np.array at the end: 10k individual ndarray
+    # element stores were a measurable slice of encode.
     cores: List[Core] = []
     core_ids: Dict[Core, int] = {}
     hostnames: List[str] = []
     host_ids: Dict[str, int] = {}
+    host_in_base_by_id: List[bool] = []
+    req_ids: Dict[Tuple, int] = {}
+    uniq_vecs: List[np.ndarray] = []
 
     n = len(pods)
-    pod_core = np.zeros(n, np.int32)
-    pod_host = np.full(n, -1, np.int32)
-    pod_host_in_base = np.zeros(n, bool)
-    pod_open_host = np.full(n, -1, np.int32)
-    pod_req = np.zeros((n, usable.shape[1]), np.float32)
+    core_l = [0] * n
+    host_l = [-1] * n
+    hib_l = [False] * n
+    openh_l = [-1] * n
+    reqid_l = [0] * n
     base_has_hostname = constraints.requirements.has(lbl.HOSTNAME)
 
-    req_cache: Dict[Tuple, np.ndarray] = {}
+    # template collapse: pods sharing (selector/affinity template, injected
+    # non-hostname decisions) resolve their core through one identity-keyed
+    # dict hit; hostname and request id resolve through one more each
+    cid_cache: Dict[Tuple, Tuple] = {}
+    rid_cache: Dict[int, int] = {}
     for i, pod in enumerate(pods):
+        if plan is not None:
+            st = sts[i]
+            dec = plan.by_pod.get(id(pod))
+            # inline the common decision shapes: none, or a single
+            # hostname pin (spread/anti-affinity/ports) which contributes
+            # nothing to the zone token
+            if dec is None:
+                ztok = ()
+                dh = None
+            elif len(dec) == 1:
+                ((dk, dv),) = dec.items()
+                if dk == lbl.HOSTNAME:
+                    ztok = ()
+                    dh = dv
+                else:
+                    ztok = plan.zone_token(pod)
+                    dh = None
+            else:
+                ztok = plan.zone_token(pod)
+                dh = dec.get(lbl.HOSTNAME)
+            k2 = (id(st.merge_tid), id(ztok))
+            hit = cid_cache.get(k2)
+            if hit is None:
+                if ztok:
+                    core, base_host = merged_core(st, ztok)
+                else:
+                    core, base_host = st.core0, st.hostname0
+                cid = core_ids.get(core)
+                if cid is None:
+                    cid = len(cores)
+                    core_ids[core] = cid
+                    cores.append(core)
+                hit = cid_cache[k2] = (cid, base_host)
+            cid, base_host = hit
+            # hostname precedence mirrors the selector-merge order: folded
+            # affinity > injected decision > the pod's own selector
+            hostname = base_host if (dh is None or st.aff_hostname is not None) else dh
+            rid = rid_cache.get(id(st.req_tid))
+            if rid is None:
+                rid = req_ids.get(st.req_key)
+                if rid is None:
+                    rid = len(uniq_vecs)
+                    req_ids[st.req_key] = rid
+                    uniq_vecs.append(res.to_scaled_vector(st.req, axes))
+                rid_cache[id(st.req_tid)] = rid
+            core_l[i] = cid
+            reqid_l[i] = rid
+            if hostname is None:
+                continue
+            hid = host_ids.get(hostname)
+            if hid is None:
+                hid = len(hostnames)
+                host_ids[hostname] = hid
+                hostnames.append(hostname)
+                host_in_base_by_id.append(table.hostname_in_base(hostname))
+            host_l[i] = hid
+            in_base = host_in_base_by_id[hid]
+            hib_l[i] = in_base
+            openh_l[i] = hid if (in_base or not base_has_hostname) else -2
+            continue
         core, hostname = pod_core_and_hostname(pod)
+        requests = pod_requests[i]
+        rkey = tuple(sorted(requests.items()))
         cid = core_ids.get(core)
         if cid is None:
             cid = len(cores)
             core_ids[core] = cid
             cores.append(core)
-        pod_core[i] = cid
+        core_l[i] = cid
         if hostname is not None:
             hid = host_ids.get(hostname)
             if hid is None:
                 hid = len(hostnames)
                 host_ids[hostname] = hid
                 hostnames.append(hostname)
-            pod_host[i] = hid
-            in_base = table.hostname_in_base(hostname)
-            pod_host_in_base[i] = in_base
+                host_in_base_by_id.append(table.hostname_in_base(hostname))
+            host_l[i] = hid
+            in_base = host_in_base_by_id[hid]
+            hib_l[i] = in_base
             # node hostname state if this pod opens a node: joinable (h) when
             # the merged hostname set stays non-empty ({h}), poisoned (-2)
             # when the base domains exclude h (set intersects to ∅ — later
             # hostname pods can never match, reference requirements.go:175)
-            pod_open_host[i] = hid if (in_base or not base_has_hostname) else -2
-        requests = pod_requests[i]
-        rkey = tuple(sorted(requests.items()))
-        vec = req_cache.get(rkey)
-        if vec is None:
-            vec = res.to_scaled_vector(requests, axes)
-            req_cache[rkey] = vec
-        pod_req[i] = vec
+            openh_l[i] = hid if (in_base or not base_has_hostname) else -2
+        rid = req_ids.get(rkey)
+        if rid is None:
+            rid = len(uniq_vecs)
+            req_ids[rkey] = rid
+            uniq_vecs.append(res.to_scaled_vector(requests, axes))
+        reqid_l[i] = rid
+
+    pod_core = np.array(core_l, np.int32)
+    pod_host = np.array(host_l, np.int32)
+    pod_host_in_base = np.array(hib_l, bool)
+    pod_open_host = np.array(openh_l, np.int32)
+    R = usable.shape[1]
+    # final row = zeros, backing the padding pods
+    uniq_req = np.vstack(uniq_vecs + [np.zeros(R, np.float32)]).astype(np.float32)
+    pod_req_id_core = np.array(reqid_l, np.int32)
+    pod_req = uniq_req[pod_req_id_core]
 
     # signature closure over THIS batch's cores, scoped to the reachable
     # set and re-indexed densely: a cached table accumulates signatures and
@@ -292,7 +408,6 @@ def encode(
                 join_table[li, cid] = local[out]
 
     f_max = max((len(s.frontier) for s in signatures), default=1) or 1
-    R = usable.shape[1]
     frontiers = np.full((S, f_max, R), FRONTIER_PAD, np.float32)
     for li, s in enumerate(signatures):
         if len(s.frontier):
@@ -328,4 +443,7 @@ def encode(
         hostnames=hostnames,
         axes=axes,
         usable=usable,
+        # padding pods point at uniq_req's final all-zero row
+        pod_req_id=pad1(pod_req_id_core, len(uniq_vecs)),
+        uniq_req=uniq_req,
     )

@@ -1,0 +1,136 @@
+"""Fused single-dispatch solve parity (solver/fused.py): the compact i16
+upload + device gather + bit-packed typemask must reproduce exactly what the
+unfused path computes — the lax.scan PackResult plus decode's host-side
+surviving-type matrix. Runs on CPU (kernel="scan"); the chip runs the same
+wrapper with kernel="pallas"."""
+
+import random
+
+import numpy as np
+import pytest
+
+
+def encoded_batch(n_pods, seed=42, n_types=50):
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(n_types), key=lambda it: it.effective_price())
+    provisioner = make_provisioner(solver="tpu")
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cc = c.clone()
+    topo = Topology(Cluster(), rng=random.Random(1))
+    plan = topo.inject_plan(cc, pods)
+    daemon = daemon_overhead(Cluster(), cc)
+    return enc.encode(cc, catalog, pods, daemon, plan=plan)
+
+
+@pytest.mark.parametrize("n_pods,n_max,seed", [(60, 64, 1), (300, 128, 2), (900, 256, 3)])
+def test_fused_matches_unfused(n_pods, n_max, seed):
+    import jax
+
+    from karpenter_tpu.solver import fused
+    from karpenter_tpu.solver import kernel as K
+
+    batch = encoded_batch(n_pods, seed=seed)
+    assert fused.ids_fit(batch)
+
+    # unfused reference: scan kernel + host typemask
+    ref = K.pack(*batch.pack_args(), n_max=n_max)
+    ref = K.PackResult(*(np.asarray(a) for a in ref))
+    mask_arr = batch.type_mask_matrix()
+    fits = np.all(
+        ref.node_req[:, None, :] <= batch.usable[None, :, :], axis=-1
+    )
+    ref_mask = (
+        mask_arr[np.clip(ref.node_sig, 0, None)]
+        & fits
+        & (ref.node_sig >= 0)[:, None]
+    )
+
+    # fused: compact upload, one dispatch, one buffer
+    pod_tab = fused.pack_pod_table(batch)
+    assert pod_tab.dtype == np.int16
+    uniq = batch.uniq_req
+    # the compact upload must be materially smaller than the old 10-array ship
+    assert pod_tab.nbytes + uniq.nbytes < batch.pod_req.nbytes
+    buf = jax.device_get(
+        fused.fused_solve(
+            pod_tab, uniq,
+            batch.join_table.astype(np.int32),
+            batch.frontiers.astype(np.float32),
+            batch.daemon.astype(np.float32),
+            mask_arr.astype(bool),
+            batch.usable.astype(np.float32),
+            n_max=n_max, kernel="scan",
+        )
+    )
+    got, got_mask = fused.split_fused(
+        buf, len(batch.pod_valid), n_max, batch.usable.shape[1], batch.usable.shape[0]
+    )
+
+    np.testing.assert_array_equal(np.asarray(got.assignment), ref.assignment)
+    np.testing.assert_array_equal(np.asarray(got.node_sig), ref.node_sig)
+    np.testing.assert_array_equal(np.asarray(got.node_host), ref.node_host)
+    np.testing.assert_array_equal(np.asarray(got.node_req), ref.node_req)
+    assert int(got.n_nodes) == int(ref.n_nodes)
+    np.testing.assert_array_equal(got_mask, ref_mask)
+
+
+def test_device_invariants_cache_hits_by_content():
+    from karpenter_tpu.solver import fused
+
+    b1 = encoded_batch(60, seed=1)
+    b2 = encoded_batch(60, seed=1)
+    cache = fused.DeviceInvariants()
+    a = cache.get(b1)
+    b = cache.get(b2)  # same content, different objects -> same device arrays
+    assert all(x is y for x, y in zip(a, b))
+    assert len(cache._cache) == 1
+
+
+def test_backend_solve_uses_fused_typemask_on_scan(monkeypatch):
+    """Drive TpuScheduler.solve end-to-end with the fused path forced on
+    (scan kernel, CPU) and assert assignment parity with the FFD oracle."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import FFDScheduler
+    from karpenter_tpu.solver import backend as bk
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    monkeypatch.setattr(
+        bk.TpuScheduler, "_fused_eligible", lambda self, batch: True
+    )
+    catalog = instance_types(50)
+    provisioner = make_provisioner(solver="tpu")
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    pods = diverse_pods(300, random.Random(7))
+
+    tpu_nodes = bk.TpuScheduler(Cluster(), rng=random.Random(1)).solve(
+        c.clone(), catalog, list(pods)
+    )
+    ffd_nodes = FFDScheduler(Cluster(), rng=random.Random(1)).solve(
+        c.clone(), catalog, list(pods)
+    )
+    assert len(tpu_nodes) == len(ffd_nodes)
+    tpu_sets = sorted(sorted(p.key for p in n.pods) for n in tpu_nodes)
+    ffd_sets = sorted(sorted(p.key for p in n.pods) for n in ffd_nodes)
+    assert tpu_sets == ffd_sets
+    # surviving-type options agree too (fused typemask vs FFD narrowing)
+    tpu_opts = {
+        tuple(sorted(p.key for p in n.pods)): sorted(t.name for t in n.instance_type_options)
+        for n in tpu_nodes
+    }
+    ffd_opts = {
+        tuple(sorted(p.key for p in n.pods)): sorted(t.name for t in n.instance_type_options)
+        for n in ffd_nodes
+    }
+    assert tpu_opts == ffd_opts
