@@ -132,6 +132,10 @@ class TpuScheduler:
         sidecar takes precedence (its own process owns the device), and a
         shape whose fused compile/run already failed stays on the unfused
         ladder."""
+        import os
+
+        if os.environ.get("KARPENTER_PACKER", "auto").lower() not in ("auto", "fused"):
+            return False
         if self.service_address and time.monotonic() >= self._remote_down_until:
             return False
         from karpenter_tpu.solver import fused

@@ -267,8 +267,21 @@ def pack_best(*args, n_max: int) -> PackResult:
     """The fastest available packing kernel per platform: Pallas on TPU
     (≈4× the lax.scan kernel at 10k pods), the native C++ packer on CPU
     (the reference's in-process FFD loop over the tensor encoding), and
-    lax.scan as the universal fallback."""
+    lax.scan as the universal fallback. ``KARPENTER_PACKER`` forces a
+    specific kernel (native | scan | pallas | auto) — benchmarking and
+    incident escape hatch."""
+    import os
+
     from karpenter_tpu.solver import kernel as _k
+
+    forced = os.environ.get("KARPENTER_PACKER", "auto").lower()
+    if forced == "native":
+        from karpenter_tpu.solver import native
+
+        native.native_available(wait=180)  # forced: block for the g++ build
+        return native.pack_native(*args, n_max=n_max)
+    if forced == "scan":
+        return _k.pack(*args, n_max=n_max)
 
     P = args[6].shape[0]  # pod_req
     S, F = args[8].shape[0], args[8].shape[1]  # frontiers
