@@ -158,3 +158,27 @@ def test_v2_parity_on_real_encoded_batch():
     out = jax.device_get(tuple(pack_pallas_v2(*args, n_max=n_max)))
     for name, a, b in zip(kernel.PackResult._fields, ref, out):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_v2_multi_solve_route_parity():
+    """The sharded multi-solve's v2 route (VERDICT r2 #4): a stacked
+    constraint-diverse batch solved by the per-shard v2 kernel must match
+    the vmapped lax.scan kernel exactly."""
+    import jax
+
+    from karpenter_tpu.parallel import sharding as sh
+
+    # identical batches → identical closure shapes across the stack (the
+    # production multi-solve stacks same-bucket batches; differing S would
+    # not stack). Parity is per-batch, so duplication loses nothing.
+    stacks = [encoded_batch(300, seed=3), encoded_batch(300, seed=3)]
+    arrays = tuple(np.stack([np.asarray(s[i]) for s in stacks]) for i in range(10))
+    mesh = sh.make_solver_mesh()
+    n_max = 128
+    got = sh._pallas_v2_multi(mesh, arrays, n_max=n_max)
+    ref = sh._packed_multi(*[jax.device_put(a) for a in arrays], n_max=n_max)
+    for name in ("assignment", "node_sig", "node_host", "node_req", "n_nodes"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            err_msg=name,
+        )
