@@ -295,3 +295,18 @@ class Lease:
     acquire_time: Optional[float] = None
     renew_time: Optional[float] = None
     lease_transitions: int = 0
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    """admissionregistration.k8s.io/v1 — the apiserver-side registration of
+    the admission webhook (reference: knative certificates.NewController
+    keeps clientConfig.caBundle current, cmd/webhook/main.go:46-63).
+
+    ``webhooks`` entries are kept as RAW wire dicts: the caBundle
+    reconciler only rewrites ``clientConfig.caBundle`` and must round-trip
+    every other field (rules, sideEffects, admissionReviewVersions, ...)
+    byte-for-byte."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Dict[str, object]] = field(default_factory=list)

@@ -51,6 +51,12 @@ RESOURCES: Dict[str, Tuple[str, str]] = {
     "storageclasses": ("/apis/storage.k8s.io/v1", "storageclasses"),
     "pdbs": ("/apis/policy/v1", "poddisruptionbudgets"),
     "leases": ("/apis/coordination.k8s.io/v1", "leases"),
+    "validatingwebhookconfigurations": (
+        "/apis/admissionregistration.k8s.io/v1", "validatingwebhookconfigurations",
+    ),
+    "mutatingwebhookconfigurations": (
+        "/apis/admissionregistration.k8s.io/v1", "mutatingwebhookconfigurations",
+    ),
 }
 
 WATCH_RECONNECT_DELAY = 1.0
@@ -61,13 +67,19 @@ WATCH_READ_TIMEOUT = 60.0
 # with a clean EOF (resumable from the last RV) rather than a socket timeout
 WATCH_TIMEOUT_SECONDS = 45
 
-# Kinds the informer plane watches by default: everything EXCEPT leases.
-# Leader election reads its Lease with uncached get_live (kube/leader.py), so
-# a lease informer is dead weight — it would churn on every node-heartbeat
-# lease cluster-wide AND requires list/watch RBAC the shipped manifests
-# deliberately do not grant (deploy/rbac.yaml grants leases get/create/update
-# only); watching it 403s forever and fails wait_for_sync.
-WATCH_KINDS = tuple(k for k in Cluster.KINDS if k != "leases")
+# Kinds the informer plane watches by default: everything EXCEPT leases and
+# webhook registrations. Leader election reads its Lease with uncached
+# get_live (kube/leader.py) and the caBundle reconciler reads its
+# registration the same way, so informers there are dead weight — leases
+# would churn on every node-heartbeat cluster-wide, and BOTH require
+# list/watch RBAC the shipped manifests deliberately do not grant (watching
+# without it 403s forever and fails wait_for_sync).
+WATCH_KINDS = tuple(
+    k for k in Cluster.KINDS
+    if k not in (
+        "leases", "validatingwebhookconfigurations", "mutatingwebhookconfigurations",
+    )
+)
 
 
 class ApiError(Exception):

@@ -71,6 +71,12 @@ KIND_INFO: Dict[str, Any] = {
     "storageclasses": ("storage.k8s.io/v1", "StorageClass", False),
     "pdbs": ("policy/v1", "PodDisruptionBudget", True),
     "leases": ("coordination.k8s.io/v1", "Lease", True),
+    "validatingwebhookconfigurations": (
+        "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", False,
+    ),
+    "mutatingwebhookconfigurations": (
+        "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration", False,
+    ),
 }
 
 
@@ -767,6 +773,20 @@ def _lease_from_wire(doc: Dict[str, Any]) -> Lease:
     )
 
 
+def _vwc_to_wire(obj) -> Dict[str, Any]:
+    # webhooks entries are raw wire dicts (see api.objects) — passthrough
+    return {"webhooks": [dict(w) for w in obj.webhooks]}
+
+
+def _vwc_from_wire(doc: Dict[str, Any]):
+    from karpenter_tpu.api.objects import ValidatingWebhookConfiguration
+
+    return ValidatingWebhookConfiguration(
+        metadata=meta_from_wire(doc.get("metadata") or {}),
+        webhooks=[dict(w) for w in doc.get("webhooks") or []],
+    )
+
+
 _TO = {
     "pods": _pod_to_wire,
     "nodes": _node_to_wire,
@@ -777,6 +797,8 @@ _TO = {
     "storageclasses": _storageclass_to_wire,
     "pdbs": _pdb_to_wire,
     "leases": _lease_to_wire,
+    "validatingwebhookconfigurations": _vwc_to_wire,
+    "mutatingwebhookconfigurations": _vwc_to_wire,
 }
 
 _FROM = {
@@ -789,6 +811,8 @@ _FROM = {
     "storageclasses": _storageclass_from_wire,
     "pdbs": _pdb_from_wire,
     "leases": _lease_from_wire,
+    "validatingwebhookconfigurations": _vwc_from_wire,
+    "mutatingwebhookconfigurations": _vwc_from_wire,
 }
 
 

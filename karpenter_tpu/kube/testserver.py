@@ -44,6 +44,8 @@ PLURALS: Dict[str, str] = {
     "storageclasses": "storageclasses",
     "poddisruptionbudgets": "pdbs",
     "leases": "leases",
+    "validatingwebhookconfigurations": "validatingwebhookconfigurations",
+    "mutatingwebhookconfigurations": "mutatingwebhookconfigurations",
 }
 
 

@@ -307,9 +307,18 @@ def main(argv=None) -> None:
     ap.add_argument("--service-name", default="karpenter-tpu-webhook")
     ap.add_argument("--service-namespace", default="karpenter")
     ap.add_argument("--no-tls", action="store_true", help="plain HTTP (dev only)")
+    ap.add_argument("--kube-api-server", default="",
+                    help="'in-cluster' or an apiserver URL; enables runtime "
+                         "caBundle self-reconciliation of the webhook "
+                         "registrations (reference: cmd/webhook/main.go:46-63)")
+    ap.add_argument("--webhook-config", action="append", default=[],
+                    metavar="KIND:NAME",
+                    help="webhook registration to keep current, e.g. "
+                         "validating:validation.webhook.provisioners.karpenter.sh "
+                         "(repeatable; defaults to the two shipped registrations)")
     args = ap.parse_args(argv)
     provider = registry.new_cloud_provider(args.cloud_provider)
-    tls_cert = tls_key = None
+    tls_cert = tls_key = ca_path = None
     if not args.no_tls:
         from karpenter_tpu.kube.certs import ensure_serving_cert
 
@@ -321,6 +330,31 @@ def main(argv=None) -> None:
         ]
         tls_cert, tls_key, ca_path = ensure_serving_cert(args.cert_dir, dns)
         print(f"serving cert ready; caBundle at {ca_path}")
+    reconciler = None
+    if args.kube_api_server and ca_path:
+        from karpenter_tpu.kube.apiserver import ApiCluster
+        from karpenter_tpu.kube.cabundle import CABundleReconciler
+
+        _KIND_ALIASES = {
+            "validating": "validatingwebhookconfigurations",
+            "mutating": "mutatingwebhookconfigurations",
+        }
+        specs = args.webhook_config or [
+            "mutating:defaulting.webhook.provisioners.karpenter.sh",
+            "validating:validation.webhook.provisioners.karpenter.sh",
+        ]
+        configs = []
+        for spec in specs:
+            kind, _, name = spec.partition(":")
+            configs.append((_KIND_ALIASES.get(kind, kind), name))
+        if args.kube_api_server == "in-cluster":
+            cluster = ApiCluster.from_env()
+        else:
+            cluster = ApiCluster(args.kube_api_server)
+        # no informer start: the reconciler reads live and patches — the
+        # webhook RBAC grants only get/update/patch on admissionregistration
+        reconciler = CABundleReconciler(cluster, configs, ca_path).start()
+        print(f"caBundle reconciler running for {configs}")
     server = serve(
         Webhook(provider, default_solver=args.default_solver),
         args.address,
@@ -332,6 +366,8 @@ def main(argv=None) -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.shutdown()
+        if reconciler is not None:
+            reconciler.stop()
 
 
 if __name__ == "__main__":
