@@ -25,8 +25,52 @@ from karpenter_tpu.kube.testserver import TestApiServer, merge_patch
 from tests.factories import make_node, make_pod, make_provisioner
 
 
+class _ExternalEnv:
+    """Conformance escape hatch (VERDICT r2 #5): run this suite against a
+    REAL kube-apiserver instead of the in-process protocol double —
+
+        KARPENTER_TEST_APISERVER=http://127.0.0.1:8001 pytest tests/test_apiserver.py
+
+    (e.g. `kubectl proxy` against a kind/minikube scratch cluster with the
+    karpenter.sh CRD from deploy/crd.yaml applied). The suite creates
+    fixed-name objects, so point it at a disposable cluster. A protocol
+    double written by the client's own author cannot catch shared
+    misunderstandings of field casing, patch semantics, or subresource
+    status codes — a periodic run of this suite against the real thing can.
+    """
+
+    def __init__(self, url: str):
+        self.url = url
+        self._clients = []
+        # the server-side handle tests use for direct setup/assertions is
+        # just another client of the real apiserver
+        self.cluster = self._new_client()
+
+    def _new_client(self, **kw) -> ApiCluster:
+        c = ApiCluster(self.url, **kw)
+        c.start()
+        assert c.wait_for_sync(30)
+        self._clients.append(c)
+        return c
+
+    def connect(self, **kw) -> ApiCluster:
+        return self._new_client(**kw)
+
+    def stop(self) -> None:
+        for c in self._clients:
+            c.stop()
+
+
 @pytest.fixture()
 def env():
+    import os
+
+    external = os.environ.get("KARPENTER_TEST_APISERVER")
+    if external:
+        e = _ExternalEnv(external)
+        yield e
+        e.stop()
+        return
     server = TestApiServer()
     server.start()
     clients = []

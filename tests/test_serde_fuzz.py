@@ -1,0 +1,189 @@
+"""Serde fuzz round-trips, validated against the shipped CRD schema.
+
+Randomized Provisioner objects (requirements algebra, taints, limits,
+kubelet config, provider blocks) must (a) survive to_wire → from_wire →
+to_wire byte-identically, and (b) produce wire documents the CRD's
+openAPIV3Schema accepts — the same contract a real apiserver enforces at
+admission (VERDICT r2 #5: conformance beyond the self-authored double).
+The validator is a small structural interpreter of deploy/crd.yaml, so a
+schema/serde drift fails here before it fails against a cluster.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Taint
+from karpenter_tpu.api.provisioner import (
+    Constraints,
+    KubeletConfiguration,
+    Limits,
+    Provisioner,
+    ProvisionerSpec,
+)
+from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.kube import serde
+
+CRD_PATH = os.path.join(os.path.dirname(__file__), "..", "deploy", "crd.yaml")
+
+
+# -- minimal openAPIV3Schema interpreter ------------------------------------
+
+def _load_crd_schema():
+    import yaml
+
+    with open(CRD_PATH) as f:
+        doc = yaml.safe_load(f)
+    return doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+
+
+def validate(doc, schema, path="$"):
+    """Structural check against the subset of openAPIV3Schema the CRD uses:
+    type, properties, items, additionalProperties, enum, minimum, anyOf,
+    x-kubernetes-preserve-unknown-fields."""
+    errs = []
+    if "anyOf" in schema:
+        subs = [validate(doc, s, path) for s in schema["anyOf"]]
+        if all(subs):
+            errs.append(f"{path}: matches no anyOf branch ({subs[0][0]})")
+        return errs
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(doc, dict):
+            return [f"{path}: expected object, got {type(doc).__name__}"]
+        props = schema.get("properties", {})
+        addl = schema.get("additionalProperties")
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields")
+        for k, v in doc.items():
+            if k in props:
+                errs += validate(v, props[k], f"{path}.{k}")
+            elif addl is not None and isinstance(addl, dict):
+                errs += validate(v, addl, f"{path}.{k}")
+            elif preserve or addl is True:
+                continue
+            elif props:
+                errs.append(f"{path}.{k}: unknown field")
+        for k in schema.get("required", []):
+            if k not in doc:
+                errs.append(f"{path}.{k}: required")
+    elif t == "array":
+        if not isinstance(doc, list):
+            return [f"{path}: expected array, got {type(doc).__name__}"]
+        for i, v in enumerate(doc):
+            errs += validate(v, schema.get("items", {}), f"{path}[{i}]")
+    elif t == "string":
+        if not isinstance(doc, str):
+            return [f"{path}: expected string, got {type(doc).__name__}"]
+        if "enum" in schema and doc not in schema["enum"]:
+            errs.append(f"{path}: {doc!r} not in enum {schema['enum']}")
+    elif t == "integer":
+        if not isinstance(doc, int) or isinstance(doc, bool):
+            return [f"{path}: expected integer, got {type(doc).__name__}"]
+        if "minimum" in schema and doc < schema["minimum"]:
+            errs.append(f"{path}: {doc} < minimum {schema['minimum']}")
+    return errs
+
+
+# -- fuzz generator ---------------------------------------------------------
+
+KEYS = ["kubernetes.io/arch", "kubernetes.io/os", "topology.kubernetes.io/zone",
+        "node.kubernetes.io/instance-type", "karpenter.sh/capacity-type", "team"]
+VALUES = ["a", "b", "zone-1", "zone-2", "amd64", "arm64", "linux", "spot", "on-demand"]
+
+
+def random_provisioner(rng: random.Random) -> Provisioner:
+    reqs = [
+        NodeSelectorRequirement(
+            key=rng.choice(KEYS),
+            operator=rng.choice(["In", "NotIn", "Exists"]),
+            values=(
+                sorted(rng.sample(VALUES, rng.randint(1, 3)))
+                if rng.random() < 0.8 else []
+            ),
+        )
+        for _ in range(rng.randint(0, 4))
+    ]
+    for r in reqs:
+        if r.operator == "Exists":
+            r.values = []
+        elif not r.values:
+            r.values = [rng.choice(VALUES)]
+    taints = [
+        Taint(
+            key=f"taint-{rng.randint(0, 3)}",
+            value=rng.choice(["", "x", "y"]),
+            effect=rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+        )
+        for _ in range(rng.randint(0, 2))
+    ]
+    limits = None
+    if rng.random() < 0.5:
+        limits = Limits(resources={
+            "cpu": float(rng.randint(1, 1000)),
+            "memory": float(rng.randint(1, 64) * 2**30),
+        })
+    spec = ProvisionerSpec(
+        constraints=Constraints(
+            labels={f"l{i}": rng.choice(VALUES) for i in range(rng.randint(0, 2))},
+            taints=taints,
+            requirements=Requirements.new(*reqs),
+            kubelet_configuration=(
+                KubeletConfiguration(cluster_dns=["10.0.0.10"])
+                if rng.random() < 0.3 else None
+            ),
+            provider=(
+                {"instanceProfile": "x", "tags": {"a": "b"}}
+                if rng.random() < 0.4 else None
+            ),
+        ),
+        ttl_seconds_after_empty=rng.choice([None, 0, 30, 600]),
+        ttl_seconds_until_expired=rng.choice([None, 60, 2592000]),
+        solver=rng.choice(["", "ffd", "tpu"]),
+        limits=limits,
+    )
+    from karpenter_tpu.api.objects import ObjectMeta
+
+    return Provisioner(metadata=ObjectMeta(name=f"fuzz-{rng.randint(0, 10**6)}"), spec=spec)
+
+
+SCHEMA = _load_crd_schema()
+
+
+def test_crd_schema_loaded_sanely():
+    spec_schema = SCHEMA["properties"]["spec"]
+    assert spec_schema["type"] == "object"
+    assert "requirements" in spec_schema["properties"]
+    ops = spec_schema["properties"]["requirements"]["items"]["properties"]["operator"]["enum"]
+    assert ops == ["In", "NotIn", "Exists"]
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzzed_provisioner_round_trip_and_schema(seed):
+    rng = random.Random(seed)
+    p = random_provisioner(rng)
+    wire1 = serde.to_wire("provisioners", p)
+    errs = validate(wire1, SCHEMA, "$")
+    # apiVersion/kind/metadata are validated apiserver-side (TypeMeta +
+    # ObjectMeta), outside the CRD's structural schema
+    errs = [
+        e for e in errs
+        if not e.startswith(("$.metadata", "$.apiVersion", "$.kind"))
+    ]
+    assert not errs, errs
+    back = serde.from_wire("provisioners", wire1)
+    wire2 = serde.to_wire("provisioners", back)
+    assert wire1 == wire2, "to_wire → from_wire → to_wire must be a fixed point"
+
+
+def test_known_bad_documents_rejected():
+    base = serde.to_wire("provisioners", random_provisioner(random.Random(1)))
+    bad_op = json.loads(json.dumps(base))
+    bad_op.setdefault("spec", {}).setdefault("requirements", []).append(
+        {"key": "k", "operator": "Gt", "values": ["1"]}
+    )
+    assert any("enum" in e for e in validate(bad_op, SCHEMA, "$"))
+    bad_ttl = json.loads(json.dumps(base))
+    bad_ttl["spec"]["ttlSecondsAfterEmpty"] = -5
+    assert any("minimum" in e for e in validate(bad_ttl, SCHEMA, "$"))
