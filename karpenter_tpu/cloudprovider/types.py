@@ -39,6 +39,10 @@ class InstanceType:
     resources: Dict[str, float] = field(default_factory=dict)
     overhead: Dict[str, float] = field(default_factory=dict)
     price: Optional[float] = None
+    # vendor-declared node labels that participate in requirement
+    # compatibility (e.g. GKE's cloud.google.com/gke-tpu-topology): a
+    # requirement on a declared key must accept the type's value
+    labels: Dict[str, str] = field(default_factory=dict)
 
     def effective_price(self) -> float:
         """Explicit price, else the cpu+mem+gpu formula the fake catalog uses

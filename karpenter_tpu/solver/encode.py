@@ -170,6 +170,7 @@ def catalog_fingerprint(instance_types: Sequence[InstanceType]) -> Tuple:
             tuple(sorted(it.resources.items())),
             tuple(sorted(it.overhead.items())),
             it.price,
+            tuple(sorted(it.labels.items())),
         )
         for it in instance_types
     )

@@ -155,7 +155,7 @@ class TestGkeLaunch:
         nodes = cluster.nodes()
         assert all(GKE_TPU_ACCELERATOR_LABEL in n.metadata.labels for n in nodes)
         for p in cluster.pods():
-            assert p.spec.node_name.startswith("gke-node-")
+            assert p.spec.node_name.startswith("gke-np-")
 
     def test_unsatisfiable_offering_raises(self):
         from karpenter_tpu.api.objects import NodeSelectorRequirement
@@ -170,3 +170,157 @@ class TestGkeLaunch:
         )
         with pytest.raises(ValueError, match="no offering"):
             provider.create(NodeRequest(template=c, instance_type_options=catalog))
+
+
+class TestGkeStockoutAndMultiHost:
+    """SimGkeAPI-backed vendor depth (VERDICT r2 #6): stockout -> ICE cache
+    -> offering fallback, atomic multi-host podslice launches, and a
+    multi-host slice landing as N bound nodes."""
+
+    def _request(self, it, zones=None, capacity=("on-demand",)):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.api.requirements import Requirements
+
+        reqs = [NodeSelectorRequirement(key=lbl.CAPACITY_TYPE, operator="In",
+                                        values=list(capacity))]
+        if zones:
+            reqs.append(NodeSelectorRequirement(key=lbl.TOPOLOGY_ZONE, operator="In",
+                                                values=list(zones)))
+        return NodeRequest(
+            template=Constraints(requirements=Requirements.new(*reqs)),
+            instance_type_options=[it],
+        )
+
+    def test_stockout_falls_through_to_next_zone_and_ice_caches(self):
+        from karpenter_tpu.cloudprovider.gke import ZONES, SimGkeAPI
+        from karpenter_tpu.utils.ttlcache import TTLCache
+
+        now = [0.0]
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api, clock=lambda: now[0])
+        it = next(t for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-4t")
+        api.set_stockout("ct5lp-hightpu-4t", ZONES[0])
+
+        node = provider.create(self._request(it))
+        # landed in the NEXT zone after the stocked-out one
+        assert node.metadata.labels[lbl.TOPOLOGY_ZONE] == ZONES[1]
+        # the stocked-out offering (zone a, on-demand) is ICE-cached OUT of
+        # the catalog — per (zone, capacity type), so zone a's SPOT offering
+        # legitimately remains purchasable
+        def od_zones():
+            return {
+                o.zone
+                for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-4t"
+                for o in t.offerings if o.capacity_type == "on-demand"
+            }
+
+        assert ZONES[0] not in od_zones()
+        # ... and returns after the 45s TTL
+        now[0] += 46.0
+        assert ZONES[0] in od_zones()
+
+    def test_total_stockout_raises_classified_error(self):
+        from karpenter_tpu.cloudprovider.gke import ZONES, GkeStockoutError, SimGkeAPI
+
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api)
+        it = next(t for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-1t")
+        for z in ZONES:
+            api.set_stockout("ct5lp-hightpu-1t", z)
+        with pytest.raises(GkeStockoutError):
+            provider.create(self._request(it))
+
+    def test_multi_host_slice_is_one_atomic_pool(self):
+        from karpenter_tpu.cloudprovider.gke import GKE_NODEPOOL_LABEL, SimGkeAPI
+
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api)
+        it = next(
+            t for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-4t-4x4"
+        )
+        req = self._request(it)
+        nodes = [provider.create(req) for _ in range(4)]
+        # ONE atomic node-pool create of count=4, not four pools
+        assert len(api.create_calls) == 1
+        assert api.create_calls[0].count == 4
+        assert api.create_calls[0].tpu_topology == "4x4"
+        # all four nodes share the topology and the pool
+        pools = {n.metadata.labels[GKE_NODEPOOL_LABEL] for n in nodes}
+        assert len(pools) == 1
+        assert {n.metadata.labels[GKE_TPU_TOPOLOGY_LABEL] for n in nodes} == {"4x4"}
+        assert len({n.metadata.name for n in nodes}) == 4
+        # a fifth create starts a NEW slice
+        provider.create(req)
+        assert len(api.create_calls) == 2
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_multi_host_workload_lands_as_n_bound_nodes(self, solver):
+        """Four pods, one per host of a 4x4 v5e podslice, selected via the
+        gke-tpu-topology label + hostname anti-affinity (one worker per
+        host): the controller binds them onto 4 nodes of ONE node pool."""
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+        from karpenter_tpu.cloudprovider.gke import GKE_NODEPOOL_LABEL, SimGkeAPI
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.kube.client import Cluster
+
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api)
+        cluster = Cluster()
+        provisioner = make_provisioner(solver=solver)
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        cluster.create("provisioners", provisioner)
+        controller.reconcile(provisioner.metadata.name)
+        worker = controller.workers[provisioner.metadata.name]
+
+        sel = {"job": "trainer"}
+        pods = []
+        for i in range(4):
+            p = make_pod(
+                name=f"worker-{i}",
+                labels=sel,
+                requests={"cpu": "8", TPU_RESOURCE: "4"},
+                node_selector={GKE_TPU_TOPOLOGY_LABEL: "4x4"},
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels=sel),
+                        topology_key=lbl.HOSTNAME,
+                    )
+                ],
+            )
+            cluster.create("pods", p)
+            pods.append(p)
+            worker.add(p)
+        worker.batcher.idle_duration = 0.05
+        vnodes = worker.provision_once()
+        controller.stop()
+
+        assert sum(len(v.pods) for v in vnodes) == 4
+        nodes = cluster.nodes()
+        assert len(nodes) == 4
+        # every node is a host of the SAME atomic podslice
+        assert len(api.create_calls) == 1 and api.create_calls[0].count == 4
+        assert {n.metadata.labels[GKE_NODEPOOL_LABEL] for n in nodes} == {
+            api.create_calls[0].name
+        }
+        assert {n.metadata.labels[GKE_TPU_TOPOLOGY_LABEL] for n in nodes} == {"4x4"}
+        assert {n.metadata.labels[lbl.INSTANCE_TYPE] for n in nodes} == {
+            "ct5lp-hightpu-4t-4x4"
+        }
+        bound = {p.spec.node_name for p in cluster.pods()}
+        assert len(bound) == 4 and all(bound)
+
+    def test_topology_selector_routes_to_the_matching_slice_shape(self):
+        """A pod selecting gke-tpu-topology=4x4 must ONLY fit the 4x4 slice
+        shape — the vendor-declared type labels participate in requirement
+        compatibility (types with a different declared topology are out)."""
+        pods = [
+            make_pod(
+                requests={"cpu": "8", TPU_RESOURCE: "4"},
+                node_selector={GKE_TPU_TOPOLOGY_LABEL: "4x4"},
+            )
+        ]
+        vnodes = solve(pods, "ffd")
+        assert len(vnodes) == 1
+        names = {t.name for t in vnodes[0].instance_type_options}
+        assert names == {"ct5lp-hightpu-4t-4x4"}
