@@ -67,6 +67,8 @@ class ProvisionerWorker:
             cluster, solver_service_address=solver_service_address
         )
         self.batcher = batcher or Batcher()
+        self._pending_lock = threading.Lock()
+        self._pending_keys: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # set once the TPU solver warmup finished (success or failure) —
@@ -92,9 +94,20 @@ class ProvisionerWorker:
             instance_types = self.cloud_provider.get_instance_types(
                 self.provisioner.spec.constraints.provider
             )
-            pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(4)]
+            # on a real accelerator, warm the FULL batch bucket (the batcher
+            # caps batches at max_items, so the first event storm solves in
+            # that shape bucket — warming only a tiny bucket would leave the
+            # storm to pay the multi-second compile); CPU test runs keep the
+            # small bucket, their scan-kernel compile at 2048 is too slow
+            from karpenter_tpu.solver.pallas_kernel import pallas_available
+
+            n_warm = self.batcher.max_items if pallas_available() else 4
+            pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(n_warm)]
             self.scheduler.solve(self.provisioner, instance_types, pods)
-            logger.debug("solver warmed for provisioner %s", self.provisioner.name)
+            logger.debug(
+                "solver warmed for provisioner %s (%d-pod bucket)",
+                self.provisioner.name, n_warm,
+            )
         except Exception:
             logger.exception("solver warmup failed (first batch will compile)")
         finally:
@@ -118,17 +131,37 @@ class ProvisionerWorker:
 
     # -- API ---------------------------------------------------------------
     def add(self, pod: Pod) -> threading.Event:
-        """Enqueue a pod; returns the gate the selection reconciler blocks on
-        (reference: provisioner.go:77-79)."""
+        """Enqueue a pod; returns the gate the selection reconciler MAY block
+        on (reference: provisioner.go:77-79). The pod's key is tracked as
+        pending until its batch has been solved, so a non-blocking selection
+        can tell "awaiting its batch" from "needs another round"."""
+        with self._pending_lock:
+            self._pending_keys.add(pod.key)
         return self.batcher.add(pod)
+
+    def is_pending(self, key) -> bool:
+        """Is this pod enqueued or in the batch currently being solved?"""
+        with self._pending_lock:
+            return key in self._pending_keys
 
     # -- the provision loop ------------------------------------------------
     def provision_once(self) -> List[VirtualNode]:
         # flush unconditionally so gate waiters never stall on a failed solve
         # (reference: provisioner.go:84 `defer p.batcher.Flush()`)
+        batch_keys = ()
         try:
             pods, _window = self.batcher.wait()
-            pods = [p for p in pods if is_provisionable(p)]
+            batch_keys = {p.key for p in pods}
+            # dedupe by key: watch-event storms and verify requeues can
+            # enqueue the same (or a replaced) pod object twice; double
+            # inclusion would double its requests in the solve
+            seen = set()
+            unique = []
+            for p in pods:
+                if is_provisionable(p) and p.key not in seen:
+                    seen.add(p.key)
+                    unique.append(p)
+            pods = unique
             if not pods:
                 return []
             metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
@@ -146,6 +179,8 @@ class ProvisionerWorker:
                     self.cluster.update("provisioners", live)
             return nodes
         finally:
+            with self._pending_lock:
+                self._pending_keys -= set(batch_keys)
             self.batcher.flush()
 
     def _launch(self, vnode: VirtualNode) -> bool:

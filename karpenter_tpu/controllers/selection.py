@@ -261,7 +261,15 @@ class SelectionController:
         self.preferences = Preferences(clock=clock)
         self.volume_topology = VolumeTopology(cluster)
         self.allow_pod_affinity = allow_pod_affinity
-        self.wait = wait  # tests drive workers inline; don't block on gates
+        # wait=True blocks each reconcile on the batch gate, the reference's
+        # goroutine idiom (controller.go:86-115: 10k goroutines are free).
+        # wait=False is the thread-pool idiom the controller process runs
+        # with: enqueue and return — a pod sitting in an unresolved batch is
+        # recognized via worker.is_pending and NOT re-relaxed/re-enqueued,
+        # and the 5s verify requeue provides the completion check. Without
+        # this, a 32-thread pool caps batch formation at ~32 pods/solve
+        # under a 10k-pod event storm.
+        self.wait = wait
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
         pod = self.cluster.try_get("pods", name, namespace)
@@ -283,9 +291,16 @@ class SelectionController:
         so the manager retries with backoff — each retry relaxes another
         preference (the reference returns an error for the same reason,
         controller.go:107-108)."""
+        workers = self.provisioners.list_workers()
+        # already enqueued and awaiting its batch: this reconcile is the
+        # verify requeue firing early — don't relax another preference or
+        # double-enqueue, just keep the requeue clock running
+        if any(
+            w.is_pending(pod.key) for w in workers if hasattr(w, "is_pending")
+        ):
+            return True
         self.preferences.relax(pod)
         self.volume_topology.inject(pod)
-        workers = self.provisioners.list_workers()
         if not workers:
             return False
         errs = []

@@ -149,7 +149,13 @@ def build_runtime(
         solver_service_address=options.solver_service_address or None,
     )
     selection = SelectionController(
-        cluster, provisioning, allow_pod_affinity=allow_pod_affinity
+        cluster, provisioning, allow_pod_affinity=allow_pod_affinity,
+        # non-blocking enqueue: a 32-thread reconcile pool must not cap
+        # batch formation at 32 pods/solve under an event storm (the
+        # reference affords blocking because its 10k goroutines are free,
+        # selection/controller.go:183); completion is verified by the 5s
+        # requeue, in-flight pods are guarded by worker.is_pending
+        wait=False,
     )
     termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
     node = NodeController(cluster)
