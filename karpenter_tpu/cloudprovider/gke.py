@@ -309,6 +309,7 @@ class GkeCloudProvider(CloudProvider):
             raise ValueError("no instance type options")
         reqs = request.template.requirements
         last_err: Optional[Exception] = None
+        ice_skipped = False
         # options are price-sorted by the solver; within a type, try each
         # allowed offering, falling through stockouts to the next zone and
         # then to the next (pricier) type — the reference's ICE fallback
@@ -321,7 +322,14 @@ class GkeCloudProvider(CloudProvider):
                     continue
                 key = (it.name, o.zone, o.capacity_type)
                 if self._unavailable.get(key) is not None:
+                    ice_skipped = True
                     continue
+                # one critical section from pending-check through pool
+                # creation to the pending store: provision_once launches
+                # vnodes from a thread pool, and two concurrent creates of
+                # the same slice key must not both create a pool (duplicate
+                # pools + the second store would orphan the first's
+                # unclaimed hosts, breaking the atomic-slice invariant)
                 with self._lock:
                     pending = self._pending_hosts.get(key)
                     if pending:
@@ -329,27 +337,34 @@ class GkeCloudProvider(CloudProvider):
                         if not pending:
                             del self._pending_hosts[key]
                         return node
-                try:
-                    pool = self.api.create_node_pool(
-                        machine_type=it.name,
-                        zone=o.zone,
-                        spot=o.capacity_type == "spot",
-                        count=hosts,
-                        tpu_topology=it.labels.get(GKE_TPU_TOPOLOGY_LABEL, ""),
-                    )
-                except GkeStockoutError as e:
-                    # classified capacity error: cache the offering out for
-                    # the ICE TTL and fall through to the next offering
-                    self._unavailable.set((it.name, o.zone, o.capacity_type), True)
-                    last_err = e
-                    continue
-                nodes = [self._node(it, o, inst) for inst in pool.instances]
-                first = nodes.pop(0)
-                if nodes:
-                    with self._lock:
+                    try:
+                        pool = self.api.create_node_pool(
+                            machine_type=it.name,
+                            zone=o.zone,
+                            spot=o.capacity_type == "spot",
+                            count=hosts,
+                            tpu_topology=it.labels.get(GKE_TPU_TOPOLOGY_LABEL, ""),
+                        )
+                    except GkeStockoutError as e:
+                        # classified capacity error: cache the offering out
+                        # for the ICE TTL, fall through to the next offering
+                        self._unavailable.set(key, True)
+                        last_err = e
+                        continue
+                    nodes = [self._node(it, o, inst) for inst in pool.instances]
+                    first = nodes.pop(0)
+                    if nodes:
                         self._pending_hosts[key] = nodes
-                return first
-        raise last_err or ValueError(
+                    return first
+        if last_err is not None:
+            raise last_err
+        if ice_skipped:
+            # every candidate offering is sitting out its ICE TTL — this is
+            # a (transient) capacity condition, not a requirements bug
+            raise GkeStockoutError(
+                "all candidate offerings are capacity-constrained (ICE-cached)"
+            )
+        raise ValueError(
             "no offering satisfies the request's zone/capacity-type requirements"
         )
 
@@ -377,9 +392,28 @@ class GkeCloudProvider(CloudProvider):
         )
 
     def delete(self, node: Node) -> None:
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        purged: List[Node] = []
         with self._lock:
             self.delete_calls.append(node.metadata.name)
+            if pool:
+                # a multi-host slice is dying: its unclaimed pending hosts
+                # must die with it — handing a stale sibling out later would
+                # pair a "fresh" node with hosts scaled down long ago
+                for key, nodes in list(self._pending_hosts.items()):
+                    keep = [
+                        n for n in nodes
+                        if n.metadata.labels.get(GKE_NODEPOOL_LABEL) != pool
+                    ]
+                    if len(keep) != len(nodes):
+                        purged += [n for n in nodes if n not in keep]
+                        if keep:
+                            self._pending_hosts[key] = keep
+                        else:
+                            del self._pending_hosts[key]
         self.api.delete_instance(node.metadata.name)
+        for n in purged:
+            self.api.delete_instance(n.metadata.name)
 
     # -- webhook hooks -----------------------------------------------------
     def default(self, constraints: Constraints) -> None:

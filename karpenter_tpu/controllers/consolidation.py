@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -56,6 +57,10 @@ MIN_SAVINGS_FRACTION = 0.05
 # cluster-wide availability dip with only per-pod PDB retries as a brake.
 EVICT_WAVE_SIZE = 5
 WAVE_CHECK_INTERVAL = 10.0
+# safety valve: a wave that has not settled after this long (e.g. an
+# unrelated permanently-unschedulable pod appeared) stops blocking further
+# consolidation — bounded disruption must not become unbounded deadlock
+WAVE_SETTLE_TIMEOUT = 300.0
 
 
 @dataclass
@@ -106,8 +111,11 @@ class ConsolidationController:
         if migration not in ("bind", "evict"):
             raise ValueError(f"migration must be bind|evict, got {migration}")
         self.wave_size = EVICT_WAVE_SIZE
-        # node names of the in-flight evict wave, awaiting settlement
-        self._pending_wave: List[str] = []
+        # in-flight evict wave PER PROVISIONER (reconciles of different
+        # provisioners run concurrently): name -> (node names, pod keys
+        # already pending when the wave launched, settle deadline)
+        self._wave_lock = threading.Lock()
+        self._pending_waves: Dict[str, tuple] = {}
         if migration == "bind" and isinstance(cluster, ApiCluster):
             # would fail mid-execute on the first rebind (409), leaking the
             # already-launched replacements next to the old capacity
@@ -261,7 +269,17 @@ class ConsolidationController:
             except Exception:
                 logger.exception("retiring node %s", old.metadata.name)
         if self.migration == "evict":
-            self._pending_wave = [n.metadata.name for n in retire]
+            # baseline: pods ALREADY pending before this wave — a
+            # pre-existing unschedulable pod must not gate settlement
+            baseline = {
+                p.key for p in self.cluster.pods() if podutil.is_provisionable(p)
+            }
+            with self._wave_lock:
+                self._pending_waves[plan.provisioner.metadata.name] = (
+                    [n.metadata.name for n in retire],
+                    baseline,
+                    self.cluster.clock() + WAVE_SETTLE_TIMEOUT,
+                )
         logger.info(
             "consolidating %d of %d candidate nodes -> %d planned (%s migration), "
             "price %.3f -> %.3f (saving %.3f)",
@@ -270,19 +288,37 @@ class ConsolidationController:
         )
         return launched
 
-    def wave_settled(self) -> bool:
-        """Has the in-flight evict wave fully landed? True when every
-        retired node is gone (termination finished its drain) and no
-        recreated pod is still waiting for capacity — only then may the
-        next wave disrupt more nodes."""
-        if not self._pending_wave:
+    def wave_settled(self, provisioner_name: str) -> bool:
+        """Has this provisioner's in-flight evict wave fully landed? True
+        when every retired node is gone (termination finished its drain)
+        and no pod that appeared SINCE the wave launched is still waiting
+        for capacity (pods already pending before the wave don't gate it) —
+        only then may the next wave disrupt more nodes. A wave past its
+        settle deadline stops gating (logged): bounded disruption must not
+        become unbounded deadlock on an unrelated stuck pod."""
+        with self._wave_lock:
+            wave = self._pending_waves.get(provisioner_name)
+        if wave is None:
             return True
-        for name in self._pending_wave:
+        node_names, baseline, deadline = wave
+        if self.cluster.clock() >= deadline:
+            logger.warning(
+                "consolidation wave for %s did not settle within %.0fs; "
+                "releasing the gate", provisioner_name, WAVE_SETTLE_TIMEOUT,
+            )
+            with self._wave_lock:
+                self._pending_waves.pop(provisioner_name, None)
+            return True
+        for name in node_names:
             if self.cluster.try_get("nodes", name, namespace="") is not None:
                 return False
-        if any(podutil.is_provisionable(p) for p in self.cluster.pods()):
+        if any(
+            podutil.is_provisionable(p) and p.key not in baseline
+            for p in self.cluster.pods()
+        ):
             return False
-        self._pending_wave = []
+        with self._wave_lock:
+            self._pending_waves.pop(provisioner_name, None)
         return True
 
     # -- reconcile ---------------------------------------------------------
@@ -292,14 +328,16 @@ class ConsolidationController:
         provisioner = self.cluster.try_get("provisioners", name, namespace="")
         if provisioner is None:
             return None
-        if not self.wave_settled():
+        if not self.wave_settled(name):
             # the previous wave's pods have not all re-seated: no new
             # disruption yet, check back shortly
             return WAVE_CHECK_INTERVAL
         plan = self.plan(provisioner)
         if plan.worthwhile:
             self.execute(plan)
-            if self._pending_wave:
+            with self._wave_lock:
+                in_flight = name in self._pending_waves
+            if in_flight:
                 return WAVE_CHECK_INTERVAL
         return REQUEUE_INTERVAL
 

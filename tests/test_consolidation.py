@@ -302,13 +302,13 @@ class TestEvictWavePacing:
         # the recreated workload is still pending — wave NOT settled
         pending = make_pod(name="recreated-0", requests={"cpu": "0.5"})
         cluster.create("pods", pending)
-        assert controller.wave_settled() is False
+        assert controller.wave_settled(provisioner.metadata.name) is False
         controller.reconcile(provisioner.metadata.name)
         assert len(cluster.nodes()) == n_after_first  # no new disruption
         # the pod re-seats -> the gate opens -> the next wave proceeds
         survivors = cluster.nodes()
         cluster.bind(pending, survivors[0].metadata.name)
-        assert controller.wave_settled() is True
+        assert controller.wave_settled(provisioner.metadata.name) is True
         controller.reconcile(provisioner.metadata.name)
         assert len(cluster.nodes()) < n_after_first
         assert n_after_first - len(cluster.nodes()) <= EVICT_WAVE_SIZE
@@ -321,3 +321,52 @@ class TestEvictWavePacing:
         cluster, controller, provisioner = self._evict_env(1000)
         controller.reconcile(provisioner.metadata.name)
         assert 1000 - len(cluster.nodes()) == EVICT_WAVE_SIZE
+
+    def test_preexisting_pending_pod_does_not_gate_waves(self):
+        """A pod that was ALREADY unschedulable before the wave launched
+        (e.g. permanently unsatisfiable) must not deadlock consolidation."""
+        cluster, controller, provisioner = self._evict_env(20)
+        cluster.create("pods", make_pod(name="stuck-forever", requests={"cpu": "999"}))
+        n0 = len(cluster.nodes())
+        controller.reconcile(provisioner.metadata.name)
+        n1 = len(cluster.nodes())
+        assert n0 - n1 > 0  # first wave ran despite the stuck pod
+        # the stuck pod is in the wave's baseline: the gate opens
+        assert controller.wave_settled(provisioner.metadata.name) is True
+        controller.reconcile(provisioner.metadata.name)
+        assert len(cluster.nodes()) < n1  # second wave proceeded
+
+    def test_wave_settle_timeout_releases_the_gate(self):
+        from karpenter_tpu.controllers.consolidation import WAVE_SETTLE_TIMEOUT
+
+        now = [1000.0]
+        cluster = Cluster(clock=lambda: now[0])
+        provider = FakeCloudProvider(instance_types(20))
+        provisioner = make_provisioner(solver="ffd")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(
+            catalog_requirements(provider.get_instance_types())
+        )
+        cluster.create("provisioners", provisioner)
+        controller = ConsolidationController(cluster, provider, migration="evict")
+        from karpenter_tpu.api.objects import OwnerReference
+
+        owner = OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="rs")
+        for i in range(12):
+            node = make_node(
+                name=f"big-{i}", capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+                provisioner_name="default",
+                labels={lbl.INSTANCE_TYPE: "fake-it-19", lbl.TOPOLOGY_ZONE: "test-zone-1",
+                        lbl.CAPACITY_TYPE: "on-demand"},
+            )
+            cluster.create("nodes", node)
+            cluster.create("pods", make_pod(name=f"pod-{i}", requests={"cpu": "0.5"},
+                                            node_name=node.metadata.name,
+                                            unschedulable=False, owner=owner))
+        controller.reconcile(provisioner.metadata.name)
+        # a NEW stuck pod appears after the wave: the gate holds...
+        cluster.create("pods", make_pod(name="new-stuck", requests={"cpu": "999"}))
+        assert controller.wave_settled(provisioner.metadata.name) is False
+        # ...until the settle deadline passes — then it releases (logged)
+        now[0] += WAVE_SETTLE_TIMEOUT + 1
+        assert controller.wave_settled(provisioner.metadata.name) is True

@@ -324,3 +324,57 @@ class TestGkeStockoutAndMultiHost:
         assert len(vnodes) == 1
         names = {t.name for t in vnodes[0].instance_type_options}
         assert names == {"ct5lp-hightpu-4t-4x4"}
+
+    def test_concurrent_slice_launches_share_one_pool(self):
+        """provision_once launches vnodes from a thread pool: concurrent
+        creates of the same slice key must claim hosts of ONE atomic pool,
+        never race two pools into existence."""
+        import threading
+
+        from karpenter_tpu.cloudprovider.gke import GKE_NODEPOOL_LABEL, SimGkeAPI
+
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api)
+        it = next(
+            t for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-4t-4x4"
+        )
+        req = self._request(it)
+        nodes = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(4)
+
+        def launch():
+            barrier.wait()
+            n = provider.create(req)
+            with lock:
+                nodes.append(n)
+
+        threads = [threading.Thread(target=launch) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(api.create_calls) == 1
+        assert {n.metadata.labels[GKE_NODEPOOL_LABEL] for n in nodes} == {
+            api.create_calls[0].name
+        }
+        assert len({n.metadata.name for n in nodes}) == 4
+
+    def test_delete_purges_pending_slice_siblings(self):
+        from karpenter_tpu.cloudprovider.gke import SimGkeAPI
+
+        api = SimGkeAPI()
+        provider = GkeCloudProvider(api=api)
+        it = next(
+            t for t in provider.get_instance_types() if t.name == "ct5lp-hightpu-4t-4x4"
+        )
+        req = self._request(it)
+        first = provider.create(req)  # pool of 4; 3 pending
+        assert len(provider._pending_hosts) == 1
+        provider.delete(first)
+        # the dying slice's unclaimed siblings die with it
+        assert provider._pending_hosts == {}
+        assert api.node_pools == {}  # pool fully reaped
+        # the next create starts a FRESH atomic slice
+        provider.create(req)
+        assert len(api.create_calls) == 2
