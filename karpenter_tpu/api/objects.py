@@ -310,3 +310,23 @@ class ValidatingWebhookConfiguration:
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     webhooks: List[Dict[str, object]] = field(default_factory=list)
+
+
+@dataclass
+class Event:
+    """core/v1 Event — operator-visible record of a controller action
+    (launch/terminate/consolidate). The reference snapshot emits none
+    (SURVEY §5.5), so this is additive capability: kubectl describe on a
+    node or provisioner shows what the controllers did to it."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    source_component: str = "karpenter-tpu"
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0

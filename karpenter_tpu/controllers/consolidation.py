@@ -286,6 +286,14 @@ class ConsolidationController:
             len(retire), len(plan.nodes), len(plan.proposed), self.migration,
             plan.current_price, plan.proposed_price, plan.savings,
         )
+        from karpenter_tpu.kube.events import recorder_for
+
+        recorder_for(self.cluster).event(
+            "Provisioner", plan.provisioner.metadata.name, "Consolidated",
+            f"retiring {len(retire)} of {len(plan.nodes)} candidate node(s) "
+            f"({self.migration} migration), hourly price "
+            f"{plan.current_price:.3f} -> {plan.proposed_price:.3f}",
+        )
         return launched
 
     def wave_settled(self, provisioner_name: str) -> bool:

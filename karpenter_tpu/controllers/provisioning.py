@@ -224,9 +224,22 @@ class ProvisionerWorker:
                 # (reference: provisioner.go:155-164)
                 pass
             self._bind(vnode.pods, node.metadata.name)
+            from karpenter_tpu.kube.events import recorder_for
+
+            recorder_for(self.cluster).event(
+                "Node", node.metadata.name, "Launched",
+                f"launched {node.metadata.labels.get(lbl.INSTANCE_TYPE, '?')} "
+                f"for provisioner {self.provisioner.name}; bound {len(vnode.pods)} pod(s)",
+            )
             return True
         except Exception:
             logger.exception("launching node")
+            from karpenter_tpu.kube.events import recorder_for
+
+            recorder_for(self.cluster).event(
+                "Provisioner", self.provisioner.name, "LaunchFailed",
+                "node launch failed; see controller logs", type="Warning",
+            )
             return False
 
     def _bind(self, pods: List[Pod], node_name: str) -> None:

@@ -141,6 +141,12 @@ class Terminator:
     def terminate(self, node: Node) -> None:
         self.cloud_provider.delete(node)
         self.cluster.remove_finalizer("nodes", node, lbl.TERMINATION_FINALIZER)
+        from karpenter_tpu.kube.events import recorder_for
+
+        recorder_for(self.cluster).event(
+            "Node", node.metadata.name, "Terminated",
+            "cordoned, drained and deleted the backing instance",
+        )
         logger.info("Deleted node %s", node.metadata.name)
 
     def get_pods(self, node: Node) -> List[Pod]:

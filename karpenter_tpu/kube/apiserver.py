@@ -57,6 +57,7 @@ RESOURCES: Dict[str, Tuple[str, str]] = {
     "mutatingwebhookconfigurations": (
         "/apis/admissionregistration.k8s.io/v1", "mutatingwebhookconfigurations",
     ),
+    "events": ("/api/v1", "events"),
 }
 
 WATCH_RECONNECT_DELAY = 1.0
@@ -77,7 +78,10 @@ WATCH_TIMEOUT_SECONDS = 45
 WATCH_KINDS = tuple(
     k for k in Cluster.KINDS
     if k not in (
+        # write-mostly kinds the controllers never read back: informers on
+        # them are churn + RBAC surface for nothing
         "leases", "validatingwebhookconfigurations", "mutatingwebhookconfigurations",
+        "events",
     )
 )
 

@@ -66,7 +66,7 @@ class Cluster:
     """Typed object store: pods, nodes, daemonsets, provisioners, PVCs, PVs,
     storage classes, PDBs."""
 
-    KINDS = ("pods", "nodes", "daemonsets", "provisioners", "pvcs", "pvs", "storageclasses", "pdbs", "leases", "validatingwebhookconfigurations", "mutatingwebhookconfigurations")
+    KINDS = ("pods", "nodes", "daemonsets", "provisioners", "pvcs", "pvs", "storageclasses", "pdbs", "leases", "validatingwebhookconfigurations", "mutatingwebhookconfigurations", "events")
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
         self._lock = threading.RLock()

@@ -1,0 +1,104 @@
+"""Operator-visible Events for controller actions.
+
+The reference snapshot emits no Events (SURVEY §5.5) — this is additive
+capability: ``kubectl describe node``/``provisioner`` shows what the
+controllers did (launched, bound N pods, terminated, consolidated) and why.
+
+``EventRecorder`` mirrors client-go's recorder shape: fire-and-forget
+(an event that fails to write must never fail the action that caused it),
+deduplicating repeats of the same (object, reason, message) into a count
+bump within an aggregation window, exactly like the apiserver's event
+series handling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+from karpenter_tpu.api.objects import Event, ObjectMeta
+from karpenter_tpu.kube.client import Cluster
+
+logger = logging.getLogger("karpenter.events")
+
+AGGREGATION_WINDOW = 600.0  # repeats inside this window bump count
+
+
+class EventRecorder:
+    def __init__(self, cluster: Cluster, component: str = "karpenter-tpu"):
+        self.cluster = cluster
+        self.component = component
+        self._lock = threading.Lock()
+        self._seen: Dict[Tuple, Tuple[float, Event]] = {}
+        self._counter = 0
+
+    def event(
+        self,
+        involved_kind: str,
+        involved_name: str,
+        reason: str,
+        message: str,
+        type: str = "Normal",
+        namespace: str = "",
+    ) -> Optional[Event]:
+        """Record an event; returns the stored object (or None on failure —
+        recording is never allowed to break the calling controller)."""
+        try:
+            now = self.cluster.clock()
+            key = (involved_kind, involved_name, namespace, reason, message)
+            with self._lock:
+                hit = self._seen.get(key)
+                if hit is not None and now - hit[0] < AGGREGATION_WINDOW:
+                    ev = hit[1]
+                    ev.count += 1
+                    ev.last_timestamp = now
+                    self._seen[key] = (now, ev)
+                    try:
+                        self.cluster.update("events", ev)
+                    except Exception:
+                        pass  # the event may have been pruned; re-create below
+                    else:
+                        return ev
+                self._counter += 1
+                name = f"{involved_name}.{self._counter:x}.{int(now)}"
+            ev = Event(
+                metadata=ObjectMeta(name=name, namespace=namespace or "default"),
+                involved_kind=involved_kind,
+                involved_name=involved_name,
+                involved_namespace=namespace,
+                reason=reason,
+                message=message,
+                type=type,
+                source_component=self.component,
+                first_timestamp=now,
+                last_timestamp=now,
+            )
+            self.cluster.create("events", ev)
+            with self._lock:
+                self._seen[key] = (now, ev)
+                # bound the dedupe table
+                if len(self._seen) > 4096:
+                    cutoff = now - AGGREGATION_WINDOW
+                    self._seen = {
+                        k: v for k, v in self._seen.items() if v[0] >= cutoff
+                    }
+            return ev
+        except Exception:
+            logger.debug("event emit failed", exc_info=True)
+            return None
+
+
+_NULL = None
+
+
+def recorder_for(cluster: Cluster) -> EventRecorder:
+    """One recorder per cluster object (controllers share it)."""
+    rec = getattr(cluster, "_event_recorder", None)
+    if rec is None:
+        rec = EventRecorder(cluster)
+        try:
+            cluster._event_recorder = rec
+        except AttributeError:
+            pass
+    return rec

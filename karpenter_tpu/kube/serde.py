@@ -77,6 +77,7 @@ KIND_INFO: Dict[str, Any] = {
     "mutatingwebhookconfigurations": (
         "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration", False,
     ),
+    "events": ("v1", "Event", True),
 }
 
 
@@ -787,6 +788,46 @@ def _vwc_from_wire(doc: Dict[str, Any]):
     )
 
 
+def _event_to_wire(e) -> Dict[str, Any]:
+    return _drop_none(
+        {
+            "involvedObject": _drop_none(
+                {
+                    "kind": e.involved_kind,
+                    "name": e.involved_name,
+                    "namespace": e.involved_namespace or None,
+                }
+            ),
+            "reason": e.reason,
+            "message": e.message,
+            "type": e.type,
+            "count": e.count,
+            "source": {"component": e.source_component},
+            "firstTimestamp": _ts(e.first_timestamp),
+            "lastTimestamp": _ts(e.last_timestamp),
+        }
+    )
+
+
+def _event_from_wire(doc: Dict[str, Any]):
+    from karpenter_tpu.api.objects import Event
+
+    inv = doc.get("involvedObject") or {}
+    return Event(
+        metadata=meta_from_wire(doc.get("metadata") or {}),
+        involved_kind=inv.get("kind", ""),
+        involved_name=inv.get("name", ""),
+        involved_namespace=inv.get("namespace", ""),
+        reason=doc.get("reason", ""),
+        message=doc.get("message", ""),
+        type=doc.get("type", "Normal"),
+        count=int(doc.get("count") or 1),
+        source_component=(doc.get("source") or {}).get("component", ""),
+        first_timestamp=parse_ts(doc.get("firstTimestamp")) or 0.0,
+        last_timestamp=parse_ts(doc.get("lastTimestamp")) or 0.0,
+    )
+
+
 _TO = {
     "pods": _pod_to_wire,
     "nodes": _node_to_wire,
@@ -799,6 +840,7 @@ _TO = {
     "leases": _lease_to_wire,
     "validatingwebhookconfigurations": _vwc_to_wire,
     "mutatingwebhookconfigurations": _vwc_to_wire,
+    "events": _event_to_wire,
 }
 
 _FROM = {
@@ -813,6 +855,7 @@ _FROM = {
     "leases": _lease_from_wire,
     "validatingwebhookconfigurations": _vwc_from_wire,
     "mutatingwebhookconfigurations": _vwc_from_wire,
+    "events": _event_from_wire,
 }
 
 

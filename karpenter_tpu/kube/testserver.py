@@ -46,6 +46,7 @@ PLURALS: Dict[str, str] = {
     "leases": "leases",
     "validatingwebhookconfigurations": "validatingwebhookconfigurations",
     "mutatingwebhookconfigurations": "mutatingwebhookconfigurations",
+    "events": "events",
 }
 
 

@@ -1,0 +1,86 @@
+"""Operator-visible Events (kube/events.py): launch/terminate/consolidate
+actions are recorded as core/v1 Events with client-go-style aggregation —
+additive capability (the reference snapshot emits none, SURVEY §5.5)."""
+
+import time
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.kube.events import EventRecorder, recorder_for
+from tests.factories import make_node, make_pod, make_provisioner
+
+
+class TestRecorder:
+    def test_repeat_aggregates_into_count(self):
+        now = [100.0]
+        cluster = Cluster(clock=lambda: now[0])
+        rec = EventRecorder(cluster)
+        e1 = rec.event("Node", "n1", "Launched", "msg")
+        now[0] += 5
+        e2 = rec.event("Node", "n1", "Launched", "msg")
+        assert e2 is e1 and e1.count == 2
+        assert len(cluster.list("events", None)) == 1
+        # a different message is a fresh event
+        rec.event("Node", "n1", "Launched", "other")
+        assert len(cluster.list("events", None)) == 2
+
+    def test_recorder_shared_per_cluster(self):
+        cluster = Cluster()
+        assert recorder_for(cluster) is recorder_for(cluster)
+
+    def test_emit_failure_never_raises(self):
+        class Broken(Cluster):
+            def create(self, kind, obj):
+                if kind == "events":
+                    raise RuntimeError("boom")
+                return super().create(kind, obj)
+
+        rec = EventRecorder(Broken())
+        assert rec.event("Node", "n1", "Launched", "msg") is None
+
+
+class TestControllerEvents:
+    def test_launch_and_consolidate_emit(self):
+        from karpenter_tpu.controllers.consolidation import ConsolidationController
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(20))
+        provisioner = make_provisioner(solver="ffd")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(
+            catalog_requirements(provider.get_instance_types())
+        )
+        cluster.create("provisioners", provisioner)
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        controller.reconcile(provisioner.metadata.name)
+        worker = controller.workers[provisioner.metadata.name]
+        pod = make_pod(requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        worker.add(pod)
+        worker.batcher.idle_duration = 0.05
+        worker.provision_once()
+        controller.stop()
+        reasons = {e.reason for e in cluster.list("events", None)}
+        assert "Launched" in reasons
+        launched = [e for e in cluster.list("events", None) if e.reason == "Launched"]
+        assert launched[0].involved_kind == "Node"
+        assert "bound 1 pod(s)" in launched[0].message
+
+    def test_termination_emits(self):
+        from karpenter_tpu.controllers.termination import TerminationController
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = TerminationController(cluster, provider, start_queue=False)
+        node = make_node(
+            name="doomed", provisioner_name="default",
+            finalizers=[lbl.TERMINATION_FINALIZER],
+        )
+        cluster.create("nodes", node)
+        cluster.delete("nodes", "doomed", namespace="")
+        controller.reconcile("doomed")
+        reasons = {e.reason for e in cluster.list("events", None)}
+        assert "Terminated" in reasons
