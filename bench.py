@@ -540,7 +540,7 @@ def bench_config(config: int, iters: int):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=10000)
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=12)
     ap.add_argument("--solver", default="tpu", choices=["tpu", "ffd"])
     ap.add_argument("--grid", action="store_true", help="run the reference's full batch grid")
     ap.add_argument("--consolidation", type=int, metavar="N_NODES", default=0,

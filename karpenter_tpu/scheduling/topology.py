@@ -636,12 +636,21 @@ class Topology:
             ):
                 # fast path: all-fresh domains, zero seed counts, no pinned
                 # pods → min-count assignment degenerates to round-robin
-                # (the general path is O(pods × domains) = O(n²/maxSkew))
+                # (the general path is O(pods × domains) = O(n²/maxSkew)).
+                # Inlined plan writes: hostname decisions never touch zone
+                # tokens, and this loop runs for thousands of pods per solve
                 domains = list(group.spread)  # pool order → cross-group overlap
+                n_dom = len(domains)
+                by_pod = plan.by_pod
                 for j, pod in enumerate(group.pods):
-                    domain = domains[j % len(domains)]
+                    domain = domains[j % n_dom]
                     group.spread[domain] += 1
-                    plan.set(pod, key, domain)
+                    pid = id(pod)
+                    d = by_pod.get(pid)
+                    if d is None:
+                        by_pod[pid] = {key: domain}
+                    else:
+                        d[key] = domain
                 continue
             registered = group.spread.keys()
             for pod, st in zip(group.pods, group.sts):
