@@ -54,6 +54,10 @@ def _p99(times):
     return sorted(times)[min(len(times) - 1, max(math.ceil(0.99 * len(times)) - 1, 0))]
 
 
+def _p90(times):
+    return sorted(times)[min(len(times) - 1, max(math.ceil(0.9 * len(times)) - 1, 0))]
+
+
 def bench_once(
     n_pods: int,
     iters: int,
@@ -125,6 +129,10 @@ def bench_once(
         # paid once per kernel dispatch (saturation retries pay it again)
         adj = rtt * dispatches
         out["p99_minus_rtt_s"] = round(max(_p99(times) - adj, 0.0), 4)
+        # p99 over a dozen samples is max(): on a timeshared box a single
+        # CPU-contention spike lands there (the in-run CPU-native p99 shows
+        # the same spikes). p90 is the noise-robust tail.
+        out["p90_minus_rtt_s"] = round(max(_p90(times) - adj, 0.0), 4)
         out["mean_minus_rtt_s"] = round(max(statistics.mean(times) - adj, 0.0), 4)
     return out
 
@@ -669,7 +677,7 @@ def main():
         "unschedulable_expected": r["unschedulable_expected"],
         "unexplained": r["unexplained"],
     }
-    for k in ("breakdown_ms", "transport_rtt_floor_ms", "p99_minus_rtt_s", "mean_minus_rtt_s"):
+    for k in ("breakdown_ms", "transport_rtt_floor_ms", "p99_minus_rtt_s", "p90_minus_rtt_s", "mean_minus_rtt_s"):
         if k in r:
             line[k] = r[k]
     if args.solver == "tpu":
