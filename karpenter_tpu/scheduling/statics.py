@@ -21,7 +21,7 @@ OR-term — reference: requirements.go:55-75) and split hostname exactly like
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Pod

@@ -24,7 +24,7 @@ exactly as in ``backend._pack``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import jax
 import numpy as np
