@@ -26,6 +26,9 @@ benchmark-grid:  ## the reference's full batch grid
 benchmark-consolidation:  ## BASELINE config 5: 1k-node re-pack
 	$(PY) bench.py --consolidation 1000
 
+benchmark-storm:  ## 10k pod watch events through the full pipeline
+	$(PY) bench.py --selection-storm 10000
+
 benchmark-multi:  ## BASELINE config 4: concurrent provisioner batches on the mesh
 	$(PY) bench.py --multi 8 --pods 1250
 
@@ -58,5 +61,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test battletest deflake benchmark benchmark-grid \
-	benchmark-consolidation dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle
