@@ -378,6 +378,15 @@ class GkeCloudProvider(CloudProvider):
             GKE_NODEPOOL_LABEL: inst.node_pool,
         }
         labels.update(it.labels)  # accelerator + topology for TPU shapes
+        # derived at label time so ANY catalog (custom, serde round-tripped,
+        # labels-free) still yields correctly-labeled TPU nodes
+        chips = int(it.resources.get(TPU_RESOURCE, 0))
+        if chips:
+            labels.setdefault(GKE_TPU_ACCELERATOR_LABEL, "tpu-v5-lite-podslice")
+            labels.setdefault(
+                GKE_TPU_TOPOLOGY_LABEL,
+                TPU_TOPOLOGY_BY_CHIPS.get(chips, f"1x{chips}"),
+            )
         allocatable = {k: v - it.overhead.get(k, 0.0) for k, v in it.resources.items()}
         return Node(
             metadata=ObjectMeta(name=inst.name, namespace="", labels=labels),

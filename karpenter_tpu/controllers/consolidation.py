@@ -263,17 +263,21 @@ class ConsolidationController:
         retire = plan.nodes
         if self.migration == "evict" and len(retire) > self.wave_size:
             retire = retire[: self.wave_size]
+        # baseline BEFORE the deletes: pods already pending before this wave
+        # must not gate settlement, but pods displaced BY the wave (evicted
+        # and recreated while the delete loop runs) must — snapshotting
+        # after the deletes would let them slip into the baseline
+        baseline = (
+            {p.key for p in self.cluster.pods() if podutil.is_provisionable(p)}
+            if self.migration == "evict"
+            else set()
+        )
         for old in retire:
             try:
                 self.cluster.delete("nodes", old.metadata.name, namespace="")
             except Exception:
                 logger.exception("retiring node %s", old.metadata.name)
         if self.migration == "evict":
-            # baseline: pods ALREADY pending before this wave — a
-            # pre-existing unschedulable pod must not gate settlement
-            baseline = {
-                p.key for p in self.cluster.pods() if podutil.is_provisionable(p)
-            }
             with self._wave_lock:
                 self._pending_waves[plan.provisioner.metadata.name] = (
                     [n.metadata.name for n in retire],

@@ -154,14 +154,19 @@ class ProvisionerWorker:
             batch_keys = {p.key for p in pods}
             # dedupe by key: watch-event storms and verify requeues can
             # enqueue the same (or a replaced) pod object twice; double
-            # inclusion would double its requests in the solve
-            seen = set()
-            unique = []
+            # inclusion would double its requests in the solve. Keep the
+            # LATEST object per key (a replaced watch object carries the
+            # freshest spec, e.g. after preference relaxation) at the
+            # FIRST occurrence's position (stable FFD input order).
+            latest = {}
+            key_order = []
             for p in pods:
-                if is_provisionable(p) and p.key not in seen:
-                    seen.add(p.key)
-                    unique.append(p)
-            pods = unique
+                if not is_provisionable(p):
+                    continue
+                if p.key not in latest:
+                    key_order.append(p.key)
+                latest[p.key] = p
+            pods = [latest[k] for k in key_order]
             if not pods:
                 return []
             metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Optional, Tuple
 
 from karpenter_tpu.api.objects import Event, ObjectMeta
 from karpenter_tpu.kube.client import Cluster
@@ -30,7 +31,10 @@ class EventRecorder:
         self.cluster = cluster
         self.component = component
         self._lock = threading.Lock()
-        self._seen: Dict[Tuple, Tuple[float, Event]] = {}
+        # insertion/update-ordered so overflow evicts the least recently
+        # UPDATED key in O(1) — an age-only prune cannot shrink the table
+        # during a distinct-event storm inside the aggregation window
+        self._seen: "OrderedDict[Tuple, Tuple[float, Event]]" = OrderedDict()
         self._counter = 0
 
     def event(
@@ -54,6 +58,7 @@ class EventRecorder:
                     ev.count += 1
                     ev.last_timestamp = now
                     self._seen[key] = (now, ev)
+                    self._seen.move_to_end(key)
                     try:
                         self.cluster.update("events", ev)
                     except Exception:
@@ -77,12 +82,11 @@ class EventRecorder:
             self.cluster.create("events", ev)
             with self._lock:
                 self._seen[key] = (now, ev)
-                # bound the dedupe table
-                if len(self._seen) > 4096:
-                    cutoff = now - AGGREGATION_WINDOW
-                    self._seen = {
-                        k: v for k, v in self._seen.items() if v[0] >= cutoff
-                    }
+                self._seen.move_to_end(key)
+                # hard cap: evict least-recently-updated (an evicted key
+                # merely loses aggregation — its next emit re-creates)
+                while len(self._seen) > 4096:
+                    self._seen.popitem(last=False)
             return ev
         except Exception:
             logger.debug("event emit failed", exc_info=True)

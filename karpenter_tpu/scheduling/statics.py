@@ -36,7 +36,7 @@ class PodStatics:
     __slots__ = (
         "sel_raw",          # tuple(pod.spec.node_selector.items()) — validity token
         "sel_ref",          # the node_selector dict itself — identity token
-        "aff_id",           # id(pod.spec.affinity) — validity token
+        "aff_ref",          # the affinity object itself — identity token
         "core0",            # canonical core with no injected decisions
         "hostname0",        # hostname with no injected decisions
         "aff_entries",      # folded affinity (key, op, values) minus hostname
@@ -113,7 +113,7 @@ def _build(pod: Pod) -> PodStatics:
     spec = pod.spec
     st.sel_raw = tuple(spec.node_selector.items())
     st.sel_ref = spec.node_selector
-    st.aff_id = id(spec.affinity)
+    st.aff_ref = spec.affinity
 
     # -- canonical core + hostname (mirrors signature.pod_core_and_hostname)
     reqs: List[Tuple[str, str, Tuple[str, ...]]] = []
@@ -227,7 +227,7 @@ def statics(pod: Pod) -> PodStatics:
     recomputing."""
     spec = pod.spec
     st = getattr(pod, "_solve_statics", None)
-    if st is not None and st.aff_id == id(spec.affinity):
+    if st is not None and st.aff_ref is spec.affinity:
         if st.sel_ref is spec.node_selector:
             return st
         if st.sel_raw == tuple(spec.node_selector.items()):

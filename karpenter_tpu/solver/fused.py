@@ -97,6 +97,12 @@ class DeviceInvariants:
         h.update(np.ascontiguousarray(batch.usable).tobytes())
         key = h.digest()
         hit = self._cache.get(key)
+        if hit is not None:
+            # LRU, not FIFO: interleaving invariant sets (several
+            # provisioners on one scheduler) must not evict the hot entry
+            self._order.remove(key)
+            self._order.append(key)
+            return hit
         if hit is None:
             hit = tuple(
                 jax.device_put(a)

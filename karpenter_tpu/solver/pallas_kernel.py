@@ -282,6 +282,12 @@ def pack_best(*args, n_max: int) -> PackResult:
         return native.pack_native(*args, n_max=n_max)
     if forced == "scan":
         return _k.pack(*args, n_max=n_max)
+    if forced == "pallas":
+        # forced means forced: no silent fallback — fail loudly if the
+        # backend can't serve it (incident escape-hatch semantics)
+        if not pallas_available():
+            raise RuntimeError("KARPENTER_PACKER=pallas but no TPU backend")
+        return pack_pallas(*args, n_max=n_max)
 
     P = args[6].shape[0]  # pod_req
     S, F = args[8].shape[0], args[8].shape[1]  # frontiers
