@@ -318,3 +318,105 @@ class TestEncodeCache:
         # base + the plain pod's open signature only
         assert len(batch.signatures) <= 2
         assert batch.join_table.shape[0] == len(batch.signatures)
+
+
+class TestRandomizedParityWide:
+    """Wider feature mix than TestRandomizedParity: pod (anti-)affinity,
+    host ports, preferred node affinity, taints/tolerations, extended
+    resources, and a live cluster seeded with scheduled pods (topology
+    counts) — the interactions the r3 statics/DomainPlan rewrite must keep
+    byte-equal between the plan-consuming TPU path and the
+    selector-materializing FFD path."""
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_fuzz_wide(self, seed):
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            PodAffinityTerm,
+            PreferredSchedulingTerm,
+            NodeSelectorTerm,
+            Toleration,
+        )
+        from tests.factories import make_node
+        from tests.test_scheduling_parity import with_port
+
+        rng = random.Random(1000 + seed)
+        catalog = instance_types(rng.randint(10, 50))
+        cluster = Cluster()
+        # seed the live cluster: scheduled pods feeding topology/affinity
+        # counts (reference: topology.go:119-127 counts existing pods)
+        for z in ("test-zone-1", "test-zone-2"):
+            node = make_node(
+                name=f"live-{z}", provisioner_name="default",
+                capacity={"cpu": "16", "memory": "32Gi", "pods": "100"},
+                labels={lbl.TOPOLOGY_ZONE: z, lbl.INSTANCE_TYPE: "fake-it-5",
+                        lbl.CAPACITY_TYPE: "on-demand"},
+            )
+            cluster.seed("nodes", node)
+            for j in range(rng.randint(0, 2)):
+                cluster.seed(
+                    "pods",
+                    make_pod(name=f"seeded-{z}-{j}", labels={"app": "web"},
+                             requests={"cpu": "0.5"},
+                             node_name=node.metadata.name, unschedulable=False),
+                )
+        pods = []
+        n = rng.randint(10, 70)
+        for i in range(n):
+            kind = rng.random()
+            requests = {
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([128, 256, 512, 1024])}Mi",
+            }
+            sel = {"app": rng.choice(["web", "db"])}
+            if kind < 0.2:
+                pods.append(make_pod(requests=requests))
+            elif kind < 0.35:
+                # required pod affinity to an app group (zone or hostname)
+                pods.append(make_pod(
+                    requests=requests, labels=sel,
+                    pod_requirements=[PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels=sel),
+                        topology_key=rng.choice([lbl.TOPOLOGY_ZONE, lbl.HOSTNAME]),
+                    )],
+                ))
+            elif kind < 0.5:
+                pods.append(make_pod(
+                    requests=requests, labels=sel,
+                    pod_anti_requirements=[PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels=sel),
+                        topology_key=rng.choice([lbl.TOPOLOGY_ZONE, lbl.HOSTNAME]),
+                    )],
+                ))
+            elif kind < 0.62:
+                pods.append(with_port(
+                    make_pod(requests=requests),
+                    host_port=rng.choice([8080, 8443, 9090]),
+                    protocol=rng.choice(["TCP", "UDP"]),
+                ))
+            elif kind < 0.74:
+                # preferred node affinity (heaviest term folds into the core)
+                pods.append(make_pod(
+                    requests=requests,
+                    node_preferences=[
+                        PreferredSchedulingTerm(
+                            weight=rng.randint(1, 100),
+                            preference=NodeSelectorTerm(match_expressions=[
+                                R(key=lbl.TOPOLOGY_ZONE, operator="In",
+                                  values=[rng.choice(["test-zone-1", "test-zone-2"])])
+                            ]),
+                        )
+                    ],
+                ))
+            elif kind < 0.86:
+                pods.append(make_pod(
+                    requests=requests,
+                    tolerations=[Toleration(key="dedicated", value="team")],
+                    node_selector={lbl.TOPOLOGY_ZONE: rng.choice(
+                        ["test-zone-1", "test-zone-2", "test-zone-3"])},
+                ))
+            else:
+                r2 = dict(requests)
+                r2[res.NVIDIA_GPU] = str(rng.choice([1, 2]))
+                pods.append(make_pod(requests=r2))
+        assert_parity(*both_solve(pods, catalog, cluster=cluster, seed=seed))
