@@ -82,6 +82,10 @@ class EncodedBatch:
     # uniq_req row is all-zero and backs the padding pods.
     pod_req_id: np.ndarray = None  # [P] i32
     uniq_req: np.ndarray = None  # [U+1, R] f32
+    # the TRIMMED axis names matching the emitted arrays' R (inactive
+    # resource axes are dropped at emission); decode maps totals back
+    # through these, not RESOURCE_AXES + axes
+    axis_names: list = None
 
     def type_mask_matrix(self) -> np.ndarray:
         """[S_local, T] stacked signature→type masks for THIS batch's
@@ -418,6 +422,33 @@ def encode(
 
     daemon_vec = res.to_scaled_vector(daemon, axes)
 
+    # Trim inactive resource axes from the EMITTED arrays: kernel time and
+    # transfer bytes scale with R, and a typical batch exercises 3 of the
+    # 8+ reserved axes (cpu/memory/pods). An axis must stay when any pod
+    # requests it, the daemon overhead uses it, or some type's usable
+    # capacity is NEGATIVE there (overhead > capacity — trimming that axis
+    # would stop the fit test from rejecting such types). Fit semantics on
+    # a trimmed axis are vacuous (0 ≤ usable), and the frontier PAD rows
+    # still fail on the kept axes, so assignments are unchanged (the wide
+    # parity sweep pins this). NOTE: stacked multi-solves must encode
+    # same-shaped batches — same pod-axis usage, like the existing same-S
+    # requirement.
+    full_names = res.RESOURCE_AXES + list(axes)
+    active = (uniq_req != 0).any(axis=0) | (daemon_vec != 0) | (usable < 0).any(axis=0)
+    if not active.any():
+        active[0] = True  # keep at least one axis (kernels need R >= 1)
+    if not active.all():
+        keep = np.flatnonzero(active)
+        pod_req = pod_req[:, keep]
+        uniq_req = uniq_req[:, keep]
+        frontiers = frontiers[:, :, keep]
+        daemon_vec = daemon_vec[keep]
+        usable_out = usable[:, keep]
+        axis_names = [full_names[i] for i in keep]
+    else:
+        usable_out = usable
+        axis_names = full_names
+
     # pad pods to bucket
     p_pad = _bucket(max(n, 1))
     pad = p_pad - n
@@ -443,7 +474,8 @@ def encode(
         cores=cores,
         hostnames=hostnames,
         axes=axes,
-        usable=usable,
+        usable=usable_out,
+        axis_names=axis_names,
         # padding pods point at uniq_req's final all-zero row
         pod_req_id=pad1(pod_req_id_core, len(uniq_vecs)),
         uniq_req=uniq_req,

@@ -58,8 +58,10 @@ def test_fused_matches_unfused(n_pods, n_max, seed):
     pod_tab = fused.pack_pod_table(batch)
     assert pod_tab.dtype == np.int16
     uniq = batch.uniq_req
-    # the compact upload must be materially smaller than the old 10-array ship
-    assert pod_tab.nbytes + uniq.nbytes < batch.pod_req.nbytes
+    # the compact upload must be materially smaller than what the unfused
+    # path ships per solve (the seven per-pod arrays)
+    per_pod_bytes = sum(np.asarray(a).nbytes for a in batch.pack_args()[:7])
+    assert pod_tab.nbytes + uniq.nbytes < per_pod_bytes
     buf = jax.device_get(
         fused.fused_solve(
             pod_tab, uniq,
