@@ -731,6 +731,19 @@ def main():
         pipe = bench_pipelined(args.pods, streams=3, iters=max(2, args.iters // 2))
         line["pipelined_pods_per_sec"] = pipe["pods_per_sec"]
         line["pipelined_streams"] = pipe["streams"]
+        # apples-to-apples: the CPU path through the SAME 3-stream harness
+        # (both are GIL-bound on host work; the comparison isolates the
+        # device-vs-native pack difference under continuous load)
+        try:
+            cpu_pipe = bench_pipelined(
+                args.pods, streams=3, iters=max(2, args.iters // 2), packer="native"
+            )
+            line["cpu_native_pipelined_pods_per_sec"] = cpu_pipe["pods_per_sec"]
+            line["tpu_vs_cpu_pipelined"] = round(
+                pipe["pods_per_sec"] / cpu_pipe["pods_per_sec"], 3
+            )
+        except Exception as e:
+            line["cpu_native_pipelined_error"] = str(e)[:120]
         if "cpu_native_pods_per_sec" in line:
             line["tpu_pipelined_vs_cpu_native"] = round(
                 pipe["pods_per_sec"] / line["cpu_native_pods_per_sec"], 3

@@ -16,8 +16,6 @@ system-level invariants asserted at the end:
 import random
 import time
 
-import pytest
-
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.cloudprovider.gke import GkeCloudProvider, SimGkeAPI, ZONES
 from karpenter_tpu.kube.client import Cluster
@@ -30,7 +28,6 @@ from tests.factories import make_pod, make_provisioner
 SOAK_SECONDS = 25.0
 
 
-@pytest.mark.timeout(180)
 def test_soak_full_runtime_random_churn():
     rng = random.Random(20260730)
     api = SimGkeAPI()
