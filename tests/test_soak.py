@@ -129,3 +129,79 @@ def test_soak_full_runtime_random_churn():
             assert n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == "default"
     finally:
         rt.stop()
+
+
+def test_soak_over_apiserver_boundary():
+    """The same churn pushed across the real HTTP + wire-format boundary:
+    TestApiServer + ApiCluster informers (RV-resumed watches), server-side
+    binds (409 on re-bind), merge-patches under load. Shorter than the
+    in-memory soak — every operation pays a real round trip."""
+    import karpenter_tpu.kube.apiserver as apimod
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_tpu.kube.apiserver import ApiCluster
+    from karpenter_tpu.kube.testserver import TestApiServer
+
+    rng = random.Random(42)
+    server = TestApiServer()
+    server.start()
+    client = ApiCluster(server.url)
+    client.start()
+    assert client.wait_for_sync(10)
+    provider = FakeCloudProvider(instance_types(20))
+    rt = build_runtime(Options(), cluster=client, cloud_provider=provider)
+    rt.manager.start()
+    try:
+        prov = make_provisioner(solver="ffd")
+        server.cluster.create("provisioners", prov)
+        deadline = time.time() + 10
+        while time.time() < deadline and not rt.provisioning.workers:
+            time.sleep(0.02)
+        for w in rt.provisioning.workers.values():
+            w.batcher.idle_duration = 0.1
+
+        created = []
+        stop = time.time() + 10.0
+        i = 0
+        while time.time() < stop:
+            action = rng.random()
+            if action < 0.7:
+                name = f"api-soak-{i}"
+                i += 1
+                server.cluster.create(
+                    "pods",
+                    make_pod(name=name, requests={"cpu": f"{rng.choice([0.25, 0.5, 1])}"}),
+                )
+                created.append(name)
+            elif created:
+                victim = created[rng.randrange(len(created))]
+                try:
+                    server.cluster.delete("pods", victim)
+                except Exception:
+                    pass
+            time.sleep(rng.uniform(0.01, 0.05))
+
+        settle_deadline = time.time() + 60
+        while time.time() < settle_deadline:
+            pending = [
+                p for p in server.cluster.pods() if podutil.is_provisionable(p)
+            ]
+            if not pending:
+                break
+            time.sleep(0.25)
+        pending = [p for p in server.cluster.pods() if podutil.is_provisionable(p)]
+        assert not pending, (
+            f"{len(pending)} pods pending after settle over apiserver: "
+            f"{[p.metadata.name for p in pending[:5]]}"
+        )
+        # the client's informer cache converged to the server's truth
+        server_pods = {p.metadata.name for p in server.cluster.pods()}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            client_pods = {p.metadata.name for p in client.pods()}
+            if client_pods == server_pods:
+                break
+            time.sleep(0.2)
+        assert {p.metadata.name for p in client.pods()} == server_pods
+    finally:
+        rt.stop()
+        server.stop()
