@@ -534,17 +534,22 @@ class Topology:
         plan: DomainPlan,
     ) -> None:
         if group.anti:
-            shared_for_nonmatching: Optional[str] = None
-            for pod, st in zip(group.pods, group.sts):
-                if group.selector_matches(pod, st):
-                    # pairwise separation: a fresh node each
-                    domain = self._fresh_hostname(generated_hostnames)
-                else:
-                    # must only avoid the providers' nodes; share one
-                    if shared_for_nonmatching is None:
-                        shared_for_nonmatching = self._fresh_hostname(generated_hostnames)
-                    domain = shared_for_nonmatching
-                plan.set(pod, group.key, domain)
+            # pairwise separation: a fresh node per selector-matching
+            # member; non-matchers only avoid the providers and share one.
+            # Names are drawn in one batched rng call.
+            flags = [
+                group.selector_matches(p, st)
+                for p, st in zip(group.pods, group.sts)
+            ]
+            n_match = sum(flags)
+            fresh = self._fresh_hostnames(
+                n_match + (1 if n_match < len(flags) else 0), generated_hostnames
+            )
+            shared_for_nonmatching = fresh[n_match] if n_match < len(flags) else None
+            it = iter(fresh)
+            key = group.key
+            for pod, matched in zip(group.pods, flags):
+                plan.set(pod, key, next(it) if matched else shared_for_nonmatching)
             return
         # affinity: the whole group lands on one fresh node, provided the
         # match can come from the group itself or another batch pod
@@ -582,12 +587,23 @@ class Topology:
         return None, None
 
     def _fresh_hostname(self, generated_hostnames: List[str]) -> str:
-        # 40 random bits as base-32 hex-ish text: same entropy class as the
-        # old 8-char alphanumeric draw at ~1/4 the cost (a host-spread batch
-        # generates thousands of these per solve)
+        # 40 random bits as hex text: same entropy class as the old 8-char
+        # alphanumeric draw at ~1/4 the cost (a host-spread batch generates
+        # thousands of these per solve)
         name = f"h{self.rng.getrandbits(40):010x}"
         generated_hostnames.append(name)
         return name
+
+    def _fresh_hostnames(self, n: int, generated_hostnames: List[str]) -> List[str]:
+        """n fresh hostnames from ONE rng draw (one 40n-bit integer sliced
+        into 10-hex-char chunks) — per-call rng overhead dominated the
+        anti-affinity hostname loops at thousands of names per solve."""
+        if n <= 0:
+            return []
+        blob = f"{self.rng.getrandbits(40 * n):0{10 * n}x}"
+        names = [f"h{blob[10 * k:10 * (k + 1)]}" for k in range(n)]
+        generated_hostnames.extend(names)
+        return names
 
     # -- host ports --------------------------------------------------------
     def _inject_host_ports(
@@ -736,8 +752,12 @@ class Topology:
         groups overlap — and skew cannot be violated
         (reference: topology.go:98-112)."""
         n_domains = math.ceil(len(group.pods) / max(group.constraint.max_skew, 1))
-        while len(hostname_pool) < n_domains:
-            hostname_pool.append(self._fresh_hostname(generated_hostnames))
+        if len(hostname_pool) < n_domains:
+            hostname_pool.extend(
+                self._fresh_hostnames(
+                    n_domains - len(hostname_pool), generated_hostnames
+                )
+            )
         # pods already pinned to a hostname by affinity participate with that
         # hostname as a registered domain
         for pod in group.pods:
