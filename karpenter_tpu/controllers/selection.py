@@ -277,6 +277,13 @@ class SelectionController:
             return None
         if not is_provisionable(pod):
             return None
+        # prewarm the pod's solve statics HERE, in the wide reconcile pool,
+        # so the worker's solve (one thread, latency-critical) finds every
+        # canonical core/request vector memoized instead of building 10k of
+        # them inside its latency budget
+        from karpenter_tpu.scheduling.statics import statics
+
+        statics(pod)
         errs = validate(pod, self.allow_pod_affinity)
         if errs:
             logger.error("Ignoring pod %s, %s", pod.key, "; ".join(errs))
