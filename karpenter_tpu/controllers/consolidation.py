@@ -98,6 +98,7 @@ class ConsolidationController:
         enabled: bool = True,
         solver_service_address: Optional[str] = None,
         migration: Optional[str] = None,  # "bind" | "evict" | None = auto
+        wave_size: int = EVICT_WAVE_SIZE,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -110,7 +111,7 @@ class ConsolidationController:
             migration = "evict" if isinstance(cluster, ApiCluster) else "bind"
         if migration not in ("bind", "evict"):
             raise ValueError(f"migration must be bind|evict, got {migration}")
-        self.wave_size = EVICT_WAVE_SIZE
+        self.wave_size = max(1, wave_size)
         # in-flight evict wave PER PROVISIONER (reconciles of different
         # provisioners run concurrently): name -> (node names, pod keys
         # already pending when the wave launched, settle deadline)

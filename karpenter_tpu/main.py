@@ -164,6 +164,7 @@ def build_runtime(
         cloud_provider,
         enabled=consolidation_enabled,
         solver_service_address=options.solver_service_address or None,
+        wave_size=options.consolidation_wave_size,
     )
     counter = CounterController(cluster)
     pvc = PVCController(cluster)

@@ -37,6 +37,10 @@ class Options:
     consolidation_enabled: bool = field(
         default_factory=lambda: _env("KARPENTER_CONSOLIDATION", "false").lower() == "true"
     )
+    # evict-mode retirement pacing: nodes retired per reconcile wave
+    consolidation_wave_size: int = field(
+        default_factory=lambda: int(_env("KARPENTER_CONSOLIDATION_WAVE_SIZE", "5"))
+    )
     # leader election: path to a shared lease file; empty = single-process,
     # no election (reference: cmd/controller/main.go:84-85)
     leader_election_lease: str = field(
@@ -57,6 +61,8 @@ class Options:
             errs.append("kube client QPS must be positive")
         if self.kube_client_burst <= 0:
             errs.append("kube client burst must be positive")
+        if self.consolidation_wave_size <= 0:
+            errs.append("consolidation wave size must be positive")
         if self.default_solver not in ("ffd", "tpu"):
             errs.append(f"solver must be ffd|tpu, got {self.default_solver}")
         from karpenter_tpu.logging_config import validate_log_config
@@ -91,6 +97,11 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         help="enable the consolidation (cost-optimal deprovisioning) controller"
         " (--no-consolidation overrides KARPENTER_CONSOLIDATION=true)",
     )
+    ap.add_argument(
+        "--consolidation-wave-size", type=int,
+        default=opts.consolidation_wave_size,
+        help="evict-mode pacing: nodes retired per consolidation wave",
+    )
     ns = ap.parse_args(argv)
     out = Options(
         cluster_name=ns.cluster_name,
@@ -104,6 +115,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
         consolidation_enabled=ns.consolidation,
+        consolidation_wave_size=ns.consolidation_wave_size,
         leader_election_lease=ns.leader_election_lease,
         log_config_file=ns.log_config_file,
         log_level=ns.log_level,
