@@ -103,21 +103,20 @@ class DeviceInvariants:
             self._order.remove(key)
             self._order.append(key)
             return hit
-        if hit is None:
-            hit = tuple(
-                jax.device_put(a)
-                for a in (
-                    batch.join_table.astype(np.int32),
-                    batch.frontiers.astype(np.float32),
-                    batch.daemon.astype(np.float32),
-                    mask.astype(bool),
-                    batch.usable.astype(np.float32),
-                )
+        hit = tuple(
+            jax.device_put(a)
+            for a in (
+                batch.join_table.astype(np.int32),
+                batch.frontiers.astype(np.float32),
+                batch.daemon.astype(np.float32),
+                mask.astype(bool),
+                batch.usable.astype(np.float32),
             )
-            self._cache[key] = hit
-            self._order.append(key)
-            while len(self._order) > self.MAX_ENTRIES:
-                self._cache.pop(self._order.pop(0), None)
+        )
+        self._cache[key] = hit
+        self._order.append(key)
+        while len(self._order) > self.MAX_ENTRIES:
+            self._cache.pop(self._order.pop(0), None)
         return hit
 
 
