@@ -387,3 +387,86 @@ class TestEndToEnd:
         node = cluster.nodes()[0]
         assert node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] == "default"
         assert node.spec.provider_id.startswith("sim:///")
+
+
+class TestSecurityGroups:
+    """reference: aws/suite_test.go Context("Security Groups") — the
+    selector restricts which groups land in the launch template; matching
+    nothing is a loud failure."""
+
+    def test_selector_restricts_groups_in_template(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(
+            provider, provider_cfg={"securityGroupSelector": {"purpose": "extra"}}
+        )
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+        lts = list(api.launch_templates.values())
+        assert lts, "launch expected to create a template"
+        assert lts[-1]["security_groups"] == ["sg-extra"]
+
+    def test_default_selector_picks_node_groups(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+        lts = list(api.launch_templates.values())
+        assert lts[-1]["security_groups"] == ["sg-nodes"]
+
+    def test_no_matching_groups_is_loud(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(
+            provider, provider_cfg={"securityGroupSelector": {"purpose": "nope"}}
+        )
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        with pytest.raises(Exception, match="security groups"):
+            provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+
+
+class TestEphemeralStorage:
+    """reference: aws/suite_test.go Context("Ephemeral Storage") — pods
+    requesting ephemeral-storage schedule against the types' usable
+    storage; over-sized requests are certified unsatisfiable."""
+
+    def test_pod_with_ephemeral_storage_schedules(self, env):
+        import random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+        from tests.factories import make_pod, make_provisioner
+
+        api, provider, _ = env
+        prov = make_provisioner(solver="ffd")
+        c = prov.spec.constraints
+        provider.default(c)
+        catalog = provider.get_instance_types()
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = [
+            make_pod(requests={"cpu": "0.5", "ephemeral-storage": "1Gi"})
+            for _ in range(4)
+        ]
+        nodes = Scheduler(Cluster(), rng=random.Random(1)).solve(prov, catalog, pods)
+        assert sum(len(n.pods) for n in nodes) == 4
+
+    def test_oversized_ephemeral_storage_certified_unsatisfiable(self, env):
+        import random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.oracle import classify_drops
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+        from tests.factories import make_pod, make_provisioner
+
+        api, provider, _ = env
+        prov = make_provisioner(solver="ffd")
+        c = prov.spec.constraints
+        provider.default(c)
+        catalog = provider.get_instance_types()
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = [make_pod(requests={"cpu": "0.5", "ephemeral-storage": "1Pi"})]
+        cluster = Cluster()
+        nodes = Scheduler(cluster, rng=random.Random(1)).solve(prov, catalog, pods)
+        assert sum(len(n.pods) for n in nodes) == 0
+        verdict = classify_drops(
+            cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
+        )
+        assert verdict["dropped"] == 1 and not verdict["unexplained"]
