@@ -470,3 +470,27 @@ class TestEphemeralStorage:
             cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
         )
         assert verdict["dropped"] == 1 and not verdict["unexplained"]
+
+
+class TestInstanceProfile:
+    """reference: aws/suite_test.go Context("Instance Profile") — the
+    provider-config profile flows into the launch template; absent means
+    the (empty/cluster-default) profile."""
+
+    def test_profile_from_provider_config(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(
+            provider, provider_cfg={"instanceProfile": "overridden-profile"}
+        )
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+        lts = list(api.launch_templates.values())
+        assert lts[-1]["instance_profile"] == "overridden-profile"
+
+    def test_default_profile_when_unspecified(self, env):
+        api, provider, _ = env
+        c, catalog = constraints_for(provider)
+        cheapest = sorted(catalog, key=lambda it: it.effective_price())
+        provider.create(NodeRequest(template=c, instance_type_options=cheapest))
+        lts = list(api.launch_templates.values())
+        assert lts[-1]["instance_profile"] == ""
