@@ -693,6 +693,7 @@ class Topology:
                         d[key] = domain
                 continue
             registered = group.spread.keys()
+            soft = group.constraint.when_unsatisfiable == "ScheduleAnyway"
             for pod, st in zip(group.pods, group.sts):
                 # the pod's own requirements may narrow the registered
                 # domains; registered domains are already constraint-viable
@@ -707,6 +708,16 @@ class Topology:
                             if allowed is None
                             else (allowed & {pinned})
                         )
+                if allowed is not None and not allowed:
+                    # the pod's own narrowing excludes every registered
+                    # domain. ScheduleAnyway is a SOFT constraint
+                    # (reference: 'should violate max-skew when unsat =
+                    # schedule anyway'): leave the pod unconstrained by this
+                    # spread and let it schedule. DoNotSchedule falls
+                    # through to next_domain's empty pick ("" — no offering
+                    # provides it), keeping the pod visibly unschedulable.
+                    if soft:
+                        continue
                 domain = group.next_domain(allowed)
                 plan.set(pod, key, domain)
 

@@ -4,6 +4,8 @@ instance_selection_test.go)."""
 
 import random
 
+import pytest
+
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import NodeSelectorRequirement as R, Taint, Toleration
 from karpenter_tpu.cloudprovider.fake import (
@@ -202,3 +204,77 @@ class TestAccelerators:
         pods = [make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(20)]
         nodes = solve(pods, catalog=catalog)
         assert sum(len(n.pods) for n in nodes) == 20
+
+
+class TestScheduleAnyway:
+    """whenUnsatisfiable semantics (reference: 'should violate max-skew
+    when unsat = schedule anyway' / '... not ... do not schedule'): when a
+    pod's own narrowing excludes every registered spread domain,
+    ScheduleAnyway drops the constraint (no domain pinned — the pod remains
+    schedulable on its own merits), DoNotSchedule pins an unprovidable
+    domain (pod visibly unschedulable)."""
+
+    def _inject(self, when: str):
+        from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+        from karpenter_tpu.scheduling.topology import Topology
+        from tests.factories import make_provisioner
+
+        sel = {"app": "s"}
+        spread = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=lbl.TOPOLOGY_ZONE,
+            when_unsatisfiable=when,
+            label_selector=LabelSelector(match_labels=sel),
+        )
+        # the pod's own affinity excludes every zone the constraints
+        # register (NotIn all viable) -> allowed domains are empty
+        pod = make_pod(
+            labels=sel,
+            requests={"cpu": "0.5"},
+            node_requirements=[
+                R(key=lbl.TOPOLOGY_ZONE, operator="NotIn",
+                  values=["test-zone-1", "test-zone-2", "test-zone-3"])
+            ],
+            topology=[spread],
+        )
+        catalog = instance_types(10)
+        prov = make_provisioner()
+        c = prov.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        plan = Topology(Cluster(), rng=random.Random(1)).inject_plan(c, [pod])
+        return pod, plan
+
+    def test_schedule_anyway_leaves_pod_unpinned(self):
+        pod, plan = self._inject("ScheduleAnyway")
+        # soft: the spread stays out of the pod's way entirely
+        assert plan.decision(pod, lbl.TOPOLOGY_ZONE) is None
+
+    def test_do_not_schedule_pins_unprovidable_domain(self):
+        pod, plan = self._inject("DoNotSchedule")
+        pinned = plan.decision(pod, lbl.TOPOLOGY_ZONE)
+        # hard: a domain is pinned, and it is one no offering provides
+        assert pinned is not None
+        assert pinned not in ("test-zone-1", "test-zone-2", "test-zone-3")
+
+    @pytest.mark.parametrize("solver", ["ffd", "tpu"])
+    def test_soft_spread_never_blocks_scheduling(self, solver):
+        from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+        from tests.factories import make_provisioner
+
+        sel = {"app": "s"}
+        spread = TopologySpreadConstraint(
+            max_skew=1, topology_key=lbl.TOPOLOGY_ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels=sel),
+        )
+        catalog = instance_types(10)
+        prov = make_provisioner(solver=solver)
+        c = prov.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = [
+            make_pod(labels=sel, requests={"cpu": "0.5"}, topology=[spread])
+            for _ in range(6)
+        ]
+        nodes = Scheduler(Cluster(), rng=random.Random(1)).solve(prov, catalog, pods)
+        assert sum(len(n.pods) for n in nodes) == 6
