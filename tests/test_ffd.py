@@ -190,6 +190,31 @@ class TestDaemonOverhead:
         nodes = solve([make_pod(requests={"cpu": "1"})], cluster=cluster)
         assert len(nodes) == 1
 
+    def test_daemonset_without_matching_toleration_ignored(self):
+        # reference: 'should ignore daemonsets without matching tolerations'
+        # — a tainted provisioner's nodes never run an intolerant daemonset,
+        # so its requests must not inflate the overhead
+        from karpenter_tpu.scheduling.ffd import daemon_overhead
+        from tests.factories import make_provisioner
+
+        cluster = Cluster()
+        cluster.create("daemonsets", make_daemonset(requests={"cpu": "4"}))
+        prov = make_provisioner(
+            taints=[Taint(key="dedicated", value="team", effect="NoSchedule")]
+        )
+        overhead = daemon_overhead(cluster, prov.spec.constraints)
+        assert overhead.get(res.CPU, 0.0) == 0.0
+        # the same daemonset WITH the toleration counts
+        cluster.create(
+            "daemonsets",
+            make_daemonset(
+                requests={"cpu": "2"},
+                tolerations=[Toleration(key="dedicated", value="team")],
+            ),
+        )
+        overhead = daemon_overhead(cluster, prov.spec.constraints)
+        assert overhead.get(res.CPU, 0.0) == 2.0
+
 
 class TestAccelerators:
     def test_gpu_pod_gets_gpu_node(self):
