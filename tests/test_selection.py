@@ -322,3 +322,59 @@ class TestVolumeTopology:
         assert pod.spec.node_name != ""
         node = cluster.get("nodes", pod.spec.node_name, namespace="")
         assert node.metadata.labels[lbl.TOPOLOGY_ZONE] == "test-zone-3"
+
+
+class TestProvisionerRouting:
+    """reference: selection/suite_test.go — alphabetical priority among
+    matching provisioners, and a PreferNoSchedule-tainted provisioner loses
+    to an untainted match (the pod would need the final relaxation rung to
+    tolerate it)."""
+
+    def _controller(self, cluster, provider, *provs):
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        for p in provs:
+            cluster.create("provisioners", p)
+            controller.reconcile(p.metadata.name)
+        return controller
+
+    def test_alphabetical_priority_among_matches(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.selection import SelectionController
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = self._controller(
+            cluster, provider,
+            make_provisioner(name="zeta"), make_provisioner(name="alpha"),
+        )
+        selection = SelectionController(cluster, controller, wait=False)
+        pod = make_pod(requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        assert selection.select_provisioner(pod) is True
+        assert controller.workers["alpha"].is_pending(pod.key)
+        assert not controller.workers["zeta"].is_pending(pod.key)
+
+    def test_prefer_no_schedule_taint_loses_to_untainted_match(self):
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.selection import SelectionController
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = self._controller(
+            cluster, provider,
+            make_provisioner(
+                name="aaa-tainted",
+                taints=[Taint(key="soft", value="x", effect="PreferNoSchedule")],
+            ),
+            make_provisioner(name="bbb-clean"),
+        )
+        selection = SelectionController(cluster, controller, wait=False)
+        pod = make_pod(requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        assert selection.select_provisioner(pod) is True
+        # alphabetically first but tainted -> skipped without relaxation
+        assert controller.workers["bbb-clean"].is_pending(pod.key)
+        assert not controller.workers["aaa-tainted"].is_pending(pod.key)
