@@ -158,7 +158,7 @@ class TpuScheduler:
         if self._device_cache is None:
             self._device_cache = fused.DeviceInvariants()
         join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
-        pod_tab = fused.pack_pod_table(batch)
+        pod_tab, open_by_core, bhh = fused.pack_pod_table(batch)
         # bucket U so a drifting unique-request count doesn't recompile
         uniq = batch.uniq_req
         u_pad = 16
@@ -172,7 +172,8 @@ class TpuScheduler:
 
         buf = jax.device_get(
             fused.fused_solve(
-                pod_tab, uniq, join_d, front_d, daemon_d, mask_d, usable_d,
+                pod_tab, open_by_core, bhh, uniq,
+                join_d, front_d, daemon_d, mask_d, usable_d,
                 n_max=n_max, kernel="pallas" if pallas_available() else "scan",
             )
         )

@@ -86,6 +86,12 @@ class EncodedBatch:
     # resource axes are dropped at emission); decode maps totals back
     # through these, not RESOURCE_AXES + axes
     axis_names: list = None
+    # per-core fresh-node signatures + whether the base constraints carry a
+    # hostname requirement — the fused dispatch derives pod_open_sig and
+    # pod_open_host ON DEVICE from these instead of shipping two more
+    # per-pod rows
+    open_sig_by_core: np.ndarray = None  # [C] i32
+    base_has_hostname: bool = False
 
     def type_mask_matrix(self) -> np.ndarray:
         """[S_local, T] stacked signature→type masks for THIS batch's
@@ -479,4 +485,6 @@ def encode(
         # padding pods point at uniq_req's final all-zero row
         pod_req_id=pad1(pod_req_id_core, len(uniq_vecs)),
         uniq_req=uniq_req,
+        open_sig_by_core=open_sig_by_core,
+        base_has_hostname=base_has_hostname,
     )

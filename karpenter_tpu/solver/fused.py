@@ -29,13 +29,15 @@ from typing import Dict
 import jax
 import numpy as np
 
-# pod scalar rows in the packed [6, P] i16 table
+# pod scalar rows in the packed [4, P] i16 table. open_sig and open_host
+# are DERIVED on device: open_sig = open_sig_by_core[core] (a tiny [C]
+# array shipped alongside), open_host = host when joinable (host in base
+# domains, or no base hostname requirement) else the poison value -2 —
+# exactly encode's host-side formulas.
 ROW_FLAGS = 0  # bit0 = valid, bit1 = host_in_base
-ROW_OPEN_SIG = 1
-ROW_CORE = 2
-ROW_HOST = 3
-ROW_OPEN_HOST = 4
-ROW_REQ_ID = 5
+ROW_CORE = 1
+ROW_HOST = 2
+ROW_REQ_ID = 3
 
 I16_MAX = 32766
 
@@ -53,21 +55,23 @@ def ids_fit(batch) -> bool:
     )
 
 
-def pack_pod_table(batch) -> np.ndarray:
-    """The per-solve compact upload: [6, P] i16."""
+def pack_pod_table(batch):
+    """The per-solve compact upload: ([4, P] i16 pod table,
+    [C] i16 per-core open signatures, scalar base_has_hostname i32)."""
     flags = batch.pod_valid.astype(np.int16) | (
         batch.pod_host_in_base.astype(np.int16) << 1
     )
-    return np.stack(
+    tab = np.stack(
         [
             flags,
-            batch.pod_open_sig.astype(np.int16),
             batch.pod_core.astype(np.int16),
             batch.pod_host.astype(np.int16),
-            batch.pod_open_host.astype(np.int16),
             batch.pod_req_id.astype(np.int16),
         ]
     )
+    open_by_core = np.asarray(batch.open_sig_by_core).astype(np.int16)
+    bhh = np.array([1 if batch.base_has_hostname else 0], np.int32)
+    return tab, open_by_core, bhh
 
 
 class DeviceInvariants:
@@ -136,7 +140,9 @@ def _pack_typebits(ok, T32):
 
 @partial(jax.jit, static_argnames=("n_max", "kernel"))
 def fused_solve(
-    pod_tab,  # [6, P] i16
+    pod_tab,  # [4, P] i16
+    open_by_core,  # [C] i16 — per-core fresh-node signatures
+    bhh,  # [1] i32 — base constraints carry a hostname requirement
     uniq_req,  # [U, R] f32 (last row zeros = padding pods)
     join_table,  # [S, C] i32 (device-resident)
     frontiers,  # [S, F, R] f32 (device-resident)
@@ -153,10 +159,15 @@ def fused_solve(
     tab = pod_tab.astype(jnp.int32)
     pod_valid = (tab[ROW_FLAGS] & 1) != 0
     pod_host_in_base = (tab[ROW_FLAGS] & 2) != 0
-    pod_open_sig = tab[ROW_OPEN_SIG]
     pod_core = tab[ROW_CORE]
     pod_host = tab[ROW_HOST]
-    pod_open_host = tab[ROW_OPEN_HOST]
+    pod_open_sig = open_by_core.astype(jnp.int32)[pod_core]
+    # encode's host-side formula, on device: joinable hostname state when
+    # the merged hostname set stays non-empty, poisoned (-2) otherwise
+    joinable = pod_host_in_base | (bhh[0] == 0)
+    pod_open_host = jnp.where(
+        pod_host >= 0, jnp.where(joinable, pod_host, -2), -1
+    ).astype(jnp.int32)
     pod_req = uniq_req[tab[ROW_REQ_ID]]  # [P, R] gather on device
 
     args = (

@@ -55,16 +55,16 @@ def test_fused_matches_unfused(n_pods, n_max, seed):
     )
 
     # fused: compact upload, one dispatch, one buffer
-    pod_tab = fused.pack_pod_table(batch)
-    assert pod_tab.dtype == np.int16
+    pod_tab, open_by_core, bhh = fused.pack_pod_table(batch)
+    assert pod_tab.dtype == np.int16 and pod_tab.shape[0] == 4
     uniq = batch.uniq_req
     # the compact upload must be materially smaller than what the unfused
     # path ships per solve (the seven per-pod arrays)
     per_pod_bytes = sum(np.asarray(a).nbytes for a in batch.pack_args()[:7])
-    assert pod_tab.nbytes + uniq.nbytes < per_pod_bytes
+    assert pod_tab.nbytes + open_by_core.nbytes + uniq.nbytes < per_pod_bytes
     buf = jax.device_get(
         fused.fused_solve(
-            pod_tab, uniq,
+            pod_tab, open_by_core, bhh, uniq,
             batch.join_table.astype(np.int32),
             batch.frontiers.astype(np.float32),
             batch.daemon.astype(np.float32),
