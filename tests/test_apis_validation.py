@@ -121,3 +121,24 @@ class TestLimits:
         assert limits.exceeded_by({"cpu": 10.0}) is not None  # at the limit
         assert limits.exceeded_by({"cpu": 9.9}) is None
         assert limits.exceeded_by({"memory": 1e12}) is None  # unlimited resource
+
+
+class TestLabelKeyEdges:
+    """reference: v1alpha5 suite 'should fail for invalid label keys' /
+    'should allow labels kOps require'."""
+
+    def test_malformed_label_key_rejected(self):
+        p = make_provisioner(labels={"not a valid key!": "v"})
+        assert validate_provisioner(p)
+
+    def test_kops_domain_exception_allowed(self):
+        p = make_provisioner(labels={"kops.k8s.io/instancegroup": "nodes"})
+        assert not validate_provisioner(p)
+
+    def test_invalid_taint_value_rejected(self):
+        from karpenter_tpu.api.objects import Taint
+
+        p = make_provisioner(
+            taints=[Taint(key="ok", value="bad value!", effect="NoSchedule")]
+        )
+        assert validate_provisioner(p)
