@@ -6,7 +6,8 @@ Mirrors ``pkg/apis/provisioning/v1alpha5/labels.go`` and the group constants in
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+import re
+from typing import Dict, List, Optional, Set
 
 # Kubernetes well-known labels.
 TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
@@ -59,6 +60,61 @@ NORMALIZED_LABELS: Dict[str, str] = {
 }
 
 IGNORED_LABELS: Set[str] = {TOPOLOGY_REGION}
+
+
+# Syntax rules (reference: provisioner_validation.go:75-100 via
+# k8s.io/apimachinery validation.IsQualifiedName / IsValidLabelValue).
+_NAME_RE = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9\-_.]*[A-Za-z0-9])?$")
+_DNS1123_SUBDOMAIN_RE = re.compile(
+    r"^[a-z0-9]([a-z0-9\-]*[a-z0-9])?(\.[a-z0-9]([a-z0-9\-]*[a-z0-9])?)*$"
+)
+_MAX_NAME_LEN = 63
+_MAX_PREFIX_LEN = 253
+
+
+def check_qualified_name(key: str) -> List[str]:
+    """Syntax errors for a label/taint key: ``[prefix/]name`` where the
+    optional prefix is a DNS-1123 subdomain (≤253 chars) and the name is ≤63
+    alphanumeric-bounded chars allowing ``-_.`` inside."""
+    errs: List[str] = []
+    parts = key.split("/")
+    if len(parts) == 1:
+        name = parts[0]
+    elif len(parts) == 2:
+        prefix, name = parts
+        if not prefix:
+            errs.append(f"{key}: prefix part must be non-empty")
+        elif len(prefix) > _MAX_PREFIX_LEN:
+            errs.append(f"{key}: prefix part must be no more than {_MAX_PREFIX_LEN} characters")
+        elif not _DNS1123_SUBDOMAIN_RE.fullmatch(prefix):
+            errs.append(f"{key}: prefix part must be a lowercase RFC 1123 subdomain")
+    else:
+        return [f"{key}: a qualified name must consist of a name part and an optional prefix part separated by a single '/'"]
+    if not name:
+        errs.append(f"{key}: name part must be non-empty")
+    elif len(name) > _MAX_NAME_LEN:
+        errs.append(f"{key}: name part must be no more than {_MAX_NAME_LEN} characters")
+    elif not _NAME_RE.fullmatch(name):
+        errs.append(
+            f"{key}: name part must consist of alphanumeric characters, '-', '_' or '.', "
+            "and must start and end with an alphanumeric character"
+        )
+    return errs
+
+
+def check_label_value(value: str) -> List[str]:
+    """Syntax errors for a label or taint value: empty or ≤63
+    alphanumeric-bounded chars allowing ``-_.`` inside."""
+    if not value:
+        return []
+    if len(value) > _MAX_NAME_LEN:
+        return [f"{value}: must be no more than {_MAX_NAME_LEN} characters"]
+    if not _NAME_RE.fullmatch(value):
+        return [
+            f"{value}: a valid label value must consist of alphanumeric characters, "
+            "'-', '_' or '.', and must start and end with an alphanumeric character"
+        ]
+    return []
 
 
 def _label_domain(key: str) -> str:

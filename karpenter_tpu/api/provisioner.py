@@ -168,14 +168,20 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
         errs.append(f"solver must be one of [{SOLVER_FFD}, {SOLVER_TPU}], got {spec.solver}")
     c = spec.constraints
     for key, value in c.labels.items():
+        errs.extend(lbl.check_qualified_name(key))
         err = lbl.check_restricted_label(key)
         if err:
             errs.append(err)
         if not value:
             errs.append(f"label {key} has empty value")
+        else:
+            errs.extend(lbl.check_label_value(value))
     for taint in c.taints:
         if not taint.key:
             errs.append("taint key must not be empty")
+        else:
+            errs.extend(lbl.check_qualified_name(taint.key))
+        errs.extend(lbl.check_label_value(taint.value))
         if taint.effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
             errs.append(f"invalid taint effect {taint.effect}")
     for req in c.requirements.requirements:
@@ -183,6 +189,7 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
             errs.append(
                 f"operator {req.operator} not in {sorted(SUPPORTED_PROVISIONER_OPS)} for key {req.key}"
             )
+        # key syntax is covered by c.requirements.validate() below
         err = lbl.check_restricted_label(req.key)
         if err:
             errs.append(err)

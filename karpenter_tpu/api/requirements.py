@@ -180,20 +180,8 @@ class Requirements:
 
 
 def _is_qualified_name(key: str) -> bool:
-    if not key or len(key) > 317:  # 253 prefix + / + 63 name
-        return False
-    parts = key.split("/")
-    if len(parts) > 2:
-        return False
-    name = parts[-1]
-    if not name or len(name) > 63:
-        return False
-    return all(c.isalnum() or c in "-_." for c in name) and name[0].isalnum() and name[-1].isalnum()
+    return not lbl.check_qualified_name(key)
 
 
 def _is_valid_label_value(value: str) -> bool:
-    if value == "":
-        return True
-    if len(value) > 63:
-        return False
-    return all(c.isalnum() or c in "-_." for c in value) and value[0].isalnum() and value[-1].isalnum()
+    return not lbl.check_label_value(value)

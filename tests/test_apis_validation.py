@@ -142,3 +142,32 @@ class TestLabelKeyEdges:
             taints=[Taint(key="ok", value="bad value!", effect="NoSchedule")]
         )
         assert validate_provisioner(p)
+
+    def test_malformed_label_value_rejected(self):
+        assert validate_provisioner(make_provisioner(labels={"example.com/team": "bad value!"}))
+        assert validate_provisioner(make_provisioner(labels={"example.com/team": "-leading"}))
+        assert validate_provisioner(make_provisioner(labels={"example.com/team": "x" * 64}))
+
+    def test_valid_label_value_allowed(self):
+        assert not validate_provisioner(make_provisioner(labels={"example.com/team": "a-b_c.d"}))
+        assert not validate_provisioner(make_provisioner(labels={"example.com/team": "x" * 63}))
+
+    def test_label_key_length_and_prefix_syntax(self):
+        # name part > 63 chars
+        assert validate_provisioner(make_provisioner(labels={"p" * 64: "v"}))
+        # prefix not a DNS-1123 subdomain
+        assert validate_provisioner(make_provisioner(labels={"Bad_Domain!/name": "v"}))
+        # multiple slashes
+        assert validate_provisioner(make_provisioner(labels={"a/b/c": "v"}))
+        # prefix > 253 chars
+        assert validate_provisioner(make_provisioner(labels={("a" * 254) + "/name": "v"}))
+
+    def test_malformed_taint_key_rejected(self):
+        p = make_provisioner(taints=[Taint(key="not a key!", effect="NoSchedule")])
+        assert validate_provisioner(p)
+
+    def test_malformed_requirement_key_rejected(self):
+        p = make_provisioner(
+            requirements=[NodeSelectorRequirement(key="spaced key", operator="Exists")]
+        )
+        assert validate_provisioner(p)
