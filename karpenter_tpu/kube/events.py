@@ -13,6 +13,7 @@ series handling.
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 from collections import OrderedDict
@@ -51,6 +52,9 @@ class EventRecorder:
         try:
             now = self.cluster.clock()
             key = (involved_kind, involved_name, namespace, reason, message)
+            # The lock guards only _seen/_counter bookkeeping; API writes
+            # happen outside it so a slow apiserver call can't serialize
+            # every controller's event emission behind this recorder.
             with self._lock:
                 hit = self._seen.get(key)
                 if hit is not None and now - hit[0] < AGGREGATION_WINDOW:
@@ -59,12 +63,20 @@ class EventRecorder:
                     ev.last_timestamp = now
                     self._seen[key] = (now, ev)
                     self._seen.move_to_end(key)
-                    try:
-                        self.cluster.update("events", ev)
-                    except Exception:
-                        pass  # the event may have been pruned; re-create below
-                    else:
-                        return ev
+                    # snapshot under the lock: the write below races with
+                    # other threads' bumps, and a half-mutated event must
+                    # never be serialized to the wire
+                    snapshot = copy.copy(ev)
+                else:
+                    ev = None
+            if ev is not None:
+                try:
+                    self.cluster.update("events", snapshot)
+                except Exception:
+                    pass  # the event may have been pruned; re-create below
+                else:
+                    return ev
+            with self._lock:
                 self._counter += 1
                 name = f"{involved_name}.{self._counter:x}.{int(now)}"
             ev = Event(
