@@ -97,14 +97,18 @@ def sort_pods_ffd_with_statics(pods: Sequence[Pod]):
 
     from karpenter_tpu.scheduling.statics import statics
 
+    import operator
+
     n = len(pods)
     sts = [statics(p) for p in pods]
     if n < 256:
         order = sorted(range(n), key=lambda i: (-sts[i].cpu, -sts[i].mem))
     else:
-        cpu = np.fromiter((s.cpu for s in sts), dtype=np.float64, count=n)
-        mem = np.fromiter((s.mem for s in sts), dtype=np.float64, count=n)
-        order = np.lexsort((-mem, -cpu))  # primary key last; lexsort is stable
+        cpu = np.fromiter(map(operator.attrgetter("cpu"), sts), dtype=np.float64, count=n)
+        mem = np.fromiter(map(operator.attrgetter("mem"), sts), dtype=np.float64, count=n)
+        # primary key last; lexsort is stable. tolist() first: indexing
+        # Python lists with np.int64 scalars pays a boxing cost per element
+        order = np.lexsort((-mem, -cpu)).tolist()
     return [pods[i] for i in order], [sts[i] for i in order]
 
 

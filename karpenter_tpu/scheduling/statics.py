@@ -50,6 +50,9 @@ class PodStatics:
         "labels_key",       # tuple(sorted(metadata.labels.items()))
         "aff_terms",        # tuple of (group_key, term, anti) for supported keys
         "spreads",          # tuple of (group_key, constraint)
+        "topo_any",         # bool: any aff_terms/spreads/host_ports (discovery skip)
+        "topo_code",        # int id of the (aff keys, spread keys, ports) class;
+                            # 0 = no topology, -1 = registry overflow (slow path)
         "key_entries",      # {key: ((op, values_tuple), ...)} for NARROWED_KEYS
         "constrains",       # frozenset of keys the spec itself narrows
         "merge_tid",        # interned id of (sel_raw, aff_entries, aff_hostname)
@@ -66,6 +69,20 @@ class PodStatics:
 _merge_interns: Dict[Tuple, Tuple] = {}
 _req_interns: Dict[Tuple, Tuple] = {}
 _INTERN_MAX = 1 << 20
+
+# topology-class registry: pods whose (affinity group keys, spread group
+# keys, has-ports) agree are distributed to the same topology groups, so
+# discovery can bucket a batch by ONE int per pod and gather members with
+# numpy instead of 10k Python appends. Codes live in statics memos, so the
+# table is never cleared — it is capped instead (code -1 = per-pod path).
+# The lock makes code assignment atomic: statics are built concurrently
+# from the selection reconcile pool, and two classes sharing one code
+# would silently merge their topology groups in discovery.
+import threading as _threading
+
+_topo_classes: Dict[Tuple, int] = {}
+_topo_lock = _threading.Lock()
+_TOPO_CLASS_MAX = 1 << 16
 
 
 def _intern(table: Dict[Tuple, Tuple], key: Tuple) -> Tuple:
@@ -211,6 +228,26 @@ def _build(pod: Pod) -> PodStatics:
     st.spreads = tuple(
         (_group_key(ns, c), c) for c in spec.topology_spread_constraints
     )
+    st.topo_any = bool(st.aff_terms or st.spreads or st.host_ports)
+    if st.topo_any:
+        ckey = (
+            tuple(k for k, _, _ in st.aff_terms),
+            tuple(k for k, _ in st.spreads),
+            bool(st.host_ports),
+        )
+        code = _topo_classes.get(ckey)
+        if code is None:
+            with _topo_lock:
+                code = _topo_classes.get(ckey)
+                if code is None:
+                    if len(_topo_classes) >= _TOPO_CLASS_MAX:
+                        code = -1  # registry full: per-pod discovery path
+                    else:
+                        code = len(_topo_classes) + 1
+                        _topo_classes[ckey] = code
+        st.topo_code = code
+    else:
+        st.topo_code = 0
     return st
 
 

@@ -129,6 +129,52 @@ class DomainPlan:
             tok = self.ztokens[pid] = intern.setdefault(items, items)
         return tok
 
+    @staticmethod
+    def intern_token(key: str, domain: str) -> Tuple:
+        """The canonical interned token of a single zone-class decision —
+        lets bulk writers stamp one shared token across a whole group
+        instead of each pod re-building it lazily in encode."""
+        items = ((key, domain),)
+        intern = DomainPlan._tok_intern
+        if len(intern) > (1 << 20):
+            intern.clear()
+        return intern.setdefault(items, items)
+
+    def set_zone_bulk(self, members, key: str, domain: str) -> None:
+        """Assign one non-hostname decision to many pods at once, stamping
+        the shared interned token. Pods that already carry another
+        non-hostname decision take the generic ``set`` path (their token
+        must be rebuilt from the full decision dict)."""
+        tok = self.intern_token(key, domain)
+        by_pod = self.by_pod
+        ztokens = self.ztokens
+        hostname_key = lbl.HOSTNAME
+        for pod in members:
+            pid = id(pod)
+            d = by_pod.get(pid)
+            if d is None:
+                by_pod[pid] = {key: domain}
+                ztokens[pid] = tok
+            elif all(k == hostname_key or k == key for k in d):
+                d[key] = domain
+                ztokens[pid] = tok
+            else:
+                d[key] = domain
+                ztokens.pop(pid, None)
+
+    def set_hostname_bulk(self, pods_and_names) -> None:
+        """Assign hostname decisions for many (pod, name) pairs; hostname
+        never contributes to zone tokens, so no token bookkeeping."""
+        by_pod = self.by_pod
+        hostname_key = lbl.HOSTNAME
+        for pod, name in pods_and_names:
+            pid = id(pod)
+            d = by_pod.get(pid)
+            if d is None:
+                by_pod[pid] = {hostname_key: name}
+            else:
+                d[hostname_key] = name
+
     def items(self, pod: Pod) -> Optional[Dict[str, str]]:
         return self.by_pod.get(id(pod))
 
@@ -195,6 +241,28 @@ class AffinityGroup:
     def key(self) -> str:
         return self.term.topology_key
 
+    def match_flags(self, members) -> List[bool]:
+        """``selector_matches`` over (pod, statics) pairs with the memo and
+        namespace test hoisted — this runs O(pods) per group per solve."""
+        sel = self.term.label_selector
+        nss = self._namespaces
+        if sel is None:
+            return [p.metadata.namespace in nss for p, _ in members]
+        memo = self._match_memo
+        out = []
+        append = out.append
+        matches = sel.matches
+        for pod, st in members:
+            if pod.metadata.namespace not in nss:
+                append(False)
+                continue
+            lk = st.labels_key
+            hit = memo.get(lk)
+            if hit is None:
+                hit = memo[lk] = matches(pod.metadata.labels)
+            append(hit)
+        return out
+
     def selector_matches(self, pod: Pod, st: Optional[PodStatics] = None) -> bool:
         if pod.metadata.namespace not in self._namespaces:
             return False
@@ -248,26 +316,7 @@ class Topology:
         aff_groups: Dict[Tuple, AffinityGroup] = {}
         spread_groups: Dict[Tuple, TopologyGroup] = {}
         port_members: List[Tuple[Pod, PodStatics]] = []
-        for pod, st in zip(pods, sts):
-            if st.aff_terms:
-                for key, term, anti in st.aff_terms:
-                    g = aff_groups.get(key)
-                    if g is None:
-                        g = aff_groups[key] = AffinityGroup(
-                            pod.metadata.namespace, term, anti
-                        )
-                    g.pods.append(pod)
-                    g.sts.append(st)
-            if st.host_ports:
-                port_members.append((pod, st))
-            if st.spreads:
-                for key, constraint in st.spreads:
-                    g = spread_groups.get(key)
-                    if g is None:
-                        g = spread_groups[key] = TopologyGroup(pod, constraint)
-                        g.pods.pop()  # ctor added the pod; re-add with its st
-                    g.pods.append(pod)
-                    g.sts.append(st)
+        self._discover(pods, sts, aff_groups, spread_groups, port_members)
         self._inject_affinity(
             constraints, pods, list(aff_groups.values()), generated_hostnames, plan
         )
@@ -284,6 +333,126 @@ class Topology:
                 )
             )
         return plan
+
+    # -- discovery ---------------------------------------------------------
+    @staticmethod
+    def _discover(pods, sts, aff_groups, spread_groups, port_members) -> None:
+        """Distribute pods into affinity/spread/port structures. Large
+        batches are bucketed by the statics-interned topology-class code and
+        gathered with numpy — one C-level gather per (class, group) instead
+        of 10k Python-level appends — preserving batch order within every
+        group (stable argsort). Small batches and registry-overflow pods
+        (code -1) take the per-pod path."""
+        n = len(pods)
+        bucketed = False
+        if n >= 512:
+            import operator
+
+            import numpy as np
+
+            codes = np.fromiter(
+                map(operator.attrgetter("topo_code"), sts), np.int64, count=n
+            )
+            if codes.any():
+                bucketed = True
+                order = np.argsort(codes, kind="stable")
+                sorted_codes = codes[order]
+                uniq, starts = np.unique(sorted_codes, return_index=True)
+                bounds = list(starts.tolist()) + [n]
+                # visit classes in order of FIRST APPEARANCE in the batch,
+                # not registry-code order: group creation order decides
+                # processing order downstream (stable anti-first sort), and
+                # it must match the per-pod path / be independent of what
+                # earlier solves registered
+                first_pos = order[starts].tolist()
+                visit_order = sorted(range(len(uniq)), key=first_pos.__getitem__)
+                aff_idx: Dict[Tuple, list] = {}
+                spread_idx: Dict[Tuple, list] = {}
+                port_idx: list = []
+                slow_idx = None
+                for j in visit_order:
+                    code = int(uniq[j])
+                    if code == 0:
+                        continue
+                    idx = order[bounds[j]:bounds[j + 1]]
+                    if code == -1:
+                        slow_idx = idx
+                        continue
+                    rep = sts[int(idx[0])]
+                    for key, term, anti in rep.aff_terms:
+                        if key not in aff_groups:
+                            aff_groups[key] = AffinityGroup(
+                                pods[int(idx[0])].metadata.namespace, term, anti
+                            )
+                        aff_idx.setdefault(key, []).append(idx)
+                    for key, constraint in rep.spreads:
+                        if key not in spread_groups:
+                            g = spread_groups[key] = TopologyGroup(
+                                pods[int(idx[0])], constraint
+                            )
+                            g.pods.pop()  # ctor added the pod; gathered below
+                        spread_idx.setdefault(key, []).append(idx)
+                    if rep.host_ports:
+                        port_idx.append(idx)
+
+                def gather(target_pods, target_sts, idx_arrays):
+                    idx = (
+                        np.sort(np.concatenate(idx_arrays))
+                        if len(idx_arrays) > 1
+                        else idx_arrays[0]
+                    ).tolist()
+                    getter = operator.itemgetter(*idx)
+                    if len(idx) == 1:
+                        target_pods.append(getter(pods))
+                        target_sts.append(getter(sts))
+                    else:
+                        target_pods.extend(getter(pods))
+                        target_sts.extend(getter(sts))
+
+                for key, arrays in aff_idx.items():
+                    g = aff_groups[key]
+                    gather(g.pods, g.sts, arrays)
+                for key, arrays in spread_idx.items():
+                    g = spread_groups[key]
+                    gather(g.pods, g.sts, arrays)
+                if port_idx:
+                    idx = (
+                        np.sort(np.concatenate(port_idx))
+                        if len(port_idx) > 1
+                        else port_idx[0]
+                    ).tolist()
+                    port_members.extend((pods[i], sts[i]) for i in idx)
+                if slow_idx is None:
+                    return
+                pairs = [(pods[i], sts[i]) for i in slow_idx.tolist()]
+            else:
+                return
+        if not bucketed:
+            pairs = zip(pods, sts)
+        aff_get = aff_groups.get
+        spread_get = spread_groups.get
+        for pod, st in pairs:
+            if not st.topo_any:
+                continue
+            if st.aff_terms:
+                for key, term, anti in st.aff_terms:
+                    g = aff_get(key)
+                    if g is None:
+                        g = aff_groups[key] = AffinityGroup(
+                            pod.metadata.namespace, term, anti
+                        )
+                    g.pods.append(pod)
+                    g.sts.append(st)
+            if st.host_ports:
+                port_members.append((pod, st))
+            if st.spreads:
+                for key, constraint in st.spreads:
+                    g = spread_get(key)
+                    if g is None:
+                        g = spread_groups[key] = TopologyGroup(pod, constraint)
+                        g.pods.pop()  # ctor added the pod; re-add with its st
+                    g.pods.append(pod)
+                    g.sts.append(st)
 
     # -- pod (anti-)affinity ----------------------------------------------
     def _inject_affinity(
@@ -380,7 +549,87 @@ class Topology:
         viable = constraints.requirements.zones()
         key = group.key
         members = list(zip(group.pods, group.sts))
-        pins = [plan.decision(p, key) for p, _ in members]
+        by_pod_get = plan.by_pod.get
+        pins = [
+            d.get(key) if (d := by_pod_get(id(p))) else None for p, _ in members
+        ]
+        # bulk fast path: no member is narrowed by its own spec and none is
+        # pinned by an earlier pass — the per-pod loops then degenerate to a
+        # handful of distinct domains stamped across the whole group (the
+        # overwhelmingly common shape: template pods with pod-affinity only)
+        unrestricted = not any(pins) and all(
+            key not in st.key_entries for _, st in members
+        )
+        if unrestricted and group.anti:
+            flags = group.match_flags(members)
+            n_match = sum(flags)
+            clean = sorted(d for d in viable if group.match_counts.get(d, 0) == 0)
+            # one clean zone is reserved for the non-matching cohort (see the
+            # general path below for the rationale); with no narrowing the
+            # reservation choice is simply the first clean zone
+            reserved = clean[0] if (n_match and n_match < len(flags) and clean) else None
+            free_list = [d for d in clean if d != reserved]
+            matching_pods = [p for (p, _), m in zip(members, flags) if m]
+            # matchers claim one free zone each; beyond the free zones they
+            # are provably unplaceable
+            placed = matching_pods[: len(free_list)]
+            for d, pod in zip(free_list, placed):
+                group.match_counts[d] = 1
+                plan.set_zone_bulk((pod,), key, d)
+            if len(matching_pods) > len(placed):
+                plan.set_zone_bulk(matching_pods[len(placed):], key, UNSATISFIABLE_DOMAIN)
+            if n_match < len(flags):
+                free_nm = sorted(
+                    d for d in viable if group.match_counts.get(d, 0) == 0
+                )
+                shared_nm = free_nm[0] if free_nm else UNSATISFIABLE_DOMAIN
+                plan.set_zone_bulk(
+                    [p for (p, _), m in zip(members, flags) if not m], key, shared_nm
+                )
+            return
+        if unrestricted and not group.anti and members:
+            # resolve the FIRST member through the general logic (it may
+            # seed a domain via a batch provider); every later unrestricted
+            # member then picks the populated argmax, which placing there
+            # only strengthens — so the rest of the group lands on one
+            # domain computed once
+            self._assign_zonal_affinity_general(
+                constraints, group, batch, plan, [members[0]], viable, key
+            )
+            rest = members[1:]
+            if not rest:
+                return
+            populated = sorted(
+                (d for d in viable if group.match_counts.get(d, 0) > 0),
+                key=lambda d: (-group.match_counts[d], d),
+            )
+            if populated:
+                # match_counts is not updated for the bulk members: the
+                # group is complete after this write and nothing reads the
+                # counts afterwards (cross-group state flows via plan pins)
+                plan.set_zone_bulk([p for p, _ in rest], key, populated[0])
+            else:
+                # first member resolved unsatisfiable with no counts: no
+                # provider exists for the whole group
+                plan.set_zone_bulk([p for p, _ in rest], key, UNSATISFIABLE_DOMAIN)
+            return
+        self._assign_zonal_affinity_general(
+            constraints, group, batch, plan, members, viable, key, pins
+        )
+
+    def _assign_zonal_affinity_general(
+        self,
+        constraints: Constraints,
+        group: AffinityGroup,
+        batch: List[Pod],
+        plan: DomainPlan,
+        members,
+        viable,
+        key: str,
+        pins=None,
+    ) -> None:
+        if pins is None:
+            pins = [plan.decision(p, key) for p, _ in members]
         if group.anti:
             # Selector-matching members claim a zone each (pairwise
             # separation); non-matching members only need SOME zone free of
@@ -389,13 +638,16 @@ class Topology:
             # non-matchers is never a win — so one clean zone is reserved
             # for them. This keeps drops to the provable minimum:
             # max(m - (clean - 1), 0) matchers (see scheduling/oracle.py).
+            flags = group.match_flags(members)
             matching = [
-                (p, st, pin) for (p, st), pin in zip(members, pins)
-                if group.selector_matches(p, st)
+                (p, st, pin)
+                for ((p, st), pin), m in zip(zip(members, pins), flags)
+                if m
             ]
             nonmatching = [
-                (p, st, pin) for (p, st), pin in zip(members, pins)
-                if not group.selector_matches(p, st)
+                (p, st, pin)
+                for ((p, st), pin), m in zip(zip(members, pins), flags)
+                if not m
             ]
             reserved: Optional[str] = None
             if nonmatching and matching:
@@ -537,19 +789,17 @@ class Topology:
             # pairwise separation: a fresh node per selector-matching
             # member; non-matchers only avoid the providers and share one.
             # Names are drawn in one batched rng call.
-            flags = [
-                group.selector_matches(p, st)
-                for p, st in zip(group.pods, group.sts)
-            ]
+            flags = group.match_flags(list(zip(group.pods, group.sts)))
             n_match = sum(flags)
             fresh = self._fresh_hostnames(
                 n_match + (1 if n_match < len(flags) else 0), generated_hostnames
             )
             shared_for_nonmatching = fresh[n_match] if n_match < len(flags) else None
             it = iter(fresh)
-            key = group.key
-            for pod, matched in zip(group.pods, flags):
-                plan.set(pod, key, next(it) if matched else shared_for_nonmatching)
+            plan.set_hostname_bulk(
+                (pod, next(it) if matched else shared_for_nonmatching)
+                for pod, matched in zip(group.pods, flags)
+            )
             return
         # affinity: the whole group lands on one fresh node, provided the
         # match can come from the group itself or another batch pod
@@ -560,8 +810,7 @@ class Topology:
             return
         shared = pinned if pinned is not None else self._fresh_hostname(generated_hostnames)
         plan.set(provider, group.key, shared)
-        for pod in group.pods:
-            plan.set(pod, group.key, shared)
+        plan.set_hostname_bulk((pod, shared) for pod in group.pods)
 
     @staticmethod
     def _batch_provider(
@@ -694,13 +943,19 @@ class Topology:
                 continue
             registered = group.spread.keys()
             soft = group.constraint.when_unsatisfiable == "ScheduleAnyway"
+            narrowed = self._narrowed
+            decision = plan.decision
+            next_domain = group.next_domain
+            is_hostname = key == lbl.HOSTNAME
+            by_pod = plan.by_pod
+            ztokens = plan.ztokens
+            tok_cache: Dict[str, Tuple] = {}
+            hostname_key = lbl.HOSTNAME
             for pod, st in zip(group.pods, group.sts):
                 # the pod's own requirements may narrow the registered
                 # domains; registered domains are already constraint-viable
-                allowed = self._narrowed(
-                    st, plan.decision(pod, key), key, registered
-                )
-                if key == lbl.HOSTNAME:
+                allowed = narrowed(st, decision(pod, key), key, registered)
+                if is_hostname:
                     pinned = plan.get(pod, lbl.HOSTNAME)
                     if pinned is not None:
                         allowed = (
@@ -718,8 +973,29 @@ class Topology:
                     # provides it), keeping the pod visibly unschedulable.
                     if soft:
                         continue
-                domain = group.next_domain(allowed)
-                plan.set(pod, key, domain)
+                domain = next_domain(allowed)
+                # inlined plan.set with eager token stamping: zone-spread
+                # batches run this for thousands of pods per solve
+                pid = id(pod)
+                d = by_pod.get(pid)
+                if is_hostname:
+                    if d is None:
+                        by_pod[pid] = {key: domain}
+                    else:
+                        d[key] = domain
+                    continue
+                tok = tok_cache.get(domain)
+                if tok is None:
+                    tok = tok_cache[domain] = DomainPlan.intern_token(key, domain)
+                if d is None:
+                    by_pod[pid] = {key: domain}
+                    ztokens[pid] = tok
+                elif all(k == hostname_key or k == key for k in d):
+                    d[key] = domain
+                    ztokens[pid] = tok
+                else:
+                    d[key] = domain
+                    ztokens.pop(pid, None)
 
     def _topology_groups(
         self, pods: List[Pod], sts: Optional[List[PodStatics]] = None

@@ -52,6 +52,43 @@ REMOTE_BREAKER_SECONDS = 30.0
 _fused_failed_shapes: set = set()
 
 
+def _with_hostname(reqs, hostname: str, cache: dict):
+    """``reqs.add(NodeSelectorRequirement(HOSTNAME, In, [hostname]))`` with
+    the signature-invariant parts (requirements tuple, sorted sets minus the
+    hostname entry, the hostname key's position and prior ValueSet) computed
+    once per signature — decode runs this for every hostname-pinned node."""
+    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.utils.sets import ValueSet
+
+    hit = cache.get(id(reqs))
+    if hit is None:
+        items = list(reqs._sets)
+        host_pos = None
+        base_set = None
+        for pos, (k, vs) in enumerate(items):
+            if k == lbl.HOSTNAME:
+                host_pos = pos
+                base_set = vs
+                break
+        if host_pos is None:
+            # insertion point that keeps the items key-sorted
+            host_pos = sum(1 for k, _ in items if k < lbl.HOSTNAME)
+        hit = cache[id(reqs)] = (reqs, reqs.requirements, items, host_pos, base_set)
+    _, base_reqs, items, host_pos, base_set = hit
+    vs = ValueSet.of(hostname)
+    if base_set is not None:
+        vs = vs.intersection(base_set)
+        out_items = list(items)
+        out_items[host_pos] = (lbl.HOSTNAME, vs)
+    else:
+        out_items = list(items)
+        out_items.insert(host_pos, (lbl.HOSTNAME, vs))
+    req = NodeSelectorRequirement(
+        key=lbl.HOSTNAME, operator="In", values=[hostname]
+    )
+    return Requirements(base_reqs + (req,), tuple(out_items))
+
+
 class TpuScheduler:
     def __init__(
         self,
@@ -342,19 +379,34 @@ class TpuScheduler:
                 mask_all = mask_arr[np.asarray(node_sig)[live_idx]]  # [L, T]
                 ok_all = fit_all & mask_all
             types_arr = np.array(instance_types, dtype=object)
+            # most nodes share identical surviving-type masks (few
+            # signatures × similar totals): build each distinct list once
+            # and share the object — safe under the codebase-wide
+            # replace-never-mutate convention (VirtualNode.add REPLACES
+            # instance_type_options). Materializing 431×380 per-node lists
+            # was the decode hot spot.
+            _, uniq_row, row_of = np.unique(
+                np.packbits(ok_all, axis=1), axis=0,
+                return_index=True, return_inverse=True,
+            )
+            uniq_lists = [list(types_arr[ok_all[int(r)]]) for r in uniq_row]
+            row_of = row_of.reshape(-1)
         nodes: List[VirtualNode] = []
+        # hostname requirement fast path: all nodes of one signature share
+        # (reqs tuple, sets minus hostname); per node only the hostname
+        # ValueSet intersection and one tuple splice differ —
+        # assignment-identical to sig.requirements.add(hostname In [h])
+        sig_host_cache: Dict[int, tuple] = {}
         for row, n in enumerate(live):
             sig = batch.signatures[int(node_sig[n])]
             total = node_req[n]
-            surviving = list(types_arr[ok_all[row]])
+            surviving = uniq_lists[int(row_of[row])]
             node_constraints = constraints.clone()
             reqs = sig.requirements
             h = int(node_host[n])
             if h >= 0:
-                reqs = reqs.add(
-                    NodeSelectorRequirement(
-                        key=lbl.HOSTNAME, operator="In", values=[batch.hostnames[h]]
-                    )
+                reqs = _with_hostname(
+                    reqs, batch.hostnames[h], sig_host_cache
                 )
             node_constraints.requirements = reqs
             requests = {

@@ -168,9 +168,31 @@ class EncodeCache:
         self.tables.clear()
 
 
+# fingerprint memo keyed by the catalog's object identities: providers
+# recreate InstanceType objects per get_instance_types() call, but within a
+# worker the same objects recur for many solves, and re-deriving the
+# semantic fingerprint walked 400 types every solve. Holding the catalog
+# tuple in the value keeps the ids valid for the entry's lifetime.
+_fp_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+_FP_CACHE_MAX = 8
+
+
 def catalog_fingerprint(instance_types: Sequence[InstanceType]) -> Tuple:
     """Order-sensitive semantic identity of a catalog — every field that
     feeds type compatibility or the usable-capacity matrix."""
+    id_key = tuple(map(id, instance_types))
+    hit = _fp_cache.get(id_key)
+    if hit is not None:
+        _fp_cache.move_to_end(id_key)
+        return hit[1]
+    fp = _catalog_fingerprint(instance_types)
+    _fp_cache[id_key] = (tuple(instance_types), fp)
+    while len(_fp_cache) > _FP_CACHE_MAX:
+        _fp_cache.popitem(last=False)
+    return fp
+
+
+def _catalog_fingerprint(instance_types: Sequence[InstanceType]) -> Tuple:
     return tuple(
         (
             it.name,
@@ -278,33 +300,38 @@ def encode(
     base_has_hostname = constraints.requirements.has(lbl.HOSTNAME)
 
     # template collapse: pods sharing (selector/affinity template, injected
-    # non-hostname decisions) resolve their core through one identity-keyed
-    # dict hit; hostname and request id resolve through one more each
-    cid_cache: Dict[Tuple, Tuple] = {}
-    rid_cache: Dict[int, int] = {}
-    for i, pod in enumerate(pods):
-        if plan is not None:
+    # non-hostname decisions, request template) resolve (core id, base
+    # hostname, request id) through ONE identity-keyed dict hit; injected
+    # hostnames resolve through one more
+    tmpl_cache: Dict[Tuple, Tuple] = {}
+    if plan is not None:
+        by_pod_get = plan.by_pod.get
+        ztokens_get = plan.ztokens.get
+        zone_token = plan.zone_token
+        tmpl_get = tmpl_cache.get
+        host_ids_get = host_ids.get
+        HOSTNAME = lbl.HOSTNAME
+        EMPTY = ()
+        for i, pod in enumerate(pods):
             st = sts[i]
-            dec = plan.by_pod.get(id(pod))
-            # inline the common decision shapes: none, or a single
-            # hostname pin (spread/anti-affinity/ports) which contributes
-            # nothing to the zone token
+            pid = id(pod)
+            dec = by_pod_get(pid)
             if dec is None:
-                ztok = ()
+                ztok = EMPTY
                 dh = None
-            elif len(dec) == 1:
-                ((dk, dv),) = dec.items()
-                if dk == lbl.HOSTNAME:
-                    ztok = ()
-                    dh = dv
-                else:
-                    ztok = plan.zone_token(pod)
-                    dh = None
             else:
-                ztok = plan.zone_token(pod)
-                dh = dec.get(lbl.HOSTNAME)
-            k2 = (id(st.merge_tid), id(ztok))
-            hit = cid_cache.get(k2)
+                # zone tokens are stamped eagerly by the bulk injection
+                # writers; the lazy build only runs for per-pod writers
+                dh = dec.get(HOSTNAME)
+                ztok = ztokens_get(pid)
+                if ztok is None:
+                    ztok = (
+                        EMPTY
+                        if dh is not None and len(dec) == 1
+                        else zone_token(pod)
+                    )
+            k2 = (id(st.merge_tid), id(ztok), id(st.req_tid))
+            hit = tmpl_get(k2)
             if hit is None:
                 if ztok:
                     core, base_host = merged_core(st, ztok)
@@ -315,24 +342,23 @@ def encode(
                     cid = len(cores)
                     core_ids[core] = cid
                     cores.append(core)
-                hit = cid_cache[k2] = (cid, base_host)
-            cid, base_host = hit
-            # hostname precedence mirrors the selector-merge order: folded
-            # affinity > injected decision > the pod's own selector
-            hostname = base_host if (dh is None or st.aff_hostname is not None) else dh
-            rid = rid_cache.get(id(st.req_tid))
-            if rid is None:
                 rid = req_ids.get(st.req_key)
                 if rid is None:
                     rid = len(uniq_vecs)
                     req_ids[st.req_key] = rid
                     uniq_vecs.append(res.to_scaled_vector(st.req, axes))
-                rid_cache[id(st.req_tid)] = rid
+                hit = tmpl_cache[k2] = (cid, base_host, rid)
+            cid, base_host, rid = hit
             core_l[i] = cid
             reqid_l[i] = rid
+            # hostname precedence mirrors the selector-merge order: folded
+            # affinity > injected decision > the pod's own selector
+            hostname = (
+                base_host if (dh is None or st.aff_hostname is not None) else dh
+            )
             if hostname is None:
                 continue
-            hid = host_ids.get(hostname)
+            hid = host_ids_get(hostname)
             if hid is None:
                 hid = len(hostnames)
                 host_ids[hostname] = hid
@@ -342,7 +368,7 @@ def encode(
             in_base = host_in_base_by_id[hid]
             hib_l[i] = in_base
             openh_l[i] = hid if (in_base or not base_has_hostname) else -2
-            continue
+    for i, pod in enumerate(pods if plan is None else ()):
         core, hostname = pod_core_and_hostname(pod)
         requests = pod_requests[i]
         rkey = tuple(sorted(requests.items()))
