@@ -62,6 +62,33 @@ class Runtime:
             self.log_watcher.stop()
         if hasattr(self.cluster, "stop"):
             self.cluster.stop()
+        # undo the post-warmup GC policy: a test booting a runtime
+        # in-process must not leak a frozen heap into the rest of the run
+        from karpenter_tpu.utils.gcpolicy import restore
+
+        restore()
+
+
+def _freeze_gc_when_warm(runtime: Runtime, timeout: float = 300.0) -> None:
+    """Apply the GC freeze policy once the first provisioning worker has
+    warmed (its solve compiled — the warm heap now exists). Waits in a
+    daemon thread; gives up silently after ``timeout`` (no provisioner ever
+    applied → nothing worth freezing)."""
+    import threading
+    import time as _t
+
+    from karpenter_tpu.utils.gcpolicy import freeze_after_warmup
+
+    def wait() -> None:
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            workers = list(getattr(runtime.provisioning, "workers", {}).values())
+            if any(w.warmed.is_set() for w in workers):
+                freeze_after_warmup()
+                return
+            _t.sleep(1.0)
+
+    threading.Thread(target=wait, name="gc-freeze-when-warm", daemon=True).start()
 
 
 def _serve_endpoints(runtime: Runtime) -> None:
@@ -269,6 +296,10 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     runtime.manager.start()
     if serve:
         _serve_endpoints(runtime)
+    # freeze the warm heap out of future GC scans once the first worker has
+    # actually warmed (compiled its solve) — collector passes over the
+    # long-lived JAX/catalog/table objects were the solve-latency tail
+    _freeze_gc_when_warm(runtime)
     logger.info(
         "karpenter-tpu controller started (provider=%s, solver=%s)",
         runtime.cloud_provider.name(),

@@ -109,6 +109,8 @@ def sort_pods_ffd_with_statics(pods: Sequence[Pod]):
         # primary key last; lexsort is stable. tolist() first: indexing
         # Python lists with np.int64 scalars pays a boxing cost per element
         order = np.lexsort((-mem, -cpu)).tolist()
+        getter = operator.itemgetter(*order)
+        return list(getter(pods)), list(getter(sts))
     return [pods[i] for i in order], [sts[i] for i in order]
 
 

@@ -76,7 +76,7 @@ class DomainPlan:
     touching the pods. ``materialize`` applies the decisions as selector
     overlays for the FFD path (callers snapshot/restore around it)."""
 
-    __slots__ = ("by_pod", "ztokens", "_pods", "sts")
+    __slots__ = ("ztokens", "hostdecs", "_pods", "sts")
 
     # canonical NON-hostname decision tuples, interned PROCESS-WIDE so the
     # encode can memo per (pod template, decisions) on object identity
@@ -86,14 +86,26 @@ class DomainPlan:
     _tok_intern: Dict[Tuple, Tuple] = {}
 
     def __init__(self, pods: List[Pod]):
-        self.by_pod: Dict[int, Dict[str, str]] = {}
+        # THE storage: ztokens maps pod id -> interned sorted tuple of the
+        # pod's non-hostname decisions; hostdecs maps pod id -> injected
+        # hostname. Decisions per pod are 1-2 items, so the tuple IS the
+        # map — no per-pod dict allocation on the hot path, and the encode
+        # loop reads both with one plain dict get each.
         self.ztokens: Dict[int, Tuple] = {}
+        self.hostdecs: Dict[int, Optional[str]] = {}
         self._pods = pods  # keeps ids stable for the plan's lifetime
         self.sts: Optional[List] = None  # statics parallel to `pods`, set by inject_plan
 
     def decision(self, pod: Pod, key: str) -> Optional[str]:
-        d = self.by_pod.get(id(pod))
-        return None if d is None else d.get(key)
+        pid = id(pod)
+        if key == lbl.HOSTNAME:
+            return self.hostdecs.get(pid)
+        tok = self.ztokens.get(pid)
+        if tok:
+            for k, v in tok:
+                if k == key:
+                    return v
+        return None
 
     def get(self, pod: Pod, key: str) -> Optional[str]:
         v = self.decision(pod, key)
@@ -101,88 +113,71 @@ class DomainPlan:
 
     def set(self, pod: Pod, key: str, domain: str) -> None:
         pid = id(pod)
-        d = self.by_pod.get(pid)
-        if d is None:
-            d = self.by_pod[pid] = {}
-        d[key] = domain
-        if key != lbl.HOSTNAME:
-            self.ztokens.pop(pid, None)  # token rebuilt lazily on read
-
-    def zone_token(self, pod: Pod) -> Tuple:
-        """Canonical interned tuple of this pod's non-hostname decisions —
-        built lazily (most reads happen once, in encode) and interned so
-        consumers can memo on object identity."""
-        pid = id(pod)
+        if key == lbl.HOSTNAME:
+            self.hostdecs[pid] = domain
+            return
         tok = self.ztokens.get(pid)
-        if tok is None:
-            d = self.by_pod.get(pid)
-            if not d:
-                return ()
-            if len(d) == 1:  # the overwhelmingly common single decision
-                ((k, v),) = d.items()
-                items = () if k == lbl.HOSTNAME else ((k, v),)
-            else:
-                items = tuple(sorted((k, v) for k, v in d.items() if k != lbl.HOSTNAME))
-            intern = DomainPlan._tok_intern
-            if len(intern) > (1 << 20):
-                intern.clear()
-            tok = self.ztokens[pid] = intern.setdefault(items, items)
-        return tok
+        if not tok:
+            self.ztokens[pid] = self.intern_token(key, domain)
+            return
+        merged = dict(tok)
+        merged[key] = domain
+        self.ztokens[pid] = self._intern(tuple(sorted(merged.items())))
 
     @staticmethod
-    def intern_token(key: str, domain: str) -> Tuple:
-        """The canonical interned token of a single zone-class decision —
-        lets bulk writers stamp one shared token across a whole group
-        instead of each pod re-building it lazily in encode."""
-        items = ((key, domain),)
+    def _intern(items: Tuple) -> Tuple:
         intern = DomainPlan._tok_intern
         if len(intern) > (1 << 20):
             intern.clear()
         return intern.setdefault(items, items)
 
+    def zone_token(self, pod: Pod) -> Tuple:
+        """Canonical interned tuple of this pod's non-hostname decisions."""
+        return self.ztokens.get(id(pod), ())
+
+    @staticmethod
+    def intern_token(key: str, domain: str) -> Tuple:
+        """The canonical interned token of a single zone-class decision —
+        lets bulk writers stamp one shared token across a whole group."""
+        return DomainPlan._intern(((key, domain),))
+
     def set_zone_bulk(self, members, key: str, domain: str) -> None:
         """Assign one non-hostname decision to many pods at once, stamping
-        the shared interned token. Pods that already carry another
-        non-hostname decision take the generic ``set`` path (their token
-        must be rebuilt from the full decision dict)."""
+        the shared interned token. Pods that already carry a different
+        non-hostname decision merge through the generic ``set`` path."""
         tok = self.intern_token(key, domain)
-        by_pod = self.by_pod
         ztokens = self.ztokens
-        hostname_key = lbl.HOSTNAME
+        ztokens_get = ztokens.get
         for pod in members:
             pid = id(pod)
-            d = by_pod.get(pid)
-            if d is None:
-                by_pod[pid] = {key: domain}
-                ztokens[pid] = tok
-            elif all(k == hostname_key or k == key for k in d):
-                d[key] = domain
+            old = ztokens_get(pid)
+            if not old or (len(old) == 1 and old[0][0] == key):
                 ztokens[pid] = tok
             else:
-                d[key] = domain
-                ztokens.pop(pid, None)
+                self.set(pod, key, domain)
 
     def set_hostname_bulk(self, pods_and_names) -> None:
         """Assign hostname decisions for many (pod, name) pairs; hostname
         never contributes to zone tokens, so no token bookkeeping."""
-        by_pod = self.by_pod
-        hostname_key = lbl.HOSTNAME
-        for pod, name in pods_and_names:
-            pid = id(pod)
-            d = by_pod.get(pid)
-            if d is None:
-                by_pod[pid] = {hostname_key: name}
-            else:
-                d[hostname_key] = name
+        self.hostdecs.update((id(pod), name) for pod, name in pods_and_names)
 
     def items(self, pod: Pod) -> Optional[Dict[str, str]]:
-        return self.by_pod.get(id(pod))
+        """This pod's decisions as a dict (fresh object), or None."""
+        pid = id(pod)
+        tok = self.ztokens.get(pid)
+        host = self.hostdecs.get(pid)
+        if not tok and host is None:
+            return None
+        d = dict(tok) if tok else {}
+        if host is not None:
+            d[lbl.HOSTNAME] = host
+        return d
 
     def materialize(self, pods: List[Pod]) -> None:
         """Write decisions into the pods' nodeSelectors (always replacing
         the dict, never mutating in place, so snapshot/restore works)."""
         for p in pods:
-            d = self.by_pod.get(id(p))
+            d = self.items(p)
             if d:
                 p.spec.node_selector = {**p.spec.node_selector, **d}
 
@@ -549,9 +544,12 @@ class Topology:
         viable = constraints.requirements.zones()
         key = group.key
         members = list(zip(group.pods, group.sts))
-        by_pod_get = plan.by_pod.get
+        ztokens_get = plan.ztokens.get
         pins = [
-            d.get(key) if (d := by_pod_get(id(p))) else None for p, _ in members
+            next((v for k, v in tok if k == key), None)
+            if (tok := ztokens_get(id(p)))
+            else None
+            for p, _ in members
         ]
         # bulk fast path: no member is narrowed by its own spec and none is
         # pinned by an earlier pass — the per-pod loops then degenerate to a
@@ -930,16 +928,12 @@ class Topology:
                 # tokens, and this loop runs for thousands of pods per solve
                 domains = list(group.spread)  # pool order → cross-group overlap
                 n_dom = len(domains)
-                by_pod = plan.by_pod
-                for j, pod in enumerate(group.pods):
-                    domain = domains[j % n_dom]
-                    group.spread[domain] += 1
-                    pid = id(pod)
-                    d = by_pod.get(pid)
-                    if d is None:
-                        by_pod[pid] = {key: domain}
-                    else:
-                        d[key] = domain
+                n_mem = len(group.pods)
+                assigned = [domains[j % n_dom] for j in range(n_mem)]
+                plan.hostdecs.update(zip(map(id, group.pods), assigned))
+                for j in range(min(n_dom, n_mem)):
+                    # members j, j+n_dom, j+2*n_dom, ... landed on domains[j]
+                    group.spread[domains[j]] += (n_mem - j + n_dom - 1) // n_dom
                 continue
             registered = group.spread.keys()
             soft = group.constraint.when_unsatisfiable == "ScheduleAnyway"
@@ -947,10 +941,9 @@ class Topology:
             decision = plan.decision
             next_domain = group.next_domain
             is_hostname = key == lbl.HOSTNAME
-            by_pod = plan.by_pod
             ztokens = plan.ztokens
+            hostdecs = plan.hostdecs
             tok_cache: Dict[str, Tuple] = {}
-            hostname_key = lbl.HOSTNAME
             for pod, st in zip(group.pods, group.sts):
                 # the pod's own requirements may narrow the registered
                 # domains; registered domains are already constraint-viable
@@ -977,25 +970,17 @@ class Topology:
                 # inlined plan.set with eager token stamping: zone-spread
                 # batches run this for thousands of pods per solve
                 pid = id(pod)
-                d = by_pod.get(pid)
                 if is_hostname:
-                    if d is None:
-                        by_pod[pid] = {key: domain}
-                    else:
-                        d[key] = domain
+                    hostdecs[pid] = domain
                     continue
-                tok = tok_cache.get(domain)
-                if tok is None:
-                    tok = tok_cache[domain] = DomainPlan.intern_token(key, domain)
-                if d is None:
-                    by_pod[pid] = {key: domain}
-                    ztokens[pid] = tok
-                elif all(k == hostname_key or k == key for k in d):
-                    d[key] = domain
+                old = ztokens.get(pid)
+                if not old or (len(old) == 1 and old[0][0] == key):
+                    tok = tok_cache.get(domain)
+                    if tok is None:
+                        tok = tok_cache[domain] = DomainPlan.intern_token(key, domain)
                     ztokens[pid] = tok
                 else:
-                    d[key] = domain
-                    ztokens.pop(pid, None)
+                    plan.set(pod, key, domain)
 
     def _topology_groups(
         self, pods: List[Pod], sts: Optional[List[PodStatics]] = None

@@ -348,12 +348,14 @@ class TpuScheduler:
         valid_idx = np.flatnonzero((a >= 0) & (a < n_nodes))
         order = valid_idx[np.argsort(a[valid_idx], kind="stable")]
         groups, starts = np.unique(a[order], return_index=True)
-        bounds = np.append(starts, len(order))
-        # plain list comprehension: measured 10x FASTER than object-array
-        # slicing here (filling an object ndarray from a list + fancy
-        # indexing pays per-element refcount churn)
+        bounds = np.append(starts, len(order)).tolist()
+        # plain list comprehension over PYTHON ints: measured 10x faster
+        # than object-array slicing, and indexing a list with np.int64
+        # scalars pays a boxing cost per element
+        order_l = order.tolist()
+        batch_pods = batch.pods
         pods_by_node: Dict[int, List[Pod]] = {
-            int(g): [batch.pods[i] for i in order[bounds[k]:bounds[k + 1]]]
+            int(g): [batch_pods[i] for i in order_l[bounds[k]:bounds[k + 1]]]
             for k, g in enumerate(groups)
         }
 

@@ -244,10 +244,11 @@ def encode(
             sts = plan.sts
         else:
             sts = [statics(p) for p in pods]
-        pod_extras = set()
-        for st in sts:
-            if st.extra_res:
-                pod_extras |= st.extra_res
+        import operator
+
+        pod_extras = frozenset().union(
+            *map(operator.attrgetter("extra_res"), sts)
+        ) if sts else set()
         extras = sorted(
             pod_extras
             | set(
@@ -305,31 +306,17 @@ def encode(
     # hostnames resolve through one more
     tmpl_cache: Dict[Tuple, Tuple] = {}
     if plan is not None:
-        by_pod_get = plan.by_pod.get
         ztokens_get = plan.ztokens.get
-        zone_token = plan.zone_token
+        hostdecs_get = plan.hostdecs.get
         tmpl_get = tmpl_cache.get
         host_ids_get = host_ids.get
-        HOSTNAME = lbl.HOSTNAME
         EMPTY = ()
         for i, pod in enumerate(pods):
             st = sts[i]
             pid = id(pod)
-            dec = by_pod_get(pid)
-            if dec is None:
-                ztok = EMPTY
-                dh = None
-            else:
-                # zone tokens are stamped eagerly by the bulk injection
-                # writers; the lazy build only runs for per-pod writers
-                dh = dec.get(HOSTNAME)
-                ztok = ztokens_get(pid)
-                if ztok is None:
-                    ztok = (
-                        EMPTY
-                        if dh is not None and len(dec) == 1
-                        else zone_token(pod)
-                    )
+            # ztokens/hostdecs ARE the plan storage — one dict get each
+            ztok = ztokens_get(pid, EMPTY)
+            dh = hostdecs_get(pid)
             k2 = (id(st.merge_tid), id(ztok), id(st.req_tid))
             hit = tmpl_get(k2)
             if hit is None:

@@ -1,0 +1,56 @@
+"""Controller-process GC policy.
+
+A 10k-pod solve allocates hundreds of thousands of short-lived objects, all
+freed by refcounting (the solve structures are acyclic) — yet every
+allocation burst trips the cyclic collector, whose gen-2 passes scan the
+whole warm heap (JAX, the catalog, the signature tables) for 100-200ms.
+Those pauses land squarely in the solve-latency tail: the p90/p99 of the
+latency benchmark showed 200ms host spikes that disappear entirely under
+this policy.
+
+``freeze_after_warmup`` is the Instagram/CPython-documented recipe: collect
+once, ``gc.freeze()`` the warm heap into the permanent generation so later
+collections never scan it, and raise the gen-0 threshold so collections are
+rare. Cycles created afterwards are still collected — just less often and
+against a small young heap.
+
+Call it once, AFTER the warm heap actually exists — i.e. after the first
+solve has compiled (the benchmark freezes after its warmup solve; the
+runtime freezes when the first provisioning worker reports warmed).
+``restore`` undoes the policy (tests that boot a runtime in-process must
+not leak a frozen heap into the rest of the session).
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+
+_lock = threading.Lock()
+_frozen = False
+_saved_thresholds = None
+
+
+def freeze_after_warmup(gen0_threshold: int = 50000) -> None:
+    global _frozen, _saved_thresholds
+    with _lock:
+        if _frozen:
+            return
+        _saved_thresholds = gc.get_threshold()
+        gc.collect()
+        gc.freeze()
+        gc.set_threshold(gen0_threshold, 20, 20)
+        _frozen = True
+
+
+def restore() -> None:
+    """Unfreeze the permanent generation and restore the default
+    thresholds (idempotent)."""
+    global _frozen, _saved_thresholds
+    with _lock:
+        if not _frozen:
+            return
+        gc.unfreeze()
+        if _saved_thresholds is not None:
+            gc.set_threshold(*_saved_thresholds)
+        _frozen = False
