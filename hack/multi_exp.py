@@ -1,0 +1,110 @@
+"""Scratch experiment: TPU sharded multi-solve vs native CPU loop at several B."""
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.parallel.sharding import make_solver_mesh, sharded_multi_solve
+from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import encode as enc
+from karpenter_tpu.solver.native import native_available, pack_native
+
+assert native_available(wait=120), "native packer build failed"
+from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+catalog = sorted(instance_types(400), key=lambda it: it.effective_price())
+FIELDS = ("pod_valid", "pod_open_sig", "pod_core", "pod_host",
+          "pod_host_in_base", "pod_open_host", "pod_req",
+          "join_table", "frontiers", "daemon")
+
+
+def build(B, n_pods):
+    batches = []
+    for b in range(B):
+        provisioner = make_provisioner(name=f"prov-{b}")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(100 + b)))
+        cc = c.clone()
+        Topology(Cluster(), rng=random.Random(b)).inject(cc, pods)
+        daemon = daemon_overhead(Cluster(), cc)
+        batches.append(enc.encode(cc, catalog, pods, daemon))
+    return batches
+
+
+x = np.zeros(8, np.float32)
+f = jax.jit(lambda a: a + 1)
+jax.device_get(f(x))
+rtts = []
+for i in range(5):
+    t0 = time.perf_counter()
+    jax.device_get(f(x + (i + 1) * 1e-6))
+    rtts.append(time.perf_counter() - t0)
+floor = min(rtts)
+print(f"rtt floor {floor*1e3:.1f} ms", flush=True)
+
+n_pods = 1250
+for B in (8, 32, 64):
+    batches = build(B, n_pods)
+    arrays = tuple(np.stack([np.asarray(getattr(b, fl)) for b in batches]) for fl in FIELDS)
+    sig_type_mask = np.stack([b.type_mask_matrix() for b in batches])
+    prices = np.array([it.effective_price() for it in catalog], np.float32)
+    mesh = make_solver_mesh()
+    n_max = max(256, len(batches[0].pod_valid) // 4)
+    n_real = batches[0].n_pods
+
+    pad_mask = np.zeros(arrays[6].shape, np.float32)
+    pad_mask[:, n_real:, :] = 1.0
+    sh = NamedSharding(mesh, PS("data", None, None))
+    base_req = jax.device_put(arrays[6], sh)
+    mask_dev = jax.device_put(pad_mask, sh)
+    perturb = jax.jit(lambda base, m, eps: base + m * eps)
+    placed = list(arrays)
+
+    def run(eps):
+        placed[6] = perturb(base_req, mask_dev, eps)
+        result, cheapest = sharded_multi_solve(
+            mesh, tuple(placed), sig_type_mask, batches[0].usable, prices, n_max=n_max
+        )
+        jax.device_get((result.n_nodes, cheapest[:, 0]))
+        return result
+
+    result = run(0.0)
+    specs = [PS("data")] * 6 + [None, PS("data", None, None),
+                                PS("data", None, None, None), PS("data", None)]
+    for i, s in enumerate(specs):
+        if i == 6:
+            continue
+        placed[i] = jax.device_put(arrays[i], NamedSharding(mesh, s))
+    run(0.0)
+    times = []
+    for it in range(6):
+        t0 = time.perf_counter()
+        run((it + 1) * 1e-7)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    scheduled = int((np.asarray(result.assignment)[:, :n_real] >= 0).sum())
+
+    cpu_times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        tot = 0
+        for b in batches:
+            r = pack_native(*b.pack_args(), n_max=n_max)
+            tot += int((np.asarray(r.assignment)[: b.n_pods] >= 0).sum())
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_best = min(cpu_times)
+    print(
+        f"B={B:3d}: tpu wall {best*1e3:7.1f}ms adj {(best-floor)*1e3:7.1f}ms "
+        f"{scheduled/best:10.0f} raw {scheduled/max(best-floor,1e-9):12.0f} adj pods/s | "
+        f"cpu {cpu_best*1e3:6.1f}ms {tot/cpu_best:12.0f} pods/s",
+        flush=True,
+    )

@@ -74,6 +74,25 @@ def instance_types(total: int) -> List[InstanceType]:
     ]
 
 
+def instance_types_tradeoff(total: int) -> List[InstanceType]:
+    """n types with ANTI-correlated cpu/mem (cpu-heavy ↔ mem-heavy ends of
+    the range): every type is Pareto-optimal, so the encoded capacity
+    frontier is ``total`` wide. The linear/assorted catalogs are
+    Pareto-degenerate (F=1 — each type dominates the previous), which never
+    exercises the solver's multi-frontier (v2) region."""
+    return [
+        new_instance_type(
+            f"trade-it-{i}",
+            resources={
+                res.CPU: float(2 + i),
+                res.MEMORY: res.parse_quantity(f"{2 * (total - i)}Gi"),
+                res.PODS: 110.0,
+            },
+        )
+        for i in range(total)
+    ]
+
+
 def instance_types_assorted() -> List[InstanceType]:
     """Full cross product 7cpu×8mem×3zones×2ct×2os×2arch = 1,344 unique types
     — drives price-optimality tests (reference: fake/instancetype.go:79-110)."""

@@ -49,8 +49,13 @@ class Runtime:
     servers: list = None  # HTTP servers (metrics, health) when serving
     elector: object = None  # LeaderElector when a lease is configured
     log_watcher: object = None  # LogLevelWatcher when a config file is set
+    _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
     def stop(self) -> None:
+        if self._gc_freeze_cancel is not None:
+            # cancel BEFORE restore: a freeze landing after restore() would
+            # leak the frozen heap this stop exists to undo
+            self._gc_freeze_cancel.set()
         self.manager.stop()
         self.provisioning.stop()
         self.termination.stop()
@@ -73,20 +78,24 @@ def _freeze_gc_when_warm(runtime: Runtime, timeout: float = 300.0) -> None:
     """Apply the GC freeze policy once the first provisioning worker has
     warmed (its solve compiled — the warm heap now exists). Waits in a
     daemon thread; gives up silently after ``timeout`` (no provisioner ever
-    applied → nothing worth freezing)."""
+    applied → nothing worth freezing). ``Runtime.stop`` cancels the wait —
+    a freeze landing after stop's restore() would leak the frozen heap."""
     import threading
     import time as _t
 
     from karpenter_tpu.utils.gcpolicy import freeze_after_warmup
 
+    cancel = runtime._gc_freeze_cancel = threading.Event()
+
     def wait() -> None:
         deadline = _t.monotonic() + timeout
-        while _t.monotonic() < deadline:
+        while _t.monotonic() < deadline and not cancel.is_set():
             workers = list(getattr(runtime.provisioning, "workers", {}).values())
             if any(w.warmed.is_set() for w in workers):
-                freeze_after_warmup()
+                if not cancel.is_set():
+                    freeze_after_warmup()
                 return
-            _t.sleep(1.0)
+            cancel.wait(1.0)
 
     threading.Thread(target=wait, name="gc-freeze-when-warm", daemon=True).start()
 

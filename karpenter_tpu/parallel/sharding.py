@@ -30,6 +30,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from karpenter_tpu.solver import kernel
 
 
+# route + shape-gate report of the most recent sharded_multi_solve (the
+# dryrun and bench surface it; single-writer per process is fine there)
+last_route: Optional[dict] = None
+
+
 def make_solver_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
     """2D mesh over (data, model). ``model_parallel`` shards the instance-type
     axis; the rest of the devices shard independent solve batches."""
@@ -199,21 +204,41 @@ def sharded_multi_solve(
     result = None
     from karpenter_tpu.solver.pallas_kernel import (
         _pallas_failed_shapes,
-        pallas_shape_eligible,
+        pallas_available,
     )
+    from karpenter_tpu.solver.pallas_kernel_v2 import v2_vmem_ok
 
     B, P_pods = batch_arrays[6].shape[0], batch_arrays[6].shape[1]
     S, F = batch_arrays[8].shape[1], batch_arrays[8].shape[2]
     R = batch_arrays[6].shape[2]
     C = batch_arrays[7].shape[2]
-    shape_key = ("multi", B, P_pods, n_max)
-    if (
-        shape_key not in _pallas_failed_shapes
-        and pallas_shape_eligible(P_pods, S, F)
+    from karpenter_tpu.solver.pallas_kernel import BLOCK, PALLAS_UNROLL_BUDGET
+
+    # PURE shape gates, evaluated unconditionally so the route report (and
+    # the CPU-mesh dryrun) always traverses them; pallas_available() is
+    # applied only at dispatch below
+    v1_shape_ok = (
+        P_pods % BLOCK == 0
+        and S * F <= PALLAS_UNROLL_BUDGET
         and B % mesh.shape["data"] == 0
-    ):
+    )
+    v2_shape_ok = (
+        P_pods % 128 == 0
+        and B % mesh.shape["data"] == 0
+        and v2_vmem_ok(S, n_max, C, F * R)
+    )
+    global last_route
+    last_route = {
+        "route": "lax.scan-multi",
+        "v1_shape_eligible": bool(v1_shape_ok),
+        "v2_shape_eligible": bool(v2_shape_ok),
+        "S": int(S), "F": int(F), "B": int(B), "P": int(P_pods),
+    }
+    shape_key = ("multi", B, P_pods, n_max)
+    if shape_key not in _pallas_failed_shapes and v1_shape_ok and pallas_available():
         try:
             result = _pallas_multi(mesh, *placed, n_max=n_max)
+            last_route["route"] = "pallas-v1-multi"
         except Exception:
             import logging
 
@@ -226,19 +251,11 @@ def sharded_multi_solve(
     if result is None:
         # constraint-diverse stacks past the v1 unroll budget: the v2
         # (matmul-gather, compile O(F)) kernel — same ladder as pack_best
-        from karpenter_tpu.solver.pallas_kernel import pallas_available
-        from karpenter_tpu.solver.pallas_kernel_v2 import v2_vmem_ok
-
         v2_key = ("multi-v2", B, P_pods, n_max)
-        if (
-            v2_key not in _pallas_failed_shapes
-            and pallas_available()
-            and P_pods % 128 == 0
-            and B % mesh.shape["data"] == 0
-            and v2_vmem_ok(S, n_max, C, F * R)
-        ):
+        if v2_key not in _pallas_failed_shapes and pallas_available() and v2_shape_ok:
             try:
                 result = _pallas_v2_multi(mesh, batch_arrays, n_max=n_max)
+                last_route["route"] = "pallas-v2-multi"
             except Exception:
                 import logging
 

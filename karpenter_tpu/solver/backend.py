@@ -196,15 +196,7 @@ class TpuScheduler:
             self._device_cache = fused.DeviceInvariants()
         join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
         pod_tab, open_by_core, bhh = fused.pack_pod_table(batch)
-        # bucket U so a drifting unique-request count doesn't recompile
-        uniq = batch.uniq_req
-        u_pad = 16
-        while u_pad < uniq.shape[0]:
-            u_pad *= 2
-        if u_pad != uniq.shape[0]:
-            uniq = np.vstack(
-                [uniq, np.zeros((u_pad - uniq.shape[0], uniq.shape[1]), np.float32)]
-            )
+        uniq = fused.pad_uniq_req(batch.uniq_req)
         from karpenter_tpu.solver.pallas_kernel import pallas_available
 
         buf = jax.device_get(

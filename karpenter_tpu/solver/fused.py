@@ -55,6 +55,21 @@ def ids_fit(batch) -> bool:
     )
 
 
+def pad_uniq_req(uniq: np.ndarray) -> np.ndarray:
+    """Pad the unique-request matrix to a power-of-two row count (min 16)
+    so a drifting unique-request count doesn't recompile the fused
+    dispatch. The padding rows are zeros, like the batch's own final
+    all-zero row backing the padding pods."""
+    u_pad = 16
+    while u_pad < uniq.shape[0]:
+        u_pad *= 2
+    if u_pad != uniq.shape[0]:
+        uniq = np.vstack(
+            [uniq, np.zeros((u_pad - uniq.shape[0], uniq.shape[1]), np.float32)]
+        )
+    return uniq
+
+
 def pack_pod_table(batch):
     """The per-solve compact upload: ([4, P] i16 pod table,
     [C] i16 per-core open signatures, scalar base_has_hostname i32)."""
