@@ -1,0 +1,234 @@
+"""Wire-level behavior of the cloud-API HTTP double: pagination, throttling
+with Retry-After, 5xx retry/backoff, error-code classification, and the
+fleet error body (reference: the provider drives a real SDK over HTTP
+against behavior-programmable fakes — aws/fake/ec2api.go:35-137)."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.httpapi import (
+    CloudAPIServer,
+    HttpCloudAPI,
+    ThrottlingError,
+)
+from karpenter_tpu.cloudprovider.simulated import (
+    CloudAPIError,
+    InsufficientCapacityError,
+    SimCloudAPI,
+    SimulatedCloudProvider,
+)
+
+
+@pytest.fixture()
+def wire():
+    api = SimCloudAPI()
+    server = CloudAPIServer(api).start()  # default page size: 3 (paginates)
+    client = HttpCloudAPI(server.url, backoff_base=0.01)
+    yield api, server, client
+    server.stop()
+
+
+class TestPagination:
+    def test_instance_types_span_pages(self, wire):
+        api, server, client = wire
+        got = client.describe_instance_types()
+        assert [i.name for i in got] == [i.name for i in api.catalog]
+        # 11 catalog entries at page size 3 → 4 paged GETs, one logical call
+        assert api.calls["describe_instance_types"] == 4
+
+    def test_explicit_page_size(self, wire):
+        api, server, client = wire
+        client.page_size = 100
+        got = client.describe_instance_types()
+        assert len(got) == len(api.catalog)
+        assert api.calls["describe_instance_types"] == 1
+
+
+class TestRetries:
+    def test_throttle_retried_honoring_retry_after(self, wire):
+        api, server, client = wire
+        api.inject_error("describe_subnets", ThrottlingError(retry_after=0.01))
+        subnets = client.describe_subnets({"purpose": "nodes"})
+        assert len(subnets) == 3
+        assert client.retries == 1
+
+    def test_injected_5xx_retried_with_backoff(self, wire):
+        api, server, client = wire
+        api.inject_error("describe_security_groups", CloudAPIError("control plane down"))
+        groups = client.describe_security_groups({"purpose": "nodes"})
+        assert [g.id for g in groups] == ["sg-nodes"]
+        assert client.retries == 1
+
+    def test_retries_exhausted_raises_typed_error(self, wire):
+        api, server, client = wire
+        for _ in range(10):
+            api.inject_error("describe_subnets", CloudAPIError("still down"))
+        with pytest.raises(CloudAPIError):
+            client.describe_subnets({})
+        assert client.retries == client.max_attempts - 1
+
+    def test_ice_not_retried_maps_to_typed_error(self, wire):
+        api, server, client = wire
+        api.inject_error("create_fleet", InsufficientCapacityError("no pool"))
+        with pytest.raises(InsufficientCapacityError):
+            client.create_fleet("on-demand", [("lt", "sim.gp-4x", "sim-zone-1a")])
+        assert client.retries == 0
+
+    def test_unknown_route_is_client_error(self, wire):
+        api, server, client = wire
+        with pytest.raises(CloudAPIError):
+            client._request("GET", "/v1/no-such-thing")
+        assert client.retries == 0
+
+
+class TestFleetWire:
+    def test_retried_fleet_post_does_not_double_launch(self, wire):
+        """A lost response to the non-idempotent fleet POST must not leak
+        an untracked instance: the client token replays the recorded
+        answer on retry (the CreateFleet ClientToken contract)."""
+        import json as _json
+        import urllib.request
+
+        api, server, client = wire
+        body = _json.dumps({
+            "capacityType": "on-demand",
+            "overrides": [{"launchTemplate": "lt", "instanceType": "sim.gp-4x",
+                           "zone": "sim-zone-1a"}],
+            "clientToken": "tok-1",
+        }).encode()
+
+        def post():
+            req = urllib.request.Request(
+                server.url + "/v1/fleet", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return _json.loads(r.read())
+
+        first = post()
+        second = post()  # the "retry after a lost response"
+        assert first == second
+        assert len(api.instances) == 1
+
+    def test_blank_tag_value_is_exists_wildcard_over_the_wire(self, wire):
+        """selector value "" means key-exists; parse_qs must not drop the
+        blank pair or the wire filter silently loosens to match-all."""
+        api, server, client = wire
+        named = client.describe_subnets({"Name": ""})
+        assert {s.id for s in named} == {"subnet-1", "subnet-2", "subnet-3"}
+        none = client.describe_security_groups({"Name": ""})
+        assert none == []  # no security group carries a Name tag
+
+    def test_missing_field_is_400_not_retried(self, wire):
+        api, server, client = wire
+        with pytest.raises(CloudAPIError):
+            client._request("POST", "/v1/fleet", {"overrides": []})
+        assert client.retries == 0
+        assert api.calls.get("create_fleet") is None
+
+    def test_per_override_ice_errors_cross_the_wire(self, wire):
+        api, server, client = wire
+        api.insufficient_capacity_pools.add(("on-demand", "sim.gp-4x", "sim-zone-1a"))
+        instances, errors = client.create_fleet(
+            "on-demand",
+            [("lt", "sim.gp-4x", "sim-zone-1a"), ("lt", "sim.gp-8x", "sim-zone-1b")],
+        )
+        assert errors == [("on-demand", "sim.gp-4x", "sim-zone-1a")]
+        assert len(instances) == 1 and instances[0].instance_type == "sim.gp-8x"
+        # the launch is real server-side state, visible to later describes
+        assert [i.id for i in client.describe_instances([instances[0].id])] == [
+            instances[0].id
+        ]
+
+    def test_terminate_round_trip(self, wire):
+        api, server, client = wire
+        instances, _ = client.create_fleet("on-demand", [("lt", "sim.gp-2x", "sim-zone-1b")])
+        client.terminate_instances([instances[0].id])
+        assert api.instances[instances[0].id].state == "terminated"
+
+    def test_launch_template_name_quoting(self, wire):
+        api, server, client = wire
+        name = "karpenter/lt: weird name+chars"
+        assert client.ensure_launch_template(name, {"k": "v"}) == name
+        assert name in api.launch_templates
+        client.delete_launch_template(name)
+        assert name not in api.launch_templates
+
+
+class TestGkeWire:
+    @pytest.fixture()
+    def gke_wire(self):
+        from karpenter_tpu.cloudprovider.gke import SimGkeAPI
+        from karpenter_tpu.cloudprovider.httpapi import GkeAPIServer, HttpGkeAPI
+
+        api = SimGkeAPI()
+        server = GkeAPIServer(api).start()
+        client = HttpGkeAPI(server.url, backoff_base=0.01)
+        yield api, server, client
+        server.stop()
+
+    def test_node_pool_round_trip(self, gke_wire):
+        api, server, client = gke_wire
+        pool = client.create_node_pool("ct5lp-hightpu-4t", "us-central1-a", False, 2)
+        assert len(pool.instances) == 2
+        assert pool.name in api.node_pools
+        client.delete_instance(pool.instances[0].name)
+        assert len(api.node_pools[pool.name].instances) == 1
+        client.delete_node_pool(pool.name)
+        assert pool.name not in api.node_pools
+
+    def test_stockout_crosses_as_409_and_classifies(self, gke_wire):
+        from karpenter_tpu.cloudprovider.gke import GkeStockoutError
+
+        api, server, client = gke_wire
+        api.set_stockout("ct5lp-hightpu-4t", "us-central1-a")
+        with pytest.raises(GkeStockoutError):
+            client.create_node_pool("ct5lp-hightpu-4t", "us-central1-a", False, 4)
+        assert client.retries == 0  # a stockout is not transport — never retried
+
+    def test_bad_request_crosses_as_400(self, gke_wire):
+        from karpenter_tpu.cloudprovider.gke import GkeApiError
+
+        api, server, client = gke_wire
+        with pytest.raises(GkeApiError):
+            client.create_node_pool("ct5lp-hightpu-4t", "us-central1-a", False, 0)
+        assert client.retries == 0
+
+    def test_provider_over_wire_stockout_marks_ice(self, gke_wire):
+        """End-to-end: GkeCloudProvider over the HTTP client — a stockout
+        crossing the wire still drives the ICE/unavailable-offerings path."""
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.api.requirements import Requirements
+        from karpenter_tpu.cloudprovider.gke import GkeCloudProvider
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.cloudprovider.types import NodeRequest
+
+        api, server, client = gke_wire
+        provider = GkeCloudProvider(api=client)
+        c = Constraints(requirements=Requirements.new())
+        provider.default(c)
+        catalog = provider.get_instance_types()
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        node = provider.create(NodeRequest(template=c, instance_type_options=catalog))
+        assert node.metadata.name.startswith("gke-")
+
+
+class TestProviderOverWire:
+    def test_provider_survives_transient_throttle_during_launch(self, wire):
+        """End-to-end: a provider whose control plane throttles mid-launch
+        still creates the node — the wire client absorbs the 429."""
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.api.requirements import Requirements
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.cloudprovider.types import NodeRequest
+
+        api, server, client = wire
+        provider = SimulatedCloudProvider(client)
+        c = Constraints(requirements=Requirements.new())
+        provider.default(c)
+        catalog = provider.get_instance_types()
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        api.inject_error("create_fleet", ThrottlingError(retry_after=0.01))
+        node = provider.create(
+            NodeRequest(template=c, instance_type_options=catalog)
+        )
+        assert node.metadata.name.startswith("i-")
+        assert client.retries >= 1

@@ -27,12 +27,28 @@ from karpenter_tpu.utils import resources as res
 from tests.factories import make_pod, make_provisioner
 
 
-@pytest.fixture()
-def env():
+@pytest.fixture(params=["inproc", "http"])
+def env(request):
+    """The whole suite runs twice: once with the in-process double, once
+    with every control-plane call crossing a real HTTP wire against the
+    same double (VERDICT r3 ask #7 — a client and double written by the
+    same hand can share a protocol misunderstanding; serde + status-code
+    mapping must survive a real boundary). Error injection and call
+    counting still program the underlying SimCloudAPI."""
     now = [1000.0]
     api = SimCloudAPI()
-    provider = SimulatedCloudProvider(api, clock=lambda: now[0])
-    return api, provider, now
+    if request.param == "http":
+        from karpenter_tpu.cloudprovider.httpapi import CloudAPIServer, HttpCloudAPI
+
+        server = CloudAPIServer(api, page_size=10_000).start()
+        provider = SimulatedCloudProvider(
+            HttpCloudAPI(server.url, backoff_base=0.01), clock=lambda: now[0]
+        )
+        yield api, provider, now
+        server.stop()
+    else:
+        provider = SimulatedCloudProvider(api, clock=lambda: now[0])
+        yield api, provider, now
 
 
 def constraints_for(provider, requirements=None, provider_cfg=None):
