@@ -37,6 +37,9 @@ dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) __graft_entry__.py
 
+envtest:  ## boot a REAL kube-apiserver (kubebuilder-tools) and run the conformance suite against it
+	hack/envtest.sh
+
 image:  ## build the container image (controller + webhook + solver entrypoints)
 	docker build -t karpenter-tpu:latest .
 
