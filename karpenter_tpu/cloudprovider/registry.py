@@ -36,5 +36,31 @@ def _register_builtins() -> None:
     register("simulated", SimulatedCloudProvider)
     register("gke", GkeCloudProvider)
 
+    def _resolve_base(url: str, name: str) -> str:
+        # control plane over the wire: --cloud-provider=<name>-http with
+        # KARPENTER_CLOUD_API_URL (or the url kwarg) pointing at a server
+        # speaking the cloudprovider/httpapi.py REST protocol
+        import os
+
+        base = url or os.environ.get("KARPENTER_CLOUD_API_URL", "")
+        if not base:
+            raise ValueError(f"{name} needs KARPENTER_CLOUD_API_URL (or url=...)")
+        return base
+
+    def _http_simulated(url: str = "") -> CloudProvider:
+        from karpenter_tpu.cloudprovider.httpapi import HttpCloudAPI
+
+        return SimulatedCloudProvider(
+            HttpCloudAPI(_resolve_base(url, "simulated-http"))
+        )
+
+    def _http_gke(url: str = "") -> CloudProvider:
+        from karpenter_tpu.cloudprovider.httpapi import HttpGkeAPI
+
+        return GkeCloudProvider(api=HttpGkeAPI(_resolve_base(url, "gke-http")))
+
+    register("simulated-http", _http_simulated)
+    register("gke-http", _http_gke)
+
 
 _register_builtins()

@@ -211,6 +211,19 @@ class TestGkeWire:
         assert node.metadata.name.startswith("gke-")
 
 
+class TestRegistryWiring:
+    def test_http_backed_providers_constructible_by_name(self, wire, monkeypatch):
+        from karpenter_tpu.cloudprovider.registry import new_cloud_provider
+
+        api, server, client = wire
+        provider = new_cloud_provider("simulated-http", url=server.url)
+        assert provider.name() == "simulated"
+        assert len(provider.get_instance_types()) == len(api.catalog) - 1  # metal filtered
+        monkeypatch.delenv("KARPENTER_CLOUD_API_URL", raising=False)
+        with pytest.raises(ValueError):
+            new_cloud_provider("simulated-http")  # no URL anywhere
+
+
 class TestProviderOverWire:
     def test_provider_survives_transient_throttle_during_launch(self, wire):
         """End-to-end: a provider whose control plane throttles mid-launch

@@ -471,3 +471,82 @@ class TestUnschedulabilityOracle:
         assert verdict["dropped"] == 1
         assert verdict["expected"] == {oracle.NO_CAPACITY: 1}
         assert verdict["unexplained"] == []
+
+
+class TestBulkPathEdges:
+    """The bulk fast paths in zonal (anti-)affinity assignment and the
+    token-merge slow paths they defer to (DomainPlan stores decisions as
+    interned tuples; pods crossing multiple groups exercise the merge)."""
+
+    def test_pod_in_zone_affinity_and_hostname_anti_groups(self):
+        # one pod carries BOTH a zone-affinity term and a hostname
+        # anti-affinity term: the hostname decision must not disturb the
+        # zone token, and both constraints must hold in the result
+        sel = {"app": "both"}
+        pods = [
+            make_pod(
+                labels=sel,
+                requests={"cpu": "0.5"},
+                pod_requirements=[affinity(sel, key=lbl.TOPOLOGY_ZONE)],
+                pod_anti_requirements=[affinity(sel, key=lbl.HOSTNAME)],
+            )
+            for _ in range(4)
+        ]
+        for solver in ("ffd", "tpu"):
+            nodes = solve(list(pods), solver=solver)
+            placed = [n for n in nodes if n.pods]
+            # anti-host: pairwise separation -> one matching pod per node
+            assert all(len(n.pods) == 1 for n in placed)
+            assert sum(len(n.pods) for n in placed) == 4
+            # zone affinity: all in ONE zone
+            zones = {zone_of(n) for n in placed}
+            assert len(zones) == 1, zones
+
+    def test_narrowed_member_takes_general_path_others_bulk(self):
+        # 10 unrestricted members + 1 member whose own selector narrows it
+        # to a different zone than the group majority would pick: the
+        # narrowed pod must land in ITS zone (general path), the rest
+        # colocate (bulk path); the narrowed pod is the group's first
+        # member so its choice seeds the populated domain
+        sel = {"app": "mixed"}
+        narrow = make_pod(
+            labels=sel, requests={"cpu": "0.5"},
+            node_selector={lbl.TOPOLOGY_ZONE: "test-zone-2"},
+            pod_requirements=[affinity(sel)],
+        )
+        rest = [
+            make_pod(labels=sel, requests={"cpu": "0.5"},
+                     pod_requirements=[affinity(sel)])
+            for _ in range(10)
+        ]
+        for solver in ("ffd", "tpu"):
+            nodes = solve([narrow] + list(rest), solver=solver)
+            by_zone = {}
+            for n in nodes:
+                for p in n.pods:
+                    by_zone.setdefault(zone_of(n), []).append(p)
+            # self-affinity: everyone in one zone, and it must be the
+            # narrowed member's only allowed zone
+            assert set(by_zone) == {"test-zone-2"}
+            assert sum(len(v) for v in by_zone.values()) == 11
+
+    def test_zone_decision_merges_with_prior_zone_decision(self):
+        # a pod in TWO zone-affinity groups: the second group's assignment
+        # must see the first group's pin (live read) and adopt it rather
+        # than splitting the pod across zones
+        sel_a, sel_b = {"app": "a"}, {"app": "b"}
+        both = make_pod(
+            labels={**sel_a, **sel_b}, requests={"cpu": "0.5"},
+            pod_requirements=[affinity(sel_a), affinity(sel_b)],
+        )
+        friends_a = [make_pod(labels=sel_a, requests={"cpu": "0.5"},
+                              pod_requirements=[affinity(sel_a)]) for _ in range(3)]
+        friends_b = [make_pod(labels=sel_b, requests={"cpu": "0.5"},
+                              pod_requirements=[affinity(sel_b)]) for _ in range(3)]
+        for solver in ("ffd", "tpu"):
+            nodes = solve([both] + friends_a + friends_b, solver=solver)
+            zones = {zone_of(n) for n in nodes if n.pods}
+            # everyone must collapse into one zone: the shared member pins
+            # both groups together
+            assert len(zones) == 1, zones
+            assert sum(len(n.pods) for n in nodes) == 7
