@@ -11,10 +11,38 @@ from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.utils import resources as res
 
 
+# memo keyed by the catalog's object identities (the value holds the tuple
+# so the ids stay valid): the union walks 400 types and runs on EVERY solve
+# via the scheduler facade's idempotent re-layering. Identities are stable
+# between catalog refreshes — providers TTL-cache the constructed
+# InstanceType list (e.g. InstanceTypeProvider.get, 5 min). Concurrent
+# per-provisioner workers share this, hence the lock.
+import threading as _threading
+
+_catreq_cache: Dict[tuple, tuple] = {}
+_catreq_lock = _threading.Lock()
+_CATREQ_CACHE_MAX = 8
+
+
 def catalog_requirements(instance_types: Sequence[InstanceType]) -> Requirements:
     """Union of supported {instance-type, zone, arch, os, capacity-type}
     values, layered into every provisioner at apply
-    (reference: requirements.go:25-47)."""
+    (reference: requirements.go:25-47). Requirements are immutable, so the
+    identity-keyed memo hands out one shared object."""
+    id_key = tuple(map(id, instance_types))
+    with _catreq_lock:
+        hit = _catreq_cache.get(id_key)
+    if hit is not None:
+        return hit[1]
+    out = _catalog_requirements(instance_types)
+    with _catreq_lock:
+        while len(_catreq_cache) >= _CATREQ_CACHE_MAX:
+            _catreq_cache.pop(next(iter(_catreq_cache)), None)
+        _catreq_cache[id_key] = (tuple(instance_types), out)
+    return out
+
+
+def _catalog_requirements(instance_types: Sequence[InstanceType]) -> Requirements:
     supported: Dict[str, set] = {
         lbl.INSTANCE_TYPE: set(),
         lbl.TOPOLOGY_ZONE: set(),

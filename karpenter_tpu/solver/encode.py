@@ -306,17 +306,17 @@ def encode(
     # hostnames resolve through one more
     tmpl_cache: Dict[Tuple, Tuple] = {}
     if plan is not None:
-        ztokens_get = plan.ztokens.get
-        hostdecs_get = plan.hostdecs.get
         tmpl_get = tmpl_cache.get
         host_ids_get = host_ids.get
         EMPTY = ()
-        for i, pod in enumerate(pods):
-            st = sts[i]
-            pid = id(pod)
-            # ztokens/hostdecs ARE the plan storage — one dict get each
-            ztok = ztokens_get(pid, EMPTY)
-            dh = hostdecs_get(pid)
+        # ztokens/hostdecs ARE the plan storage: gather both columns in two
+        # C-level map passes instead of per-pod method calls in the loop
+        pids = list(map(id, pods))
+        ztoks = [t if t is not None else EMPTY for t in map(plan.ztokens.get, pids)]
+        dhs = list(map(plan.hostdecs.get, pids))
+        for i, st in enumerate(sts):
+            ztok = ztoks[i]
+            dh = dhs[i]
             k2 = (id(st.merge_tid), id(ztok), id(st.req_tid))
             hit = tmpl_get(k2)
             if hit is None:
