@@ -420,3 +420,66 @@ class TestRandomizedParityWide:
                 r2[res.NVIDIA_GPU] = str(rng.choice([1, 2]))
                 pods.append(make_pod(requests=r2))
         assert_parity(*both_solve(pods, catalog, cluster=cluster, seed=seed))
+
+
+class TestRandomizedParityMultiFrontier:
+    """F>1 catalogs (anti-correlated cpu/mem — every type Pareto-optimal,
+    frontier width = catalog size): the frontier axis the linear/assorted
+    catalogs never exercise (they are Pareto-degenerate, F=1). The r4
+    decode mask-dedupe, encode axis-trimming, and the kernels' frontier
+    fit loops must stay assignment-identical at every F."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_tradeoff_catalog(self, seed):
+        from karpenter_tpu.cloudprovider.fake import instance_types_tradeoff
+
+        rng = random.Random(3000 + seed)
+        catalog = instance_types_tradeoff(rng.randint(4, 24))
+        pods = []
+        for i in range(rng.randint(10, 60)):
+            kind = rng.random()
+            requests = {
+                "cpu": f"{rng.choice([250, 500, 1000, 2000])}m",
+                "memory": f"{rng.choice([256, 1024, 4096, 8192])}Mi",
+            }
+            sel = {"app": rng.choice(["web", "db", "cache"])}
+            if kind < 0.4:
+                pods.append(make_pod(requests=requests))
+            elif kind < 0.6:
+                pods.append(make_pod(
+                    requests=requests,
+                    node_selector={lbl.TOPOLOGY_ZONE: rng.choice(
+                        ["test-zone-1", "test-zone-2", "test-zone-3"])},
+                ))
+            elif kind < 0.8:
+                pods.append(make_pod(labels=sel, requests=requests,
+                                     topology=[zone_spread(max_skew=1, labels=sel)]))
+            else:
+                pods.append(make_pod(labels=sel, requests=requests,
+                                     topology=[hostname_spread(max_skew=2, labels=sel)]))
+        assert_parity(*both_solve(pods, catalog, seed=seed))
+
+    def test_cpu_vs_memory_heavy_pick_different_frontier_ends(self):
+        """Sanity that the tradeoff catalog genuinely exercises F>1: a
+        cpu-heavy and a memory-heavy pod must be packable, and the batch
+        encodes with frontier width equal to the catalog size."""
+        from karpenter_tpu.cloudprovider.fake import instance_types_tradeoff
+        from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import encode as enc
+
+        catalog = sorted(instance_types_tradeoff(8), key=lambda it: it.effective_price())
+        provisioner = make_provisioner()
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = sort_pods_ffd([
+            make_pod(requests={"cpu": "8", "memory": "1Gi"}),
+            make_pod(requests={"cpu": "1", "memory": "12Gi"}),
+        ])
+        cc = c.clone()
+        plan = Topology(Cluster(), rng=random.Random(1)).inject_plan(cc, pods)
+        batch = enc.encode(cc, catalog, pods, daemon_overhead(Cluster(), cc), plan=plan)
+        assert batch.frontiers.shape[1] == 8
+        ffd, tpu = both_solve(pods, catalog)
+        assert_parity(ffd, tpu)
+        assert sum(len(n.pods) for n in tpu) == 2
