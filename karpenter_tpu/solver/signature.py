@@ -24,6 +24,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from collections import OrderedDict
+
 import numpy as np
 
 from karpenter_tpu.api import labels as lbl
@@ -132,6 +134,12 @@ class SignatureTable:
         self._join_cache: Dict[Tuple[int, Core], int] = {}
         self._core_reqs: Dict[Core, Requirements] = {}
         self._mask_matrix: Optional[np.ndarray] = None
+        # per-cores-vocabulary closure results (dense local reindex, join
+        # table, frontiers, open sigs) — filled by encode; valid for the
+        # table's lifetime because joins/signatures are append-only and
+        # base-invariant (set_base only refreshes hostname state, which is
+        # deliberately outside signatures)
+        self._closure_memo: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         # signature 0 is the base itself
         self._base_hostnames = base.requirements.get(lbl.HOSTNAME)
         self._intern(self._strip_hostname(base.requirements))
