@@ -483,3 +483,63 @@ class TestRandomizedParityMultiFrontier:
         ffd, tpu = both_solve(pods, catalog)
         assert_parity(ffd, tpu)
         assert sum(len(n.pods) for n in tpu) == 2
+
+
+class TestClosureMemo:
+    """The dense closure reindex (visit sweep + SxC join-table fill) is
+    memoized per core vocabulary on the SignatureTable; a repeated
+    vocabulary must not re-sweep joins, and the memoized arrays are shared
+    frozen objects."""
+
+    def test_repeat_vocabulary_hits_memo(self):
+        import random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.solver.signature import SignatureTable
+        from tests.factories import make_pod
+
+        catalog = instance_types(20)
+        c0 = make_provisioner(solver="tpu").spec.constraints
+        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
+        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        pods = lambda: [
+            make_pod(requests={"cpu": "1"}, node_selector={"team": f"t{i % 4}"})
+            for i in range(12)
+        ]
+        sched.solve(c0, catalog, pods())
+        table = next(iter(sched._encode_cache.tables.values()))[1]
+        assert len(table._closure_memo) == 1
+        joins_before = len(table._join_cache)
+        calls = []
+        orig_join = SignatureTable.join
+        SignatureTable.join = lambda self, *a: (calls.append(1), orig_join(self, *a))[1]
+        try:
+            n2 = sched.solve(c0, catalog, pods())
+        finally:
+            SignatureTable.join = orig_join
+        assert calls == [], f"repeat vocabulary re-swept {len(calls)} joins"
+        assert sum(len(n.pods) for n in n2) == 12
+        # the memoized arrays are frozen: accidental in-place mutation by a
+        # future consumer must fail loudly, not corrupt sibling solves
+        entry = next(iter(table._closure_memo.values()))
+        assert not entry[1].flags.writeable and not entry[2].flags.writeable
+
+    def test_vocabulary_change_misses_then_caches(self):
+        import random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from tests.factories import make_pod
+
+        catalog = instance_types(20)
+        c0 = make_provisioner(solver="tpu").spec.constraints
+        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
+        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        for k in (2, 5, 2):
+            sched.solve(c0, catalog, [
+                make_pod(requests={"cpu": "1"}, node_selector={"team": f"t{i % k}"})
+                for i in range(10)
+            ])
+        table = next(iter(sched._encode_cache.tables.values()))[1]
+        assert len(table._closure_memo) == 2  # k=2 and k=5 vocabularies
