@@ -491,18 +491,12 @@ class TestClosureMemo:
     vocabulary must not re-sweep joins, and the memoized arrays are shared
     frozen objects."""
 
+    _setup = TestEncodeCache._setup  # same scheduler/catalog recipe
+
     def test_repeat_vocabulary_hits_memo(self):
-        import random
-
-        from karpenter_tpu.kube.client import Cluster
-        from karpenter_tpu.solver.backend import TpuScheduler
         from karpenter_tpu.solver.signature import SignatureTable
-        from tests.factories import make_pod
 
-        catalog = instance_types(20)
-        c0 = make_provisioner(solver="tpu").spec.constraints
-        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
-        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        catalog, c0, sched = self._setup()
         pods = lambda: [
             make_pod(requests={"cpu": "1"}, node_selector={"team": f"t{i % 4}"})
             for i in range(12)
@@ -519,23 +513,15 @@ class TestClosureMemo:
         finally:
             SignatureTable.join = orig_join
         assert calls == [], f"repeat vocabulary re-swept {len(calls)} joins"
+        assert len(table._join_cache) == joins_before
         assert sum(len(n.pods) for n in n2) == 12
         # the memoized arrays are frozen: accidental in-place mutation by a
         # future consumer must fail loudly, not corrupt sibling solves
         entry = next(iter(table._closure_memo.values()))
-        assert not entry[1].flags.writeable and not entry[2].flags.writeable
+        assert all(not a.flags.writeable for a in entry[1:4])
 
     def test_vocabulary_change_misses_then_caches(self):
-        import random
-
-        from karpenter_tpu.kube.client import Cluster
-        from karpenter_tpu.solver.backend import TpuScheduler
-        from tests.factories import make_pod
-
-        catalog = instance_types(20)
-        c0 = make_provisioner(solver="tpu").spec.constraints
-        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
-        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        catalog, c0, sched = self._setup()
         for k in (2, 5, 2):
             sched.solve(c0, catalog, [
                 make_pod(requests={"cpu": "1"}, node_selector={"team": f"t{i % k}"})
