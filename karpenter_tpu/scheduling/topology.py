@@ -544,20 +544,11 @@ class Topology:
         viable = constraints.requirements.zones()
         key = group.key
         members = list(zip(group.pods, group.sts))
-        ztokens_get = plan.ztokens.get
-        pins = [
-            next((v for k, v in tok if k == key), None)
-            if (tok := ztokens_get(id(p)))
-            else None
-            for p, _ in members
-        ]
         # bulk fast path: no member is narrowed by its own spec and none is
         # pinned by an earlier pass — the per-pod loops then degenerate to a
         # handful of distinct domains stamped across the whole group (the
         # overwhelmingly common shape: template pods with pod-affinity only)
-        unrestricted = not any(pins) and all(
-            key not in st.key_entries for _, st in members
-        )
+        unrestricted = _group_unrestricted(key, group.pods, group.sts, plan)
         if unrestricted and group.anti:
             flags = group.match_flags(members)
             n_match = sum(flags)
@@ -612,7 +603,7 @@ class Topology:
                 plan.set_zone_bulk([p for p, _ in rest], key, UNSATISFIABLE_DOMAIN)
             return
         self._assign_zonal_affinity_general(
-            constraints, group, batch, plan, members, viable, key, pins
+            constraints, group, batch, plan, members, viable, key
         )
 
     def _assign_zonal_affinity_general(
@@ -943,6 +934,31 @@ class Topology:
             is_hostname = key == lbl.HOSTNAME
             ztokens = plan.ztokens
             hostdecs = plan.hostdecs
+            if not is_hostname and registered:
+                # bulk fast path: no member narrowed by its own spec and
+                # none pinned by an earlier pass — the per-pod argmin over
+                # counts (ties toward the later-iterated key, matching
+                # next_domain's <=) becomes a tight water-filling sim with
+                # one bulk write per domain
+                if _group_unrestricted(key, group.pods, group.sts, plan):
+                    doms = list(registered)
+                    counts = [group.spread[d] for d in doms]
+                    nd = len(doms)
+                    by_dom: List[List[Pod]] = [[] for _ in range(nd)]
+                    for pod in group.pods:
+                        m_i = 0
+                        m_c = counts[0]
+                        for j in range(1, nd):
+                            if counts[j] <= m_c:
+                                m_i = j
+                                m_c = counts[j]
+                        counts[m_i] += 1
+                        by_dom[m_i].append(pod)
+                    for j, members in enumerate(by_dom):
+                        group.spread[doms[j]] = counts[j]
+                        if members:
+                            plan.set_zone_bulk(members, key, doms[j])
+                    continue
             tok_cache: Dict[str, Tuple] = {}
             for pod, st in zip(group.pods, group.sts):
                 # the pod's own requirements may narrow the registered
@@ -1069,6 +1085,20 @@ def snapshot_selectors(pods: List[Pod]) -> List[Dict[str, str]]:
 def restore_selectors(pods: List[Pod], saved: List[Dict[str, str]]) -> None:
     for p, s in zip(pods, saved):
         p.spec.node_selector = s
+
+
+def _group_unrestricted(key: str, pods, sts, plan: DomainPlan) -> bool:
+    """The bulk fast paths' shared gate: no member's own spec narrows
+    ``key`` and no member carries a prior injected decision on it. MUST
+    stay in sync with ``_narrowed``'s inputs — key_entries plus the
+    plan's non-hostname decisions (zone tokens)."""
+    if any(key in st.key_entries for st in sts):
+        return False
+    ztokens_get = plan.ztokens.get
+    return not any(
+        (tok := ztokens_get(id(p))) and any(k == key for k, _ in tok)
+        for p in pods
+    )
 
 
 def _pinned_hostname(
