@@ -1,0 +1,64 @@
+"""The post-warmup GC policy: idempotent freeze, full restore, and the
+runtime wiring that must never freeze after stop() has restored."""
+
+import gc
+import threading
+import time
+import types
+
+from karpenter_tpu.utils import gcpolicy
+
+
+def test_freeze_restore_round_trip():
+    before = gc.get_threshold()
+    try:
+        gcpolicy.freeze_after_warmup(gen0_threshold=12345)
+        assert gc.get_threshold()[0] == 12345
+        gcpolicy.freeze_after_warmup(gen0_threshold=99999)  # idempotent
+        assert gc.get_threshold()[0] == 12345
+    finally:
+        gcpolicy.restore()
+    assert gc.get_threshold() == before
+    gcpolicy.restore()  # idempotent
+    assert gc.get_threshold() == before
+
+
+def test_stop_cancels_pending_freeze():
+    """A worker warming AFTER Runtime.stop must not re-freeze the heap —
+    the stop()-then-freeze race the cancel event exists to close."""
+    from karpenter_tpu.main import _freeze_gc_when_warm
+
+    before = gc.get_threshold()
+    warmed = threading.Event()
+    worker = types.SimpleNamespace(warmed=warmed)
+    provisioning = types.SimpleNamespace(workers={"p": worker})
+    runtime = types.SimpleNamespace(provisioning=provisioning, _gc_freeze_cancel=None)
+    _freeze_gc_when_warm(runtime, timeout=5.0)
+    assert runtime._gc_freeze_cancel is not None
+    # stop() semantics: cancel BEFORE any freeze can land
+    runtime._gc_freeze_cancel.set()
+    warmed.set()
+    time.sleep(1.5)  # give the wait thread its poll tick
+    assert gc.get_threshold() == before, "freeze landed after cancel"
+    gcpolicy.restore()
+
+
+def test_freeze_fires_once_worker_warms():
+    from karpenter_tpu.main import _freeze_gc_when_warm
+
+    before = gc.get_threshold()
+    warmed = threading.Event()
+    worker = types.SimpleNamespace(warmed=warmed)
+    provisioning = types.SimpleNamespace(workers={"p": worker})
+    runtime = types.SimpleNamespace(provisioning=provisioning, _gc_freeze_cancel=None)
+    try:
+        _freeze_gc_when_warm(runtime, timeout=10.0)
+        warmed.set()
+        deadline = time.time() + 5
+        while time.time() < deadline and gc.get_threshold() == before:
+            time.sleep(0.05)
+        assert gc.get_threshold() != before, "freeze never fired after warmup"
+    finally:
+        runtime._gc_freeze_cancel.set()
+        gcpolicy.restore()
+    assert gc.get_threshold() == before
