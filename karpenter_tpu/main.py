@@ -92,8 +92,10 @@ def _freeze_gc_when_warm(runtime: Runtime, timeout: float = 300.0) -> None:
         while _t.monotonic() < deadline and not cancel.is_set():
             workers = list(getattr(runtime.provisioning, "workers", {}).values())
             if any(w.warmed.is_set() for w in workers):
-                if not cancel.is_set():
-                    freeze_after_warmup()
+                # cancel is re-checked under gcpolicy's lock: stop() sets
+                # the event BEFORE calling restore, so a freeze can never
+                # land after restore
+                freeze_after_warmup(unless=cancel)
                 return
             cancel.wait(1.0)
 

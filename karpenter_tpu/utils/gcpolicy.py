@@ -31,10 +31,14 @@ _frozen = False
 _saved_thresholds = None
 
 
-def freeze_after_warmup(gen0_threshold: int = 50000) -> None:
+def freeze_after_warmup(gen0_threshold: int = 50000, unless=None) -> None:
+    """``unless`` is an optional threading.Event checked INSIDE the lock:
+    a canceller that sets the event and then calls ``restore`` can never
+    lose to a freeze landing between its two steps (the check-then-freeze
+    race the runtime's stop path must not have)."""
     global _frozen, _saved_thresholds
     with _lock:
-        if _frozen:
+        if _frozen or (unless is not None and unless.is_set()):
             return
         _saved_thresholds = gc.get_threshold()
         gc.collect()

@@ -33,14 +33,33 @@ def test_stop_cancels_pending_freeze():
     worker = types.SimpleNamespace(warmed=warmed)
     provisioning = types.SimpleNamespace(workers={"p": worker})
     runtime = types.SimpleNamespace(provisioning=provisioning, _gc_freeze_cancel=None)
-    _freeze_gc_when_warm(runtime, timeout=5.0)
-    assert runtime._gc_freeze_cancel is not None
-    # stop() semantics: cancel BEFORE any freeze can land
-    runtime._gc_freeze_cancel.set()
-    warmed.set()
-    time.sleep(1.5)  # give the wait thread its poll tick
-    assert gc.get_threshold() == before, "freeze landed after cancel"
-    gcpolicy.restore()
+    try:
+        _freeze_gc_when_warm(runtime, timeout=5.0)
+        assert runtime._gc_freeze_cancel is not None
+        # stop() semantics: cancel BEFORE any freeze can land
+        runtime._gc_freeze_cancel.set()
+        warmed.set()
+        deadline = time.time() + 0.5
+        while time.time() < deadline and gc.get_threshold() == before:
+            time.sleep(0.02)  # a freeze would flip thresholds; none may
+        assert gc.get_threshold() == before, "freeze landed after cancel"
+    finally:
+        gcpolicy.restore()
+    assert gc.get_threshold() == before
+
+
+def test_freeze_skipped_when_cancelled_inside_lock():
+    """The check-then-freeze window is closed INSIDE gcpolicy: a cancel
+    event set before the locked check always wins, even if the caller
+    already passed its own check."""
+    before = gc.get_threshold()
+    cancel = threading.Event()
+    cancel.set()
+    try:
+        gcpolicy.freeze_after_warmup(unless=cancel)
+        assert gc.get_threshold() == before
+    finally:
+        gcpolicy.restore()
 
 
 def test_freeze_fires_once_worker_warms():
