@@ -192,6 +192,25 @@ class TestGkeWire:
             client.create_node_pool("ct5lp-hightpu-4t", "us-central1-a", False, 0)
         assert client.retries == 0
 
+    def test_multi_host_podslice_atomic_over_the_wire(self, gke_wire):
+        """A count=N pool crosses the wire as one atomic creation: N
+        instances in the response, all server-side; a stocked-out slice
+        yields zero instances, never a partial pool."""
+        from karpenter_tpu.cloudprovider.gke import GkeStockoutError
+
+        api, server, client = gke_wire
+        pool = client.create_node_pool(
+            "ct5lp-hightpu-4t", "us-central1-a", False, 4, tpu_topology="4x4"
+        )
+        assert len(pool.instances) == 4
+        assert pool.tpu_topology == "4x4"
+        assert all(i.node_pool == pool.name for i in pool.instances)
+        assert len(api.node_pools[pool.name].instances) == 4
+        api.set_stockout("ct5lp-hightpu-4t", "us-central1-b")
+        with pytest.raises(GkeStockoutError):
+            client.create_node_pool("ct5lp-hightpu-4t", "us-central1-b", False, 4)
+        assert len(api.node_pools) == 1  # no partial second pool
+
     def test_provider_over_wire_stockout_marks_ice(self, gke_wire):
         """End-to-end: GkeCloudProvider over the HTTP client — a stockout
         crossing the wire still drives the ICE/unavailable-offerings path."""
