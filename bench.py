@@ -168,6 +168,36 @@ def onchip_parity_check(n_pods: int = 500) -> str:
         assert_equal("v1-multi", got, ref)
     checked.append("v1-multi")
 
+    # 3b. fused single-dispatch through the v2 kernel (F>1 tradeoff
+    # catalog past the v1 unroll budget — the constraint-diverse route)
+    from karpenter_tpu.cloudprovider.fake import instance_types_tradeoff
+    from karpenter_tpu.solver.backend import TpuScheduler
+    from karpenter_tpu.testing import make_pod
+
+    tcat = sorted(instance_types_tradeoff(16), key=lambda it: it.effective_price())
+    tprov = make_provisioner(solver="tpu")
+    tc = tprov.spec.constraints
+    tc.requirements = tc.requirements.merge(catalog_requirements(tcat))
+    rng9 = random.Random(9)
+    tpods = sort_pods_ffd([
+        make_pod(
+            requests={"cpu": f"{rng9.choice([0.25, 0.5, 1])}"},
+            node_selector={"team": f"t{i % 64}"},
+        )
+        for i in range(512)
+    ])
+    tcc = tc.clone()
+    tplan = Topology(Cluster(), rng=random.Random(1)).inject_plan(tcc, tpods)
+    tbatch = enc.encode(tcc, tcat, tpods, daemon_overhead(Cluster(), tcc), plan=tplan)
+    tsched = TpuScheduler(Cluster())
+    route = tsched._fused_route(tbatch, 256)
+    if route != "v2":
+        raise AssertionError(f"tradeoff batch routed {route}, not fused-v2")
+    fres2, _ = tsched._pack_fused(tbatch, 256, "v2")
+    ref2 = K.pack(*tbatch.pack_args(), n_max=256)
+    assert_equal("fused-v2", fres2, ref2)
+    checked.append("fused-v2")
+
     # 4. v2 (matmul-gather) kernel on an F>1 shape past the v1 unroll
     # budget — the route constraint-diverse batches take in production
     from karpenter_tpu.solver import pallas_kernel as pk
@@ -409,12 +439,14 @@ def bench_selection_storm(n_pods: int):
                     if pod.metadata.name in created and pod.metadata.name not in bind_times:
                         bind_times[pod.metadata.name] = time.perf_counter()
 
+        from karpenter_tpu.testing import make_pod
+
         cluster.watch("pods", on_pod)
         rng = random.Random(5)
         t0 = time.perf_counter()
         for i in range(n_pods):
             name = f"storm-{i}"
-            p = __import__("karpenter_tpu.testing", fromlist=["make_pod"]).make_pod(
+            p = make_pod(
                 name=name, requests={"cpu": f"{rng.choice([0.25, 0.5, 1])}"}
             )
             with lock:

@@ -125,15 +125,16 @@ class TpuScheduler:
         than pods) and retries at full P on saturation (table full with
         unscheduled pods)."""
         p = len(batch.pod_valid)
-        n_max = min(p, 512) if self._fused_eligible(batch) else max(256, p // 4)
+        route = self._fused_route(batch, min(p, 512))
+        n_max = min(p, 512) if route else max(256, p // 4)
         self.last_profile["pack_dispatches"] = 0
         args = None
         while True:
             self.last_profile["pack_dispatches"] += 1
             result = typemask = None
-            if self._fused_eligible(batch):
+            if route:
                 try:
-                    result, typemask = self._pack_fused(batch, n_max)
+                    result, typemask = self._pack_fused(batch, n_max, route)
                 except Exception:
                     # same containment contract as pack_best: one
                     # pathological shape must not crash the batch or degrade
@@ -141,7 +142,8 @@ class TpuScheduler:
                     # (which has its own v1→v2→scan fallbacks)
                     shape = self._fused_shape(batch, n_max)
                     logger.exception(
-                        "fused solve failed for shape %s; unfused ladder", shape
+                        "fused %s solve failed for shape %s; unfused ladder",
+                        route, shape,
                     )
                     _fused_failed_shapes.add(shape)
             if result is None:
@@ -154,6 +156,9 @@ class TpuScheduler:
             if not saturated or n_max >= p:
                 return result, typemask
             n_max = p
+            # routing is n_max-dependent (the v2 VMEM gate): re-derive for
+            # the full-table retry
+            route = self._fused_route(batch, n_max)
 
     @staticmethod
     def _fused_shape(batch: enc.EncodedBatch, n_max: int) -> tuple:
@@ -162,50 +167,85 @@ class TpuScheduler:
             batch.frontiers.shape[1], n_max,
         )
 
-    def _fused_eligible(self, batch: enc.EncodedBatch) -> bool:
-        """The fused single-dispatch path serves exactly the shapes the v1
-        Pallas kernel serves (TPU, lane-aligned P, S·F within the unroll
-        budget) whose interned ids fit the compact i16 upload. A configured
-        sidecar takes precedence (its own process owns the device), and a
-        shape whose fused compile/run already failed stays on the unfused
-        ladder."""
+    def _fused_route(self, batch: enc.EncodedBatch, n_max: int) -> Optional[str]:
+        """Which fused single-dispatch route serves this batch at this node
+        table size — ``"v1"`` (the unrolled Pallas kernel's shapes: TPU,
+        lane-aligned P, S·F within the unroll budget), ``"v2"`` (the
+        matmul-gather kernel for constraint-diverse batches past the v1
+        budget whose tables fit VMEM), or ``None`` (unfused ladder). Both
+        require the interned ids to fit the compact i16 upload. A
+        configured sidecar takes precedence (its own process owns the
+        device), and a shape whose fused compile/run already failed stays
+        on the unfused ladder."""
         import os
 
         if os.environ.get("KARPENTER_PACKER", "auto").lower() not in ("auto", "fused"):
-            return False
+            return None
         if self.service_address and time.monotonic() >= self._remote_down_until:
-            return False
+            return None
         from karpenter_tpu.solver import fused
-        from karpenter_tpu.solver.pallas_kernel import pallas_shape_eligible
+        from karpenter_tpu.solver.pallas_kernel import (
+            BLOCK,
+            pallas_available,
+            pallas_shape_eligible,
+        )
+        from karpenter_tpu.solver.pallas_kernel_v2 import v2_vmem_ok
 
         P = len(batch.pod_valid)
         S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
         if any(s[:3] == (P, S, F) for s in _fused_failed_shapes):
-            return False
-        return pallas_shape_eligible(P, S, F) and fused.ids_fit(batch)
+            return None
+        if not fused.ids_fit(batch):
+            return None
+        if pallas_shape_eligible(P, S, F):
+            return "v1"
+        C = batch.join_table.shape[1]
+        R = batch.frontiers.shape[2]
+        if (
+            pallas_available()
+            and P % BLOCK == 0
+            and v2_vmem_ok(S, n_max, C, F * R)
+        ):
+            return "v2"
+        return None
 
-    def _pack_fused(self, batch: enc.EncodedBatch, n_max: int):
+    def _pack_fused(self, batch: enc.EncodedBatch, n_max: int, route: str):
         """One compact upload + one dispatch + one fetch (solver/fused.py);
-        join table, frontiers, daemon, type masks and usable capacities ride
-        the device-resident invariants cache."""
+        join table, frontiers, daemon, type masks and usable capacities —
+        and on the v2 route the per-core join tables — ride the
+        device-resident invariants cache."""
         import jax
 
         from karpenter_tpu.solver import fused
 
         if self._device_cache is None:
             self._device_cache = fused.DeviceInvariants()
-        join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
         pod_tab, open_by_core, bhh = fused.pack_pod_table(batch)
         uniq = fused.pad_uniq_req(batch.uniq_req)
-        from karpenter_tpu.solver.pallas_kernel import pallas_available
-
-        buf = jax.device_get(
-            fused.fused_solve(
-                pod_tab, open_by_core, bhh, uniq,
-                join_d, front_d, daemon_d, mask_d, usable_d,
-                n_max=n_max, kernel="pallas" if pallas_available() else "scan",
+        if route == "v2":
+            (front_j_d, compat_j_d, jvals_d, front_d, daemon_d, mask_d,
+             usable_d) = self._device_cache.get_v2(batch)
+            buf = jax.device_get(
+                fused.fused_solve_v2(
+                    pod_tab, open_by_core, bhh, uniq,
+                    front_j_d, compat_j_d, jvals_d, front_d, daemon_d,
+                    mask_d, usable_d,
+                    n_max=n_max,
+                    F=batch.frontiers.shape[1],
+                    R=batch.frontiers.shape[2],
+                )
             )
-        )
+        else:
+            join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
+            from karpenter_tpu.solver.pallas_kernel import pallas_available
+
+            buf = jax.device_get(
+                fused.fused_solve(
+                    pod_tab, open_by_core, bhh, uniq,
+                    join_d, front_d, daemon_d, mask_d, usable_d,
+                    n_max=n_max, kernel="pallas" if pallas_available() else "scan",
+                )
+            )
         return fused.split_fused(
             buf, len(batch.pod_valid), n_max, batch.usable.shape[1],
             batch.usable.shape[0],

@@ -96,15 +96,18 @@ class DeviceInvariants:
     catalog) — re-uploading the join table, frontiers, type masks and usable
     capacities per solve wastes tunnel bandwidth on bytes that did not
     change. Keyed by content digest, so a changed catalog or closure simply
-    misses."""
+    misses. ``get_v2`` additionally holds the v2 kernel's per-core join
+    tables (frontJ/compatJ/jvals — by far the largest arrays of a diverse
+    solve) device-resident under the same digest."""
 
     MAX_ENTRIES = 4
 
     def __init__(self):
         self._cache: "Dict[bytes, tuple]" = {}
+        self._cache_v2: "Dict[bytes, tuple]" = {}
         self._order: list = []
 
-    def get(self, batch):
+    def _digest(self, batch) -> bytes:
         import hashlib
 
         mask = batch.type_mask_matrix()
@@ -114,28 +117,58 @@ class DeviceInvariants:
         h.update(np.ascontiguousarray(batch.daemon).tobytes())
         h.update(np.ascontiguousarray(mask).tobytes())
         h.update(np.ascontiguousarray(batch.usable).tobytes())
-        key = h.digest()
-        hit = self._cache.get(key)
-        if hit is not None:
-            # LRU, not FIFO: interleaving invariant sets (several
-            # provisioners on one scheduler) must not evict the hot entry
+        return h.digest()
+
+    def _touch(self, key: bytes) -> None:
+        # LRU, not FIFO: interleaving invariant sets (several provisioners
+        # on one scheduler) must not evict the hot entry
+        if key in self._order:
             self._order.remove(key)
-            self._order.append(key)
-            return hit
-        hit = tuple(
-            jax.device_put(a)
-            for a in (
-                batch.join_table.astype(np.int32),
-                batch.frontiers.astype(np.float32),
-                batch.daemon.astype(np.float32),
-                mask.astype(bool),
-                batch.usable.astype(np.float32),
-            )
-        )
-        self._cache[key] = hit
         self._order.append(key)
         while len(self._order) > self.MAX_ENTRIES:
-            self._cache.pop(self._order.pop(0), None)
+            dead = self._order.pop(0)
+            self._cache.pop(dead, None)
+            self._cache_v2.pop(dead, None)
+
+    def get(self, batch):
+        key = self._digest(batch)
+        hit = self._cache.get(key)
+        if hit is None:
+            hit = self._cache[key] = tuple(
+                jax.device_put(a)
+                for a in (
+                    batch.join_table.astype(np.int32),
+                    batch.frontiers.astype(np.float32),
+                    batch.daemon.astype(np.float32),
+                    batch.type_mask_matrix().astype(bool),
+                    batch.usable.astype(np.float32),
+                )
+            )
+        self._touch(key)
+        return hit
+
+    def get_v2(self, batch):
+        """(front_j, compat_j, jvals, frontiers, daemon, mask, usable) on
+        device — the v2 route's per-core tables computed once per closure."""
+        key = self._digest(batch)
+        hit = self._cache_v2.get(key)
+        if hit is None:
+            from karpenter_tpu.solver.pallas_kernel_v2 import _precompute
+
+            front_j, compat_j, jvals, _ = _precompute(
+                np.asarray(batch.join_table), np.asarray(batch.frontiers, np.float32)
+            )
+            hit = self._cache_v2[key] = tuple(
+                jax.device_put(a)
+                for a in (
+                    front_j, compat_j, jvals,
+                    batch.frontiers.astype(np.float32),
+                    batch.daemon.astype(np.float32),
+                    batch.type_mask_matrix().astype(bool),
+                    batch.usable.astype(np.float32),
+                )
+            )
+        self._touch(key)
         return hit
 
 
@@ -153,6 +186,57 @@ def _pack_typebits(ok, T32):
     )
 
 
+def _unpack_pods(pod_tab, open_by_core, bhh, uniq_req):
+    """In-jit inverse of ``pack_pod_table``: the per-pod kernel inputs from
+    the compact i16 upload (encode's host-side formulas, on device)."""
+    import jax.numpy as jnp
+
+    tab = pod_tab.astype(jnp.int32)
+    pod_valid = (tab[ROW_FLAGS] & 1) != 0
+    pod_host_in_base = (tab[ROW_FLAGS] & 2) != 0
+    pod_core = tab[ROW_CORE]
+    pod_host = tab[ROW_HOST]
+    pod_open_sig = open_by_core.astype(jnp.int32)[pod_core]
+    # joinable hostname state when the merged hostname set stays non-empty,
+    # poisoned (-2) otherwise
+    joinable = pod_host_in_base | (bhh[0] == 0)
+    pod_open_host = jnp.where(
+        pod_host >= 0, jnp.where(joinable, pod_host, -2), -1
+    ).astype(jnp.int32)
+    pod_req = uniq_req[tab[ROW_REQ_ID]]  # [P, R] gather on device
+    return (
+        pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base,
+        pod_open_host, pod_req,
+    )
+
+
+def _finalize(result, sig_type_mask, usable):
+    """Surviving-type bitmask per node (decode's old host-side [N, T, R]
+    broadcast) + everything flattened into ONE int32 buffer for one fetch."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    T = usable.shape[0]
+    T32 = (T + 31) // 32
+    pad_t = T32 * 32 - T
+    mask = sig_type_mask[jnp.clip(result.node_sig, 0)]  # [N, T]
+    fits = jnp.all(result.node_req[:, None, :] <= usable[None, :, :], axis=-1)
+    ok = mask & fits & (result.node_sig >= 0)[:, None]
+    if pad_t:
+        ok = jnp.pad(ok, ((0, 0), (0, pad_t)))
+    typebits = _pack_typebits(ok, T32)  # [N, T32] i32
+
+    parts = [
+        result.assignment.reshape(-1),
+        result.node_sig.reshape(-1),
+        result.node_host.reshape(-1),
+        lax.bitcast_convert_type(result.node_req, jnp.int32).reshape(-1),
+        typebits.reshape(-1),
+        result.n_nodes.reshape(-1).astype(jnp.int32),
+    ]
+    return jnp.concatenate(parts)
+
+
 @partial(jax.jit, static_argnames=("n_max", "kernel"))
 def fused_solve(
     pod_tab,  # [4, P] i16
@@ -167,58 +251,77 @@ def fused_solve(
     n_max: int,
     kernel: str,  # "pallas" | "scan"
 ):
-    import jax.numpy as jnp
-
     from karpenter_tpu.solver import kernel as _k
 
-    tab = pod_tab.astype(jnp.int32)
-    pod_valid = (tab[ROW_FLAGS] & 1) != 0
-    pod_host_in_base = (tab[ROW_FLAGS] & 2) != 0
-    pod_core = tab[ROW_CORE]
-    pod_host = tab[ROW_HOST]
-    pod_open_sig = open_by_core.astype(jnp.int32)[pod_core]
-    # encode's host-side formula, on device: joinable hostname state when
-    # the merged hostname set stays non-empty, poisoned (-2) otherwise
-    joinable = pod_host_in_base | (bhh[0] == 0)
-    pod_open_host = jnp.where(
-        pod_host >= 0, jnp.where(joinable, pod_host, -2), -1
-    ).astype(jnp.int32)
-    pod_req = uniq_req[tab[ROW_REQ_ID]]  # [P, R] gather on device
-
-    args = (
-        pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base,
-        pod_open_host, pod_req, join_table, frontiers, daemon,
-    )
+    unpacked = _unpack_pods(pod_tab, open_by_core, bhh, uniq_req)
+    args = unpacked + (join_table, frontiers, daemon)
     if kernel == "pallas":
         from karpenter_tpu.solver.pallas_kernel import pack_pallas
 
         result = pack_pallas(*args, n_max=n_max)
     else:
         result = _k.pack(*args, n_max=n_max)
+    return _finalize(result, sig_type_mask, usable)
 
-    # surviving-type bitmask per node (decode's old host-side [N, T, R]
-    # broadcast): signature-compatible ∧ node total fits the type's usable
-    T = usable.shape[0]
-    T32 = (T + 31) // 32
-    pad_t = T32 * 32 - T
-    mask = sig_type_mask[jnp.clip(result.node_sig, 0)]  # [N, T]
-    fits = jnp.all(result.node_req[:, None, :] <= usable[None, :, :], axis=-1)
-    ok = mask & fits & (result.node_sig >= 0)[:, None]
-    if pad_t:
-        ok = jnp.pad(ok, ((0, 0), (0, pad_t)))
-    typebits = _pack_typebits(ok, T32)  # [N, T32] i32
 
-    from jax import lax
+@partial(jax.jit, static_argnames=("n_max", "F", "R"))
+def fused_solve_v2(
+    pod_tab,  # [4, P] i16
+    open_by_core,  # [C] i16
+    bhh,  # [1] i32
+    uniq_req,  # [U, R] f32
+    front_j,  # [C, FRp, S_pad] f32 (device-resident; pallas_kernel_v2._precompute)
+    compat_j,  # [C, 8, S_pad] f32 (device-resident)
+    jvals,  # [C, 8, S_pad] f32 (device-resident)
+    frontiers,  # [S, F, R] f32 (device-resident; open-fits derivation)
+    daemon,  # [R] f32 (device-resident)
+    sig_type_mask,  # [S, T] bool (device-resident)
+    usable,  # [T, R] f32 (device-resident)
+    n_max: int,
+    F: int,
+    R: int,
+):
+    """The fused dispatch through the v2 (matmul-gather) kernel: the route
+    for constraint-diverse batches past the v1 unroll budget. Same one
+    compact upload / one buffer back; the v2 host precompute
+    (``_open_fits_host``) is derived ON DEVICE and the per-core join tables
+    ride the invariants cache."""
+    import jax.numpy as jnp
 
-    parts = [
-        result.assignment.reshape(-1),
-        result.node_sig.reshape(-1),
-        result.node_host.reshape(-1),
-        lax.bitcast_convert_type(result.node_req, jnp.int32).reshape(-1),
-        typebits.reshape(-1),
-        result.n_nodes.reshape(-1).astype(jnp.int32),
-    ]
-    return jnp.concatenate(parts)
+    from karpenter_tpu.solver import kernel as _k
+    from karpenter_tpu.solver.pallas_kernel_v2 import _pack_v2_call
+
+    (pod_valid, pod_open_sig, pod_core, pod_host, pod_host_in_base,
+     pod_open_host, pod_req) = _unpack_pods(pod_tab, open_by_core, bhh, uniq_req)
+    # _open_fits_host's formula, in-jit: daemon+req fits ANY frontier of
+    # the pod's open signature (open sigs are always valid indices)
+    need = pod_req + daemon[None, :]
+    limits = frontiers[pod_open_sig]  # [P, F, R]
+    open_fits = jnp.any(jnp.all(need[:, None, :] <= limits, axis=-1), axis=-1)
+    pod_scal = jnp.stack([
+        pod_valid.astype(jnp.int32), pod_open_sig, pod_core, pod_host,
+        pod_host_in_base.astype(jnp.int32), pod_open_host,
+    ])
+    assignment, node_sig, node_host, node_req_t, count = _pack_v2_call(
+        pod_scal,
+        pod_req.T,
+        front_j,
+        compat_j,
+        jvals,
+        open_fits.reshape(1, -1).astype(jnp.int32),
+        daemon.reshape(R, 1),
+        n_max=n_max,
+        F=F,
+        R=R,
+    )
+    result = _k.PackResult(
+        assignment=assignment[0],
+        node_sig=node_sig[0, :n_max],
+        node_host=node_host[0, :n_max],
+        node_req=node_req_t[:, :n_max].T,
+        n_nodes=count[0, 0],
+    )
+    return _finalize(result, sig_type_mask, usable)
 
 
 def split_fused(buf, p: int, n: int, r: int, t: int):

@@ -108,7 +108,7 @@ def test_backend_solve_uses_fused_typemask_on_scan(monkeypatch):
     from karpenter_tpu.testing import diverse_pods, make_provisioner
 
     monkeypatch.setattr(
-        bk.TpuScheduler, "_fused_eligible", lambda self, batch: True
+        bk.TpuScheduler, "_fused_route", lambda self, batch, n_max: "v1"
     )
     catalog = instance_types(50)
     provisioner = make_provisioner(solver="tpu")
@@ -136,3 +136,59 @@ def test_backend_solve_uses_fused_typemask_on_scan(monkeypatch):
         for n in ffd_nodes
     }
     assert tpu_opts == ffd_opts
+
+
+class TestFusedRoute:
+    """Shape routing of the fused single-dispatch path: v1 within the
+    unroll budget, v2 for diverse F>1 batches whose tables fit VMEM, None
+    otherwise (unfused ladder)."""
+
+    def _batch_and_sched(self, n_types, k_labels, n_pods=512):
+        from karpenter_tpu.cloudprovider.fake import instance_types_tradeoff
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import backend as bk
+        from karpenter_tpu.solver import encode as enc
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        catalog = sorted(
+            instance_types_tradeoff(n_types), key=lambda it: it.effective_price()
+        )
+        prov = make_provisioner(solver="tpu")
+        c = prov.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = sort_pods_ffd([
+            make_pod(requests={"cpu": "0.5"}, node_selector={"team": f"t{i % k_labels}"})
+            for i in range(n_pods)
+        ])
+        cc = c.clone()
+        plan = Topology(Cluster(), rng=random.Random(1)).inject_plan(cc, pods)
+        batch = enc.encode(cc, catalog, pods, daemon_overhead(Cluster(), cc), plan=plan)
+        return batch, bk.TpuScheduler(Cluster())
+
+    def test_diverse_f_gt1_routes_v2_when_pallas_available(self, monkeypatch):
+        from karpenter_tpu.solver import backend as bk
+
+        batch, sched = self._batch_and_sched(n_types=16, k_labels=64)
+        S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
+        assert S * F > 1024  # past the v1 unroll budget
+        import karpenter_tpu.solver.pallas_kernel as pk
+
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        assert sched._fused_route(batch, 256) == "v2"
+        # and on a CPU-only backend the same shape takes the unfused ladder
+        monkeypatch.setattr(pk, "pallas_available", lambda: False)
+        assert sched._fused_route(batch, 256) is None
+
+    def test_vmem_overflow_falls_off_the_v2_route(self, monkeypatch):
+        import karpenter_tpu.solver.pallas_kernel as pk
+
+        batch, sched = self._batch_and_sched(n_types=16, k_labels=64)
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        assert sched._fused_route(batch, 256) == "v2"
+        # a node table too large for the VMEM budget disables ONLY this
+        # n_max, without memoizing a failure
+        assert sched._fused_route(batch, 1 << 14) is None
+        assert sched._fused_route(batch, 256) == "v2"
