@@ -148,19 +148,18 @@ def onchip_parity_check(n_pods: int = 500) -> str:
 
     # 3. sharded v1 multi-solve — B sized to the mesh's data axis so the
     # gate works on any rig (1 chip here, but a v4-8 has 4+)
-    from karpenter_tpu.parallel import sharding as sharding_mod
     from karpenter_tpu.parallel.sharding import make_solver_mesh, sharded_multi_solve
 
     args = batch.pack_args()
     mesh = make_solver_mesh()
     n_b = 2 * mesh.shape["data"]
     stacked = tuple(np.stack([np.asarray(a)] * n_b) for a in args)
-    mres, _ = sharded_multi_solve(
+    mres, _, mroute = sharded_multi_solve(
         mesh, stacked, np.stack([batch.type_mask_matrix()] * n_b), batch.usable,
         np.array([it.effective_price() for it in catalog], np.float32),
         n_max=n_max,
     )
-    route = (sharding_mod.last_route or {}).get("route")
+    route = mroute.get("route")
     if route != "pallas-v1-multi":
         raise AssertionError(f"multi gate took route {route}, not pallas-v1-multi")
     for b in range(n_b):
@@ -631,7 +630,7 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
 
     def run(epsilon: float):
         placed[6] = perturb(base_req, mask_dev, epsilon)
-        result, cheapest = sharded_multi_solve(
+        result, cheapest, _ = sharded_multi_solve(
             mesh, tuple(placed), sig_type_mask, batches[0].usable, prices, n_max=n_max
         )
         # a real fetch forces execution — under the tunneled backend,

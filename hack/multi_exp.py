@@ -71,7 +71,7 @@ for B in (8, 32, 64):
 
     def run(eps):
         placed[6] = perturb(base_req, mask_dev, eps)
-        result, cheapest = sharded_multi_solve(
+        result, cheapest, _ = sharded_multi_solve(
             mesh, tuple(placed), sig_type_mask, batches[0].usable, prices, n_max=n_max
         )
         jax.device_get((result.n_nodes, cheapest[:, 0]))

@@ -38,6 +38,28 @@ class EventRecorder:
         self._seen: "OrderedDict[Tuple, Tuple[float, Event]]" = OrderedDict()
         self._counter = 0
 
+    def _bump(self, key, now, exclude=None):
+        """Under the lock: if ``key`` holds a live aggregation entry (other
+        than ``exclude``, the object whose server copy is known pruned),
+        bump its count and return ``(event, wire_snapshot)``. The snapshot
+        is taken under the lock: the API write happens outside it and races
+        with other threads' bumps, and a half-mutated event must never be
+        serialized to the wire. Returns ``(None, None)`` on miss."""
+        with self._lock:
+            hit = self._seen.get(key)
+            if (
+                hit is None
+                or hit[1] is exclude
+                or now - hit[0] >= AGGREGATION_WINDOW
+            ):
+                return None, None
+            ev = hit[1]
+            ev.count += 1
+            ev.last_timestamp = now
+            self._seen[key] = (now, ev)
+            self._seen.move_to_end(key)
+            return ev, copy.copy(ev)
+
     def event(
         self,
         involved_kind: str,
@@ -55,27 +77,27 @@ class EventRecorder:
             # The lock guards only _seen/_counter bookkeeping; API writes
             # happen outside it so a slow apiserver call can't serialize
             # every controller's event emission behind this recorder.
-            with self._lock:
-                hit = self._seen.get(key)
-                if hit is not None and now - hit[0] < AGGREGATION_WINDOW:
-                    ev = hit[1]
-                    ev.count += 1
-                    ev.last_timestamp = now
-                    self._seen[key] = (now, ev)
-                    self._seen.move_to_end(key)
-                    # snapshot under the lock: the write below races with
-                    # other threads' bumps, and a half-mutated event must
-                    # never be serialized to the wire
-                    snapshot = copy.copy(ev)
-                else:
-                    ev = None
+            ev, snapshot = self._bump(key, now)
+            stale = None
             if ev is not None:
                 try:
                     self.cluster.update("events", snapshot)
                 except Exception:
-                    pass  # the event may have been pruned; re-create below
+                    stale = ev  # pruned server-side: re-create below
                 else:
                     return ev
+            # re-check: another thread may have created this key while we
+            # were outside the lock (ADVICE r4). Bump that fresh event
+            # instead of creating a near-simultaneous duplicate — unless
+            # the entry is the very object whose update just failed, which
+            # must be replaced, not bumped forever.
+            ev, snapshot = self._bump(key, now, exclude=stale)
+            if ev is not None:
+                try:
+                    self.cluster.update("events", snapshot)
+                except Exception:
+                    pass  # fire-and-forget; aggregation already recorded
+                return ev
             with self._lock:
                 self._counter += 1
                 name = f"{involved_name}.{self._counter:x}.{int(now)}"

@@ -30,11 +30,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from karpenter_tpu.solver import kernel
 
 
-# route + shape-gate report of the most recent sharded_multi_solve (the
-# dryrun and bench surface it; single-writer per process is fine there)
-last_route: Optional[dict] = None
-
-
 def make_solver_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
     """2D mesh over (data, model). ``model_parallel`` shards the instance-type
     axis; the rest of the devices shard independent solve batches."""
@@ -194,7 +189,11 @@ def sharded_multi_solve(
     node's cheapest launchable type, with the batch axis sharded over 'data'
     and the instance-type axis over 'model'. On a TPU backend the per-shard
     pack runs as the Pallas kernel (assignment-identical; parity-tested),
-    falling back to the vmapped lax.scan kernel elsewhere."""
+    falling back to the vmapped lax.scan kernel elsewhere.
+
+    Returns ``(PackResult, cheapest, route)`` — ``route`` is this call's
+    route + shape-gate report (returned, not a module global, so concurrent
+    sharded solves can't clobber each other's report — ADVICE r4)."""
     def shard(spec):
         return NamedSharding(mesh, spec)
 
@@ -227,8 +226,7 @@ def sharded_multi_solve(
         and B % mesh.shape["data"] == 0
         and v2_vmem_ok(S, n_max, C, F * R)
     )
-    global last_route
-    last_route = {
+    route = {
         "route": "lax.scan-multi",
         "v1_shape_eligible": bool(v1_shape_ok),
         "v2_shape_eligible": bool(v2_shape_ok),
@@ -238,7 +236,7 @@ def sharded_multi_solve(
     if shape_key not in _pallas_failed_shapes and v1_shape_ok and pallas_available():
         try:
             result = _pallas_multi(mesh, *placed, n_max=n_max)
-            last_route["route"] = "pallas-v1-multi"
+            route["route"] = "pallas-v1-multi"
         except Exception:
             import logging
 
@@ -255,7 +253,7 @@ def sharded_multi_solve(
         if v2_key not in _pallas_failed_shapes and pallas_available() and v2_shape_ok:
             try:
                 result = _pallas_v2_multi(mesh, batch_arrays, n_max=n_max)
-                last_route["route"] = "pallas-v2-multi"
+                route["route"] = "pallas-v2-multi"
             except Exception:
                 import logging
 
@@ -270,4 +268,4 @@ def sharded_multi_solve(
     usable_s = jax.device_put(usable, shard(P("model", None)))
     prices_s = jax.device_put(prices, shard(P("model")))
     cheapest = _cheapest_multi(result.node_req, result.node_sig, mask_s, usable_s, prices_s)
-    return result, cheapest
+    return result, cheapest, route

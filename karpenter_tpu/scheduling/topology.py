@@ -336,10 +336,10 @@ class Topology:
         batches are bucketed by the statics-interned topology-class code and
         gathered with numpy — one C-level gather per (class, group) instead
         of 10k Python-level appends — preserving batch order within every
-        group (stable argsort). Small batches and registry-overflow pods
-        (code -1) take the per-pod path."""
+        group (stable argsort). Registry-overflow pods (code -1) ride the
+        same bucketed pass as singleton entries at their batch positions so
+        member order matches the per-pod (<512) path exactly."""
         n = len(pods)
-        bucketed = False
         if n >= 512:
             import operator
 
@@ -349,7 +349,6 @@ class Topology:
                 map(operator.attrgetter("topo_code"), sts), np.int64, count=n
             )
             if codes.any():
-                bucketed = True
                 order = np.argsort(codes, kind="stable")
                 sorted_codes = codes[order]
                 uniq, starts = np.unique(sorted_codes, return_index=True)
@@ -360,19 +359,29 @@ class Topology:
                 # it must match the per-pod path / be independent of what
                 # earlier solves registered
                 first_pos = order[starts].tolist()
-                visit_order = sorted(range(len(uniq)), key=first_pos.__getitem__)
                 aff_idx: Dict[Tuple, list] = {}
                 spread_idx: Dict[Tuple, list] = {}
                 port_idx: list = []
-                slow_idx = None
-                for j in visit_order:
+                # Registry-overflow pods (code -1) join the visit as
+                # singleton entries at their own batch positions instead of
+                # a trailing per-pod pass: once the class registry fills,
+                # member order — which drives zone/hostname assignment —
+                # must stay batch-interleaved exactly like the per-pod
+                # (<512) path (ADVICE r4).
+                entries: list = []
+                for j in range(len(uniq)):
                     code = int(uniq[j])
                     if code == 0:
                         continue
                     idx = order[bounds[j]:bounds[j + 1]]
                     if code == -1:
-                        slow_idx = idx
-                        continue
+                        entries.extend(
+                            (int(i), idx[k:k + 1]) for k, i in enumerate(idx)
+                        )
+                    else:
+                        entries.append((first_pos[j], idx))
+                entries.sort(key=operator.itemgetter(0))
+                for _, idx in entries:
                     rep = sts[int(idx[0])]
                     for key, term, anti in rep.aff_terms:
                         if key not in aff_groups:
@@ -417,16 +426,12 @@ class Topology:
                         else port_idx[0]
                     ).tolist()
                     port_members.extend((pods[i], sts[i]) for i in idx)
-                if slow_idx is None:
-                    return
-                pairs = [(pods[i], sts[i]) for i in slow_idx.tolist()]
-            else:
                 return
-        if not bucketed:
-            pairs = zip(pods, sts)
+            return  # no pod in the batch has topology features
+        # small batch: per-pod path
         aff_get = aff_groups.get
         spread_get = spread_groups.get
-        for pod, st in pairs:
+        for pod, st in zip(pods, sts):
             if not st.topo_any:
                 continue
             if st.aff_terms:

@@ -15,6 +15,7 @@ the join table and frontiers here.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -176,7 +177,11 @@ class EncodeCache:
 # worker the same objects recur for many solves, and re-deriving the
 # semantic fingerprint walked 400 types every solve. Holding the catalog
 # tuple in the value keeps the ids valid for the entry's lifetime.
+# Lock-protected: catalog_fingerprint runs from concurrent per-provisioner
+# solve workers, and an unlocked popitem can race a sibling's move_to_end
+# into a KeyError (same contract as requirements._catreq_cache).
 _fp_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+_fp_lock = threading.Lock()
 _FP_CACHE_MAX = 8
 
 
@@ -184,14 +189,16 @@ def catalog_fingerprint(instance_types: Sequence[InstanceType]) -> Tuple:
     """Order-sensitive semantic identity of a catalog — every field that
     feeds type compatibility or the usable-capacity matrix."""
     id_key = tuple(map(id, instance_types))
-    hit = _fp_cache.get(id_key)
-    if hit is not None:
-        _fp_cache.move_to_end(id_key)
-        return hit[1]
+    with _fp_lock:
+        hit = _fp_cache.get(id_key)
+        if hit is not None:
+            _fp_cache.move_to_end(id_key)
+            return hit[1]
     fp = _catalog_fingerprint(instance_types)
-    _fp_cache[id_key] = (tuple(instance_types), fp)
-    while len(_fp_cache) > _FP_CACHE_MAX:
-        _fp_cache.popitem(last=False)
+    with _fp_lock:
+        _fp_cache[id_key] = (tuple(instance_types), fp)
+        while len(_fp_cache) > _FP_CACHE_MAX:
+            _fp_cache.popitem(last=False)
     return fp
 
 

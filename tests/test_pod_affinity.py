@@ -550,3 +550,77 @@ class TestBulkPathEdges:
             # both groups together
             assert len(zones) == 1, zones
             assert sum(len(n.pods) for n in nodes) == 7
+
+
+class TestDiscoverOverflowOrder:
+    """Registry-overflow pods (topo_code == -1) must keep batch-interleaved
+    member and group-creation order in the bucketed (>=512) discovery path
+    (ADVICE r4: overflow members used to gather after every coded class,
+    so zone/hostname assignment order diverged from the per-pod path once
+    the class registry filled)."""
+
+    def test_overflow_members_interleave_in_batch_order(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from karpenter_tpu.scheduling import statics as statics_mod
+        from karpenter_tpu.scheduling.topology import Topology
+
+        import uuid
+
+        # unique selector per invocation: the statics class registry is a
+        # process global, and a re-run must re-create (not re-find) class A
+        # so class B still overflows
+        sel = {"app": f"ovf-{uuid.uuid4().hex[:8]}"}
+        k1, k2 = lbl.TOPOLOGY_ZONE, "test.overflow/k2"
+
+        def spreads(*keys):
+            return [
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=k,
+                    label_selector=LabelSelector(match_labels=sel),
+                )
+                for k in keys
+            ]
+
+        # class A = spread on k1 only; class B = spread on k1 AND k2 — a
+        # DIFFERENT topology class sharing group k1, so the k1 group mixes
+        # coded and overflow members when class B overflows
+        pods = [
+            make_pod(name=f"ovf-{i:04d}", requests={"cpu": "0.1"})
+            if i % 3 == 2 else make_pod(
+                name=f"ovf-{i:04d}", labels=sel, requests={"cpu": "0.1"},
+                topology=spreads(k1) if i % 3 == 0 else spreads(k1, k2),
+            )
+            for i in range(540)
+        ]
+        # allow exactly ONE new class: class A interns, class B gets -1
+        saved = statics_mod._TOPO_CLASS_MAX
+        statics_mod._TOPO_CLASS_MAX = len(statics_mod._topo_classes) + 1
+        try:
+            sts = [statics_mod.statics(p) for p in pods]
+        finally:
+            statics_mod._TOPO_CLASS_MAX = saved
+        codes = {s.topo_code for s in sts if s.topo_any}
+        assert -1 in codes, codes
+        assert any(c > 0 for c in codes), codes
+
+        aff_groups, spread_groups, port_members = {}, {}, []
+        Topology._discover(pods, sts, aff_groups, spread_groups, port_members)
+
+        expected = {}
+        for i, p in enumerate(pods):
+            if i % 3 != 2:
+                expected.setdefault(k1, []).append(p.metadata.name)
+            if i % 3 == 1:
+                expected.setdefault(k2, []).append(p.metadata.name)
+        assert len(spread_groups) == 2
+        # group creation order = first appearance of each key in the batch,
+        # independent of which classes overflowed
+        assert [g.constraint.topology_key for g in spread_groups.values()] == [k1, k2]
+        for g in spread_groups.values():
+            # member order = batch order, overflow members interleaved
+            # exactly like the per-pod path
+            assert [p.metadata.name for p in g.pods] == expected[g.constraint.topology_key]
+            assert all(s is statics_mod.statics(p) for p, s in zip(g.pods, g.sts))
