@@ -138,11 +138,79 @@ def default_provisioner(provisioner: Provisioner, default_solver: str = SOLVER_F
         provisioner.spec.solver = default_solver
 
 
+# The one condition every Provisioner maintains: it is validated, its
+# catalog is reachable, and its worker is running (reference:
+# register.go:51-54, provisioner_status.go:38-41 — the knative
+# LivingConditionSet over ``Active``).
+ACTIVE = "Active"
+
+
+@dataclass
+class Condition:
+    """knative-style status condition (reference: provisioner_status.go:28-33
+    — ``apis.Conditions``): ``status`` is "True"/"False"/"Unknown", and
+    ``last_transition_time`` moves only when ``status`` flips."""
+
+    type: str = ACTIVE
+    status: str = "Unknown"
+    severity: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[float] = None
+
+
 @dataclass
 class ProvisionerStatus:
     last_scale_time: Optional[float] = None
     resources: Dict[str, float] = field(default_factory=dict)
-    conditions: List[str] = field(default_factory=list)
+    conditions: List[Condition] = field(default_factory=list)
+
+    def condition(self, type: str = ACTIVE) -> Optional[Condition]:
+        for c in self.conditions:
+            if c.type == type:
+                return c
+        return None
+
+    def set_condition(
+        self,
+        type: str = ACTIVE,
+        status: str = "True",
+        reason: str = "",
+        message: str = "",
+        now: Optional[float] = None,
+    ) -> bool:
+        """Set/refresh a condition with knative ConditionManager semantics:
+        ``lastTransitionTime`` bumps only when the status value flips.
+        Returns True when anything observable changed, so callers can skip
+        the status write on steady-state reconciles."""
+        cond = self.condition(type)
+        if cond is None:
+            self.conditions.append(
+                Condition(
+                    type=type, status=status, reason=reason, message=message,
+                    last_transition_time=now,
+                )
+            )
+            return True
+        changed = (
+            cond.status != status
+            or cond.reason != reason
+            or cond.message != message
+        )
+        if cond.status != status:
+            cond.last_transition_time = now
+        cond.status = status
+        cond.reason = reason
+        cond.message = message
+        return changed
+
+    def mark_active(self, now: Optional[float] = None) -> bool:
+        return self.set_condition(ACTIVE, "True", now=now)
+
+    def mark_not_active(
+        self, reason: str, message: str, now: Optional[float] = None
+    ) -> bool:
+        return self.set_condition(ACTIVE, "False", reason, message, now=now)
 
 
 @dataclass

@@ -25,8 +25,16 @@ class CounterController:
             return
         counts = self.resource_counts_for(name)
         if counts != provisioner.status.resources:
-            provisioner.status.resources = counts
-            self.cluster.update("provisioners", provisioner)
+            from karpenter_tpu.kube import serde
+
+            # status subresource write (deploy/crd.yaml subresources.status):
+            # null clears the field when the last node is gone — an empty
+            # object would merge as a no-op under RFC 7386
+            self.cluster.patch_status(
+                "provisioners", name,
+                {"resources": serde.quantities(counts) if counts else None},
+                namespace="",
+            )
 
     def resource_counts_for(self, provisioner_name: str) -> Dict[str, float]:
         """Sum node capacity over this provisioner's nodes

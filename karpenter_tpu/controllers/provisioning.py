@@ -178,10 +178,18 @@ class ProvisionerWorker:
             with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
                 launched = list(pool.map(self._launch, nodes))
             if any(launched):  # only actual creations count as a scale event
-                live = self.cluster.try_get("provisioners", self.provisioner.name, namespace="")
-                if live is not None:
-                    live.status.last_scale_time = self.cluster.clock()
-                    self.cluster.update("provisioners", live)
+                from karpenter_tpu.kube import serde
+
+                try:
+                    # status subresource: a main-resource write would have
+                    # its status silently dropped by a real apiserver
+                    self.cluster.patch_status(
+                        "provisioners", self.provisioner.name,
+                        {"lastScaleTime": serde.wire_ts(self.cluster.clock())},
+                        namespace="",
+                    )
+                except Exception:
+                    logger.debug("lastScaleTime write failed", exc_info=True)
             return nodes
         finally:
             with self._pending_lock:
@@ -307,10 +315,58 @@ class ProvisioningController:
         if provisioner is None or provisioner.metadata.deletion_timestamp is not None:
             self._teardown(name)
             return None
-        self.apply(provisioner)
+        # Active condition lifecycle (reference: provisioner_status.go:38-41,
+        # the knative living ``Active`` set): every Apply outcome lands in
+        # status.conditions, and the status write happens only on change so
+        # steady-state requeues don't churn the apiserver.
+        try:
+            self.apply(provisioner)
+        except Exception as e:
+            reason = (
+                "ValidationFailed" if isinstance(e, ValueError) else "ApplyFailed"
+            )
+            self._set_active(provisioner, "False", reason, str(e))
+            raise
+        self._set_active(provisioner, "True")
         # requeue to pick up instance-type catalog drift
         # (reference: provisioning/controller.go:82, 5 minutes)
         return REQUEUE_INTERVAL
+
+    def _set_active(
+        self, provisioner: Provisioner, value: str, reason: str = "", message: str = ""
+    ) -> None:
+        """Persist the Active condition through the status subresource.
+        The live (cached) object is never mutated here: on a failed write
+        the cache still holds the old condition, so the next reconcile's
+        comparison re-detects the drift and retries. lastTransitionTime
+        moves only when the status value flips (knative semantics)."""
+        from karpenter_tpu.api.provisioner import ACTIVE, Condition
+        from karpenter_tpu.kube import serde
+
+        cond = provisioner.status.condition(ACTIVE)
+        if cond is not None and (cond.status, cond.reason, cond.message) == (
+            value, reason, message,
+        ):
+            return
+        ltt = (
+            self.cluster.clock()
+            if cond is None or cond.status != value
+            else cond.last_transition_time
+        )
+        wire = serde.prov_condition_to_wire(
+            Condition(
+                type=ACTIVE, status=value, reason=reason, message=message,
+                last_transition_time=ltt,
+            )
+        )
+        try:
+            self.cluster.patch_status(
+                "provisioners", provisioner.name, {"conditions": [wire]}, namespace=""
+            )
+        except Exception:
+            # a lost condition write surfaces again on the next reconcile;
+            # it must never mask the Apply outcome itself
+            logger.debug("provisioner Active condition write failed", exc_info=True)
 
     def apply(self, provisioner: Provisioner) -> None:
         """Validate, default, layer live catalog requirements, and (re)start

@@ -440,6 +440,24 @@ class ApiCluster(Cluster):
         self._notify(kind, "MODIFIED", fresh)
         return fresh
 
+    def patch_status(self, kind: str, name: str, status: dict, namespace: str = "default"):
+        """Merge-patch against the ``/status`` subresource — the apiserver
+        drops status changes on main-resource writes for kinds with
+        ``subresources.status`` (deploy/crd.yaml), so controllers must come
+        through here."""
+        code, doc = self._request(
+            "PATCH",
+            self._path(kind, namespace, name, "status"),
+            {"status": status},
+            content_type="application/merge-patch+json",
+        )
+        if code != 200:
+            _raise_for(code, str(doc))
+        fresh = serde.from_wire(kind, doc)
+        self._cache_put(kind, fresh)
+        self._notify(kind, "MODIFIED", fresh)
+        return fresh
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         status, doc = self._request("DELETE", self._path(kind, namespace, name))
         if status not in (200, 202):

@@ -194,6 +194,15 @@ class Cluster:
         self._notify(kind, "MODIFIED", obj)
         return obj
 
+    def patch_status(self, kind: str, name: str, status: dict, namespace: str = "default"):
+        """Merge-patch the status subresource (``status`` is the wire-shape
+        dict of status fields). This is the ONLY route by which controllers
+        persist status for kinds whose CRD enables ``subresources.status``
+        (deploy/crd.yaml): a real apiserver silently drops status changes
+        carried on main-resource writes, so carrying them on ``update()``
+        works against this in-memory store but not in production."""
+        return self.merge_patch(kind, name, {"status": status}, namespace=namespace)
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Delete with finalizer semantics: objects carrying finalizers only
         get a deletion timestamp; removal happens when finalizers clear.

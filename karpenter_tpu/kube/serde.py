@@ -50,6 +50,7 @@ from karpenter_tpu.api.objects import (
     WeightedPodAffinityTerm,
 )
 from karpenter_tpu.api.provisioner import (
+    Condition,
     Constraints,
     KubeletConfiguration,
     Limits,
@@ -93,6 +94,10 @@ def _ts(value: Optional[float]) -> Optional[str]:
         datetime.datetime.fromtimestamp(value, tz=datetime.timezone.utc)
         .strftime("%Y-%m-%dT%H:%M:%SZ")
     )
+
+
+# public name for controllers composing status patches in wire shape
+wire_ts = _ts
 
 
 def _ts_micro(value: Optional[float]) -> Optional[str]:
@@ -580,6 +585,43 @@ def _daemonset_from_wire(doc: Dict[str, Any]) -> DaemonSet:
     )
 
 
+def _prov_conditions_to_wire(conds: List[Condition]) -> List[Dict[str, Any]]:
+    # knative apis.Condition wire shape (reference: provisioner_status.go:28-33)
+    return [
+        _drop_none(
+            {
+                "type": c.type,
+                "status": c.status,
+                "severity": c.severity or None,
+                "reason": c.reason or None,
+                "message": c.message or None,
+                "lastTransitionTime": _ts(c.last_transition_time),
+            }
+        )
+        for c in conds
+    ]
+
+
+def prov_condition_to_wire(c: Condition) -> Dict[str, Any]:
+    """Wire shape of one provisioner condition — controllers build status
+    patches from this so the patch and the serializer can never drift."""
+    return _prov_conditions_to_wire([c])[0]
+
+
+def _prov_conditions_from_wire(raw) -> List[Condition]:
+    return [
+        Condition(
+            type=c.get("type", ""),
+            status=c.get("status", "Unknown") or "Unknown",
+            severity=c.get("severity", "") or "",
+            reason=c.get("reason", "") or "",
+            message=c.get("message", "") or "",
+            last_transition_time=parse_ts(c.get("lastTransitionTime")),
+        )
+        for c in raw or []
+    ]
+
+
 def _provisioner_to_wire(p: Provisioner) -> Dict[str, Any]:
     c = p.spec.constraints
     spec = _drop_none(
@@ -609,7 +651,7 @@ def _provisioner_to_wire(p: Provisioner) -> Dict[str, Any]:
             {
                 "lastScaleTime": _ts(p.status.last_scale_time),
                 "resources": quantities(p.status.resources) or None,
-                "conditions": list(p.status.conditions) or None,
+                "conditions": _prov_conditions_to_wire(p.status.conditions) or None,
             }
         ),
     }
@@ -649,7 +691,7 @@ def _provisioner_from_wire(doc: Dict[str, Any]) -> Provisioner:
         status=ProvisionerStatus(
             last_scale_time=parse_ts(status.get("lastScaleTime")),
             resources=parse_quantities(status.get("resources")),
-            conditions=list(status.get("conditions") or []),
+            conditions=_prov_conditions_from_wire(status.get("conditions")),
         ),
     )
 

@@ -52,6 +52,12 @@ PLURALS: Dict[str, str] = {
 
 from karpenter_tpu.kube.serde import json_merge as merge_patch  # shared RFC 7386 impl
 
+# Kinds whose CRD declares ``subresources: {status: {}}`` (deploy/crd.yaml):
+# like a real apiserver, main-resource writes to these kinds silently keep
+# the CURRENT status, and status changes must come through the ``/status``
+# subresource.
+STATUS_SUBRESOURCE_KINDS = {"provisioners"}
+
 
 def _status(code: int, reason: str, message: str) -> dict:
     return {
@@ -180,6 +186,19 @@ class TestApiServer:
                 obj.metadata.creation_timestamp = current.metadata.creation_timestamp
                 if current.metadata.deletion_timestamp is not None:
                     obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+                if req.kind in STATUS_SUBRESOURCE_KINDS:
+                    if req.subresource == "status":
+                        # PUT to /status replaces status only
+                        current.status = obj.status
+                        obj = current
+                    else:
+                        # main-resource write: the apiserver keeps the
+                        # current status when subresources.status is on
+                        obj.status = current.status
+                elif req.subresource:
+                    return self._send_json(
+                        404, _status(404, "NotFound", f"no subresource {req.subresource}")
+                    )
                 server.cluster.update(req.kind, obj)
                 self._send_json(200, serde.to_wire(req.kind, obj))
 
@@ -192,6 +211,21 @@ class TestApiServer:
                     current = server._get(req)
                 except NotFound as e:
                     return self._send_json(404, _status(404, "NotFound", str(e)))
+                if req.kind in STATUS_SUBRESOURCE_KINDS:
+                    if req.subresource == "status":
+                        # only the status field of the patch applies
+                        patch = (
+                            {"status": patch["status"]}
+                            if patch.get("status") is not None
+                            else {}
+                        )
+                    elif "status" in patch:
+                        # main-resource patch: status changes are dropped
+                        patch = {k: v for k, v in patch.items() if k != "status"}
+                elif req.subresource:
+                    return self._send_json(
+                        404, _status(404, "NotFound", f"no subresource {req.subresource}")
+                    )
                 merged_doc = merge_patch(serde.to_wire(req.kind, current), patch)
                 obj = serde.from_wire(req.kind, merged_doc)
                 obj.metadata.namespace = current.metadata.namespace

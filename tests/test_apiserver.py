@@ -596,3 +596,54 @@ class TestNodeLifecycleOverApiserver:
             lbl.EMPTINESS_TIMESTAMP_ANNOTATION
             not in env.cluster.get("nodes", "busy", namespace="").metadata.annotations
         )
+
+
+class TestStatusSubresource:
+    """Provisioners declare ``subresources: {status: {}}`` (deploy/crd.yaml),
+    so — like a real apiserver — main-resource writes keep the current
+    status and status changes only land through ``/status``."""
+
+    def test_main_resource_put_drops_status(self, env):
+        c = env.connect()
+        c.create("provisioners", make_provisioner())
+        c.patch_status(
+            "provisioners", "default",
+            {"resources": {"cpu": "4"}}, namespace="",
+        )
+        live = c.get_live("provisioners", "default", namespace="")
+        assert live.status.resources == {"cpu": 4.0}
+        # a full-object PUT carrying a mutated status must NOT change it
+        live.status.resources = {}
+        live.spec.solver = "tpu"
+        c.update("provisioners", live)
+        after = c.get_live("provisioners", "default", namespace="")
+        assert after.spec.solver == "tpu"  # spec write landed
+        assert after.status.resources == {"cpu": 4.0}  # status kept
+
+    def test_main_resource_patch_drops_status(self, env):
+        c = env.connect()
+        c.create("provisioners", make_provisioner())
+        c.merge_patch(
+            "provisioners", "default",
+            {"spec": {"solver": "tpu"}, "status": {"resources": {"cpu": "9"}}},
+            namespace="",
+        )
+        after = c.get_live("provisioners", "default", namespace="")
+        assert after.spec.solver == "tpu"
+        assert after.status.resources == {}
+
+    def test_active_condition_lands_over_the_wire(self, env):
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+
+        c = env.connect()
+        c.create("provisioners", make_provisioner())
+        controller = ProvisioningController(
+            c, FakeCloudProvider(instance_types(5)), start_workers=False
+        )
+        controller.reconcile("default")
+        live = c.get_live("provisioners", "default", namespace="")
+        cond = live.status.condition()
+        assert cond is not None and (cond.type, cond.status) == ("Active", "True")
+        assert cond.last_transition_time is not None
+        controller.stop()

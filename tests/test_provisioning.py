@@ -191,3 +191,101 @@ class TestBatcher:
         # new adds get a fresh gate
         gate2 = b.add("y")
         assert not gate2.is_set()
+
+
+class TestActiveCondition:
+    """Provisioner ``Active`` condition lifecycle (reference:
+    provisioner_status.go:28-41 — the knative living condition set): every
+    Apply outcome lands in status.conditions with reason +
+    lastTransitionTime, and the transition time moves only on flips."""
+
+    def _controller(self, clock=None):
+        cluster = Cluster(clock=clock)
+        provider = FakeCloudProvider(instance_types(5))
+        return cluster, ProvisioningController(cluster, provider, start_workers=False)
+
+    def test_apply_success_marks_active(self):
+        cluster, controller = self._controller()
+        cluster.create("provisioners", make_provisioner())
+        controller.reconcile("default")
+        cond = cluster.get("provisioners", "default", namespace="").status.condition()
+        assert cond is not None
+        assert (cond.type, cond.status) == ("Active", "True")
+        assert cond.last_transition_time is not None
+        controller.stop()
+
+    def test_apply_failure_marks_not_active_with_reason(self):
+        cluster, controller = self._controller()
+        bad = make_provisioner(solver="nope")
+        cluster.create("provisioners", bad)
+        with pytest.raises(ValueError):
+            controller.reconcile("default")
+        cond = cluster.get("provisioners", "default", namespace="").status.condition()
+        assert (cond.status, cond.reason) == ("False", "ValidationFailed")
+        assert "solver" in cond.message
+        controller.stop()
+
+    def test_transition_bumps_time_steady_state_does_not(self):
+        now = [100.0]
+        cluster, controller = self._controller(clock=lambda: now[0])
+        prov = make_provisioner(solver="nope")
+        cluster.create("provisioners", prov)
+        with pytest.raises(ValueError):
+            controller.reconcile("default")
+        t_fail = cluster.get("provisioners", "default", namespace="").status.condition().last_transition_time
+        assert t_fail == 100.0
+        # fix the spec: False -> True flips the transition time
+        now[0] = 200.0
+        fixed = cluster.get("provisioners", "default", namespace="")
+        fixed.spec.solver = "ffd"
+        cluster.update("provisioners", fixed)
+        controller.reconcile("default")
+        cond = cluster.get("provisioners", "default", namespace="").status.condition()
+        assert (cond.status, cond.last_transition_time) == ("True", 200.0)
+        assert cond.reason == "" and cond.message == ""
+        # steady-state reconcile: no flip, the transition time stays put
+        now[0] = 300.0
+        controller.reconcile("default")
+        cond = cluster.get("provisioners", "default", namespace="").status.condition()
+        assert (cond.status, cond.last_transition_time) == ("True", 200.0)
+        controller.stop()
+
+    def test_condition_round_trips_over_the_wire(self):
+        from karpenter_tpu.kube import serde
+
+        cluster, controller = self._controller()
+        cluster.create("provisioners", make_provisioner())
+        controller.reconcile("default")
+        prov = cluster.get("provisioners", "default", namespace="")
+        wire = serde.to_wire("provisioners", prov)
+        wc = wire["status"]["conditions"][0]
+        assert wc["type"] == "Active" and wc["status"] == "True"
+        assert "lastTransitionTime" in wc
+        back = serde.from_wire("provisioners", wire)
+        cond = back.status.condition()
+        assert (cond.type, cond.status) == ("Active", "True")
+        assert serde.to_wire("provisioners", back) == wire
+        controller.stop()
+
+    def test_failed_condition_write_retried_next_reconcile(self):
+        # _set_active never mutates the cached object, so a swallowed write
+        # failure leaves the drift detectable and the next reconcile retries
+        cluster, controller = self._controller()
+        cluster.create("provisioners", make_provisioner())
+        real = cluster.patch_status
+        calls = {"n": 0}
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient apiserver error")
+            return real(*a, **kw)
+
+        cluster.patch_status = flaky
+        controller.reconcile("default")  # write fails, swallowed (debug log)
+        assert cluster.get("provisioners", "default", namespace="").status.condition() is None
+        controller.reconcile("default")
+        cond = cluster.get("provisioners", "default", namespace="").status.condition()
+        assert cond is not None and cond.status == "True"
+        assert calls["n"] == 2
+        controller.stop()
