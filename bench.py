@@ -352,6 +352,8 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
     provisioner = make_provisioner(solver="tpu")
     c = provisioner.spec.constraints
     c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    from karpenter_tpu.scheduling.oracle import classify_drops
+
     streams_state = []
     for s in range(streams):
         pods = diverse_pods(n_pods, random.Random(1000 + s))
@@ -361,11 +363,20 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
     prev_packer = os.environ.get("KARPENTER_PACKER")
     os.environ["KARPENTER_PACKER"] = packer
     try:
-        # warmup (compile + statics)
+        # warmup (compile + statics); every stream's drops are
+        # oracle-certified once here — iterations re-solve the same pods
+        # (VERDICT r4 #7: no uncertified "Failed to schedule" line ships)
         scheduled_per_stream = []
+        unexplained = expected_drops = 0
         for sched, pods in streams_state:
             nodes = sched.solve(provisioner, catalog, pods)
             scheduled_per_stream.append(sum(len(n.pods) for n in nodes))
+            verdict = classify_drops(
+                sched.cluster, c, catalog, pods,
+                [p for n in nodes for p in n.pods],
+            )
+            unexplained += len(verdict["unexplained"])
+            expected_drops += verdict["dropped"] - len(verdict["unexplained"])
 
         start_gate = threading.Barrier(streams + 1)
         done = []
@@ -399,6 +410,8 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
         "scheduled_total": total_scheduled,
         "wall_s": round(wall, 4),
         "pods_per_sec": round(total_scheduled / wall, 1),
+        "unschedulable_expected": expected_drops,
+        "unexplained": unexplained,
     }
 
 
@@ -515,6 +528,11 @@ def bench_diverse(n_pods: int, k_labels: int, iters: int):
         nodes = scheduler.solve(provisioner, catalog, pods)
         times.append(time.perf_counter() - t0)
     scheduled = sum(len(n.pods) for n in nodes)
+    from karpenter_tpu.scheduling.oracle import classify_drops
+
+    verdict = classify_drops(
+        scheduler.cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
+    )
     return {
         "signatures": s,
         "frontier_width": f,
@@ -524,6 +542,8 @@ def bench_diverse(n_pods: int, k_labels: int, iters: int):
         "best_s": round(min(times), 4),
         "mean_s": round(statistics.mean(times), 4),
         "pods_per_sec": round(scheduled / min(times), 1),
+        "unschedulable_expected": verdict["dropped"] - len(verdict["unexplained"]),
+        "unexplained": len(verdict["unexplained"]),
     }
 
 
@@ -565,10 +585,15 @@ def bench_consolidation(n_nodes: int, iters: int, solver: str = "tpu"):
         t0 = time.perf_counter()
         plan = controller.plan(provisioner)
         times.append(time.perf_counter() - t0)
+    placed = sum(len(v.pods) for v in plan.proposed)
     return {
         "nodes_in": n_nodes,
         "nodes_out": len(plan.proposed),
         "pods": len(plan.pods),
+        # a consolidation plan must seat every reschedulable pod
+        # (ConsolidationPlan.worthwhile enforces this before any evict)
+        "repack_placed": placed,
+        "repack_drops": len(plan.pods) - placed,
         "savings_frac": round(plan.savings / max(plan.current_price, 1e-9), 3),
         "repack_s": min(times),
         "mean_s": statistics.mean(times),
@@ -594,6 +619,7 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
 
     catalog = sorted(instance_types(400), key=lambda it: it.effective_price())
     batches = []
+    batch_meta = []  # (constraints, pods) per batch, for oracle certification
     for b in range(n_provisioners):
         provisioner = make_provisioner(name=f"prov-{b}")
         c = provisioner.spec.constraints
@@ -603,6 +629,11 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
         Topology(Cluster(), rng=random.Random(b)).inject(cc, pods)
         daemon = daemon_overhead(Cluster(), cc)
         batches.append(enc.encode(cc, catalog, pods, daemon))
+        # PRE-injection pods for oracle certification: inject() writes the
+        # chosen zone/hostname into pod selectors, and the oracle reasons
+        # about the empty plan — same seed + deterministic sort makes
+        # index i of this copy the same pod as assignment column i
+        batch_meta.append((c, sort_pods_ffd(diverse_pods(n_pods, random.Random(100 + b)))))
     # all batches share the same shapes (same pod count bucket + catalog)
     arrays = tuple(
         np.stack([np.asarray(getattr(b, f)) for b in batches])
@@ -657,12 +688,26 @@ def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
         probe.sample(1)
     rtt = probe.floor
     best = min(times)
-    scheduled = int((np.asarray(result.assignment)[:, :n_real] >= 0).sum())
+    assignment_np = np.asarray(result.assignment)
+    scheduled = int((assignment_np[:, :n_real] >= 0).sum())
+
+    # oracle-certify every batch's drops: assignment index i is pods[i]
+    # (encode preserves the FFD sort order) — VERDICT r4 #7
+    from karpenter_tpu.scheduling.oracle import classify_drops
+
+    unexplained = expected_drops = 0
+    for b, (bc, bpods) in enumerate(batch_meta):
+        placed = [p for i, p in enumerate(bpods) if assignment_np[b, i] >= 0]
+        verdict = classify_drops(Cluster(), bc, catalog, bpods, placed)
+        unexplained += len(verdict["unexplained"])
+        expected_drops += verdict["dropped"] - len(verdict["unexplained"])
 
     out = {
         "provisioners": n_provisioners,
         "pods_per_batch": n_pods,
         "scheduled_total": scheduled,
+        "unschedulable_expected": expected_drops,
+        "unexplained": unexplained,
         "solve_s": best,
         "pods_per_sec": scheduled / best,
         "solve_minus_rtt_s": round(max(best - rtt, 1e-9), 4),
@@ -779,7 +824,8 @@ def bench_config(config: int, iters: int):
 
     c = provisioner.spec.constraints
     c.requirements = c.requirements.merge(catalog_requirements(catalog))
-    scheduler = Scheduler(Cluster(), rng=random.Random(1))
+    cluster = Cluster()
+    scheduler = Scheduler(cluster, rng=random.Random(1))
     nodes = scheduler.solve(provisioner, catalog, pods)  # warmup
     times = []
     for _ in range(iters):
@@ -788,6 +834,12 @@ def bench_config(config: int, iters: int):
         times.append(time.perf_counter() - t0)
     best = min(times)
     scheduled = sum(len(n.pods) for n in nodes)
+    # every published figure carries oracle certification (VERDICT r4 #7)
+    from karpenter_tpu.scheduling.oracle import classify_drops
+
+    verdict = classify_drops(
+        cluster, c, catalog, pods, [p for n in nodes for p in n.pods]
+    )
     return {
         "metric": f"BASELINE {label}",
         "value": round(scheduled / best, 1),
@@ -797,6 +849,8 @@ def bench_config(config: int, iters: int):
         "pods": len(pods),
         "nodes": len(nodes),
         "best_s": round(best, 4),
+        "unschedulable_expected": verdict["dropped"] - len(verdict["unexplained"]),
+        "unexplained": len(verdict["unexplained"]),
     }
 
 
@@ -955,6 +1009,8 @@ def main():
         pipe = bench_pipelined(args.pods, streams=3, iters=max(2, args.iters // 2))
         line["pipelined_pods_per_sec"] = pipe["pods_per_sec"]
         line["pipelined_streams"] = pipe["streams"]
+        line["pipelined_unschedulable_expected"] = pipe["unschedulable_expected"]
+        line["pipelined_unexplained"] = pipe["unexplained"]
         # apples-to-apples: the CPU path through the SAME 3-stream harness
         # (both are GIL-bound on host work; the comparison isolates the
         # device-vs-native pack difference under continuous load)
@@ -981,6 +1037,8 @@ def main():
             line["multi_tpu_raw_pods_per_sec"] = round(m["pods_per_sec"], 1)
             line["multi_cpu_pods_per_sec"] = m.get("multi_cpu_pods_per_sec")
             line["multi_tpu_wins"] = m.get("multi_tpu_wins")
+            line["multi_unschedulable_expected"] = m["unschedulable_expected"]
+            line["multi_unexplained"] = m["unexplained"]
         except Exception as e:
             line["multi_error"] = str(e)[:120]
     print(json.dumps(line))

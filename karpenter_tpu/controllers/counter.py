@@ -27,13 +27,14 @@ class CounterController:
         if counts != provisioner.status.resources:
             from karpenter_tpu.kube import serde
 
-            # status subresource write (deploy/crd.yaml subresources.status):
-            # null clears the field when the last node is gone — an empty
-            # object would merge as a no-op under RFC 7386
+            # status subresource write (deploy/crd.yaml subresources.status).
+            # RFC 7386 merges key-wise, so a key that vanished from the
+            # counts (its last node deleted) must be cleared with an
+            # explicit null or it would linger and feed Limits forever
+            patch = {k: None for k in provisioner.status.resources if k not in counts}
+            patch.update(serde.quantities(counts))
             self.cluster.patch_status(
-                "provisioners", name,
-                {"resources": serde.quantities(counts) if counts else None},
-                namespace="",
+                "provisioners", name, {"resources": patch}, namespace=""
             )
 
     def resource_counts_for(self, provisioner_name: str) -> Dict[str, float]:

@@ -424,12 +424,19 @@ class ApiCluster(Cluster):
         self._notify(kind, "MODIFIED", fresh)
         return obj
 
-    def merge_patch(self, kind: str, name: str, patch: dict, namespace: str = "default"):
+    def merge_patch(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str = "default",
+        subresource: Optional[str] = None,
+    ):
         """JSON merge-patch — the reference's single-patch-per-reconcile
         idiom (node/controller.go:106-115)."""
         status, doc = self._request(
             "PATCH",
-            self._path(kind, namespace, name),
+            self._path(kind, namespace, name, subresource),
             patch,
             content_type="application/merge-patch+json",
         )
@@ -445,18 +452,9 @@ class ApiCluster(Cluster):
         drops status changes on main-resource writes for kinds with
         ``subresources.status`` (deploy/crd.yaml), so controllers must come
         through here."""
-        code, doc = self._request(
-            "PATCH",
-            self._path(kind, namespace, name, "status"),
-            {"status": status},
-            content_type="application/merge-patch+json",
+        return self.merge_patch(
+            kind, name, {"status": status}, namespace=namespace, subresource="status"
         )
-        if code != 200:
-            _raise_for(code, str(doc))
-        fresh = serde.from_wire(kind, doc)
-        self._cache_put(kind, fresh)
-        self._notify(kind, "MODIFIED", fresh)
-        return fresh
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         status, doc = self._request("DELETE", self._path(kind, namespace, name))

@@ -25,6 +25,32 @@ class TestCounter:
         assert prov.status.resources[res.CPU] == 6.0
         assert prov.status.resources[res.MEMORY] == 8 * 1024**3
 
+    def test_vanished_resource_key_cleared(self):
+        # RFC 7386 merges key-wise: a resource whose last node vanished must
+        # be explicitly nulled or it would linger and feed Limits forever
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner())
+        cluster.create("nodes", make_node(capacity={"cpu": "4"}, provisioner_name="default"))
+        gpu = make_node(
+            capacity={"cpu": "2", "nvidia.com/gpu": "1"}, provisioner_name="default"
+        )
+        cluster.create("nodes", gpu)
+        counter = CounterController(cluster)
+        counter.reconcile("default")
+        prov = cluster.get("provisioners", "default", namespace="")
+        assert prov.status.resources.get("nvidia.com/gpu") == 1.0
+        cluster.delete("nodes", gpu.metadata.name, namespace="")
+        counter.reconcile("default")
+        prov = cluster.get("provisioners", "default", namespace="")
+        assert "nvidia.com/gpu" not in prov.status.resources
+        assert prov.status.resources[res.CPU] == 4.0
+        # converged: a further reconcile is a no-op (no patch churn)
+        calls = []
+        orig = cluster.patch_status
+        cluster.patch_status = lambda *a, **k: calls.append(1) or orig(*a, **k)
+        counter.reconcile("default")
+        assert calls == []
+
     def test_watch_mapping_enqueues_owner(self):
         cluster = Cluster()
         manager = Manager(cluster)

@@ -36,3 +36,17 @@ def test_scheduler_clears_the_reference_floor():
         f"{rate:.0f} pods/sec is below the reference's enforced "
         f"{FLOOR_PODS_PER_SEC} pods/sec floor"
     )
+
+
+def test_bench_legs_emit_oracle_certification():
+    """Every published bench figure must carry oracle certification
+    (VERDICT r4 #7): no 'Failed to schedule N pods' line ships without an
+    unschedulable_expected/unexplained verdict beside it."""
+    import bench  # repo root is on sys.path via conftest
+
+    p = bench.bench_pipelined(200, streams=2, iters=1)
+    assert p["unexplained"] == 0
+    assert "unschedulable_expected" in p
+    r = bench.bench_config(1, 1)
+    assert r["unexplained"] == 0
+    assert "unschedulable_expected" in r
