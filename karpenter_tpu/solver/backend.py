@@ -102,6 +102,7 @@ class TpuScheduler:
         # remote sidecar transport (SURVEY §5.8); None = in-process kernel
         self.service_address = service_address
         self._remote = None
+        self._remote_init_lock = threading.Lock()
         self._remote_down_until = 0.0  # circuit breaker after RPC failure
         # solve-invariant encode state (signature table, capacity matrix),
         # reused across this worker's batches; the lock covers the rare
@@ -113,24 +114,147 @@ class TpuScheduler:
         # per-stage timings of the most recent solve (bench surfaces these
         # as the latency breakdown the <100ms target is judged against)
         self.last_profile: Dict[str, float] = {}
+        # measured-cost backend routing (VERDICT r4 weak #3: `auto` used to
+        # prefer the device by platform, never by cost)
+        from karpenter_tpu.solver.router import default_router
+
+        self.router = default_router()
+        self._probe_thread: Optional[threading.Thread] = None
 
     def _pack(self, batch: enc.EncodedBatch):
-        """Run the packing kernel — on the sidecar when configured, the
-        fused single-dispatch device path when eligible, and the in-process
-        kernel ladder otherwise. Returns ``(PackResult, typemask-or-None)``
-        with HOST numpy arrays (one device→host transfer).
+        """Run the packing kernel, routing by MEASURED cost when more than
+        one backend can serve the batch: the device path (sidecar / fused /
+        Pallas ladder) and the native C++ packer are both first-class
+        contenders, and the per-shape EMA of end-to-end pack time decides —
+        ``solver: tpu`` must never be slower than its own CPU path
+        (solver/router.py). ``KARPENTER_PACKER`` forces still bypass.
+        Returns ``(PackResult, typemask-or-None)`` with HOST numpy arrays
+        (one device→host transfer)."""
+        import os
+
+        if os.environ.get("KARPENTER_PACKER", "auto").lower() == "auto":
+            candidates = self._pack_candidates()
+            if len(candidates) > 1:
+                key = (
+                    len(batch.pod_valid),
+                    batch.frontiers.shape[0],
+                    batch.frontiers.shape[1],
+                )
+                backend = self.router.choose(key, candidates)
+                t0 = time.perf_counter()
+                try:
+                    out = (
+                        self._pack_native(batch)
+                        if backend == "native"
+                        else self._pack_device(batch)
+                    )
+                except Exception:
+                    # a failed pack must record a PENALTY, not its (tiny)
+                    # elapsed time — a fast-failing backend would otherwise
+                    # win the EMA and pin every future solve to the broken
+                    # path. Probes rehabilitate it once it works again.
+                    from karpenter_tpu.solver.router import FAILURE_PENALTY_S
+
+                    self.router.record(key, backend, FAILURE_PENALTY_S)
+                    if backend != "native":
+                        raise  # the device ladder already ends in lax.scan
+                    # containment parity with the old pack_best ladder: a
+                    # broken native lib degrades to the device path, never
+                    # crashes the reconcile
+                    logger.exception(
+                        "routed native pack failed; device ladder fallback"
+                    )
+                    out = self._pack_device(batch)
+                else:
+                    self.router.record(key, backend, time.perf_counter() - t0)
+                self.last_profile["packer_backend"] = backend
+                if self.router.should_probe(key):
+                    self._shadow_probe(batch, key, candidates, backend)
+                return out
+        return self._pack_device(batch)
+
+    def _shadow_probe(self, batch, key, candidates, winner: str) -> None:
+        """Re-measure the losing backend OFF the critical path so drift
+        (tunnel weather, chip attach, host load) can re-win the route
+        without production solves ever paying the loser's latency: the
+        native probe runs inline (~1 ms), the device probe on a daemon
+        thread (its fetch wait releases the GIL; at most one in flight)."""
+        for loser in candidates:
+            if loser == winner:
+                continue
+            if loser == "native":
+                t0 = time.perf_counter()
+                try:
+                    self._pack_native(batch, prof={})
+                except Exception:
+                    logger.debug("native shadow probe failed", exc_info=True)
+                else:
+                    self.router.record(key, loser, time.perf_counter() - t0)
+            elif self._probe_thread is None or not self._probe_thread.is_alive():
+                def probe():
+                    t0 = time.perf_counter()
+                    try:
+                        self._pack_device(batch, prof={})
+                    except Exception:
+                        logger.debug("device shadow probe failed", exc_info=True)
+                    else:
+                        self.router.record(key, "device", time.perf_counter() - t0)
+
+                self._probe_thread = threading.Thread(
+                    target=probe, name="karpenter-router-probe", daemon=True
+                )
+                self._probe_thread.start()
+
+    def _pack_candidates(self) -> List[str]:
+        """Backends that can serve this worker right now, in cold-start
+        preference order: the device path first (its one-time compile then
+        lands in the worker warmup; always servable — the lax.scan kernel
+        needs only jax), then the native packer (non-blocking — while its
+        g++ build is still running it simply isn't a candidate)."""
+        from karpenter_tpu.solver import native
+
+        candidates = ["device"]
+        if native.native_available():
+            candidates.append("native")
+        return candidates
+
+    def _pack_native(self, batch: enc.EncodedBatch, prof: Optional[dict] = None):
+        """The native C++ packer as a routed first-class backend, with the
+        same small-table-then-retry contract as the device path. ``prof``
+        lets a shadow probe keep its bookkeeping out of ``last_profile``."""
+        from karpenter_tpu.solver import native
+
+        prof = self.last_profile if prof is None else prof
+        p = len(batch.pod_valid)
+        n_max = max(256, p // 4)
+        prof["pack_dispatches"] = 0
+        args = batch.pack_args()
+        while True:
+            prof["pack_dispatches"] += 1
+            result = native.pack_native(*args, n_max=n_max)
+            saturated = int(result.n_nodes) == n_max and bool(
+                (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
+            )
+            if not saturated or n_max >= p:
+                return result, None
+            n_max = p
+
+    def _pack_device(self, batch: enc.EncodedBatch, prof: Optional[dict] = None):
+        """The device-path ladder: sidecar when configured, fused
+        single-dispatch when eligible, then the pack_best kernel ladder.
 
         The node table starts small (512 slots — per-pod kernel cost is
         linear in the table size, and real packings open far fewer nodes
         than pods) and retries at full P on saturation (table full with
         unscheduled pods)."""
+        prof = self.last_profile if prof is None else prof
         p = len(batch.pod_valid)
         route = self._fused_route(batch, min(p, 512))
         n_max = min(p, 512) if route else max(256, p // 4)
-        self.last_profile["pack_dispatches"] = 0
+        prof["pack_dispatches"] = 0
         args = None
         while True:
-            self.last_profile["pack_dispatches"] += 1
+            prof["pack_dispatches"] += 1
             result = typemask = None
             if route:
                 try:
@@ -258,9 +382,13 @@ class TpuScheduler:
                 if self._remote is None:
                     from karpenter_tpu.solver.service import RemoteSolver
 
-                    self._remote = RemoteSolver(
-                        self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
-                    )
+                    # under-lock init: the router's device shadow probe can
+                    # reach here concurrently with a cold-starting solve
+                    with self._remote_init_lock:
+                        if self._remote is None:
+                            self._remote = RemoteSolver(
+                                self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
+                            )
                 result = self._remote.pack(*args, n_max=n_max)
                 # unconditional: the gauge is process-global per address, and
                 # another scheduler instance (worker hot-swap, second

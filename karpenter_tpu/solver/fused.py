@@ -103,9 +103,15 @@ class DeviceInvariants:
     MAX_ENTRIES = 4
 
     def __init__(self):
+        import threading
+
         self._cache: "Dict[bytes, tuple]" = {}
         self._cache_v2: "Dict[bytes, tuple]" = {}
         self._order: list = []
+        # the router's device shadow probe calls get()/get_v2() from its
+        # own thread while a production solve may be cold-starting the
+        # device path concurrently — the LRU list mutation must not race
+        self._lock = threading.Lock()
 
     def _digest(self, batch) -> bytes:
         import hashlib
@@ -132,9 +138,10 @@ class DeviceInvariants:
 
     def get(self, batch):
         key = self._digest(batch)
-        hit = self._cache.get(key)
+        with self._lock:
+            hit = self._cache.get(key)
         if hit is None:
-            hit = self._cache[key] = tuple(
+            hit = tuple(
                 jax.device_put(a)
                 for a in (
                     batch.join_table.astype(np.int32),
@@ -144,21 +151,24 @@ class DeviceInvariants:
                     batch.usable.astype(np.float32),
                 )
             )
-        self._touch(key)
+        with self._lock:
+            self._cache[key] = hit
+            self._touch(key)
         return hit
 
     def get_v2(self, batch):
         """(front_j, compat_j, jvals, frontiers, daemon, mask, usable) on
         device — the v2 route's per-core tables computed once per closure."""
         key = self._digest(batch)
-        hit = self._cache_v2.get(key)
+        with self._lock:
+            hit = self._cache_v2.get(key)
         if hit is None:
             from karpenter_tpu.solver.pallas_kernel_v2 import _precompute
 
             front_j, compat_j, jvals, _ = _precompute(
                 np.asarray(batch.join_table), np.asarray(batch.frontiers, np.float32)
             )
-            hit = self._cache_v2[key] = tuple(
+            hit = tuple(
                 jax.device_put(a)
                 for a in (
                     front_j, compat_j, jvals,
@@ -168,7 +178,9 @@ class DeviceInvariants:
                     batch.usable.astype(np.float32),
                 )
             )
-        self._touch(key)
+        with self._lock:
+            self._cache_v2[key] = hit
+            self._touch(key)
         return hit
 
 

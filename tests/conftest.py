@@ -26,3 +26,18 @@ if os.environ.get("KARPENTER_TEST_TPU") != "1":
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cost_router():
+    """The packer cost router is process-shared (worker hot-swap and the
+    consolidation shadow scheduler must inherit learning); tests need each
+    test's routing decisions independent of what earlier tests measured."""
+    from karpenter_tpu.solver import router
+
+    router.reset_default()
+    yield
+    router.reset_default()

