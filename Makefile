@@ -32,6 +32,12 @@ benchmark-storm:  ## 10k pod watch events through the full pipeline
 benchmark-multi:  ## BASELINE config 4: concurrent provisioner batches on the mesh
 	$(PY) bench.py --multi 8 --pods 1250
 
+benchmark-router-parity:  ## auto (cost-routed) vs best forced backend, 5 BASELINE configs
+	$(PY) bench.py --router-parity
+
+benchmark-affinity-dense:  ## device vs native head-to-head on the 50%-affinity regime
+	$(PY) bench.py --affinity-dense 10000
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -64,5 +70,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test battletest deflake benchmark benchmark-grid \
-	benchmark-consolidation benchmark-storm dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -135,11 +135,7 @@ class TpuScheduler:
         if os.environ.get("KARPENTER_PACKER", "auto").lower() == "auto":
             candidates = self._pack_candidates()
             if len(candidates) > 1:
-                key = (
-                    len(batch.pod_valid),
-                    batch.frontiers.shape[0],
-                    batch.frontiers.shape[1],
-                )
+                key = self._route_key(batch)
                 backend = self.router.choose(key, candidates)
                 t0 = time.perf_counter()
                 try:
@@ -167,43 +163,59 @@ class TpuScheduler:
                     out = self._pack_device(batch)
                 else:
                     self.router.record(key, backend, time.perf_counter() - t0)
-                self.last_profile["packer_backend"] = backend
+                # packer_backend is set by the path that actually served
+                # (the fallback above may differ from the routed choice)
                 if self.router.should_probe(key):
                     self._shadow_probe(batch, key, candidates, backend)
                 return out
         return self._pack_device(batch)
 
     def _shadow_probe(self, batch, key, candidates, winner: str) -> None:
-        """Re-measure the losing backend OFF the critical path so drift
-        (tunnel weather, chip attach, host load) can re-win the route
-        without production solves ever paying the loser's latency: the
-        native probe runs inline (~1 ms), the device probe on a daemon
-        thread (its fetch wait releases the GIL; at most one in flight)."""
-        for loser in candidates:
-            if loser == winner:
-                continue
-            if loser == "native":
+        """Re-measure the losing backend(s) OFF the critical path — on a
+        daemon thread, at most one in flight — so drift (tunnel weather,
+        chip attach, host load) can re-win the route without production
+        solves ever paying a loser's latency. The device probe's fetch wait
+        releases the GIL; a losing native probe is slow precisely when it
+        lost, so it must not run inline either."""
+        losers = [c for c in candidates if c != winner]
+        if not losers:
+            return
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            return  # previous probe still running; next cadence hit retries
+
+        def probe():
+            for loser in losers:
                 t0 = time.perf_counter()
                 try:
-                    self._pack_native(batch, prof={})
+                    if loser == "native":
+                        self._pack_native(batch, prof={})
+                    else:
+                        self._pack_device(batch, prof={})
                 except Exception:
-                    logger.debug("native shadow probe failed", exc_info=True)
+                    logger.debug("%s shadow probe failed", loser, exc_info=True)
                 else:
                     self.router.record(key, loser, time.perf_counter() - t0)
-            elif self._probe_thread is None or not self._probe_thread.is_alive():
-                def probe():
-                    t0 = time.perf_counter()
-                    try:
-                        self._pack_device(batch, prof={})
-                    except Exception:
-                        logger.debug("device shadow probe failed", exc_info=True)
-                    else:
-                        self.router.record(key, "device", time.perf_counter() - t0)
 
-                self._probe_thread = threading.Thread(
-                    target=probe, name="karpenter-router-probe", daemon=True
-                )
-                self._probe_thread.start()
+        self._probe_thread = threading.Thread(
+            target=probe, name="karpenter-router-probe", daemon=True
+        )
+        self._probe_thread.start()
+
+    @staticmethod
+    def _route_key(batch: enc.EncodedBatch) -> tuple:
+        """Shape CLASS for the router's cost memos: P is already bucketed
+        by encode's padding, but S (signature count) and F (frontier width)
+        are exact per-batch values — a churning cluster would mint a fresh
+        key per reconcile mix, re-paying cold start on production solves
+        and growing the process-shared EMA tables without bound. Pow2
+        bucketing keeps the landscape to a few dozen classes whose cost is
+        smooth within each."""
+        S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
+        return (
+            len(batch.pod_valid),
+            1 << max(S - 1, 0).bit_length(),
+            1 << max(F - 1, 0).bit_length(),
+        )
 
     def _pack_candidates(self) -> List[str]:
         """Backends that can serve this worker right now, in cold-start
@@ -227,6 +239,7 @@ class TpuScheduler:
         prof = self.last_profile if prof is None else prof
         p = len(batch.pod_valid)
         n_max = max(256, p // 4)
+        prof["packer_backend"] = "native"
         prof["pack_dispatches"] = 0
         args = batch.pack_args()
         while True:
@@ -259,6 +272,7 @@ class TpuScheduler:
             if route:
                 try:
                     result, typemask = self._pack_fused(batch, n_max, route)
+                    prof["packer_backend"] = "device"
                 except Exception:
                     # same containment contract as pack_best: one
                     # pathological shape must not crash the batch or degrade
@@ -273,7 +287,7 @@ class TpuScheduler:
             if result is None:
                 if args is None:
                     args = batch.pack_args()
-                result, typemask = self._pack_once(args, p, n_max), None
+                result, typemask = self._pack_once(args, p, n_max, prof), None
             saturated = int(result.n_nodes) == n_max and bool(
                 (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
             )
@@ -375,7 +389,10 @@ class TpuScheduler:
             batch.usable.shape[0],
         )
 
-    def _pack_once(self, args, p: int, n_max: int) -> kernel.PackResult:
+    def _pack_once(
+        self, args, p: int, n_max: int, prof: Optional[dict] = None
+    ) -> kernel.PackResult:
+        prof = self.last_profile if prof is None else prof
         r = args[6].shape[1]  # pod_req
         if self.service_address and time.monotonic() >= self._remote_down_until:
             try:
@@ -395,6 +412,7 @@ class TpuScheduler:
                 # provisioner) may have set it
                 metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(0)
                 self._remote_down_until = 0.0
+                prof["packer_backend"] = "device"  # sidecar owns the chip
                 return result
             except Exception as e:
                 # open the circuit: a dead sidecar must not stall every
@@ -410,7 +428,11 @@ class TpuScheduler:
 
         result = pack_best(*args, n_max=n_max)
         if isinstance(result.assignment, np.ndarray):
-            return result  # native CPU packer: already host arrays
+            # native CPU packer (forced, or the ladder's no-TPU branch):
+            # already host arrays, and no wire was crossed
+            prof["packer_backend"] = "native"
+            return result
+        prof["packer_backend"] = "device"
         import jax
 
         buf = jax.device_get(kernel.fuse_result(result))

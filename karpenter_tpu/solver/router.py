@@ -21,12 +21,11 @@ Semantics:
   solve of a shape class (64 by default: drift — tunnel weather, host
   load, chip attach — moves on a minutes timescale, while a device probe
   on a core-starved host can shadow a measured solve, so probes are kept
-  rare); the caller then re-measures the LOSER off the
-  critical path (the native packer inline — it costs ~1 ms — or the device
-  path on a shadow thread whose fetch wait releases the GIL) so a drifting
-  environment (tunnel weather, host load, chip attach/detach) can re-win
-  the route. EMA alpha 0.4 forgets a compile-poisoned first sample within
-  a few probes.
+  rare); the caller then re-measures the LOSER(s) off the critical path on
+  a daemon thread (a device probe's fetch wait releases the GIL; a losing
+  native probe is slow precisely when it lost, so it never runs inline) so
+  a drifting environment can re-win the route. EMA alpha 0.4 forgets a
+  compile-poisoned first sample within a few probes.
 
 The default router is PROCESS-SHARED (``default_router``): schedulers come
 and go — worker hot-swap on spec change, consolidation's per-plan shadow
@@ -87,13 +86,16 @@ class CostRouter:
             )
 
     def ema(self, key: tuple, backend: str) -> Optional[float]:
-        return self._ema.get((backend, key))
+        with self._lock:
+            return self._ema.get((backend, key))
 
     def report(self) -> Dict[str, float]:
         """Flat {backend@key: ema_seconds} snapshot (bench/metrics surface)."""
+        with self._lock:
+            items = list(self._ema.items())
         return {
             f"{backend}@{'x'.join(map(str, key))}": round(v, 6)
-            for (backend, key), v in sorted(self._ema.items())
+            for (backend, key), v in sorted(items)
         }
 
 

@@ -7,4 +7,7 @@ from karpenter_tpu.testing.factories import (  # noqa: F401
     make_provisioner,
     zone_spread,
 )
-from karpenter_tpu.testing.scenarios import diverse_pods  # noqa: F401
+from karpenter_tpu.testing.scenarios import (  # noqa: F401
+    affinity_dense_pods,
+    diverse_pods,
+)

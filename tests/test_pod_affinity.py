@@ -624,3 +624,48 @@ class TestDiscoverOverflowOrder:
             # exactly like the per-pod path
             assert [p.metadata.name for p in g.pods] == expected[g.constraint.topology_key]
             assert all(s is statics_mod.statics(p) for p, s in zip(g.pods, g.sts))
+
+
+class TestAffinityDenseScenario:
+    """The r5 #1b bench scenario (docs/affinity-regime.md): half the batch
+    in required (anti-)affinity groups must solve cleanly and certify."""
+
+    def test_generator_mix_and_clean_solve(self):
+        from karpenter_tpu.scheduling.oracle import classify_drops
+        from karpenter_tpu.testing import affinity_dense_pods
+
+        pods = affinity_dense_pods(400, random.Random(5), frac=0.5)
+        assert len(pods) == 400
+        aff = [p for p in pods if p.spec.affinity is not None]
+        assert abs(len(aff) - 200) <= 1
+        anti = [
+            p for p in aff
+            if p.spec.affinity.pod_anti_affinity is not None
+        ]
+        assert anti and len(anti) < len(aff)  # both rule kinds present
+        catalog = instance_types(50)
+        provisioner = make_provisioner(solver="tpu")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(catalog_requirements(catalog))
+        cluster = Cluster()
+        nodes = Scheduler(cluster, rng=random.Random(1)).solve(
+            provisioner, catalog, pods
+        )
+        placed = [p for n in nodes for p in n.pods]
+        verdict = classify_drops(cluster, c, catalog, pods, placed)
+        assert verdict["unexplained"] == [], verdict["unexplained"][:3]
+        # zone-affinity groups actually co-located (plain pods land on
+        # unpinned multi-zone nodes — only group members' nodes are pinned)
+        by_zone = {}
+        for n in nodes:
+            zones = n.constraints.requirements.zones()
+            for p in n.pods:
+                g = p.metadata.labels.get("aff-group")
+                if (
+                    g is not None
+                    and p.spec.affinity is not None
+                    and p.spec.affinity.pod_affinity is not None
+                ):
+                    assert len(zones) == 1, (g, zones)
+                    by_zone.setdefault(g, set()).add(next(iter(zones)))
+        assert by_zone and all(len(zs) == 1 for zs in by_zone.values())

@@ -116,9 +116,10 @@ class TestRoutedScheduler:
             pytest.skip("native packer unavailable")
         scheduler, outs = self._solve_n(4)
         backends = [b for b, _ in outs]
-        # cold start measured both; on a CPU-jax host the native packer is
-        # orders of magnitude cheaper, so exploitation must land there
-        assert set(backends[:2]) == {"device", "native"}, backends
+        # packer_backend reports what actually SERVED (on a no-TPU host the
+        # routed device ladder itself lands on the native branch), so only
+        # the exploitation outcome is asserted on labels; the router report
+        # below proves the cold start measured both routes
         assert backends[2] == backends[3] == "native", backends
         # routing is a performance decision only: identical assignments
         assert len({str(a) for _, a in outs}) == 1
