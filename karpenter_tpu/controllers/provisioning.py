@@ -308,6 +308,10 @@ class ProvisioningController:
         self.solver_service_address = solver_service_address
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
+        # provisioners with a live gauge series — a failed Apply never
+        # creates a worker, so stop()/teardown can't rely on self.workers
+        # to know which series to drop
+        self._gauged: set = set()
         self._lock = threading.Lock()
 
     def reconcile(self, name: str) -> Optional[float]:
@@ -343,6 +347,10 @@ class ProvisioningController:
         from karpenter_tpu.api.provisioner import ACTIVE, Condition
         from karpenter_tpu.kube import serde
 
+        metrics.PROVISIONER_ACTIVE.labels(provisioner=provisioner.name).set(
+            1 if value == "True" else 0
+        )
+        self._gauged.add(provisioner.name)
         cond = provisioner.status.condition(ACTIVE)
         if cond is not None and (cond.status, cond.reason, cond.message) == (
             value, reason, message,
@@ -422,6 +430,10 @@ class ProvisioningController:
             self._hashes.pop(name, None)
         if worker:
             worker.stop()
+        # drop the gauge series: a deleted provisioner must not linger on
+        # the scrape as managed-and-failing (remove() no-ops when absent)
+        self._gauged.discard(name)
+        metrics.PROVISIONER_ACTIVE.remove(name)
 
     def list_workers(self) -> List[ProvisionerWorker]:
         """Active workers sorted by provisioner name — selection priority
@@ -431,4 +443,8 @@ class ProvisioningController:
 
     def stop(self) -> None:
         for name in list(self.workers):
+            self._teardown(name)
+        # provisioners whose Apply only ever failed have a gauge series but
+        # no worker — clear those too
+        for name in list(self._gauged):
             self._teardown(name)

@@ -118,6 +118,17 @@ SOLVER_BREAKER_TRIPS = Counter(
     registry=REGISTRY,
 )
 
+# Provisioner readiness on the scrape (reference: the knative Active
+# condition, provisioner_status.go:38-41): 1 while the last Apply
+# succeeded, 0 while it is failing.
+PROVISIONER_ACTIVE = Gauge(
+    "provisioner_active",
+    "1 while the Provisioner's Active condition is True (last Apply succeeded).",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
 SOLVER_BATCH_SIZE = Histogram(
     "batch_size_pods",
     "Pods per solver batch.",

@@ -289,3 +289,42 @@ class TestActiveCondition:
         assert cond is not None and cond.status == "True"
         assert calls["n"] == 2
         controller.stop()
+
+    def test_active_gauge_tracks_condition(self):
+        from karpenter_tpu import metrics
+
+        def gauge():
+            return metrics.REGISTRY.get_sample_value(
+                "karpenter_provisioner_active", {"provisioner": "default"}
+            )
+
+        cluster, controller = self._controller()
+        bad = make_provisioner(solver="nope")
+        cluster.create("provisioners", bad)
+        with pytest.raises(ValueError):
+            controller.reconcile("default")
+        assert gauge() == 0.0
+        fixed = cluster.get("provisioners", "default", namespace="")
+        fixed.spec.solver = "ffd"
+        cluster.update("provisioners", fixed)
+        controller.reconcile("default")
+        assert gauge() == 1.0
+        cluster.delete("provisioners", "default", namespace="")
+        controller.reconcile("default")  # teardown clears the series
+        assert gauge() is None
+        controller.stop()
+
+    def test_stop_clears_gauge_for_never_started_provisioner(self):
+        from karpenter_tpu import metrics
+
+        cluster, controller = self._controller()
+        cluster.create("provisioners", make_provisioner(name="broken", solver="nope"))
+        with pytest.raises(ValueError):
+            controller.reconcile("broken")
+        assert metrics.REGISTRY.get_sample_value(
+            "karpenter_provisioner_active", {"provisioner": "broken"}
+        ) == 0.0
+        controller.stop()  # no worker ever existed for "broken"
+        assert metrics.REGISTRY.get_sample_value(
+            "karpenter_provisioner_active", {"provisioner": "broken"}
+        ) is None
