@@ -288,14 +288,26 @@ def bench_once(
             times.append(time.perf_counter() - t0)
             prof = getattr(scheduler._tpu, "last_profile", None)
             profiles.append(dict(prof) if prof else {})
-            if probe and wire_telemetry:
-                probe.sample(1)
-                iter_rtts.append(probe.samples[-1])
-            elif probe and (it % 10 == 9 or it == iters - 1):
-                # interleaved transport sampling: the floor must reflect
-                # the tunnel conditions of THIS run window, not a one-off
-                # measurement before it
-                probe.sample(2)
+            if probe:
+                # pair a wire sample only with iterations that actually
+                # crossed the wire: a native-backed (routed) iteration has
+                # nothing to subtract, and a ~100 ms probe per iteration
+                # would be pure bench-time churn
+                wired = wire_telemetry and (
+                    profiles[-1].get("packer_backend", "device") == "device"
+                )
+                if wired:
+                    probe.sample(1)
+                    iter_rtts.append(probe.samples[-1])
+                else:
+                    if wire_telemetry:
+                        iter_rtts.append(None)
+                    # interleaved transport sampling, identical density in
+                    # every mode: the floor must reflect the tunnel
+                    # conditions of THIS run window, not a one-off
+                    # measurement before it
+                    if it % 10 == 9 or it == iters - 1:
+                        probe.sample(2)
     finally:
         if prev_packer is None:
             os.environ.pop("KARPENTER_PACKER", None)
@@ -346,7 +358,8 @@ def bench_once(
             "stages_ms": {k: round(v * 1e3, 1) for k, v in wp.items()
                           if isinstance(v, float) and k.endswith("_s")},
             **({"own_rtt_ms": round(iter_rtts[worst] * 1e3, 1)}
-               if worst < len(iter_rtts) else {}),
+               if worst < len(iter_rtts) and iter_rtts[worst] is not None
+               else {}),
         }
         # wire adjustment applies ONLY to iterations that crossed the wire
         wire_iters = [
@@ -381,7 +394,7 @@ def bench_once(
                 each = [
                     max(times[i] - iter_rtts[i] * d, 0.0)
                     for i, d in zip(wire_iters, disp)
-                    if i < len(iter_rtts)
+                    if i < len(iter_rtts) and iter_rtts[i] is not None
                 ]
                 if each:
                     out["rtt_per_solve_samples"] = len(each)
