@@ -16,6 +16,7 @@ Run: python bench.py [--pods N] [--iters K] [--grid]
 import argparse
 import json
 import math
+import os
 import random
 import statistics
 import sys
@@ -1328,6 +1329,11 @@ def main():
     # With a TPU attached, the router sends these shapes wherever measured
     # cost says — the device-forced leg below keeps the on-chip path's own
     # latency story measured with per-solve wire telemetry.
+    bench_t0 = time.monotonic()
+    # optional legs stop starting once this much wall time is spent, so the
+    # record line always lands even if the harness caps the run (override
+    # with BENCH_BUDGET_S)
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1200"))
     r = bench_once(
         args.pods, args.iters, args.solver,
         breakdown=args.solver == "tpu", wire_telemetry=args.solver == "tpu",
@@ -1353,8 +1359,19 @@ def main():
         if k in r:
             line[k] = r[k]
     if args.solver == "tpu":
+        # a PROVISIONAL record line before the optional legs: the driver
+        # parses the LAST JSON line of output, so if the harness caps the
+        # run mid-leg the headline capture still exists
+        print(json.dumps({**line, "provisional": True}), flush=True)
+
+        def budget_left() -> bool:
+            return time.monotonic() - bench_t0 < budget_s
+
         # on-device kernel parity gates every bench run (CI is CPU-only)
         line["onchip_parity"] = onchip_parity_check()
+        def skip(leg: str) -> None:
+            line.setdefault("skipped_legs", []).append(leg)
+
         # the device path's own latency story, measured with PER-SOLVE wire
         # telemetry (each sample subtracts its own adjacent transport
         # measurement — VERDICT r4 ask #3)
@@ -1380,6 +1397,7 @@ def main():
             line["cpu_native_p99_s"] = round(cpu["p99_s"], 4)
         except Exception as e:
             line["cpu_native_error"] = str(e)[:120]
+        print(json.dumps({**line, "provisional": True}), flush=True)
         # continuous-load pipelined throughput in all three modes, each
         # with controller-CPU accounting: host CPU-seconds per solve is the
         # measured offload claim (VERDICT r4 ask #2)
@@ -1423,49 +1441,88 @@ def main():
             line["tpu_pipelined_vs_cpu_native"] = round(
                 pipe["pods_per_sec"] / line["cpu_native_pods_per_sec"], 3
             )
+        print(json.dumps({**line, "provisional": True}), flush=True)
         # batched multi-solve, TPU vs CPU on identical workloads
         # (VERDICT r3 ask #4)
-        try:
-            m = bench_multi_provisioner(32, 1250, 4)
-            line["multi_b"] = m["provisioners"]
-            line["multi_tpu_pods_per_sec"] = m.get("multi_tpu_pods_per_sec")
-            line["multi_tpu_raw_pods_per_sec"] = round(m["pods_per_sec"], 1)
-            line["multi_cpu_pods_per_sec"] = m.get("multi_cpu_pods_per_sec")
-            line["multi_tpu_wins"] = m.get("multi_tpu_wins")
-            line["multi_unschedulable_expected"] = m["unschedulable_expected"]
-            line["multi_unexplained"] = m["unexplained"]
-        except Exception as e:
-            line["multi_error"] = str(e)[:120]
+        if not budget_left():
+            skip("multi")
+        else:
+            try:
+                m = bench_multi_provisioner(32, 1250, 4)
+                line["multi_b"] = m["provisioners"]
+                line["multi_tpu_pods_per_sec"] = m.get("multi_tpu_pods_per_sec")
+                line["multi_tpu_raw_pods_per_sec"] = round(m["pods_per_sec"], 1)
+                line["multi_cpu_pods_per_sec"] = m.get("multi_cpu_pods_per_sec")
+                line["multi_tpu_wins"] = m.get("multi_tpu_wins")
+                line["multi_unschedulable_expected"] = m["unschedulable_expected"]
+                line["multi_unexplained"] = m["unexplained"]
+            except Exception as e:
+                line["multi_error"] = str(e)[:120]
+        print(json.dumps({**line, "provisional": True}), flush=True)
         # the r5 #1a done-bar rides the default line: auto (cost-routed)
         # within 10% of the best forced backend on all five BASELINE configs
-        try:
-            rp = bench_router_parity(2, emit=None)
-            ratios = {
-                f"config{r['config']}": r["auto_vs_best"]
-                for r in rp if "auto_vs_best" in r
-            }
-            line["router_parity"] = ratios
-            line["router_parity_ok"] = bool(ratios) and all(
-                r.get("within_10pct", False) for r in rp if "auto_vs_best" in r
-            )
-        except Exception as e:
-            line["router_parity_error"] = str(e)[:120]
+        if not budget_left():
+            skip("router_parity")
+        else:
+            try:
+                rp = bench_router_parity(2, emit=None)
+                ratios = {
+                    f"config{r['config']}": r["auto_vs_best"]
+                    for r in rp if "auto_vs_best" in r
+                }
+                line["router_parity"] = ratios
+                line["router_parity_ok"] = bool(ratios) and all(
+                    r.get("within_10pct", False) for r in rp if "auto_vs_best" in r
+                )
+            except Exception as e:
+                line["router_parity_error"] = str(e)[:120]
+        print(json.dumps({**line, "provisional": True}), flush=True)
         # the r5 #1b axis: the affinity-dense regime, head-to-head on
         # identical work (docs/affinity-regime.md is the analysis)
-        try:
-            ad = bench_affinity_dense(args.pods, 3)
-            line["affinity_dense"] = {
-                "device_pods_per_sec": ad["device_pods_per_sec"],
-                "native_pods_per_sec": ad["native_pods_per_sec"],
-                "tpu_wins": ad["tpu_wins"],
-                "device_inject_ms": ad["device_stages_ms"].get("inject_s"),
-                "native_inject_ms": ad["native_stages_ms"].get("inject_s"),
-                "device_pack_fetch_ms": ad["device_stages_ms"].get("pack_fetch_s"),
-                "native_pack_fetch_ms": ad["native_stages_ms"].get("pack_fetch_s"),
-                "unexplained": ad["unexplained"],
-            }
-        except Exception as e:
-            line["affinity_dense_error"] = str(e)[:120]
+        if not budget_left():
+            skip("affinity_dense")
+        else:
+            try:
+                ad = bench_affinity_dense(args.pods, 3)
+                line["affinity_dense"] = {
+                    "device_pods_per_sec": ad["device_pods_per_sec"],
+                    "native_pods_per_sec": ad["native_pods_per_sec"],
+                    "tpu_wins": ad["tpu_wins"],
+                    "device_inject_ms": ad["device_stages_ms"].get("inject_s"),
+                    "native_inject_ms": ad["native_stages_ms"].get("inject_s"),
+                    "device_pack_fetch_ms": ad["device_stages_ms"].get("pack_fetch_s"),
+                    "native_pack_fetch_ms": ad["native_stages_ms"].get("pack_fetch_s"),
+                    "unexplained": ad["unexplained"],
+                }
+            except Exception as e:
+                line["affinity_dense_error"] = str(e)[:120]
+        print(json.dumps({**line, "provisional": True}), flush=True)
+        # LAST leg: the DEDICATED on-chip suite (incl. the S=128 stress
+        # tests the CPU suite skips) in a subprocess, so on-chip CI is an
+        # every-round artifact, not a scheduled workflow nobody triggers
+        # (VERDICT r4 missing #3). It gets its own EXTENDED allowance —
+        # being the priority artifact, it must not be the first casualty
+        # of a tight budget.
+        if time.monotonic() - bench_t0 > budget_s + 300:
+            skip("onchip_suite")
+        else:
+            import subprocess
+
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "pytest",
+                     "tests/test_pallas_kernel.py", "tests/test_fused_solve.py",
+                     "-q", "--no-header", "-p", "no:cacheprovider"],
+                    env={**os.environ, "KARPENTER_TEST_TPU": "1"},
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    capture_output=True, text=True, timeout=600,
+                )
+                tail = (proc.stdout or proc.stderr).strip().splitlines()
+                line["onchip_suite"] = tail[-1].strip()[:160] if tail else "no output"
+                line["onchip_suite_ok"] = proc.returncode == 0
+            except Exception as e:
+                line["onchip_suite"] = f"error: {e}"[:120]
+                line["onchip_suite_ok"] = False
     print(json.dumps(line))
 
 
