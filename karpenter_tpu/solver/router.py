@@ -21,11 +21,14 @@ Semantics:
   solve of a shape class (64 by default: drift — tunnel weather, host
   load, chip attach — moves on a minutes timescale, while a device probe
   on a core-starved host can shadow a measured solve, so probes are kept
-  rare); the caller then re-measures the LOSER(s) off the critical path on
-  a daemon thread (a device probe's fetch wait releases the GIL; a losing
-  native probe is slow precisely when it lost, so it never runs inline) so
-  a drifting environment can re-win the route. EMA alpha 0.4 forgets a
-  compile-poisoned first sample within a few probes.
+  rare), rising to every 8th while the class's EMAs are NEAR-TIED (within
+  1.25×: a stale runner-up in a close race can silently drift into a real
+  loss, and refreshing it costs nothing on the critical path). The caller
+  re-measures the LOSER(s) on a daemon thread (a device probe's fetch
+  wait releases the GIL; a losing native probe is slow precisely when it
+  lost, so it never runs inline) so a drifting environment can re-win the
+  route. EMA alpha 0.4 forgets a compile-poisoned first sample within a
+  few probes.
 
 The default router is PROCESS-SHARED (``default_router``): schedulers come
 and go — worker hot-swap on spec change, consolidation's per-plan shadow
@@ -59,6 +62,13 @@ class CostRouter:
         self._solves: Dict[tuple, int] = {}
         self._lock = threading.Lock()
 
+    # EMAs within this factor are a NEAR-TIE: the run-to-run noise exceeds
+    # the gap, so the nominal winner is a coin flip whose runner-up EMA
+    # must not go stale (drift silently turns the tie into a real loss).
+    # Ties raise the SHADOW-PROBE cadence — never the production route:
+    # exploration stays off the critical path even when the race is close.
+    NEAR_TIE = 1.25
+
     def choose(self, key: tuple, candidates: List[str]) -> str:
         """Pick the backend for this solve: first unmeasured candidate (in
         preference order) during cold start, then always the cheapest."""
@@ -72,10 +82,18 @@ class CostRouter:
             return min(candidates, key=lambda c: self._ema[(c, key)])
 
     def should_probe(self, key: tuple) -> bool:
-        """True every ``probe_every``-th solve of this shape class — the
+        """True every ``probe_every``-th solve of this shape class — every
+        ``probe_every // 8``-th while the key's EMAs are near-tied — so the
         caller re-measures the losing backend(s) off the critical path."""
         n = self._solves.get(key, 0)
-        return bool(self.probe_every) and n > 0 and n % self.probe_every == 0
+        if not self.probe_every or n == 0:
+            return False
+        cadence = self.probe_every
+        with self._lock:
+            emas = sorted(v for (b, k), v in self._ema.items() if k == key)
+        if len(emas) > 1 and emas[1] <= self.NEAR_TIE * emas[0]:
+            cadence = max(4, self.probe_every // 8)
+        return n % cadence == 0
 
     def record(self, key: tuple, backend: str, seconds: float) -> None:
         k = (backend, key)

@@ -196,3 +196,50 @@ class TestRoutedScheduler:
         assert router.ema(key, "native") == FAILURE_PENALTY_S
         scheduler.solve(provisioner, catalog, pods)
         assert scheduler._tpu.last_profile["packer_backend"] == "device"
+
+
+class TestNearTie:
+    """A close race must not let the runner-up's EMA go stale — but the
+    freshness comes from a RAISED SHADOW-PROBE cadence, never from
+    sacrificing a production solve (choose() stays exploit-only)."""
+
+    def test_near_tie_raises_probe_cadence_not_route(self):
+        r = CostRouter(probe_every=64)
+        key = (2048, 9, 1)
+        r.record(key, "device", 0.0105)
+        r.record(key, "native", 0.0100)  # within the 1.25x near-tie band
+        picks, fires = [], 0
+        for _ in range(32):
+            picks.append(r.choose(key, ["device", "native"]))
+            fires += r.should_probe(key)
+        assert picks.count("native") == 32  # every solve exploits
+        assert fires == 4  # probes every 8th instead of every 64th
+
+    def test_clear_winner_probes_at_base_cadence(self):
+        r = CostRouter(probe_every=64)
+        key = (2048, 9, 1)
+        r.record(key, "device", 0.100)  # 100x apart: not a tie
+        r.record(key, "native", 0.001)
+        fires = 0
+        for _ in range(64):
+            r.choose(key, ["device", "native"])
+            fires += r.should_probe(key)
+        assert fires == 1  # only the base 64-solve cadence
+
+    def test_near_tie_probes_recover_a_stale_winner(self):
+        # the drift failure mode: the nominal winner goes stale while the
+        # world shifts; the raised probe cadence refreshes the runner-up
+        # off the critical path and the route flips
+        r = CostRouter(probe_every=64)
+        key = (1024, 5, 1)
+        r.record(key, "device", 0.010)
+        r.record(key, "native", 0.011)  # near-tie, device nominally ahead
+        for _ in range(40):
+            pick = r.choose(key, ["device", "native"])
+            # the world changed: device now takes 3x, native got faster
+            r.record(key, pick, 0.030 if pick == "device" else 0.008)
+            if r.should_probe(key):
+                # the shadow probe measures the loser's CURRENT cost
+                loser = "native" if pick == "device" else "device"
+                r.record(key, loser, 0.008 if loser == "native" else 0.030)
+        assert r.choose(key, ["device", "native"]) == "native"
