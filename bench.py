@@ -680,6 +680,102 @@ def bench_consolidation(n_nodes: int, iters: int, solver: str = "tpu"):
     }
 
 
+def bench_interruption_churn(
+    n_pods: int = 1000,
+    preempt_frac: float = 0.05,
+    rounds: int = 5,
+):
+    """Interruption churn: a steady ``n_pods`` load through the FULL
+    runtime (fake provider) while ``preempt_frac`` of the live fleet gets
+    a preemption notice each round — the per-minute churn compressed to
+    bench time. Reports the two numbers future BENCH rounds track:
+    ``interruption_evicted_unready`` (pods evicted with no replacement
+    ready — 0 under the fake provider is the done-bar) and
+    ``replacement_lead_time_p99_s`` (notice → re-bind on fresh capacity)."""
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.utils import pod as podutil
+
+    rng = random.Random(17)
+    provider = FakeCloudProvider(instance_types(20))
+    cluster = Cluster()
+    rt = build_runtime(Options(), cluster=cluster, cloud_provider=provider)
+    rt.interruption.poll_interval = 0.1  # bench-speed notice latency
+    rt.manager.start()
+    t_start = time.perf_counter()
+    try:
+        cluster.create("provisioners", make_provisioner(solver="ffd"))
+        deadline = time.time() + 10
+        while time.time() < deadline and not rt.provisioning.workers:
+            time.sleep(0.02)
+        assert rt.provisioning.workers, "provisioner worker never started"
+        for w in rt.provisioning.workers.values():
+            w.batcher.idle_duration = 0.1
+        for i in range(n_pods):
+            cluster.create(
+                "pods",
+                make_pod(
+                    name=f"churn-{i}",
+                    requests={"cpu": f"{rng.choice([0.1, 0.25, 0.5])}"},
+                ),
+            )
+
+        def settled(timeout: float) -> bool:
+            stop = time.time() + timeout
+            while time.time() < stop:
+                if not any(podutil.is_provisionable(p) for p in cluster.pods()):
+                    return True
+                time.sleep(0.1)
+            return False
+
+        assert settled(120), "steady-state load never bound"
+        preempted_total = 0
+        for _ in range(rounds):
+            live = [
+                n.metadata.name
+                for n in cluster.nodes()
+                if n.metadata.deletion_timestamp is None
+            ]
+            victims = rng.sample(live, max(1, int(math.ceil(len(live) * preempt_frac))))
+            for name in victims:
+                provider.preempt(name, grace_period_seconds=120.0)
+            preempted_total += len(victims)
+            # the round completes when every victim is drained away AND the
+            # replaced pods are bound again
+            stop = time.time() + 60
+            while time.time() < stop and any(
+                cluster.try_get("nodes", v, namespace="") is not None for v in victims
+            ):
+                time.sleep(0.05)
+            assert all(
+                cluster.try_get("nodes", v, namespace="") is None for v in victims
+            ), "preempted nodes never terminated"
+            assert settled(60), "replacement capacity never absorbed the round"
+        # let in-flight terminations finish so the drain counters settle
+        stop = time.time() + 30
+        while time.time() < stop and any(
+            n.metadata.deletion_timestamp is not None for n in cluster.nodes()
+        ):
+            time.sleep(0.1)
+        lead = sorted(rt.interruption.lead_times)
+        return {
+            "pods": n_pods,
+            "rounds": rounds,
+            "preempt_frac": preempt_frac,
+            "nodes_preempted": preempted_total,
+            "pods_replaced": len(lead),
+            "interruption_evicted_unready": rt.interruption.evicted_unready,
+            "replacement_lead_time_p50_s": round(lead[len(lead) // 2], 4) if lead else None,
+            "replacement_lead_time_p99_s": round(_p99(lead), 4) if lead else None,
+            "notices_handled": rt.interruption.notices_handled,
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        rt.stop()
+
+
 def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
     """BASELINE config 4: many provisioners' batches solved concurrently —
     stacked on the batch axis and sharded over the device mesh
@@ -1205,6 +1301,10 @@ def main():
     ap.add_argument("--selection-storm", type=int, metavar="N_PODS", default=0,
                     help="drive N pod watch events through manager->selection->"
                          "batcher->solve->bind and report end-to-end latency")
+    ap.add_argument("--interruption-churn", type=int, metavar="N_PODS", default=0,
+                    help="steady N-pod load with 5%% of nodes preempted per "
+                         "round; reports interruption_evicted_unready and "
+                         "replacement_lead_time_p99_s")
     ap.add_argument("--config", type=int, default=0, metavar="1..5",
                     help="run one of BASELINE.json's five configs")
     ap.add_argument("--all-configs", action="store_true",
@@ -1269,6 +1369,24 @@ def main():
         return
     if args.config:
         print(json.dumps(bench_config(args.config, max(args.iters, 2))))
+        return
+
+    if args.interruption_churn:
+        r = bench_interruption_churn(args.interruption_churn)
+        print(
+            json.dumps(
+                {
+                    "metric": f"interruption churn ({args.interruption_churn} pods, "
+                              f"{int(r['preempt_frac'] * 100)}% of nodes preempted "
+                              f"x {r['rounds']} rounds)",
+                    "value": r["interruption_evicted_unready"],
+                    "unit": "pods evicted without replacement ready",
+                    "vs_baseline": 1.0 if r["interruption_evicted_unready"] == 0 else 0.0,
+                    **{k: v for k, v in r.items() if k != "interruption_evicted_unready"},
+                    "interruption_evicted_unready": r["interruption_evicted_unready"],
+                }
+            )
+        )
         return
 
     if args.selection_storm:

@@ -23,6 +23,7 @@ LABEL_DOMAIN = GROUP
 CAPACITY_TYPE = LABEL_DOMAIN + "/capacity-type"
 PROVISIONER_NAME_LABEL = LABEL_DOMAIN + "/provisioner-name"
 NOT_READY_TAINT_KEY = LABEL_DOMAIN + "/not-ready"
+INTERRUPTION_TAINT_KEY = LABEL_DOMAIN + "/interruption"
 DO_NOT_EVICT_ANNOTATION = LABEL_DOMAIN + "/do-not-evict"
 EMPTINESS_TIMESTAMP_ANNOTATION = LABEL_DOMAIN + "/emptiness-timestamp"
 TERMINATION_FINALIZER = LABEL_DOMAIN + "/termination"

@@ -16,6 +16,7 @@ from typing import Any, Dict, FrozenSet, List, Optional
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice, NoticeQueue
 from karpenter_tpu.utils import resources as res
 
 _name_counter = itertools.count(1)
@@ -145,6 +146,7 @@ class FakeCloudProvider(CloudProvider):
         self.instance_types: Optional[List[InstanceType]] = instance_types
         self.create_calls: List[NodeRequest] = []
         self.delete_calls: List[str] = []
+        self.disruptions = NoticeQueue()
         self._mu = threading.Lock()
 
     def create(self, request: NodeRequest) -> Node:
@@ -187,6 +189,28 @@ class FakeCloudProvider(CloudProvider):
         if self.instance_types is not None:
             return self.instance_types
         return default_catalog()
+
+    # -- DisruptionSource ---------------------------------------------------
+    def preempt(
+        self,
+        node_name: str,
+        grace_period_seconds: float = 120.0,
+        kind: str = PREEMPTION,
+        reason: str = "",
+    ) -> DisruptionNotice:
+        """Test/bench fault injector: announce that this node's capacity
+        will be reclaimed in ``grace_period_seconds``."""
+        notice = DisruptionNotice(
+            kind=kind,
+            node_name=node_name,
+            grace_period_seconds=grace_period_seconds,
+            reason=reason,
+        )
+        self.disruptions.push(notice)
+        return notice
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        return self.disruptions.drain()
 
     def name(self) -> str:
         return "fake"

@@ -36,6 +36,7 @@ from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, PodCondition
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.ttlcache import TTLCache
 
@@ -108,6 +109,9 @@ class SimGkeAPI:
         self.create_calls: List[GkeNodePool] = []
         self.delete_calls: List[str] = []
         self._stockouts: set = set()
+        # the disruption-event bus: GCE preemption / maintenance notices
+        # tests inject and the interruption controller polls
+        self.disruptions = NoticeQueue()
 
     # -- fault injection ---------------------------------------------------
     def set_stockout(self, machine_type: str, zone: str, capacity_type: Optional[str] = None):
@@ -178,6 +182,14 @@ class SimGkeAPI:
                 pool.instances = [i for i in pool.instances if i.name != name]
                 if not pool.instances:
                     self.node_pools.pop(pool_name, None)
+
+    def send_disruption_notice(self, notice: DisruptionNotice) -> None:
+        """Fault injector: announce a preemption/maintenance event for one
+        instance (node names equal instance names here)."""
+        self.disruptions.push(notice)
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        return self.disruptions.drain()
 
 
 def _machine(name: str, cpu: float, mem_gib: float, price: float,
@@ -444,6 +456,11 @@ class GkeCloudProvider(CloudProvider):
             if key not in ("project", "network", "subnetwork", "serviceAccount", "tags"):
                 errs.append(f"unknown GKE provider field {key!r}")
         return errs
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        """DisruptionSource: drain the node-pool API's event bus (the same
+        call works over the wire via ``HttpGkeAPI``)."""
+        return self.api.poll_disruptions()
 
     def name(self) -> str:
         return "gke"

@@ -42,6 +42,7 @@ from karpenter_tpu.cloudprovider.simulated import (
     SimSecurityGroup,
     SimSubnet,
 )
+from karpenter_tpu.interruption.types import DisruptionNotice
 
 # wire error codes (the EC2-style error-code vocabulary the reference's
 # error classifier switches on — aws/errors.go)
@@ -178,6 +179,8 @@ class CloudAPIServer(_JsonApiServer):
       POST   /v1/fleet     {"capacityType", "overrides"}   → instances + errors
       POST   /v1/instances/describe  {"ids": [...]}        → {"items": [...]}
       POST   /v1/instances/terminate {"ids": [...]}        → {}
+      GET    /v1/events                                    → pending disruption
+                                                             notices (drained)
     """
 
     def __init__(self, api: Optional[SimCloudAPI] = None, page_size: int = DEFAULT_PAGE_SIZE):
@@ -259,6 +262,11 @@ class CloudAPIServer(_JsonApiServer):
         elif method == "POST" and path == "/v1/instances/terminate":
             api.terminate_instances(h._body().get("ids", []))
             h._send(200, {})
+        elif method == "GET" and path == "/v1/events":
+            # the disruption-event stream: GET drains pending notices (the
+            # SQS receive-and-delete analog; the wire consumer is the only
+            # reader, matching NoticeQueue's at-most-once contract)
+            h._send(200, {"items": [n.to_wire() for n in api.poll_disruptions()]})
         else:
             h._error(404, CODE_NOT_FOUND, f"{method} {path}")
 
@@ -412,6 +420,10 @@ class HttpCloudAPI(_WireTransport):
     def terminate_instances(self, ids: List[str]) -> None:
         self._request("POST", "/v1/instances/terminate", {"ids": list(ids)})
 
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        body = self._request("GET", "/v1/events")
+        return [DisruptionNotice.from_wire(d) for d in body.get("items", [])]
+
 
 def _tag_query(selector: Dict[str, str]) -> str:
     if not selector:
@@ -452,6 +464,7 @@ class GkeAPIServer(_JsonApiServer):
                                           a stockout answers 409)
       DELETE /gke/v1/node-pools/<name>
       DELETE /gke/v1/instances/<name>
+      GET    /gke/v1/events              → pending disruption notices (drained)
     """
 
     def __init__(self, api=None):
@@ -486,6 +499,10 @@ class GkeAPIServer(_JsonApiServer):
         elif method == "DELETE" and path.startswith("/gke/v1/instances/"):
             self.api.delete_instance(urllib.parse.unquote(path.rsplit("/", 1)[1]))
             h._send(200, {})
+        elif method == "GET" and path == "/gke/v1/events":
+            h._send(
+                200, {"items": [n.to_wire() for n in self.api.poll_disruptions()]}
+            )
         else:
             h._error(404, CODE_NOT_FOUND, f"{method} {path}")
 
@@ -525,3 +542,7 @@ class HttpGkeAPI(_WireTransport):
         self._request(
             "DELETE", f"/gke/v1/instances/{urllib.parse.quote(name, safe='')}"
         )
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        body = self._request("GET", "/gke/v1/events")
+        return [DisruptionNotice.from_wire(d) for d in body.get("items", [])]

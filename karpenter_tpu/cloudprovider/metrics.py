@@ -60,6 +60,15 @@ class MeteredCloudProvider(CloudProvider):
         finally:
             self._observe("get_instance_types", start)
 
+    def poll_disruptions(self):
+        """The DisruptionSource poll is a real control-plane call for wire
+        providers — observe it like create/delete."""
+        start = time.perf_counter()
+        try:
+            return self.delegate.poll_disruptions()
+        finally:
+            self._observe("poll_disruptions", start)
+
     # webhook hooks + name pass through unmetered, as in the reference
     def default(self, constraints: Constraints) -> None:
         return self.delegate.default(constraints)

@@ -42,6 +42,7 @@ from karpenter_tpu.api.objects import (
 )
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.ttlcache import TTLCache
 from karpenter_tpu.utils.workqueue import TokenBucket
@@ -160,6 +161,10 @@ class SimCloudAPI:
         self.launch_templates: Dict[str, Dict[str, Any]] = {}
         self.instances: Dict[str, SimInstance] = {}
         self.calls: Dict[str, int] = {}
+        # the disruption-event bus (the EventBridge/SQS analog): tests push
+        # notices via send_disruption_notice; the interruption controller
+        # drains them through the provider's poll_disruptions
+        self.disruptions = NoticeQueue()
         self._errors: Dict[str, List[Exception]] = {}
         self._counter = itertools.count(1)
         self._mu = threading.Lock()
@@ -238,6 +243,16 @@ class SimCloudAPI:
                 inst = self.instances.get(i)
                 if inst:
                     inst.state = "terminated"
+
+    def send_disruption_notice(self, notice: DisruptionNotice) -> None:
+        """Fault injector: put a disruption notice on the event bus. Node
+        names are instance ids here (``_to_node`` names Node objects after
+        the instance), so callers pass the instance id."""
+        self.disruptions.push(notice)
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        self._enter("poll_disruptions")
+        return self.disruptions.drain()
 
 
 def _tags_match(tags: Dict[str, str], selector: Dict[str, str]) -> bool:
@@ -850,6 +865,12 @@ class SimulatedCloudProvider(CloudProvider):
 
     def validate(self, constraints: Constraints) -> List[str]:
         return SimProviderConfig.deserialize(constraints.provider).validate()
+
+    def poll_disruptions(self) -> List[DisruptionNotice]:
+        """DisruptionSource: drain the control plane's event bus (works
+        identically against the in-process ``SimCloudAPI`` and the HTTP
+        client's ``GET /v1/events``)."""
+        return self.api.poll_disruptions()
 
     def name(self) -> str:
         return "simulated"

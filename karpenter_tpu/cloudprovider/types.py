@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Any, Dict, FrozenSet, List, Optional, Sequence
+from typing import TYPE_CHECKING, Any, Dict, FrozenSet, List, Optional, Sequence
+
+if TYPE_CHECKING:  # interruption.types imports nothing from this layer
+    from karpenter_tpu.interruption.types import DisruptionNotice
 
 from karpenter_tpu.api.objects import Node
 from karpenter_tpu.api.provisioner import Constraints
@@ -92,6 +95,12 @@ class CloudProvider(abc.ABC):
 
     def validate(self, constraints: Constraints) -> List[str]:
         """Vendor validation hook (webhook ValidateHook)."""
+        return []
+
+    def poll_disruptions(self) -> List["DisruptionNotice"]:
+        """The ``DisruptionSource`` protocol (karpenter_tpu/interruption):
+        return-and-clear the notices that arrived since the last poll.
+        Default: this vendor has no disruption stream."""
         return []
 
     def name(self) -> str:

@@ -367,9 +367,20 @@ class ProvisioningController:
                 last_transition_time=ltt,
             )
         )
+        # arrays replace wholesale under RFC 7386: the patch must carry the
+        # FULL conditions list, not just Active, or conditions owned by
+        # other writers get erased. Read-modify-write against the freshest
+        # cache copy (a raced write loses benignly — the next reconcile's
+        # comparison re-detects the drift and retries).
+        live = self.cluster.try_get("provisioners", provisioner.name, namespace="")
+        base = (live or provisioner).status.conditions
+        wire_conditions = [
+            serde.prov_condition_to_wire(c) for c in base if c.type != ACTIVE
+        ] + [wire]
         try:
             self.cluster.patch_status(
-                "provisioners", provisioner.name, {"conditions": [wire]}, namespace=""
+                "provisioners", provisioner.name,
+                {"conditions": wire_conditions}, namespace="",
             )
         except Exception:
             # a lost condition write surfaces again on the next reconcile;
@@ -431,15 +442,42 @@ class ProvisioningController:
         if worker:
             worker.stop()
         # drop the gauge series: a deleted provisioner must not linger on
-        # the scrape as managed-and-failing (remove() no-ops when absent)
+        # the scrape as managed-and-failing. Several prometheus_client
+        # releases raise KeyError from remove() for a never-gauged label
+        # set (e.g. a reconcile of a name whose Apply never ran), and that
+        # must not escape reconcile().
         self._gauged.discard(name)
-        metrics.PROVISIONER_ACTIVE.remove(name)
+        try:
+            metrics.PROVISIONER_ACTIVE.remove(name)
+        except KeyError:
+            pass
 
     def list_workers(self) -> List[ProvisionerWorker]:
         """Active workers sorted by provisioner name — selection priority
         order (reference: controller.go:136-145)."""
         with self._lock:
             return [self.workers[k] for k in sorted(self.workers)]
+
+    def submit(self, pod: Pod) -> Optional[ProvisionerWorker]:
+        """Inject a pod straight into the first admitting worker's batcher,
+        bypassing the selection controller — the interruption subsystem's
+        proactive-replacement hook: pods released from a disrupted node
+        enter the provisioning pipeline BEFORE the node drains, so
+        replacement capacity is launching while the old node still runs.
+        Returns the worker, or None when no provisioner admits the pod
+        (the caller leaves it pending for selection to retry)."""
+        # volume topology must ride along even though selection is bypassed:
+        # a replacement pod with a zone-bound PV packed into another zone
+        # would bind where its volume cannot attach — and selection cannot
+        # repair it later (is_pending short-circuits its reconcile)
+        from karpenter_tpu.controllers.selection import VolumeTopology
+
+        VolumeTopology(self.cluster).inject(pod)
+        for worker in self.list_workers():
+            if not worker.provisioner.spec.constraints.validate_pod(pod):
+                worker.add(pod)
+                return worker
+        return None
 
     def stop(self) -> None:
         for name in list(self.workers):

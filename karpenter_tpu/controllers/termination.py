@@ -125,16 +125,20 @@ class Terminator:
         )
         logger.info("Cordoned node %s", node.metadata.name)
 
-    def drain(self, node: Node) -> bool:
-        """Evict pods; True when the node is fully drained."""
+    def drain(self, node: Node, force: bool = False) -> bool:
+        """Evict pods; True when the node is fully drained. ``force`` is
+        the interruption subsystem's deadline hook: once the cloud's grace
+        period is spent the capacity disappears regardless, so do-not-evict
+        stops blocking and every pod is enqueued for eviction."""
         pods = self.get_pods(node)
-        for pod in pods:
-            if pod.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true":
-                logger.debug(
-                    "Unable to drain node %s: pod %s has do-not-evict",
-                    node.metadata.name, pod.key,
-                )
-                return False
+        if not force:
+            for pod in pods:
+                if pod.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true":
+                    logger.debug(
+                        "Unable to drain node %s: pod %s has do-not-evict",
+                        node.metadata.name, pod.key,
+                    )
+                    return False
         self.evict(pods)
         return len(pods) == 0
 

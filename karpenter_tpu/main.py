@@ -1,9 +1,10 @@
 """Process wiring: build the manager with all controllers registered.
 
 Mirrors ``cmd/controller/main.go:67-105``: options → cloud provider from the
-registry → manager → register the eight controllers (provisioning, selection,
-pvc, termination, node, metrics-pod, metrics-node, counter) with their
-watches → start. ``run_controller_process`` is the ``main()`` equivalent; it
+registry → manager → register the controllers (provisioning, selection, pvc,
+termination, interruption, node, consolidation, metrics-pod, metrics-node,
+counter) with their watches → start. ``run_controller_process`` is the
+``main()`` equivalent; it
 returns the assembled runtime so embedding callers (tests, simulations, a
 real-apiserver deployment shim) can drive or stop it.
 """
@@ -19,6 +20,7 @@ from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.cloudprovider.types import CloudProvider
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.manager import Manager
 from karpenter_tpu.controllers.metrics_node import NodeMetricsController
 from karpenter_tpu.controllers.metrics_pod import PodMetricsController
@@ -45,6 +47,7 @@ class Runtime:
     provisioning: ProvisioningController
     selection: SelectionController
     termination: TerminationController
+    interruption: InterruptionController
     webhook: Webhook
     servers: list = None  # HTTP servers (metrics, health) when serving
     elector: object = None  # LeaderElector when a lease is configured
@@ -196,6 +199,9 @@ def build_runtime(
         wait=False,
     )
     termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
+    interruption = InterruptionController(
+        cluster, cloud_provider, provisioning=provisioning, termination=termination
+    )
     node = NodeController(cluster)
     consolidation = ConsolidationController(
         cluster,
@@ -214,6 +220,7 @@ def build_runtime(
     manager.register("provisioning", provisioning.reconcile, concurrency=10)
     manager.register("selection", selection.reconcile, concurrency=32)
     manager.register("termination", termination.reconcile, concurrency=10)
+    manager.register("interruption", interruption.reconcile, concurrency=2)
     manager.register("node", node.reconcile, concurrency=10)
     manager.register("consolidation", consolidation.reconcile, concurrency=2)
     manager.register("counter", counter.reconcile, concurrency=2)
@@ -229,6 +236,7 @@ def build_runtime(
         "pods", lambda e, o: manager.enqueue("selection", (o.metadata.name, o.metadata.namespace))
     )
     node.register(manager)
+    interruption.register(manager)
     consolidation.register(manager)
     counter.register(manager)
     pvc.register(manager)
@@ -244,6 +252,7 @@ def build_runtime(
         provisioning=provisioning,
         selection=selection,
         termination=termination,
+        interruption=interruption,
         webhook=Webhook(cloud_provider, default_solver=options.default_solver),
     )
 

@@ -129,6 +129,56 @@ PROVISIONER_ACTIVE = Gauge(
     registry=REGISTRY,
 )
 
+# Interruption subsystem (karpenter_tpu/interruption): cloud-initiated
+# disruption handling must be visible on the scrape — notices in, drains
+# through, and the two outcome measures: pods evicted with no replacement
+# ready (the number that must stay 0 under clean preemption) and how long
+# replaced workloads waited for new capacity.
+INTERRUPTION_NOTICES = Counter(
+    "notices_total",
+    "Disruption notices received, by kind (preemption/maintenance/"
+    "capacity-reclaim) and cloud provider.",
+    ["kind", "provider"],
+    namespace=NAMESPACE,
+    subsystem="interruption",
+    registry=REGISTRY,
+)
+
+INTERRUPTION_DRAINS_STARTED = Counter(
+    "drains_started_total",
+    "Nodes handed to termination because of a disruption notice.",
+    namespace=NAMESPACE,
+    subsystem="interruption",
+    registry=REGISTRY,
+)
+
+INTERRUPTION_DRAINS_COMPLETED = Counter(
+    "drains_completed_total",
+    "Disrupted nodes fully terminated (gracefully or at the deadline).",
+    namespace=NAMESPACE,
+    subsystem="interruption",
+    registry=REGISTRY,
+)
+
+INTERRUPTION_EVICTED_UNREADY = Counter(
+    "evicted_without_replacement_total",
+    "Pods still on a disrupted node when its grace period expired — "
+    "evicted without replacement capacity ready.",
+    namespace=NAMESPACE,
+    subsystem="interruption",
+    registry=REGISTRY,
+)
+
+INTERRUPTION_REPLACEMENT_LEAD_TIME = Histogram(
+    "replacement_lead_time_seconds",
+    "Seconds from disruption notice to the replaced pod's re-bind on "
+    "fresh capacity.",
+    namespace=NAMESPACE,
+    subsystem="interruption",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)
+
 SOLVER_BATCH_SIZE = Histogram(
     "batch_size_pods",
     "Pods per solver batch.",

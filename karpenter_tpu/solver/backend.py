@@ -108,8 +108,12 @@ class TpuScheduler:
         # reused across this worker's batches; the lock covers the rare
         # concurrent solve (warmup thread vs first real batch)
         self._encode_cache = enc.EncodeCache()
-        # device-resident solve invariants for the fused dispatch
+        # device-resident solve invariants for the fused dispatch; the lock
+        # guards the lazy init — the shadow-probe thread and a production
+        # solve can both hit the None check, and two DeviceInvariants would
+        # split the LRU (every solve re-uploading what the other cached)
         self._device_cache = None
+        self._device_cache_lock = threading.Lock()
         self._solve_lock = threading.Lock()
         # per-stage timings of the most recent solve (bench surfaces these
         # as the latency breakdown the <100ms target is judged against)
@@ -184,17 +188,25 @@ class TpuScheduler:
             return  # previous probe still running; next cadence hit retries
 
         def probe():
-            for loser in losers:
-                t0 = time.perf_counter()
-                try:
-                    if loser == "native":
-                        self._pack_native(batch, prof={})
+            nonlocal batch
+            try:
+                for loser in losers:
+                    t0 = time.perf_counter()
+                    try:
+                        if loser == "native":
+                            self._pack_native(batch, prof={})
+                        else:
+                            self._pack_device(batch, prof={})
+                    except Exception:
+                        logger.debug("%s shadow probe failed", loser, exc_info=True)
                     else:
-                        self._pack_device(batch, prof={})
-                except Exception:
-                    logger.debug("%s shadow probe failed", loser, exc_info=True)
-                else:
-                    self.router.record(key, loser, time.perf_counter() - t0)
+                        self.router.record(key, loser, time.perf_counter() - t0)
+            finally:
+                # drop the closure's cell: _probe_thread keeps the finished
+                # Thread (and this closure) alive until the next probe for
+                # this worker, which for a rare shape class would pin the
+                # multi-MB EncodedBatch indefinitely
+                batch = None
 
         self._probe_thread = threading.Thread(
             target=probe, name="karpenter-router-probe", daemon=True
@@ -357,7 +369,9 @@ class TpuScheduler:
         from karpenter_tpu.solver import fused
 
         if self._device_cache is None:
-            self._device_cache = fused.DeviceInvariants()
+            with self._device_cache_lock:
+                if self._device_cache is None:
+                    self._device_cache = fused.DeviceInvariants()
         pod_tab, open_by_core, bhh = fused.pack_pod_table(batch)
         uniq = fused.pad_uniq_req(batch.uniq_req)
         if route == "v2":

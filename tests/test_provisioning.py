@@ -314,6 +314,33 @@ class TestActiveCondition:
         assert gauge() is None
         controller.stop()
 
+    def test_condition_write_preserves_foreign_conditions(self):
+        # arrays replace wholesale under RFC 7386: a 1-element Active patch
+        # would erase conditions owned by other writers — _set_active must
+        # read-modify-write the full list
+        from karpenter_tpu.api.provisioner import Condition
+
+        cluster, controller = self._controller()
+        prov = make_provisioner()
+        prov.status.conditions.append(
+            Condition(type="CatalogReady", status="True", reason="Discovered")
+        )
+        cluster.create("provisioners", prov)
+        controller.reconcile("default")
+        conds = {c.type: c for c in cluster.get("provisioners", "default", namespace="").status.conditions}
+        assert conds["Active"].status == "True"
+        assert conds["CatalogReady"].status == "True"
+        assert conds["CatalogReady"].reason == "Discovered"
+        controller.stop()
+
+    def test_reconcile_of_unknown_name_never_raises(self):
+        # _teardown guards PROVISIONER_ACTIVE.remove: several
+        # prometheus_client releases raise KeyError for a never-gauged
+        # label set, and that must not escape reconcile()
+        cluster, controller = self._controller()
+        assert controller.reconcile("ghost") is None
+        controller.stop()
+
     def test_stop_clears_gauge_for_never_started_provisioner(self):
         from karpenter_tpu import metrics
 

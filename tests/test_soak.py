@@ -159,6 +159,85 @@ def test_soak_full_runtime_random_churn():
         rt.stop()
 
 
+def test_soak_preemption_churn():
+    """Interruption leg: the full runtime under pod churn WHILE the cloud
+    preempts random nodes mid-workload (short grace periods so deadline
+    enforcement also fires). Invariants: every surviving pod is bound or
+    pending-and-retryable (nothing silently lost), every preempted node is
+    gone by the end, and the controllers never deadlock."""
+    import random as _random
+
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+
+    rng = _random.Random(20260803)
+    provider = FakeCloudProvider(instance_types(20))
+    cluster = Cluster()
+    rt = build_runtime(Options(), cluster=cluster, cloud_provider=provider)
+    rt.interruption.poll_interval = 0.2  # soak-speed notice latency
+    rt.manager.start()
+    try:
+        cluster.create("provisioners", make_provisioner(solver="ffd"))
+        wait_for_worker(rt)
+        created = []
+        preempted = set()
+        stop = time.time() + 12.0
+        i = 0
+        while time.time() < stop:
+            action = rng.random()
+            if action < 0.5:
+                name = f"preempt-soak-{i}"
+                i += 1
+                cluster.create(
+                    "pods",
+                    make_pod(name=name, requests={"cpu": f"{rng.choice([0.25, 0.5, 1])}"}),
+                )
+                created.append(name)
+            elif action < 0.65 and created:
+                try:
+                    cluster.delete("pods", rng.choice(created))
+                except Exception:
+                    pass
+            elif action < 0.9:
+                # the interruption axis: a live node gets a notice with a
+                # grace period short enough that some deadlines fire in-soak
+                nodes = [
+                    n for n in cluster.nodes()
+                    if n.metadata.deletion_timestamp is None
+                ]
+                if nodes:
+                    victim = rng.choice(nodes).metadata.name
+                    preempted.add(victim)
+                    provider.preempt(
+                        victim, grace_period_seconds=rng.choice([0.5, 2.0, 30.0])
+                    )
+            time.sleep(rng.uniform(0.005, 0.05))
+
+        settle(cluster, context="settle after preemption churn")
+        assert preempted, "soak never preempted a node"
+        # every pod that survived is bound to a LIVE node or terminating
+        live = {n.metadata.name for n in cluster.nodes()}
+        for p in cluster.pods():
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            assert p.spec.node_name in live, (
+                f"pod {p.metadata.name} stranded on {p.spec.node_name!r}"
+            )
+        # preempted nodes do not outlive their grace periods: give the
+        # termination/deadline paths a moment to finish the stragglers
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+            cluster.try_get("nodes", n, namespace="") is not None for n in preempted
+        ):
+            time.sleep(0.25)
+        for n in preempted:
+            assert cluster.try_get("nodes", n, namespace="") is None, (
+                f"preempted node {n} never terminated"
+            )
+        assert rt.interruption.notices_handled >= 1
+    finally:
+        rt.stop()
+
+
 def test_soak_over_apiserver_boundary():
     """The same churn pushed across the real HTTP + wire-format boundary:
     TestApiServer + ApiCluster informers (RV-resumed watches), server-side
