@@ -6,8 +6,8 @@ TESTFLAGS ?= -q
 
 dev: test  ## everything a presubmit needs
 
-test:  ## unit + integration suites
-	$(PY) -m pytest tests/ -x $(TESTFLAGS)
+test:  ## unit + integration suites (tier-1: slow soak/chaos legs excluded)
+	$(PY) -m pytest tests/ -x -m 'not slow' $(TESTFLAGS)
 
 battletest:  ## full suite without fail-fast + duration report (the -race analog)
 	$(PY) -m pytest tests/ $(TESTFLAGS) --durations=10
@@ -37,6 +37,10 @@ benchmark-router-parity:  ## auto (cost-routed) vs best forced backend, 5 BASELI
 
 benchmark-affinity-dense:  ## device vs native head-to-head on the 50%-affinity regime
 	$(PY) bench.py --affinity-dense 10000
+
+chaos:  ## seeded chaos suite + the bench chaos leg (success-rate done-bar: 1.0)
+	$(PY) -m pytest tests/test_chaos.py tests/test_resilience.py -q $(TESTFLAGS)
+	$(PY) bench.py --chaos 300
 
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -70,5 +74,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test battletest deflake benchmark benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

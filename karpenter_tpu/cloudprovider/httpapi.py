@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.cloudprovider.simulated import (
     CloudAPIError,
+    InstanceNotFoundError,
     InsufficientCapacityError,
     SimCloudAPI,
     SimInstance,
@@ -49,7 +50,8 @@ from karpenter_tpu.interruption.types import DisruptionNotice
 CODE_ICE = "InsufficientInstanceCapacity"
 CODE_THROTTLE = "RequestLimitExceeded"
 CODE_INTERNAL = "InternalError"
-CODE_NOT_FOUND = "NotFound"
+CODE_NOT_FOUND = "NotFound"  # route-level: unknown method+path
+CODE_INSTANCE_NOT_FOUND = "InvalidInstanceID.NotFound"  # typed: no such record
 CODE_BAD_REQUEST = "InvalidArgument"
 
 DEFAULT_PAGE_SIZE = 3  # small so real catalogs actually paginate in tests
@@ -97,8 +99,12 @@ class _JsonApiServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _error(self, status: int, code: str, message: str, headers=()):
-                self._send(status, {"error": {"code": code, "message": message}}, headers)
+            def _error(self, status: int, code: str, message: str, headers=(),
+                       details=None):
+                body: Dict[str, Any] = {"error": {"code": code, "message": message}}
+                if details:
+                    body["error"]["details"] = details
+                self._send(status, body, headers)
 
             def _body(self) -> Dict[str, Any]:
                 length = int(self.headers.get("Content-Length", 0))
@@ -114,9 +120,25 @@ class _JsonApiServer:
                     self._error(429, CODE_THROTTLE, str(e),
                                 headers=[("Retry-After", f"{e.retry_after:.3f}")])
                 except InsufficientCapacityError as e:
-                    self._error(409, CODE_ICE, str(e))
+                    # the all-ICE fleet outcome crosses the wire typed, WITH
+                    # its errored overrides, so the client-side ICE cache
+                    # marks exactly the pools the server saw exhausted
+                    details = None
+                    if getattr(e, "overrides", None):
+                        details = {"overrides": [
+                            {"capacityType": ct, "instanceType": it, "zone": z}
+                            for ct, it, z in e.overrides
+                        ]}
+                    self._error(409, CODE_ICE, str(e), details=details)
                 except _BadRequest as e:
                     self._error(400, CODE_BAD_REQUEST, str(e))
+                except InstanceNotFoundError as e:
+                    # BEFORE the CloudAPIError catch-all (it subclasses it):
+                    # a positive "no such record" must cross typed as 404 —
+                    # not as a retryable 500, and under its OWN code so a
+                    # route-level 404 (client/server skew, bad base_url)
+                    # can never read as "instance confirmed gone"
+                    self._error(404, CODE_INSTANCE_NOT_FOUND, str(e))
                 except CloudAPIError as e:
                     self._error(500, CODE_INTERNAL, str(e))
                 except Exception as e:  # a double must never hang the client
@@ -285,9 +307,12 @@ def _tag_selector(query: Dict[str, List[str]]) -> Dict[str, str]:
 
 
 class _WireTransport:
-    """Shared HTTP transport with bounded retries: up to ``max_attempts``
-    on 429 (honoring Retry-After) and on 5xx / connection errors
-    (exponential backoff from ``backoff_base``). 4xx is deterministic and
+    """Shared HTTP transport with bounded retries under the resilience
+    layer's policy (resilience/policy.py): up to ``max_attempts`` on 429
+    (honoring Retry-After) and, for idempotent requests, on 5xx /
+    connection errors with DECORRELATED-JITTER backoff from
+    ``backoff_base``, all inside a hard per-operation ``deadline`` that the
+    active reconcile-round Budget further caps. 4xx is deterministic and
     never retried; ``_typed_error`` maps the wire error code back to the
     vendor's exception vocabulary."""
 
@@ -297,21 +322,62 @@ class _WireTransport:
         timeout: float = 5.0,
         max_attempts: int = 4,
         backoff_base: float = 0.05,
+        deadline: float = 30.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.max_attempts = max_attempts
         self.backoff_base = backoff_base
+        self.deadline = deadline
         self.retries = 0  # observability: total retried requests
 
-    def _typed_error(self, code: str, message: str, status: int) -> Exception:
+    def _typed_error(
+        self, code: str, message: str, status: int, details: Optional[Dict] = None
+    ) -> Exception:
         if code == CODE_ICE:
-            return InsufficientCapacityError(message)
+            overrides = [
+                (o["capacityType"], o["instanceType"], o["zone"])
+                for o in (details or {}).get("overrides", [])
+            ]
+            return InsufficientCapacityError(message, overrides=overrides)
+        if code == CODE_INSTANCE_NOT_FOUND:
+            # typed NotFound: the control plane positively answered "no such
+            # record" — liveness consumers may treat it as confirmed-gone
+            # without waiting out the consecutive-miss threshold. A
+            # route-level CODE_NOT_FOUND stays a plain CloudAPIError.
+            return InstanceNotFoundError(f"{code}: {message}")
         return CloudAPIError(f"{code or status}: {message}")
 
-    def _request(self, method: str, path: str, body: Optional[Dict] = None) -> Dict:
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict] = None,
+        idempotent: bool = True,
+    ) -> Dict:
+        from karpenter_tpu import metrics
+        from karpenter_tpu.resilience import current_budget, decorrelated_jitter
+
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
+        budget = current_budget.get()
+        allowance = self.deadline
+        if budget is not None:
+            allowance = min(allowance, max(budget.remaining(), 0.0))
+        start = time.monotonic()
+        backoffs = decorrelated_jitter(self.backoff_base, cap=2.0)
+
+        def pause(seconds: float) -> bool:
+            """Sleep toward the next attempt — unless the deadline would
+            pass first, in which case the current error is final."""
+            if time.monotonic() - start + seconds > allowance:
+                metrics.RESILIENCE_DEADLINE_EXCEEDED.labels(dependency="wire").inc()
+                return False
+            self.retries += 1
+            metrics.RESILIENCE_RETRIES.labels(dependency="wire").inc()
+            time.sleep(seconds)
+            return True
+
         for attempt in range(self.max_attempts):
             final = attempt + 1 >= self.max_attempts
             req = urllib.request.Request(url, data=data, method=method)
@@ -325,22 +391,22 @@ class _WireTransport:
                     payload = json.loads(e.read() or b"{}")
                 except Exception:
                     pass
-                code = (payload.get("error") or {}).get("code", "")
-                message = (payload.get("error") or {}).get("message", str(e))
+                error = payload.get("error") or {}
+                code = error.get("code", "")
+                message = error.get("message", str(e))
                 if e.code == 429 and not final:
-                    self.retries += 1
-                    time.sleep(float(e.headers.get("Retry-After") or self.backoff_base))
-                    continue
-                if e.code >= 500 and not final:
-                    self.retries += 1
-                    time.sleep(self.backoff_base * (2 ** attempt))
-                    continue
-                raise self._typed_error(code, message, e.code)
+                    # a throttle names its own pause; retried regardless of
+                    # idempotency (the server rejected it unprocessed)
+                    retry_after = float(e.headers.get("Retry-After") or self.backoff_base)
+                    if pause(retry_after):
+                        continue
+                elif e.code >= 500 and not final and idempotent:
+                    if pause(next(backoffs)):
+                        continue
+                raise self._typed_error(code, message, e.code, error.get("details"))
             except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-                if final:
+                if final or not idempotent or not pause(next(backoffs)):
                     raise self._typed_error("", f"transport: {e}", 0) from e
-                self.retries += 1
-                time.sleep(self.backoff_base * (2 ** attempt))
         raise AssertionError("unreachable: every final attempt raises or returns")
 
 
@@ -402,9 +468,11 @@ class HttpCloudAPI(_WireTransport):
                 for lt, it, z in overrides
             ],
             # one token per LOGICAL launch: transport retries replay the
-            # recorded result instead of launching a second instance
+            # recorded result instead of launching a second instance —
+            # which is what makes this POST idempotent for the transport's
+            # 5xx retry policy
             "clientToken": uuid.uuid4().hex,
-        })
+        }, idempotent=True)
         instances = [_from_dict(SimInstance, d) for d in body.get("instances", [])]
         errors = [
             (e["capacityType"], e["instanceType"], e["zone"])
@@ -513,21 +581,32 @@ class HttpGkeAPI(_WireTransport):
     EC2-style methods are deliberately NOT exposed here), with the GKE
     error vocabulary mapped back to ``GkeStockoutError`` / ``GkeApiError``."""
 
-    def _typed_error(self, code: str, message: str, status: int) -> Exception:
+    def _typed_error(
+        self, code: str, message: str, status: int, details: Optional[Dict] = None
+    ) -> Exception:
         from karpenter_tpu.cloudprovider.gke import GkeApiError, GkeStockoutError
 
         if code == CODE_STOCKOUT or CODE_STOCKOUT in message:
             return GkeStockoutError(message)
+        if status == 0 or status == 429 or status >= 500:
+            # transport failures and exhausted 5xx/429 retries are TRANSIENT:
+            # they must surface as a retryable error or the resilience
+            # layer would classify a dead control plane as a healthy
+            # deterministic answer and never trip its breaker
+            return CloudAPIError(f"{code or status}: {message}")
         return GkeApiError(f"{code or status}: {message}")
 
     def create_node_pool(self, machine_type: str, zone: str, spot: bool,
                          count: int, tpu_topology: str = ""):
         from karpenter_tpu.cloudprovider.gke import GkeInstance, GkeNodePool
 
+        # NOT idempotent: unlike /v1/fleet there is no client token or
+        # replay cache — a transport retry after a committed create would
+        # leave an orphaned (possibly multi-host TPU) pool behind
         d = self._request("POST", "/gke/v1/node-pools", {
             "machineType": machine_type, "zone": zone, "spot": spot,
             "count": count, "tpuTopology": tpu_topology,
-        })
+        }, idempotent=False)
         instances = [_from_dict(GkeInstance, i) for i in d.pop("instances", [])]
         pool = _from_dict(GkeNodePool, d)
         pool.instances = instances

@@ -1,10 +1,31 @@
-"""Latency decorator for cloud providers.
+"""Latency + resilience decorator for cloud providers.
 
 Mirrors ``pkg/cloudprovider/metrics/cloudprovider.go:37-93``: every
 ``CloudProvider`` method is wrapped in a duration histogram labeled
 {controller, method, provider}. The controller label comes from a
 contextvar the manager sets around each reconcile — the analog of the
 reference's context injection (``utils/injection/injection.go:72-84``).
+
+On top of the histograms, the decorator is where the resilience layer
+(karpenter_tpu/resilience) meets the cloud: each control-plane method gets
+
+- a :class:`~karpenter_tpu.resilience.CircuitBreaker` per
+  (provider, method) — a dead control plane costs one windowed burst of
+  failures, then callers fail fast (``BreakerOpen``) until a half-open
+  probe finds it healthy again;
+- a :class:`~karpenter_tpu.resilience.RetryPolicy` with decorrelated
+  jitter and a hard per-operation deadline, capped by the active
+  reconcile-round :class:`~karpenter_tpu.resilience.Budget`. Capacity
+  signals (``InsufficientCapacityError``/stockouts) and validation errors
+  are never retried — the ICE caches own those.
+
+``create`` is NOT retried here: a provider-level retry that lands after a
+partially-completed launch (fleet committed, follow-up describe flaked)
+would orphan an instance no Node object tracks. The only safe create
+retry is the wire transport's tokened fleet POST, which replays the
+recorded answer instead of launching twice; the metered layer contributes
+the breaker. The read-path methods (describe/poll) and the idempotent
+delete retry freely.
 """
 
 from __future__ import annotations
@@ -17,20 +38,47 @@ from karpenter_tpu import metrics
 from karpenter_tpu.api.objects import Node
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
+from karpenter_tpu.resilience import BreakerBoard, BreakerOpen, RetryPolicy
 
 # Which controller's reconcile (or worker loop) is currently executing.
 reconciling_controller: contextvars.ContextVar[str] = contextvars.ContextVar(
     "reconciling_controller", default=""
 )
 
+# breaker defaults: a 10%-error chaos regime must NOT trip (windowed rate
+# well under 0.5); a dead dependency trips within min_volume calls
+BREAKER_WINDOW = 20
+BREAKER_MIN_VOLUME = 5
+BREAKER_FAILURE_RATE = 0.5
+BREAKER_OPEN_SECONDS = 10.0
+
 
 class MeteredCloudProvider(CloudProvider):
     """Wraps a provider so Create/Delete/GetInstanceTypes are all observed
-    (reference: metrics/cloudprovider.go:66-93; replaces the round-1 inline
-    timing that only covered create)."""
+    (reference: metrics/cloudprovider.go:66-93) and all pass through the
+    per-method breaker + retry policy."""
 
-    def __init__(self, delegate: CloudProvider):
+    def __init__(self, delegate: CloudProvider, resilience: bool = True):
         self.delegate = delegate
+        self.resilient = resilience
+        self.breakers = BreakerBoard(
+            window=BREAKER_WINDOW,
+            min_volume=BREAKER_MIN_VOLUME,
+            failure_rate=BREAKER_FAILURE_RATE,
+            open_seconds=BREAKER_OPEN_SECONDS,
+        )
+        name = delegate.name()
+        self._policies: Dict[str, RetryPolicy] = {
+            # max_attempts=1: breaker only — see the module docstring
+            "create": RetryPolicy(max_attempts=1, deadline=20.0,
+                                  dependency=f"{name}:create"),
+            "delete": RetryPolicy(max_attempts=3, deadline=15.0,
+                                  dependency=f"{name}:delete"),
+            "get_instance_types": RetryPolicy(max_attempts=3, deadline=15.0,
+                                              dependency=f"{name}:get_instance_types"),
+            "poll_disruptions": RetryPolicy(max_attempts=2, deadline=5.0,
+                                            dependency=f"{name}:poll_disruptions"),
+        }
 
     def _observe(self, method: str, start: float) -> None:
         metrics.CLOUDPROVIDER_DURATION.labels(
@@ -39,35 +87,61 @@ class MeteredCloudProvider(CloudProvider):
             provider=self.delegate.name(),
         ).observe(time.perf_counter() - start)
 
-    def create(self, request: NodeRequest) -> Node:
+    def _guarded(self, method: str, fn, *args):
+        """breaker(retry(fn)): the retry absorbs transient flakes inside ONE
+        logical call; the breaker sees the logical outcome, so a dependency
+        that only ever succeeds via retries still counts as healthy."""
         start = time.perf_counter()
         try:
-            return self.delegate.create(request)
+            if not self.resilient:
+                return fn(*args)
+            breaker = self.breakers.get(f"{self.delegate.name()}:{method}")
+            if not breaker.allow():
+                raise BreakerOpen(breaker.dependency, breaker.open_seconds)
+            try:
+                result = self._policies[method].call(fn, *args)
+            except BreakerOpen:
+                raise
+            except Exception as e:
+                # breaker state tracks AVAILABILITY: a deterministic answer
+                # (ICE/stockout, validation) means the dependency responded —
+                # an ICE storm must sideline offerings (the 45s cache), never
+                # open the create breaker and block the recovery launches
+                from karpenter_tpu.resilience import default_retryable
+
+                if default_retryable(e):
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+                raise
+            breaker.record_success()
+            return result
         finally:
-            self._observe("create", start)
+            self._observe(method, start)
+
+    def create(self, request: NodeRequest) -> Node:
+        return self._guarded("create", self.delegate.create, request)
 
     def delete(self, node: Node) -> None:
-        start = time.perf_counter()
-        try:
-            return self.delegate.delete(node)
-        finally:
-            self._observe("delete", start)
+        return self._guarded("delete", self.delegate.delete, node)
 
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
-        start = time.perf_counter()
-        try:
-            return self.delegate.get_instance_types(provider)
-        finally:
-            self._observe("get_instance_types", start)
+        return self._guarded("get_instance_types", self.delegate.get_instance_types, provider)
 
     def poll_disruptions(self):
         """The DisruptionSource poll is a real control-plane call for wire
-        providers — observe it like create/delete."""
-        start = time.perf_counter()
+        providers — observe it like create/delete. An open breaker yields
+        an empty poll, not an exception: the interruption loop keeps its
+        cadence and picks the stream back up when the breaker closes."""
         try:
-            return self.delegate.poll_disruptions()
-        finally:
-            self._observe("poll_disruptions", start)
+            return self._guarded("poll_disruptions", self.delegate.poll_disruptions)
+        except BreakerOpen:
+            return []
+
+    def instance_gone(self, node: Node) -> Optional[bool]:
+        # liveness probes carry their own miss-threshold debouncing; a
+        # breaker/retry layer here would only delay the reset-on-sighting
+        return self.delegate.instance_gone(node)
 
     # webhook hooks + name pass through unmetered, as in the reference
     def default(self, constraints: Constraints) -> None:
@@ -80,8 +154,8 @@ class MeteredCloudProvider(CloudProvider):
         return self.delegate.name()
 
 
-def decorate(provider: CloudProvider) -> CloudProvider:
+def decorate(provider: CloudProvider, resilience: bool = True) -> CloudProvider:
     """Idempotent wrap (reference: metrics.Decorate)."""
     if isinstance(provider, MeteredCloudProvider):
         return provider
-    return MeteredCloudProvider(provider)
+    return MeteredCloudProvider(provider, resilience=resilience)

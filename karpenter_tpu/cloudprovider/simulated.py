@@ -63,17 +63,37 @@ CREATE_FLEET_BURST = 100
 # (reference: aws/instance.go:84-91 retries 6x)
 DESCRIBE_RETRIES = 6
 
+# Consecutive describe responses an instance id must be missing from before
+# the node-liveness consumer may declare it gone — describe_instances drops
+# unknown ids silently, so one chaotic response must not orphan a node
+LIVENESS_MISS_THRESHOLD = 3
+
 DEFAULT_IMAGE_FAMILY = "standard"
 DEFAULT_SELECTOR = {"purpose": "nodes"}
 IMAGE_FAMILIES = ("standard", "minimal", "gpu")
 
 
 class InsufficientCapacityError(Exception):
-    """The fleet request could not be satisfied for any override."""
+    """The fleet request could not be satisfied for any override.
+
+    ``overrides`` carries the (capacity_type, instance_type, zone) triples
+    that errored, so the caller's ICE cache can mark exactly the exhausted
+    pools — an all-ICE fleet answer is a typed capacity condition, not an
+    empty result indistinguishable from an empty-override bug."""
+
+    def __init__(self, message: str, overrides: Sequence[Tuple[str, str, str]] = ()):
+        super().__init__(message)
+        self.overrides = list(overrides)
 
 
 class CloudAPIError(Exception):
     """Injected control-plane failure."""
+
+
+class InstanceNotFoundError(CloudAPIError):
+    """Typed NotFound: the control plane positively confirmed it has no
+    record of the instance (as opposed to dropping the id from one flaky
+    describe response)."""
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +249,13 @@ class SimCloudAPI:
                 )
                 self.instances[inst.id] = inst
                 return [inst], errors
+        if errors:
+            # EVERY override hit an exhausted pool: surface it typed (with
+            # the pools) instead of an empty result a caller could mistake
+            # for an empty-override bug
+            raise InsufficientCapacityError(
+                f"all {len(errors)} overrides insufficient", overrides=errors
+            )
         return [], errors
 
     def describe_instances(self, ids: List[str]) -> List[SimInstance]:
@@ -742,12 +769,21 @@ class InstanceProvider:
             )
         if not self.fleet_limiter.take(timeout=60):
             raise CloudAPIError("fleet request rate budget exhausted (2 QPS/100 burst)")
-        instances, errors = self.api.create_fleet(capacity_type, overrides)
+        try:
+            instances, errors = self.api.create_fleet(capacity_type, overrides)
+        except InsufficientCapacityError as e:
+            # the typed all-ICE answer (in-process raise, or the wire's 409
+            # with details): cache out exactly the pools the control plane
+            # reported exhausted, then let the capacity error propagate
+            for ct, itype, zone in e.overrides:
+                self.instance_types.unavailable.mark_unavailable(ct, itype, zone)
+            raise
         for ct, itype, zone in errors:
             self.instance_types.unavailable.mark_unavailable(ct, itype, zone)
         if not instances:
             raise InsufficientCapacityError(
-                f"fleet returned no instances ({len(errors)} unavailable pools)"
+                f"fleet returned no instances ({len(errors)} unavailable pools)",
+                overrides=errors,
             )
         instance = self._describe_with_retry(instances[0].id)
         return self._to_node(instance, options)
@@ -838,6 +874,9 @@ class SimulatedCloudProvider(CloudProvider):
             self.subnet_provider,
             self.launch_template_provider,
         )
+        from karpenter_tpu.resilience import MissTracker
+
+        self._liveness = MissTracker(threshold=LIVENESS_MISS_THRESHOLD)
 
     def create(self, request: NodeRequest) -> Node:
         config = SimProviderConfig.deserialize(request.template.provider)
@@ -871,6 +910,32 @@ class SimulatedCloudProvider(CloudProvider):
         identically against the in-process ``SimCloudAPI`` and the HTTP
         client's ``GET /v1/events``)."""
         return self.api.poll_disruptions()
+
+    def instance_gone(self, node: Node) -> Optional[bool]:
+        """Node liveness with flake debouncing. ``describe_instances``
+        silently drops unknown ids, so a single missing id is ambiguous:
+        flaky response or terminated instance? A ``terminated`` state (or a
+        typed NotFound) answers True immediately; a bare miss answers True
+        only after LIVENESS_MISS_THRESHOLD consecutive misses; an errored
+        describe answers None (unknown) without advancing the count."""
+        instance_id = node.spec.provider_id.rsplit("/", 1)[-1]
+        try:
+            found = self.api.describe_instances([instance_id])
+        except InstanceNotFoundError:
+            self._liveness.forget(instance_id)
+            return True
+        except Exception:
+            return None  # the probe failed; that is not a miss
+        if found:
+            if found[0].state == "terminated":
+                self._liveness.forget(instance_id)
+                return True
+            self._liveness.observe(instance_id, present=True)
+            return False
+        gone = self._liveness.observe(instance_id, present=False)
+        if gone:
+            self._liveness.forget(instance_id)
+        return gone
 
     def name(self) -> str:
         return "simulated"

@@ -103,5 +103,17 @@ class CloudProvider(abc.ABC):
         Default: this vendor has no disruption stream."""
         return []
 
+    def instance_gone(self, node: Node):
+        """Liveness probe for the instance backing ``node``: True when the
+        cloud has confirmed it is gone (terminated state, a typed NotFound,
+        or enough consecutive describe misses to rule out a flaky
+        response), False when it is alive, None when the probe itself
+        failed this time (unknown — the consumer keeps its cadence), and
+        ``NotImplemented`` when this vendor has no describe surface at all
+        (the consumer opts the node out of liveness probing). One missing
+        id in one flaky describe must NOT answer True — see
+        resilience.MissTracker."""
+        return NotImplemented
+
     def name(self) -> str:
         return type(self).__name__.lower()

@@ -92,12 +92,18 @@ class InterruptionController:
         return self._enforce_deadline(key)
 
     def _poll(self) -> float:
-        for notice in self.cloud_provider.poll_disruptions():
-            try:
-                self.handle_notice(notice)
-            except Exception:
-                # one malformed/raced notice must not stall the stream
-                logger.exception("handling disruption notice %r", notice)
+        # budget the poll round so the wire client's retries cannot stall
+        # the notice stream past its own cadence (resilience/policy.py);
+        # an open poll breaker yields an empty drain, not an exception
+        from karpenter_tpu.resilience import Budget
+
+        with Budget(max(self.poll_interval * 2.0, 1.0)).activate():
+            for notice in self.cloud_provider.poll_disruptions():
+                try:
+                    self.handle_notice(notice)
+                except Exception:
+                    # one malformed/raced notice must not stall the stream
+                    logger.exception("handling disruption notice %r", notice)
         return self.poll_interval
 
     def handle_notice(self, notice: DisruptionNotice) -> None:

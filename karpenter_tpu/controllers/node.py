@@ -141,15 +141,84 @@ class Finalizer:
         return None
 
 
+class CloudLiveness:
+    """Detect instances terminated out from under their Node objects.
+
+    Asks the provider's ``instance_gone`` probe — which debounces describe
+    flakes behind an N-consecutive-miss tracker (resilience.MissTracker),
+    so one chaotic describe response can never orphan a healthy node —
+    and hands a confirmed-gone node to the termination path. Providers
+    without a describe surface answer ``NotImplemented`` and opt the whole
+    sub-reconciler out (no requeue pressure); a probe that merely failed
+    this time answers None and keeps its cadence."""
+
+    PROBE_INTERVAL = 30.0
+
+    def __init__(self, cluster: Cluster, cloud_provider):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self._last_probe: dict = {}
+        self._last_sweep: Optional[float] = None
+
+    def _sweep(self, now: float) -> None:
+        """Nodes terminated by OTHER controllers (consolidation, expiration,
+        interruption) never hit this sub-reconciler's own cleanup paths;
+        sweep their probe stamps so a churning spot fleet can't grow the
+        table for the process lifetime. Time-gated: on fleets larger than
+        the threshold the table legitimately stays big, and a full scan per
+        reconcile would be O(N²) per round."""
+        if len(self._last_probe) <= 256:
+            return
+        if self._last_sweep is not None and now - self._last_sweep < self.PROBE_INTERVAL:
+            return
+        self._last_sweep = now
+        live = {n.metadata.name for n in self.cluster.nodes()}
+        for name in list(self._last_probe):
+            if name not in live:
+                del self._last_probe[name]
+
+    def reconcile(self, provisioner: Provisioner, node: Node) -> Optional[float]:
+        if self.cloud_provider is None or node.metadata.deletion_timestamp is not None:
+            return None
+        now = self.cluster.clock()
+        self._sweep(now)
+        last = self._last_probe.get(node.metadata.name)
+        if last is not None and now - last < self.PROBE_INTERVAL:
+            return self.PROBE_INTERVAL - (now - last)
+        self._last_probe[node.metadata.name] = now
+        try:
+            gone = self.cloud_provider.instance_gone(node)
+        except Exception:
+            logger.debug("liveness probe failed for %s", node.metadata.name, exc_info=True)
+            return self.PROBE_INTERVAL
+        if gone is NotImplemented:  # vendor has no liveness surface at all
+            self._last_probe.pop(node.metadata.name, None)
+            return None
+        if gone is None:
+            # the probe itself failed this time — KEEP the cadence: one
+            # flaky describe must not permanently halt liveness monitoring
+            return self.PROBE_INTERVAL
+        if gone:
+            logger.info(
+                "Triggering termination for node %s: backing instance confirmed gone",
+                node.metadata.name,
+            )
+            self._last_probe.pop(node.metadata.name, None)
+            self.cluster.delete("nodes", node.metadata.name, namespace="")
+            return None
+        return self.PROBE_INTERVAL
+
+
 class NodeController:
     """reference: node/controller.go:42-150."""
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, cloud_provider=None):
         self.cluster = cluster
         self.initialization = Initialization(cluster)
         self.expiration = Expiration(cluster)
         self.emptiness = Emptiness(cluster)
         self.finalizer = Finalizer()
+        self.liveness = CloudLiveness(cluster, cloud_provider)
 
     def reconcile(self, name: str) -> Optional[float]:
         live = self.cluster.try_get("nodes", name, namespace="")
@@ -168,7 +237,8 @@ class NodeController:
         node = copy.deepcopy(live)
         before = _snapshot(live)
         results: List[Optional[float]] = []
-        for sub in (self.initialization, self.expiration, self.emptiness, self.finalizer):
+        for sub in (self.initialization, self.expiration, self.emptiness,
+                    self.finalizer, self.liveness):
             results.append(sub.reconcile(provisioner, node))
             # a sub-reconciler may delete the node (finalizer-bearing nodes
             # stay in the store but start terminating); stop touching it then

@@ -41,6 +41,12 @@ logger = logging.getLogger("karpenter.provisioning")
 # catalog drift (provisioning/controller.go:82).
 REQUEUE_INTERVAL = 300.0
 
+# Wall-clock allowance for one provision round (catalog → solve → launches):
+# the resilience layer's retry deadlines are capped by what remains of this,
+# so a flaky control plane degrades the round as a whole instead of every
+# call independently stacking its own worst case (resilience/policy.py).
+PROVISION_ROUND_BUDGET = 60.0
+
 
 # Re-verification between enqueue and solve (reference: provisioner.go:121-134
 # and selection/controller.go:117-123 share this predicate).
@@ -69,6 +75,11 @@ class ProvisionerWorker:
         self.batcher = batcher or Batcher()
         self._pending_lock = threading.Lock()
         self._pending_keys: set = set()
+        # keys a failed launch re-queued THIS round: provision_once's
+        # cleanup must not strip their pending state while they sit in the
+        # batcher, or selection's verify requeue would re-relax preferences
+        # the pods never needed to give up
+        self._requeued_keys: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # set once the TPU solver warmup finished (success or failure) —
@@ -86,32 +97,52 @@ class ProvisionerWorker:
 
     def _warmup(self) -> None:
         try:
-            from karpenter_tpu.cloudprovider.metrics import reconciling_controller
-            from karpenter_tpu.testing.factories import make_pod
-
-            reconciling_controller.set("provisioning")
-
-            instance_types = self.cloud_provider.get_instance_types(
-                self.provisioner.spec.constraints.provider
-            )
-            # on a real accelerator, warm the FULL batch bucket (the batcher
-            # caps batches at max_items, so the first event storm solves in
-            # that shape bucket — warming only a tiny bucket would leave the
-            # storm to pay the multi-second compile); CPU test runs keep the
-            # small bucket, their scan-kernel compile at 2048 is too slow
-            from karpenter_tpu.solver.pallas_kernel import pallas_available
-
-            n_warm = self.batcher.max_items if pallas_available() else 4
-            pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(n_warm)]
-            self.scheduler.solve(self.provisioner, instance_types, pods)
-            logger.debug(
-                "solver warmed for provisioner %s (%d-pod bucket)",
-                self.provisioner.name, n_warm,
-            )
-        except Exception:
-            logger.exception("solver warmup failed (first batch will compile)")
+            # one background retry: a transient first-compile failure (TPU
+            # not plumbed yet, catalog call flake) must not make the first
+            # real batch eat the compile storm
+            for attempt in (1, 2):
+                try:
+                    self._warmup_once()
+                    return
+                except Exception:
+                    metrics.SOLVER_WARMUP_FAILURES.inc()
+                    if attempt == 2 or self._stop.is_set():
+                        logger.exception(
+                            "solver warmup failed (first batch will compile)"
+                        )
+                        return
+                    logger.exception(
+                        "solver warmup failed; retrying once in background"
+                    )
+                    self._stop.wait(1.0)
+                    if self._stop.is_set():  # shutdown mustn't pay a compile
+                        return
         finally:
             self.warmed.set()
+
+    def _warmup_once(self) -> None:
+        from karpenter_tpu.cloudprovider.metrics import reconciling_controller
+        from karpenter_tpu.testing.factories import make_pod
+
+        reconciling_controller.set("provisioning")
+
+        instance_types = self.cloud_provider.get_instance_types(
+            self.provisioner.spec.constraints.provider
+        )
+        # on a real accelerator, warm the FULL batch bucket (the batcher
+        # caps batches at max_items, so the first event storm solves in
+        # that shape bucket — warming only a tiny bucket would leave the
+        # storm to pay the multi-second compile); CPU test runs keep the
+        # small bucket, their scan-kernel compile at 2048 is too slow
+        from karpenter_tpu.solver.pallas_kernel import pallas_available
+
+        n_warm = self.batcher.max_items if pallas_available() else 4
+        pods = [make_pod(requests={"cpu": "0.1"}) for _ in range(n_warm)]
+        self.scheduler.solve(self.provisioner, instance_types, pods)
+        logger.debug(
+            "solver warmed for provisioner %s (%d-pod bucket)",
+            self.provisioner.name, n_warm,
+        )
 
     def stop(self) -> None:
         self._stop.set()
@@ -170,13 +201,23 @@ class ProvisionerWorker:
             if not pods:
                 return []
             metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
-            instance_types = self.cloud_provider.get_instance_types(
-                self.provisioner.spec.constraints.provider
-            )
-            nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
-            # parallel launch per virtual node (reference: provisioner.go:113)
-            with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
-                launched = list(pool.map(self._launch, nodes))
+            # one time budget for the whole round: catalog, solve, and every
+            # launch's retries all draw down the same allowance
+            from karpenter_tpu.resilience import Budget
+
+            budget = Budget(PROVISION_ROUND_BUDGET)
+            with budget.activate():
+                instance_types = self.cloud_provider.get_instance_types(
+                    self.provisioner.spec.constraints.provider
+                )
+                nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
+                # parallel launch per virtual node (reference: provisioner.go:113)
+                with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
+                    # executor threads don't inherit contextvars: each launch
+                    # re-activates the SHARED round budget in its own thread
+                    launched = list(
+                        pool.map(lambda v: self._launch(v, budget), nodes)
+                    )
             if any(launched):  # only actual creations count as a scale event
                 from karpenter_tpu.kube import serde
 
@@ -193,15 +234,24 @@ class ProvisionerWorker:
             return nodes
         finally:
             with self._pending_lock:
-                self._pending_keys -= set(batch_keys)
+                # fast-requeued pods are back in the batcher: keep them
+                # pending so is_pending() holds through the next round
+                self._pending_keys -= set(batch_keys) - self._requeued_keys
+                self._requeued_keys.clear()
             self.batcher.flush()
 
-    def _launch(self, vnode: VirtualNode) -> bool:
+    def _launch(self, vnode: VirtualNode, budget=None) -> bool:
         """Returns whether a node was actually created."""
+        from contextlib import nullcontext
+
         from karpenter_tpu.cloudprovider.metrics import reconciling_controller
 
         # executor threads don't inherit the worker's context
         reconciling_controller.set("provisioning")
+        with budget.activate() if budget is not None else nullcontext():
+            return self._launch_one(vnode)
+
+    def _launch_one(self, vnode: VirtualNode) -> bool:
         try:
             # fresh limits check against live status (reference:
             # provisioner.go:138-144 re-reads the provisioner)
@@ -253,6 +303,16 @@ class ProvisionerWorker:
                 "Provisioner", self.provisioner.name, "LaunchFailed",
                 "node launch failed; see controller logs", type="Warning",
             )
+            # fast retry: the pods are still provisionable — re-enter the
+            # batcher for the NEXT round (paced by the batch idle window)
+            # instead of stalling a full selection requeue period per
+            # transient launch failure; provision_once's key dedupe absorbs
+            # any concurrent selection re-submit of the same pods
+            for pod in vnode.pods:
+                if is_provisionable(pod):
+                    self.add(pod)
+                    with self._pending_lock:
+                        self._requeued_keys.add(pod.key)
             return False
 
     def _bind(self, pods: List[Pod], node_name: str) -> None:

@@ -202,7 +202,7 @@ def build_runtime(
     interruption = InterruptionController(
         cluster, cloud_provider, provisioning=provisioning, termination=termination
     )
-    node = NodeController(cluster)
+    node = NodeController(cluster, cloud_provider=cloud_provider)
     consolidation = ConsolidationController(
         cluster,
         cloud_provider,

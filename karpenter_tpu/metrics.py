@@ -179,6 +179,58 @@ INTERRUPTION_REPLACEMENT_LEAD_TIME = Histogram(
     registry=REGISTRY,
 )
 
+# Resilience layer (karpenter_tpu/resilience): every dependency the
+# controllers talk to — cloud control plane, HTTP wire, solver service —
+# shares one retry/breaker vocabulary, and its state must be scrapeable.
+RESILIENCE_BREAKER_STATE = Gauge(
+    "breaker_state",
+    "Circuit breaker state per dependency: 0 closed, 1 open, 2 half-open.",
+    ["dependency"],
+    namespace=NAMESPACE,
+    subsystem="resilience",
+    registry=REGISTRY,
+)
+
+RESILIENCE_RETRIES = Counter(
+    "retries_total",
+    "Retried operations, by dependency.",
+    ["dependency"],
+    namespace=NAMESPACE,
+    subsystem="resilience",
+    registry=REGISTRY,
+)
+
+RESILIENCE_DEADLINE_EXCEEDED = Counter(
+    "deadline_exceeded_total",
+    "Operations abandoned because the retry deadline (or the reconcile-round "
+    "budget) ran out before the attempts did.",
+    ["dependency"],
+    namespace=NAMESPACE,
+    subsystem="resilience",
+    registry=REGISTRY,
+)
+
+# Solver degradation: batches that fell back to the host FFD scheduler
+# because the accelerated path was broken (breaker open) or failed mid-solve.
+SOLVER_DEGRADED = Counter(
+    "degraded_solves_total",
+    "Solves served by the FFD fallback because the accelerated path was "
+    "unavailable, by reason (breaker_open/pack_failure).",
+    ["reason"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_WARMUP_FAILURES = Counter(
+    "warmup_failures_total",
+    "Provisioner-worker solver warmup attempts that failed (the first real "
+    "batch pays the compile when the background retry also fails).",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
 SOLVER_BATCH_SIZE = Histogram(
     "batch_size_pods",
     "Pods per solver batch.",

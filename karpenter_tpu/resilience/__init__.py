@@ -1,0 +1,36 @@
+"""Unified failure handling for the provisioning loop's I/O seams.
+
+The reference survives AWS throttling and ICE storms with per-call backoff
+(aws/instance.go retries, the 45s unavailable-offerings cache); this package
+makes that posture a first-class, observable subsystem shared by every
+dependency the controllers talk to — the cloud control plane, the HTTP wire,
+and the solver service:
+
+- :class:`RetryPolicy` — decorrelated-jitter exponential backoff with a hard
+  per-operation deadline (and a hook into the ambient :class:`Budget`).
+- :class:`CircuitBreaker` — closed/open/half-open per dependency, tripping on
+  a windowed failure rate so a dead dependency costs one bounded failure,
+  not one per call.
+- :class:`Budget` — a per-reconcile-round time budget the callers consume;
+  retry deadlines never outlive the round that issued them.
+- :class:`MissTracker` — N-consecutive-miss liveness accounting, so one
+  flaky describe can't orphan a healthy node.
+
+The chaos harness that proves all of this works lives in
+``karpenter_tpu/testing/chaos.py``; policy defaults and the thresholds are
+documented in ``docs/resilience.md``.
+"""
+
+from karpenter_tpu.resilience.breaker import (  # noqa: F401
+    BreakerBoard,
+    BreakerOpen,
+    CircuitBreaker,
+)
+from karpenter_tpu.resilience.liveness import MissTracker  # noqa: F401
+from karpenter_tpu.resilience.policy import (  # noqa: F401
+    Budget,
+    RetryPolicy,
+    current_budget,
+    decorrelated_jitter,
+    default_retryable,
+)

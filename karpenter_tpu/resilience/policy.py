@@ -1,0 +1,174 @@
+"""Retry policy and time budgets.
+
+Backoff is the AWS-recommended *decorrelated jitter*: each sleep is drawn
+uniformly from ``[base, 3 * previous_sleep]`` and capped, which spreads a
+thundering herd of retries across the window instead of synchronizing it the
+way plain exponential backoff does. Every operation also carries a hard
+deadline — a flaky dependency may cost retries, never an unbounded stall —
+and the deadline is further capped by the ambient per-reconcile-round
+:class:`Budget` when one is active.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import time
+from typing import Callable, Iterator, Optional
+
+from karpenter_tpu import metrics
+
+
+# The reconcile round currently executing, when the caller activated one.
+# RetryPolicy caps its per-operation deadline by the budget's remaining
+# time, so retries never outlive the round that issued them.
+current_budget: contextvars.ContextVar[Optional["Budget"]] = contextvars.ContextVar(
+    "resilience_budget", default=None
+)
+
+
+class Budget:
+    """A wall-clock allowance for one reconcile round.
+
+    One Budget object is shared by everything the round does (the launch
+    thread pool re-activates it per thread): ``remaining()`` is global to
+    the round, so a retry storm in one launch consumes the same allowance
+    a slow solve does — the round degrades as a whole instead of each call
+    independently stacking its own worst case.
+    """
+
+    def __init__(self, seconds: float, clock: Callable[[], float] = time.monotonic):
+        self.seconds = float(seconds)
+        self._clock = clock
+        self._deadline = clock() + self.seconds
+
+    def remaining(self) -> float:
+        return max(self._deadline - self._clock(), 0.0)
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def activate(self) -> "_BudgetContext":
+        """Install this budget as the calling thread's ambient budget
+        (``with budget.activate(): ...``). Each thread activates its own
+        context; the underlying deadline is shared."""
+        return _BudgetContext(self)
+
+
+class _BudgetContext:
+    def __init__(self, budget: Budget):
+        self._budget = budget
+        self._token: Optional[contextvars.Token] = None
+
+    def __enter__(self) -> Budget:
+        self._token = current_budget.set(self._budget)
+        return self._budget
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            current_budget.reset(self._token)
+
+
+def decorrelated_jitter(
+    base: float,
+    cap: float,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Endless sleep sequence: ``sleep = min(cap, uniform(base, 3 * prev))``."""
+    rng = rng or random
+    sleep = base
+    while True:
+        sleep = min(cap, rng.uniform(base, sleep * 3))
+        yield sleep
+
+
+# Exceptions that retrying cannot fix: capacity signals (the ICE caches own
+# those), positive not-found answers, validation/programming errors.
+# Everything else — throttles, injected control-plane failures, connection
+# resets — is presumed transient. Vendor errors are matched by name so this
+# module needs no dependency on any provider.
+_NON_RETRYABLE_NAMES = frozenset(
+    {
+        "InsufficientCapacityError",
+        "GkeStockoutError",
+        "GkeApiError",
+        "InstanceNotFoundError",
+    }
+)
+
+
+def default_retryable(exc: BaseException) -> bool:
+    if isinstance(exc, (ValueError, TypeError, KeyError)):
+        return False
+    for cls in type(exc).__mro__:
+        if cls.__name__ in _NON_RETRYABLE_NAMES:
+            return False
+    return True
+
+
+class RetryPolicy:
+    """Bounded retries with decorrelated jitter and a hard deadline.
+
+    ``call(fn)`` runs ``fn`` up to ``max_attempts`` times. A retry happens
+    only when ``retryable(exc)`` says so AND the next backoff sleep still
+    fits inside the per-operation deadline (further capped by the active
+    round :class:`Budget`); otherwise the last exception propagates. The
+    ``dependency`` label feeds the ``retries_total`` /
+    ``deadline_exceeded_total`` counters.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base: float = 0.05,
+        cap: float = 2.0,
+        deadline: float = 15.0,
+        retryable: Callable[[BaseException], bool] = default_retryable,
+        dependency: str = "",
+        rng: Optional[random.Random] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base = base
+        self.cap = cap
+        self.deadline = deadline
+        self.retryable = retryable
+        self.dependency = dependency
+        self._rng = rng
+        self._clock = clock
+        self._sleep = sleep
+
+    def effective_deadline(self) -> float:
+        """Seconds this operation may spend: the policy deadline, capped by
+        the active round budget (if any). The first attempt always runs —
+        an exhausted budget degrades to retry-free, not to no work."""
+        budget = current_budget.get()
+        if budget is None:
+            return self.deadline
+        return min(self.deadline, max(budget.remaining(), 0.0))
+
+    def call(self, fn: Callable, *args, **kwargs):
+        start = self._clock()
+        allowance = self.effective_deadline()
+        backoffs = decorrelated_jitter(self.base, self.cap, self._rng)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classification decides
+                last = e
+                if attempt + 1 >= self.max_attempts or not self.retryable(e):
+                    raise
+                pause = next(backoffs)
+                if self._clock() - start + pause > allowance:
+                    metrics.RESILIENCE_DEADLINE_EXCEEDED.labels(
+                        dependency=self.dependency or "unknown"
+                    ).inc()
+                    raise
+                metrics.RESILIENCE_RETRIES.labels(
+                    dependency=self.dependency or "unknown"
+                ).inc()
+                self._sleep(pause)
+        raise last if last is not None else AssertionError("unreachable")

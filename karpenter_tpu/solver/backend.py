@@ -47,6 +47,13 @@ logger = logging.getLogger("karpenter.solver")
 REMOTE_SOLVE_TIMEOUT = 5.0
 REMOTE_BREAKER_SECONDS = 30.0
 
+# Per-shape-class pack breaker: two failures of a shape class open it and
+# its solves route straight to the FFD fallback (no failure latency per
+# batch) until a half-open probe finds the accelerated path healthy again.
+PACK_BREAKER_WINDOW = 6
+PACK_BREAKER_MIN_VOLUME = 2
+PACK_BREAKER_OPEN_SECONDS = 30.0
+
 # (P, S, F, n_max) whose fused compile/run failed — those shapes take the
 # unfused ladder from then on (mirrors pallas_kernel._pallas_failed_shapes)
 _fused_failed_shapes: set = set()
@@ -103,7 +110,26 @@ class TpuScheduler:
         self.service_address = service_address
         self._remote = None
         self._remote_init_lock = threading.Lock()
-        self._remote_down_until = 0.0  # circuit breaker after RPC failure
+        # circuit breaker after RPC failure (resilience layer): window 1 /
+        # min_volume 1 keeps the round-1 contract — a dead sidecar trips on
+        # ANY failure, success history notwithstanding, and costs one
+        # bounded stall, not one per batch (half-open probes re-admit it)
+        from karpenter_tpu.resilience import BreakerBoard, CircuitBreaker
+
+        self._remote_breaker = CircuitBreaker(
+            dependency=f"solver-service:{service_address}" if service_address else "",
+            window=1, min_volume=1, failure_rate=0.5,
+            open_seconds=REMOTE_BREAKER_SECONDS,
+        )
+        # per-shape-class breakers over the whole accelerated pack: a shape
+        # whose device AND native paths keep failing degrades to FFD
+        # immediately instead of re-paying the failure latency every solve
+        self._pack_breakers = BreakerBoard(
+            window=PACK_BREAKER_WINDOW,
+            min_volume=PACK_BREAKER_MIN_VOLUME,
+            failure_rate=0.5,
+            open_seconds=PACK_BREAKER_OPEN_SECONDS,
+        )
         # solve-invariant encode state (signature table, capacity matrix),
         # reused across this worker's batches; the lock covers the rare
         # concurrent solve (warmup thread vs first real batch)
@@ -153,9 +179,7 @@ class TpuScheduler:
                     # elapsed time — a fast-failing backend would otherwise
                     # win the EMA and pin every future solve to the broken
                     # path. Probes rehabilitate it once it works again.
-                    from karpenter_tpu.solver.router import FAILURE_PENALTY_S
-
-                    self.router.record(key, backend, FAILURE_PENALTY_S)
+                    self.router.record_failure(key, backend)
                     if backend != "native":
                         raise  # the device ladder already ends in lax.scan
                     # containment parity with the old pack_best ladder: a
@@ -331,7 +355,7 @@ class TpuScheduler:
 
         if os.environ.get("KARPENTER_PACKER", "auto").lower() not in ("auto", "fused"):
             return None
-        if self.service_address and time.monotonic() >= self._remote_down_until:
+        if self.service_address and self._remote_breaker.available():
             return None
         from karpenter_tpu.solver import fused
         from karpenter_tpu.solver.pallas_kernel import (
@@ -408,7 +432,7 @@ class TpuScheduler:
     ) -> kernel.PackResult:
         prof = self.last_profile if prof is None else prof
         r = args[6].shape[1]  # pod_req
-        if self.service_address and time.monotonic() >= self._remote_down_until:
+        if self.service_address and self._remote_breaker.allow():
             try:
                 if self._remote is None:
                     from karpenter_tpu.solver.service import RemoteSolver
@@ -421,19 +445,21 @@ class TpuScheduler:
                                 self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
                             )
                 result = self._remote.pack(*args, n_max=n_max)
+                self._remote_breaker.record_success()
                 # unconditional: the gauge is process-global per address, and
                 # another scheduler instance (worker hot-swap, second
                 # provisioner) may have set it
                 metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(0)
-                self._remote_down_until = 0.0
                 prof["packer_backend"] = "device"  # sidecar owns the chip
                 return result
             except Exception as e:
                 # open the circuit: a dead sidecar must not stall every
-                # batch for a full RPC deadline
-                self._remote_down_until = time.monotonic() + REMOTE_BREAKER_SECONDS
+                # batch for a full RPC deadline; half-open probes re-admit
+                # it once it answers again
+                tripped = self._remote_breaker.record_failure()
                 metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(1)
-                metrics.SOLVER_BREAKER_TRIPS.labels(address=self.service_address).inc()
+                if tripped:
+                    metrics.SOLVER_BREAKER_TRIPS.labels(address=self.service_address).inc()
                 logger.error(
                     "solver service %s failed (%s); in-process kernel for %.0fs",
                     self.service_address, e, REMOTE_BREAKER_SECONDS,
@@ -484,22 +510,49 @@ class TpuScheduler:
                 batch = self._encode_retry(constraints, instance_types, pods, daemon, plan)
             except SignatureOverflow as e:
                 logger.warning("falling back to FFD: %s", e)
-                saved = snapshot_selectors(pods)
-                try:
-                    plan.materialize(list(pods))
-                    return self._ffd_fallback.solve_injected(
-                        constraints, instance_types, pods, daemon
-                    )
-                finally:
-                    restore_selectors(pods, saved)
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             prof["encode_s"] = time.perf_counter() - t0
+            # the shape class's pack breaker: while open, the batch routes
+            # to FFD immediately — pods still schedule, and nobody re-pays
+            # the accelerated path's failure latency every solve. A closed
+            # (or half-open-probing) breaker sees the pack's outcome.
+            breaker = self._pack_breakers.get(
+                "pack:" + "x".join(map(str, self._route_key(batch)))
+            )
+            if not breaker.allow():
+                metrics.SOLVER_DEGRADED.labels(reason="breaker_open").inc()
+                prof["packer_backend"] = "ffd-degraded"
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             t0 = time.perf_counter()
-            result, typemask = self._pack(batch)
+            try:
+                result, typemask = self._pack(batch)
+            except Exception:
+                breaker.record_failure()
+                metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
+                logger.exception(
+                    "accelerated pack failed; FFD fallback serves this batch"
+                )
+                prof["packer_backend"] = "ffd-degraded"
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
+            breaker.record_success()
             prof["pack_fetch_s"] = time.perf_counter() - t0
             t0 = time.perf_counter()
             nodes = self._decode(batch, result, typemask, constraints, instance_types)
             prof["decode_s"] = time.perf_counter() - t0
             return nodes
+
+    def _ffd_degrade(self, constraints, instance_types, pods, daemon, plan) -> List[VirtualNode]:
+        """The degradation ladder's floor: materialize the topology plan
+        into the pods' selectors (restored afterwards — the TPU path's
+        never-mutate contract) and serve the batch with the host FFD."""
+        saved = snapshot_selectors(pods)
+        try:
+            plan.materialize(list(pods))
+            return self._ffd_fallback.solve_injected(
+                constraints, instance_types, pods, daemon
+            )
+        finally:
+            restore_selectors(pods, saved)
 
     def _encode_retry(self, constraints, instance_types, pods, daemon, plan) -> enc.EncodedBatch:
         """Encode with the reusable cache; a cached table accumulates

@@ -103,6 +103,14 @@ class CostRouter:
                 seconds if prev is None else prev + self.alpha * (seconds - prev)
             )
 
+    def record_failure(self, key: tuple, backend: str) -> None:
+        """A backend RAISED for this shape class: record the failure
+        penalty, not the (tiny) elapsed time — a fast-failing backend must
+        lose the route, not win it with a microsecond "cost". Shadow probes
+        (and the caller's circuit breakers' half-open probes) rehabilitate
+        a fixed backend: alpha pulls the EMA back down."""
+        self.record(key, backend, FAILURE_PENALTY_S)
+
     def ema(self, key: tuple, backend: str) -> Optional[float]:
         with self._lock:
             return self._ema.get((backend, key))

@@ -146,16 +146,19 @@ class SolverService:
         self.ready.set()
 
     def warmup_loop(self, max_backoff: float = 60.0) -> None:
-        """Retry warmup with capped backoff until it succeeds — a transient
-        failure (TPU not plumbed yet) must not leave the pod NOT_SERVING
-        forever with a healthy liveness probe."""
-        backoff = 1.0
+        """Retry warmup with capped decorrelated-jitter backoff until it
+        succeeds — a transient failure (TPU not plumbed yet) must not leave
+        the pod NOT_SERVING forever with a healthy liveness probe, and a
+        fleet of sidecars restarting together must not re-warm in lockstep
+        against a shared bottleneck (resilience/policy.py)."""
+        from karpenter_tpu.resilience import decorrelated_jitter
+
+        backoffs = decorrelated_jitter(1.0, cap=max_backoff)
         while not self.ready.is_set():
             self.warmup()
             if self.ready.is_set():
                 return
-            time.sleep(backoff)
-            backoff = min(backoff * 2, max_backoff)
+            time.sleep(next(backoffs))
 
     def health_bytes(self, request: bytes) -> bytes:
         return SERVING if self.ready.is_set() else NOT_SERVING

@@ -7,6 +7,12 @@ from karpenter_tpu.testing.factories import (  # noqa: F401
     make_provisioner,
     zone_spread,
 )
+from karpenter_tpu.testing.chaos import (  # noqa: F401
+    ChaosPolicy,
+    ChaosProxy,
+    ChaosWindow,
+    chaos_wrap,
+)
 from karpenter_tpu.testing.scenarios import (  # noqa: F401
     affinity_dense_pods,
     diverse_pods,

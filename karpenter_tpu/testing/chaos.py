@@ -1,0 +1,177 @@
+"""Chaos-injection harness for the cloud control-plane doubles.
+
+The one-shot ``SimCloudAPI.inject_error`` can stage exactly one failure per
+method — enough for unit tests, useless for proving the resilience layer
+(retries, breakers, budgets) holds up under a *sustained* failure regime.
+``ChaosPolicy`` + ``chaos_wrap`` turn any control-plane double
+(``SimCloudAPI``, ``SimGkeAPI`` — and, by wrapping the double handed to
+``CloudAPIServer``/``GkeAPIServer``, the HTTP wire too: injected errors
+cross as 5xx/429/409) into a statistically misbehaving dependency:
+
+- a per-call **error probability** (optionally per method), alternating
+  injected control-plane failures with throttles;
+- an **injected latency** distribution calibrated by its p95 (exponential,
+  tail-capped so a single sample can't stall a test past its budget);
+- **ICE storms**: windows during which every ``create_fleet`` override
+  answers insufficient-capacity (the typed all-ICE error, carrying the
+  overrides, exactly like a real exhausted region);
+- **blackouts**: windows during which every wrapped method fails;
+- a **seeded RNG** so a chaos run is reproducible bit-for-bit, and
+  per-method injection counters so tests can assert chaos actually fired.
+
+Programming/fault-injection helpers (``inject_error``,
+``send_disruption_notice``, ``set_stockout`` …) and attribute access pass
+through unwrapped: chaos applies to the control-plane *calls*, not to the
+test's ability to program the double.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+# The control-plane surfaces chaos applies to. Anything else (programming
+# helpers, attributes, the disruption injectors) passes through untouched.
+CHAOS_METHODS = frozenset({
+    # SimCloudAPI
+    "describe_instance_types", "describe_subnets", "describe_security_groups",
+    "ensure_launch_template", "delete_launch_template", "create_fleet",
+    "describe_instances", "terminate_instances", "poll_disruptions",
+    # SimGkeAPI
+    "create_node_pool", "delete_node_pool", "delete_instance",
+})
+
+# exponential p95 = mean * ln(20); invert to calibrate the mean from a p95
+_LN20 = 2.9957322735539909
+
+
+@dataclass(frozen=True)
+class ChaosWindow:
+    """Half-open [start, end) window in seconds since the policy armed."""
+
+    start: float
+    end: float
+
+    def contains(self, t: float) -> bool:
+        return self.start <= t < self.end
+
+
+@dataclass
+class ChaosPolicy:
+    """What misbehavior to inject, and how much."""
+
+    error_rate: float = 0.0          # per-call failure probability
+    latency_p95: float = 0.0         # seconds; 0 = no injected latency
+    throttle_fraction: float = 0.25  # this share of injected errors throttle (429)
+    ice_storms: Sequence[ChaosWindow] = ()
+    blackouts: Sequence[ChaosWindow] = ()
+    seed: int = 0
+    # restrict chaos to these methods (None = every CHAOS_METHODS member)
+    methods: Optional[frozenset] = None
+    # cap one latency sample so a tail draw can't stall a test (× p95)
+    latency_cap_factor: float = 4.0
+
+    def applies_to(self, method: str) -> bool:
+        if method not in CHAOS_METHODS:
+            return False
+        return self.methods is None or method in self.methods
+
+
+class ChaosProxy:
+    """Wraps a control-plane double with a :class:`ChaosPolicy`.
+
+    Duck-typed: any object whose public methods appear in ``CHAOS_METHODS``
+    gets those calls intercepted; everything else proxies through, so the
+    wrapped double still serves ``CloudAPIServer``/``GkeAPIServer`` and the
+    tests' programming surface unchanged.
+    """
+
+    def __init__(self, delegate, policy: ChaosPolicy, clock=time.monotonic):
+        import random
+
+        self._delegate = delegate
+        self.policy = policy
+        self._clock = clock
+        self._t0 = clock()
+        # one lock around the RNG: chaos fires from server handler threads
+        # and controller threads at once, and a seeded run must stay
+        # deterministic in its draw SEQUENCE (interleaving may still vary)
+        self._rng = random.Random(policy.seed)
+        self._rng_mu = threading.Lock()
+        self.injected: Dict[str, int] = {}   # method -> injected failures
+        self.delayed: Dict[str, int] = {}    # method -> latency injections
+        self._count_mu = threading.Lock()
+
+    # -- bookkeeping --------------------------------------------------------
+    def _note(self, table: Dict[str, int], method: str) -> None:
+        with self._count_mu:
+            table[method] = table.get(method, 0) + 1
+
+    def injected_total(self) -> int:
+        with self._count_mu:
+            return sum(self.injected.values())
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    # -- the wrap -----------------------------------------------------------
+    def __getattr__(self, name: str):
+        attr = getattr(self._delegate, name)
+        if not callable(attr) or not self.policy.applies_to(name):
+            return attr
+
+        def chaotic(*args, **kwargs):
+            self._maybe_disturb(name, args)
+            return attr(*args, **kwargs)
+
+        return chaotic
+
+    def _maybe_disturb(self, method: str, args: tuple) -> None:
+        from karpenter_tpu.cloudprovider.httpapi import ThrottlingError
+        from karpenter_tpu.cloudprovider.simulated import (
+            CloudAPIError,
+            InsufficientCapacityError,
+        )
+
+        now = self.elapsed()
+        policy = self.policy
+        with self._rng_mu:
+            roll = self._rng.random()
+            throttle = self._rng.random() < policy.throttle_fraction
+            delay = 0.0
+            if policy.latency_p95 > 0.0:
+                delay = min(
+                    self._rng.expovariate(_LN20 / policy.latency_p95),
+                    policy.latency_p95 * policy.latency_cap_factor,
+                )
+        if delay > 0.0:
+            self._note(self.delayed, method)
+            time.sleep(delay)
+        if any(w.contains(now) for w in policy.blackouts):
+            self._note(self.injected, method)
+            raise CloudAPIError(f"chaos blackout: {method} unavailable")
+        if method == "create_fleet" and any(
+            w.contains(now) for w in policy.ice_storms
+        ):
+            self._note(self.injected, method)
+            overrides = [
+                (args[0], it, zone) for (_lt, it, zone) in (args[1] if len(args) > 1 else [])
+            ]
+            raise InsufficientCapacityError(
+                "chaos ICE storm: all pools exhausted", overrides=overrides
+            )
+        if roll < policy.error_rate:
+            self._note(self.injected, method)
+            if throttle:
+                raise ThrottlingError(retry_after=0.01)
+            raise CloudAPIError(f"chaos: injected {method} failure")
+
+
+def chaos_wrap(api, policy: ChaosPolicy, clock=time.monotonic) -> ChaosProxy:
+    """Wrap a ``SimCloudAPI``/``SimGkeAPI`` (or anything speaking their
+    method protocols) in a chaos proxy. The result is a drop-in wherever
+    the bare double went — ``SimulatedCloudProvider(api=...)``,
+    ``GkeCloudProvider(api=...)``, ``CloudAPIServer(api=...)``."""
+    return ChaosProxy(api, policy, clock=clock)

@@ -1,0 +1,412 @@
+"""Resilience-layer unit tests: retry policy (jitter, deadline, budget
+interaction), circuit breaker transitions (closed/open/half-open/close),
+the per-round budget, miss-tracked liveness, and the seams the layer is
+threaded through (metered provider, wire transport, solver degradation)."""
+
+import random
+import threading
+
+import pytest
+
+from karpenter_tpu.resilience import (
+    BreakerBoard,
+    BreakerOpen,
+    Budget,
+    CircuitBreaker,
+    MissTracker,
+    RetryPolicy,
+    decorrelated_jitter,
+    default_retryable,
+)
+
+
+class FakeClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class TestRetryPolicy:
+    def _policy(self, clock=None, **kw):
+        kw.setdefault("base", 0.001)
+        kw.setdefault("cap", 0.002)
+        kw.setdefault("sleep", lambda s: clock.advance(s) if clock else None)
+        if clock:
+            kw.setdefault("clock", clock)
+        return RetryPolicy(**kw)
+
+    def test_transient_failure_retried_to_success(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        assert self._policy(max_attempts=4).call(flaky) == "ok"
+        assert calls[0] == 3
+
+    def test_attempts_exhausted_raises_last_error(self):
+        def dead():
+            raise ConnectionError("still down")
+
+        with pytest.raises(ConnectionError):
+            self._policy(max_attempts=3).call(dead)
+
+    def test_non_retryable_raises_immediately(self):
+        calls = [0]
+
+        def bad_input():
+            calls[0] += 1
+            raise ValueError("malformed")
+
+        with pytest.raises(ValueError):
+            self._policy(max_attempts=5).call(bad_input)
+        assert calls[0] == 1
+
+    def test_capacity_errors_never_retried(self):
+        from karpenter_tpu.cloudprovider.gke import GkeStockoutError
+        from karpenter_tpu.cloudprovider.simulated import InsufficientCapacityError
+
+        assert not default_retryable(InsufficientCapacityError("all ICE"))
+        assert not default_retryable(GkeStockoutError("stockout"))
+        assert default_retryable(ConnectionError("reset"))
+        assert default_retryable(RuntimeError("weird"))
+
+    def test_deadline_cuts_retries_short(self):
+        """The hard per-operation deadline wins over max_attempts: once the
+        next backoff would cross it, the last error propagates."""
+        clock = FakeClock()
+        calls = [0]
+
+        def dead():
+            calls[0] += 1
+            clock.advance(0.6)
+            raise ConnectionError("down")
+
+        policy = self._policy(
+            clock=clock, max_attempts=10, base=0.5, cap=0.5, deadline=1.0
+        )
+        with pytest.raises(ConnectionError):
+            policy.call(dead)
+        assert calls[0] == 1  # 0.6 elapsed + ≥0.5 backoff > 1.0 deadline
+
+    def test_budget_caps_the_deadline(self):
+        """An active round budget tighter than the policy deadline wins; an
+        exhausted budget degrades to a single attempt, never to no work."""
+        clock = FakeClock()
+        calls = [0]
+
+        def dead():
+            calls[0] += 1
+            raise ConnectionError("down")
+
+        policy = self._policy(clock=clock, max_attempts=5, deadline=60.0)
+        with Budget(0.0, clock=clock).activate():
+            with pytest.raises(ConnectionError):
+                policy.call(dead)
+        assert calls[0] == 1
+        calls[0] = 0
+        with pytest.raises(ConnectionError):
+            policy.call(dead)  # no budget: the policy's own attempts apply
+        assert calls[0] == 5
+
+    def test_decorrelated_jitter_bounded(self):
+        rng = random.Random(7)
+        sleeps = []
+        gen = decorrelated_jitter(0.05, cap=1.0, rng=rng)
+        for _ in range(50):
+            sleeps.append(next(gen))
+        assert all(0.05 <= s <= 1.0 for s in sleeps)
+        assert len(set(round(s, 6) for s in sleeps)) > 10  # actually jittered
+
+
+class TestBudget:
+    def test_remaining_counts_down(self):
+        clock = FakeClock()
+        budget = Budget(10.0, clock=clock)
+        assert budget.remaining() == 10.0
+        clock.advance(4.0)
+        assert budget.remaining() == 6.0
+        clock.advance(7.0)
+        assert budget.remaining() == 0.0
+        assert budget.expired
+
+    def test_shared_across_threads(self):
+        """The launch pool re-activates ONE budget per thread: every thread
+        sees the same countdown."""
+        from karpenter_tpu.resilience import current_budget
+
+        clock = FakeClock()
+        budget = Budget(10.0, clock=clock)
+        seen = []
+
+        def worker():
+            with budget.activate():
+                seen.append(current_budget.get().remaining())
+
+        clock.advance(3.0)
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert seen == [7.0, 7.0, 7.0]
+        assert current_budget.get() is None  # never leaks out of activate()
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock, **kw):
+        kw.setdefault("window", 4)
+        kw.setdefault("min_volume", 2)
+        kw.setdefault("failure_rate", 0.5)
+        kw.setdefault("open_seconds", 10.0)
+        return CircuitBreaker("dep", clock=clock, **kw)
+
+    def test_opens_on_windowed_failure_rate(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        assert not b.record_failure()  # volume 1 < min_volume
+        assert b.state == "closed"
+        assert b.record_failure()  # 2/2 failures ≥ 0.5
+        assert b.state == "open"
+        assert b.trips == 1
+        assert not b.allow()
+
+    def test_low_failure_rate_stays_closed(self):
+        """A chaos-level ~10% error rate must NOT trip the breaker."""
+        clock = FakeClock()
+        b = self._breaker(clock, window=20, min_volume=5)
+        rng = random.Random(3)
+        for _ in range(200):
+            if rng.random() < 0.1:
+                b.record_failure()
+            else:
+                b.record_success()
+            assert b.state == "closed"
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "open"
+        clock.advance(10.1)
+        assert b.available()
+        assert b.allow()  # the half-open probe slot
+        assert b.state == "half-open"
+        assert not b.allow()  # only one probe in flight
+        b.record_success()
+        assert b.state == "closed"
+        assert b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        b.record_failure()
+        b.record_failure()
+        clock.advance(10.1)
+        assert b.allow()
+        assert b.record_failure()  # probe failed → re-open, counted as a trip
+        assert b.state == "open"
+        assert b.trips == 2
+        assert not b.allow()
+        clock.advance(10.1)
+        assert b.allow()  # a fresh cool-off earns a fresh probe
+
+    def test_call_raises_breaker_open_without_calling(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                b.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+        calls = [0]
+        with pytest.raises(BreakerOpen):
+            b.call(lambda: calls.__setitem__(0, calls[0] + 1))
+        assert calls[0] == 0
+
+    def test_board_tracks_open_dependencies(self):
+        clock = FakeClock()
+        board = BreakerBoard(clock=clock, window=4, min_volume=1,
+                             failure_rate=0.5, open_seconds=10.0)
+        board.get("a").record_failure()
+        board.get("b").record_success()
+        assert board.open_dependencies() == ["a"]
+        clock.advance(10.1)
+        board.get("a").allow()
+        board.get("a").record_success()
+        assert board.open_dependencies() == []
+
+    def test_state_gauge_published(self):
+        from prometheus_client import generate_latest
+
+        from karpenter_tpu import metrics
+
+        clock = FakeClock()
+        b = CircuitBreaker("gauge-dep", window=2, min_volume=1,
+                           failure_rate=0.5, open_seconds=10.0, clock=clock)
+        b.record_failure()
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert 'karpenter_resilience_breaker_state{dependency="gauge-dep"} 1.0' in out
+
+
+class TestMissTracker:
+    def test_requires_consecutive_misses(self):
+        t = MissTracker(threshold=3)
+        assert not t.observe("i-1", present=False)
+        assert not t.observe("i-1", present=False)
+        assert t.observe("i-1", present=False)
+
+    def test_sighting_resets_the_count(self):
+        t = MissTracker(threshold=3)
+        t.observe("i-1", present=False)
+        t.observe("i-1", present=False)
+        t.observe("i-1", present=True)  # one flaky streak, then it shows up
+        assert not t.observe("i-1", present=False)
+        assert t.misses("i-1") == 1
+
+
+class TestMeteredProviderResilience:
+    """The (provider, method) breaker + retry wrap on the metered decorator."""
+
+    def _metered(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.cloudprovider.metrics import decorate
+
+        provider = FakeCloudProvider(instance_types(4))
+        metered = decorate(provider)
+        # tests must not sleep through real backoff
+        for policy in metered._policies.values():
+            policy._sleep = lambda s: None
+        return provider, metered
+
+    def test_transient_catalog_failure_retried(self):
+        provider, metered = self._metered()
+        original = provider.get_instance_types
+        fail = [2]
+
+        def flaky(p=None):
+            if fail[0]:
+                fail[0] -= 1
+                raise ConnectionError("catalog blip")
+            return original(p)
+
+        provider.get_instance_types = flaky
+        assert len(metered.get_instance_types()) == 4
+
+    def test_dead_dependency_trips_then_fails_fast(self):
+        from karpenter_tpu.cloudprovider.metrics import (
+            BREAKER_MIN_VOLUME,
+            BREAKER_WINDOW,
+        )
+
+        provider, metered = self._metered()
+        calls = [0]
+
+        def dead(p=None):
+            calls[0] += 1
+            raise ConnectionError("dead")
+
+        provider.get_instance_types = dead
+        for _ in range(BREAKER_WINDOW):
+            with pytest.raises((ConnectionError, BreakerOpen)):
+                metered.get_instance_types()
+        with pytest.raises(BreakerOpen):
+            metered.get_instance_types()
+        before = calls[0]
+        with pytest.raises(BreakerOpen):
+            metered.get_instance_types()
+        assert calls[0] == before  # open breaker: the delegate isn't touched
+        assert calls[0] >= BREAKER_MIN_VOLUME
+
+    def test_capacity_error_does_not_trip_breaker(self):
+        """An ICE storm is a capacity condition, not unavailability: the
+        create breaker must stay closed so recovery launches flow the
+        moment capacity returns."""
+        from karpenter_tpu.cloudprovider.simulated import InsufficientCapacityError
+
+        provider, metered = self._metered()
+
+        def all_ice(request):
+            raise InsufficientCapacityError("all pools exhausted")
+
+        provider.create = all_ice
+        for _ in range(30):
+            with pytest.raises(InsufficientCapacityError):
+                metered.create(None)
+        assert metered.breakers.get("fake:create").state == "closed"
+
+    def test_open_poll_breaker_yields_empty_drain(self):
+        provider, metered = self._metered()
+
+        def dead_poll():
+            raise ConnectionError("event wire down")
+
+        provider.poll_disruptions = dead_poll
+        for _ in range(25):
+            try:
+                metered.poll_disruptions()
+            except ConnectionError:
+                pass
+        # breaker now open: the poll degrades to an empty drain, keeping
+        # the interruption controller's cadence alive
+        assert metered.breakers.get("fake:poll_disruptions").state == "open"
+        assert metered.poll_disruptions() == []
+
+
+class TestSolverDegradation:
+    def test_pack_failure_degrades_to_ffd_and_breaker_routes_immediately(self):
+        """A broken accelerated path serves the batch via FFD (pods still
+        schedule); after the shape's breaker opens, the kernel isn't even
+        attempted until the cool-off expires."""
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        catalog = instance_types(4)
+        constraints = make_provisioner(solver="tpu").spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        pack_calls = [0]
+
+        def broken_pack(batch):
+            pack_calls[0] += 1
+            raise RuntimeError("device ladder exploded")
+
+        sched._pack = broken_pack
+        pods = [make_pod(requests={"cpu": "0.5"}) for _ in range(4)]
+        for _ in range(2):  # two failures open the shape's breaker
+            nodes = sched.solve(constraints, catalog, list(pods))
+            assert nodes and sum(len(n.pods) for n in nodes) == 4
+        attempted = pack_calls[0]
+        nodes = sched.solve(constraints, catalog, list(pods))
+        assert nodes and sum(len(n.pods) for n in nodes) == 4
+        assert pack_calls[0] == attempted  # breaker open: FFD immediately
+        assert sched.last_profile.get("packer_backend") == "ffd-degraded"
+
+    def test_remote_breaker_half_open_recovers(self):
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        clock = FakeClock()
+        sched = TpuScheduler.__new__(TpuScheduler)  # breaker behavior only
+        from karpenter_tpu.resilience import CircuitBreaker
+
+        b = CircuitBreaker("solver-service:x", window=4, min_volume=1,
+                           failure_rate=0.5, open_seconds=30.0, clock=clock)
+        assert b.record_failure()  # first RPC failure trips (round-1 contract)
+        assert b.state == "open"
+        assert not b.available()  # fused route free to claim the device
+        clock.advance(30.1)
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"

@@ -238,6 +238,99 @@ def test_soak_preemption_churn():
         rt.stop()
 
 
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_chaos_churn():
+    """Chaos leg (slow): pod churn + live preemptions through the FULL
+    runtime while the simulated control plane misbehaves statistically —
+    10% call failures, 30ms p95 injected latency, a mid-soak blackout
+    window. Invariants: the system settles (no pod left provisionable),
+    nothing is silently lost, and no circuit breaker is left open once the
+    chaos stops."""
+    import random as _random
+
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+    from karpenter_tpu.testing.chaos import ChaosPolicy, ChaosWindow, chaos_wrap
+
+    rng = _random.Random(20260804)
+    api = SimCloudAPI()
+    chaos = chaos_wrap(api, ChaosPolicy(
+        error_rate=0.1,
+        latency_p95=0.03,
+        blackouts=(ChaosWindow(6.0, 8.0),),
+        seed=20260804,
+    ))
+    provider = SimulatedCloudProvider(api=chaos)
+    cluster = Cluster()
+    rt = build_runtime(Options(), cluster=cluster, cloud_provider=provider)
+    rt.interruption.poll_interval = 0.2
+    rt.manager.start()
+    try:
+        cluster.create("provisioners", make_provisioner(solver="ffd"))
+        wait_for_worker(rt)
+        created = []
+        preempted = set()
+        stop = time.time() + 15.0
+        i = 0
+        while time.time() < stop:
+            action = rng.random()
+            if action < 0.55:
+                name = f"chaos-soak-{i}"
+                i += 1
+                cluster.create(
+                    "pods",
+                    make_pod(name=name, requests={"cpu": f"{rng.choice([0.25, 0.5, 1])}"}),
+                )
+                created.append(name)
+            elif action < 0.7 and created:
+                try:
+                    cluster.delete("pods", rng.choice(created))
+                except Exception:
+                    pass
+            elif action < 0.85:
+                nodes = [
+                    n for n in cluster.nodes()
+                    if n.metadata.deletion_timestamp is None
+                ]
+                if nodes:
+                    victim = rng.choice(nodes).metadata.name
+                    preempted.add(victim)
+                    api.send_disruption_notice(DisruptionNotice(
+                        kind=PREEMPTION, node_name=victim,
+                        grace_period_seconds=rng.choice([2.0, 30.0]),
+                    ))
+            time.sleep(rng.uniform(0.005, 0.05))
+
+        assert chaos.injected_total() > 0, "soak never injected a failure"
+        settle(cluster, timeout=120.0, context="settle after chaos churn")
+        # nothing silently lost: every surviving pod is bound or terminating
+        for p in cluster.pods():
+            assert p.spec.node_name or p.metadata.deletion_timestamp is not None, (
+                f"pod {p.metadata.name} neither bound nor terminating"
+            )
+        # preempted nodes do not linger past their grace periods
+        deadline = time.time() + 60
+        while time.time() < deadline and any(
+            cluster.try_get("nodes", n, namespace="") is not None for n in preempted
+        ):
+            time.sleep(0.25)
+        for n in preempted:
+            assert cluster.try_get("nodes", n, namespace="") is None, (
+                f"preempted node {n} never terminated under chaos"
+            )
+        # the failure regime is over: no breaker may be left open
+        deadline = time.time() + 30
+        while time.time() < deadline and rt.cloud_provider.breakers.open_dependencies():
+            time.sleep(0.5)
+        assert rt.cloud_provider.breakers.open_dependencies() == []
+    finally:
+        rt.stop()
+
+
 def test_soak_over_apiserver_boundary():
     """The same churn pushed across the real HTTP + wire-format boundary:
     TestApiServer + ApiCluster informers (RV-resumed watches), server-side
