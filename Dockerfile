@@ -10,6 +10,20 @@ WORKDIR /src/native
 COPY native/ffd_pack.cpp .
 RUN g++ -O3 -shared -fPIC -o libffd_pack.so ffd_pack.cpp
 
+# Stage 1.5: static analysis gate — karplint is stdlib-only, so the bare
+# slim image (no jax, no prometheus) can run the full rule set: the rule
+# corpus must fire, the tree must be clean. A dirty tree fails the build
+# before the runtime stage ever assembles.
+FROM python:3.12-slim AS analyze
+WORKDIR /app
+COPY tools/ tools/
+COPY karpenter_tpu/ karpenter_tpu/
+COPY docs/metrics.md docs/metrics.md
+COPY tests/karplint_fixtures/ tests/karplint_fixtures/
+RUN python -m tools.karplint --selftest tests/karplint_fixtures \
+    && python -m tools.karplint karpenter_tpu \
+    && touch /analyze.ok
+
 # Stage 2: runtime
 FROM python:3.12-slim
 # jax[tpu] pulls libtpu for real chips; CPU-only environments still work
@@ -25,6 +39,8 @@ COPY karpenter_tpu/ karpenter_tpu/
 # package (solver/native.py); ship source + prebuilt so no g++ is needed
 COPY native/ffd_pack.cpp native/
 COPY --from=build /src/native/libffd_pack.so native/
+# the analyze stage gates the image: this COPY forces it to run (and pass)
+COPY --from=analyze /analyze.ok /tmp/analyze.ok
 ENV PYTHONPATH=/app
 ENV PYTHONUNBUFFERED=1
 USER 65532:65532

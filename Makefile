@@ -4,10 +4,25 @@
 PY ?= python
 TESTFLAGS ?= -q
 
-dev: test  ## everything a presubmit needs
+dev: analyze test  ## everything a presubmit needs
 
 test:  ## unit + integration suites (tier-1: slow soak/chaos legs excluded)
 	$(PY) -m pytest tests/ -x -m 'not slow' $(TESTFLAGS)
+
+analyze:  ## karplint gate: prove every rule fires on the corpus, then require a clean tree
+	$(PY) -m tools.karplint --selftest tests/karplint_fixtures
+	$(PY) -m tools.karplint karpenter_tpu
+
+analyze-baseline:  ## regenerate tools/karplint/baseline.json (P0 findings are never baselined)
+	$(PY) -m tools.karplint --write-baseline karpenter_tpu
+
+lint: analyze  ## ruff + mypy + karplint; ruff/mypy skip with a notice when not installed
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check karpenter_tpu tools bench.py; \
+	else echo "lint: ruff not installed, skipping (pip install ruff)"; fi
+	@if $(PY) -m mypy --version >/dev/null 2>&1; then \
+		$(PY) -m mypy karpenter_tpu; \
+	else echo "lint: mypy not installed, skipping (pip install mypy)"; fi
 
 battletest:  ## full suite without fail-fast + duration report (the -race analog)
 	$(PY) -m pytest tests/ $(TESTFLAGS) --durations=10
@@ -73,6 +88,6 @@ run:  ## start the controller process against the in-memory cluster
 solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
-.PHONY: dev test battletest deflake benchmark benchmark-grid \
+.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-grid \
 	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Metric naming/documentation check — a thin shim over karplint.
+
+The actual pass lives in ``tools/karplint/rules/metric_names.py`` (the
+``metric-name`` rule): Prometheus naming conventions, collision detection,
+and the docs/metrics.md listing requirement for every metric registered in
+``karpenter_tpu/metrics.py`` and ``karpenter_tpu/cloudprovider/metrics.py``.
+This entrypoint exists for CI steps and hooks that want ONLY the metric
+pass without the rest of the rule set::
+
+    python hack/check_metrics_names.py
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.karplint.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(
+        main(
+            [
+                "--root", str(REPO_ROOT),
+                "--rules", "metric-name",
+                "karpenter_tpu",
+            ]
+        )
+    )

@@ -17,6 +17,7 @@ from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
 from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice, NoticeQueue
+from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
 
 _name_counter = itertools.count(1)
@@ -181,10 +182,12 @@ class FakeCloudProvider(CloudProvider):
             ),
         )
 
+    @idempotent
     def delete(self, node: Node) -> None:
         with self._mu:
             self.delete_calls.append(node.metadata.name)
 
+    @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
         if self.instance_types is not None:
             return self.instance_types
@@ -209,6 +212,7 @@ class FakeCloudProvider(CloudProvider):
         self.disruptions.push(notice)
         return notice
 
+    @idempotent
     def poll_disruptions(self) -> List[DisruptionNotice]:
         return self.disruptions.drain()
 

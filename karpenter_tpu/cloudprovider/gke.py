@@ -37,6 +37,7 @@ from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, Po
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
 from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
+from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.ttlcache import TTLCache
 
@@ -285,6 +286,7 @@ class GkeCloudProvider(CloudProvider):
         self._pending_hosts: Dict[Tuple[str, str, str], List[Node]] = {}
 
     # -- catalog -----------------------------------------------------------
+    @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
         """The catalog minus offerings in the unavailable (ICE) cache —
         reference: aws/instancetypes.go:185-198."""
@@ -412,6 +414,7 @@ class GkeCloudProvider(CloudProvider):
             ),
         )
 
+    @idempotent
     def delete(self, node: Node) -> None:
         pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
         purged: List[Node] = []
@@ -457,6 +460,7 @@ class GkeCloudProvider(CloudProvider):
                 errs.append(f"unknown GKE provider field {key!r}")
         return errs
 
+    @idempotent
     def poll_disruptions(self) -> List[DisruptionNotice]:
         """DisruptionSource: drain the node-pool API's event bus (the same
         call works over the wire via ``HttpGkeAPI``)."""

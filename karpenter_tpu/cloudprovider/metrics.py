@@ -39,6 +39,7 @@ from karpenter_tpu.api.objects import Node
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
 from karpenter_tpu.resilience import BreakerBoard, BreakerOpen, RetryPolicy
+from karpenter_tpu.resilience.markers import idempotent
 
 # Which controller's reconcile (or worker loop) is currently executing.
 reconciling_controller: contextvars.ContextVar[str] = contextvars.ContextVar(
@@ -122,12 +123,15 @@ class MeteredCloudProvider(CloudProvider):
     def create(self, request: NodeRequest) -> Node:
         return self._guarded("create", self.delegate.create, request)
 
+    @idempotent
     def delete(self, node: Node) -> None:
         return self._guarded("delete", self.delegate.delete, node)
 
+    @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
         return self._guarded("get_instance_types", self.delegate.get_instance_types, provider)
 
+    @idempotent
     def poll_disruptions(self):
         """The DisruptionSource poll is a real control-plane call for wire
         providers — observe it like create/delete. An open breaker yields

@@ -19,7 +19,7 @@ from karpenter_tpu.utils import resources as res
 # per-provisioner workers share this, hence the lock.
 import threading as _threading
 
-_catreq_cache: Dict[tuple, tuple] = {}
+_catreq_cache: Dict[tuple, tuple] = {}  # guarded-by: _catreq_lock
 _catreq_lock = _threading.Lock()
 _CATREQ_CACHE_MAX = 8
 

@@ -43,6 +43,7 @@ from karpenter_tpu.api.objects import (
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
 from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
+from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.ttlcache import TTLCache
 from karpenter_tpu.utils.workqueue import TokenBucket
@@ -882,9 +883,11 @@ class SimulatedCloudProvider(CloudProvider):
         config = SimProviderConfig.deserialize(request.template.provider)
         return self.instance_provider.create(config, request)
 
+    @idempotent
     def delete(self, node: Node) -> None:
         self.instance_provider.delete(node)
 
+    @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
         return self.instance_type_provider.get(SimProviderConfig.deserialize(provider))
 
@@ -905,6 +908,7 @@ class SimulatedCloudProvider(CloudProvider):
     def validate(self, constraints: Constraints) -> List[str]:
         return SimProviderConfig.deserialize(constraints.provider).validate()
 
+    @idempotent
     def poll_disruptions(self) -> List[DisruptionNotice]:
         """DisruptionSource: drain the control plane's event bus (works
         identically against the in-process ``SimCloudAPI`` and the HTTP

@@ -366,12 +366,14 @@ class ProvisioningController:
         self.start_workers = start_workers  # False: tests drive provision_once inline
         self.default_solver = default_solver
         self.solver_service_address = solver_service_address
-        self.workers: Dict[str, ProvisionerWorker] = {}
-        self._hashes: Dict[str, int] = {}
+        self.workers: Dict[str, ProvisionerWorker] = {}  # guarded-by: self._lock
+        self._hashes: Dict[str, int] = {}  # guarded-by: self._lock
         # provisioners with a live gauge series — a failed Apply never
         # creates a worker, so stop()/teardown can't rely on self.workers
-        # to know which series to drop
-        self._gauged: set = set()
+        # to know which series to drop. Mutated from per-provisioner
+        # reconcile threads and iterated by stop(): same lock as the
+        # worker table.
+        self._gauged: set = set()  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def reconcile(self, name: str) -> Optional[float]:
@@ -410,7 +412,9 @@ class ProvisioningController:
         metrics.PROVISIONER_ACTIVE.labels(provisioner=provisioner.name).set(
             1 if value == "True" else 0
         )
-        self._gauged.add(provisioner.name)
+        # reconcile threads race stop()'s iteration over this set
+        with self._lock:
+            self._gauged.add(provisioner.name)
         cond = provisioner.status.condition(ACTIVE)
         if cond is not None and (cond.status, cond.reason, cond.message) == (
             value, reason, message,
@@ -432,11 +436,13 @@ class ProvisioningController:
         # other writers get erased. Read-modify-write against the freshest
         # cache copy (a raced write loses benignly — the next reconcile's
         # comparison re-detects the drift and retries).
+        from karpenter_tpu.kube.patch import upsert_condition
+
         live = self.cluster.try_get("provisioners", provisioner.name, namespace="")
         base = (live or provisioner).status.conditions
-        wire_conditions = [
-            serde.prov_condition_to_wire(c) for c in base if c.type != ACTIVE
-        ] + [wire]
+        wire_conditions = upsert_condition(
+            [serde.prov_condition_to_wire(c) for c in base], wire
+        )
         try:
             self.cluster.patch_status(
                 "provisioners", provisioner.name,
@@ -499,6 +505,7 @@ class ProvisioningController:
         with self._lock:
             worker = self.workers.pop(name, None)
             self._hashes.pop(name, None)
+            self._gauged.discard(name)
         if worker:
             worker.stop()
         # drop the gauge series: a deleted provisioner must not linger on
@@ -506,7 +513,6 @@ class ProvisioningController:
         # releases raise KeyError from remove() for a never-gauged label
         # set (e.g. a reconcile of a name whose Apply never ran), and that
         # must not escape reconcile().
-        self._gauged.discard(name)
         try:
             metrics.PROVISIONER_ACTIVE.remove(name)
         except KeyError:
@@ -540,9 +546,12 @@ class ProvisioningController:
         return None
 
     def stop(self) -> None:
-        for name in list(self.workers):
-            self._teardown(name)
-        # provisioners whose Apply only ever failed have a gauge series but
-        # no worker — clear those too
-        for name in list(self._gauged):
+        # snapshot under the lock: reconcile threads may still be mutating
+        # both tables while shutdown walks them
+        with self._lock:
+            names = set(self.workers)
+            # provisioners whose Apply only ever failed have a gauge series
+            # but no worker — clear those too
+            names |= self._gauged
+        for name in names:
             self._teardown(name)

@@ -106,14 +106,25 @@ class Orchestrator:
     def _taint_and_cordon(self, node: Node, notice: DisruptionNotice) -> None:
         """One merge patch: interruption taint + cordon + ensure the
         termination finalizer (a self-registered node may not carry it yet,
-        and without it the delete below would skip the drain path)."""
+        and without it the delete below would skip the drain path).
+
+        RFC 7386 replaces the taints array wholesale, so the patch carries
+        the FULL list — the node's current taints with the interruption
+        taint upserted (kube.patch's RMW idiom; re-tainting an already
+        noticed node is a no-op replace of the same entry)."""
+        from karpenter_tpu.kube.patch import upsert_taint
         from karpenter_tpu.kube.serde import taint_to_wire
 
-        taints = list(node.spec.taints)
-        if not any(t.key == lbl.INTERRUPTION_TAINT_KEY for t in taints):
-            taints.append(
-                Taint(key=lbl.INTERRUPTION_TAINT_KEY, value=notice.kind, effect="NoSchedule")
-            )
+        taints_wire = upsert_taint(
+            [taint_to_wire(t) for t in node.spec.taints],
+            taint_to_wire(
+                Taint(
+                    key=lbl.INTERRUPTION_TAINT_KEY,
+                    value=notice.kind,
+                    effect="NoSchedule",
+                )
+            ),
+        )
         finalizers = list(node.metadata.finalizers)
         if lbl.TERMINATION_FINALIZER not in finalizers:
             finalizers.append(lbl.TERMINATION_FINALIZER)
@@ -122,7 +133,7 @@ class Orchestrator:
             {
                 "spec": {
                     "unschedulable": True,
-                    "taints": [taint_to_wire(t) for t in taints],
+                    "taints": taints_wire,
                 },
                 "metadata": {"finalizers": finalizers},
             },

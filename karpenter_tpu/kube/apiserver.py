@@ -478,12 +478,17 @@ class ApiCluster(Cluster):
             self._notify(kind, "DELETED", fresh)
 
     def remove_finalizer(self, kind: str, obj, finalizer: str) -> None:
-        if finalizer in obj.metadata.finalizers:
-            obj.metadata.finalizers.remove(finalizer)
+        from karpenter_tpu.kube.patch import without_value
+
+        # RFC 7386 replaces the array wholesale: carry the FULL remaining
+        # list (RMW of the caller's copy), mirrored back into it so repeat
+        # calls stay idempotent against the same object
+        finalizers = without_value(obj.metadata.finalizers, finalizer)
+        obj.metadata.finalizers[:] = finalizers
         fresh = self.merge_patch(
             kind,
             obj.metadata.name,
-            {"metadata": {"finalizers": list(obj.metadata.finalizers)}},
+            {"metadata": {"finalizers": finalizers}},
             namespace=obj.metadata.namespace,
         )
         obj.metadata.resource_version = fresh.metadata.resource_version

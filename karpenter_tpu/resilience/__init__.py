@@ -27,6 +27,7 @@ from karpenter_tpu.resilience.breaker import (  # noqa: F401
     CircuitBreaker,
 )
 from karpenter_tpu.resilience.liveness import MissTracker  # noqa: F401
+from karpenter_tpu.resilience.markers import idempotent, is_idempotent  # noqa: F401
 from karpenter_tpu.resilience.policy import (  # noqa: F401
     Budget,
     RetryPolicy,

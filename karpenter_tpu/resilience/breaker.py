@@ -60,11 +60,11 @@ class CircuitBreaker:
         self.half_open_max = int(half_open_max)
         self._clock = clock
         self._mu = threading.Lock()
-        self._outcomes: deque = deque(maxlen=self.window)  # True = failure
-        self._state = CLOSED
-        self._opened_at = 0.0
-        self._probes_in_flight = 0
-        self.trips = 0  # times the breaker transitioned to OPEN
+        self._outcomes: deque = deque(maxlen=self.window)  # True = failure; guarded-by: self._mu
+        self._state = CLOSED  # guarded-by: self._mu
+        self._opened_at = 0.0  # guarded-by: self._mu
+        self._probes_in_flight = 0  # guarded-by: self._mu
+        self.trips = 0  # times the breaker transitioned to OPEN; guarded-by: self._mu
         self._publish()
 
     # -- state -------------------------------------------------------------
@@ -170,7 +170,7 @@ class BreakerBoard:
     def __init__(self, clock: Callable[[], float] = time.monotonic, **breaker_kwargs):
         self._clock = clock
         self._kwargs = breaker_kwargs
-        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}  # guarded-by: self._mu
         self._mu = threading.Lock()
 
     def get(self, dependency: str) -> CircuitBreaker:

@@ -1,0 +1,31 @@
+"""Idempotency markers for retried callables.
+
+``RetryPolicy.call`` re-invokes its callable on transient failure; that is
+only sound for operations whose replay converges to the same state
+(DELETE of a named resource, a catalog GET, an event poll with positions).
+``@idempotent`` is the explicit, analyzer-enforced declaration of that
+property: karplint's ``retry-idempotent`` rule requires it on every
+callable a retrying policy can reach, and REJECTS it on create-path
+mutators — ``create`` is breaker-only by design (a replayed create after
+a partially-committed launch orphans an instance no Node tracks), and
+marking it idempotent would invite someone to raise its ``max_attempts``.
+
+The marker is metadata only (``fn.__idempotent__ = True``); it changes no
+behavior, so applying it can never regress a passing call path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def idempotent(fn: F) -> F:
+    """Declare that replaying ``fn`` converges to the same end state."""
+    fn.__idempotent__ = True  # type: ignore[attr-defined]
+    return fn
+
+
+def is_idempotent(fn: Callable) -> bool:
+    return bool(getattr(fn, "__idempotent__", False))

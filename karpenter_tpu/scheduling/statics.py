@@ -80,7 +80,7 @@ _INTERN_MAX = 1 << 20
 # would silently merge their topology groups in discovery.
 import threading as _threading
 
-_topo_classes: Dict[Tuple, int] = {}
+_topo_classes: Dict[Tuple, int] = {}  # guarded-by: _topo_lock
 _topo_lock = _threading.Lock()
 _TOPO_CLASS_MAX = 1 << 16
 

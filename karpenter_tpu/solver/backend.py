@@ -55,8 +55,12 @@ PACK_BREAKER_MIN_VOLUME = 2
 PACK_BREAKER_OPEN_SECONDS = 30.0
 
 # (P, S, F, n_max) whose fused compile/run failed — those shapes take the
-# unfused ladder from then on (mirrors pallas_kernel._pallas_failed_shapes)
-_fused_failed_shapes: set = set()
+# unfused ladder from then on (mirrors pallas_kernel._pallas_failed_shapes).
+# Written from solve threads and the router's shadow-probe thread while
+# other solves iterate it: snapshot/mutate under the lock, or a probe's
+# add() lands mid-iteration and raises RuntimeError inside a solve.
+_fused_failed_lock = threading.Lock()
+_fused_failed_shapes: set = set()  # guarded-by: _fused_failed_lock
 
 
 def _with_hostname(reqs, hostname: str, cache: dict):
@@ -108,7 +112,7 @@ class TpuScheduler:
         self._ffd_fallback = FFDScheduler(cluster, rng=rng)
         # remote sidecar transport (SURVEY §5.8); None = in-process kernel
         self.service_address = service_address
-        self._remote = None
+        self._remote = None  # guarded-by: self._remote_init_lock
         self._remote_init_lock = threading.Lock()
         # circuit breaker after RPC failure (resilience layer): window 1 /
         # min_volume 1 keeps the round-1 contract — a dead sidecar trips on
@@ -138,7 +142,7 @@ class TpuScheduler:
         # guards the lazy init — the shadow-probe thread and a production
         # solve can both hit the None check, and two DeviceInvariants would
         # split the LRU (every solve re-uploading what the other cached)
-        self._device_cache = None
+        self._device_cache = None  # guarded-by: self._device_cache_lock
         self._device_cache_lock = threading.Lock()
         self._solve_lock = threading.Lock()
         # per-stage timings of the most recent solve (bench surfaces these
@@ -319,7 +323,8 @@ class TpuScheduler:
                         "fused %s solve failed for shape %s; unfused ladder",
                         route, shape,
                     )
-                    _fused_failed_shapes.add(shape)
+                    with _fused_failed_lock:
+                        _fused_failed_shapes.add(shape)
             if result is None:
                 if args is None:
                     args = batch.pack_args()
@@ -367,7 +372,9 @@ class TpuScheduler:
 
         P = len(batch.pod_valid)
         S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
-        if any(s[:3] == (P, S, F) for s in _fused_failed_shapes):
+        with _fused_failed_lock:
+            failed = any(s[:3] == (P, S, F) for s in _fused_failed_shapes)
+        if failed:
             return None
         if not fused.ids_fit(batch):
             return None

@@ -180,7 +180,7 @@ class EncodeCache:
 # Lock-protected: catalog_fingerprint runs from concurrent per-provisioner
 # solve workers, and an unlocked popitem can race a sibling's move_to_end
 # into a KeyError (same contract as requirements._catreq_cache).
-_fp_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+_fp_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()  # guarded-by: _fp_lock
 _fp_lock = threading.Lock()
 _FP_CACHE_MAX = 8
 

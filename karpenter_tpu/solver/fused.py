@@ -105,9 +105,9 @@ class DeviceInvariants:
     def __init__(self):
         import threading
 
-        self._cache: "Dict[bytes, tuple]" = {}
-        self._cache_v2: "Dict[bytes, tuple]" = {}
-        self._order: list = []
+        self._cache: "Dict[bytes, tuple]" = {}  # guarded-by: self._lock
+        self._cache_v2: "Dict[bytes, tuple]" = {}  # guarded-by: self._lock
+        self._order: list = []  # guarded-by: self._lock
         # the router's device shadow probe calls get()/get_v2() from its
         # own thread while a production solve may be cold-starting the
         # device path concurrently — the LRU list mutation must not race
@@ -125,7 +125,7 @@ class DeviceInvariants:
         h.update(np.ascontiguousarray(batch.usable).tobytes())
         return h.digest()
 
-    def _touch(self, key: bytes) -> None:
+    def _touch_locked(self, key: bytes) -> None:
         # LRU, not FIFO: interleaving invariant sets (several provisioners
         # on one scheduler) must not evict the hot entry
         if key in self._order:
@@ -153,7 +153,7 @@ class DeviceInvariants:
             )
         with self._lock:
             self._cache[key] = hit
-            self._touch(key)
+            self._touch_locked(key)
         return hit
 
     def get_v2(self, batch):
@@ -180,7 +180,7 @@ class DeviceInvariants:
             )
         with self._lock:
             self._cache_v2[key] = hit
-            self._touch(key)
+            self._touch_locked(key)
         return hit
 
 

@@ -26,9 +26,9 @@ _SRC = os.path.join(_REPO_ROOT, "native", "ffd_pack.cpp")
 _LIB = os.path.join(_REPO_ROOT, "native", "libffd_pack.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_load_failed = False
-_build_thread: Optional[threading.Thread] = None
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_load_failed = False  # guarded-by: _lock
+_build_thread: Optional[threading.Thread] = None  # guarded-by: _lock
 
 
 def _build_and_load() -> None:

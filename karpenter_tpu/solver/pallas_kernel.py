@@ -28,6 +28,7 @@ pod scalars [6, P] i32, pod requests [R, P] f32, node requests [R, N] f32.
 from __future__ import annotations
 
 import logging
+import threading
 from functools import partial
 
 import jax
@@ -242,8 +243,11 @@ def pallas_available() -> bool:
 
 
 # shapes (P, n_max) whose pallas compile/run failed — only those fall back,
-# one pathological batch must not degrade every other shape in the process
-_pallas_failed_shapes: set = set()
+# one pathological batch must not degrade every other shape in the process.
+# Solve threads and the router's shadow-probe thread write it concurrently
+# with other solves' membership checks: mutate under the lock.
+_failed_shapes_lock = threading.Lock()
+_pallas_failed_shapes: set = set()  # guarded-by: _failed_shapes_lock
 
 # The kernel unrolls the signature × frontier loops (S × F masked selects
 # per pod step), so Mosaic compile time scales with S·F. Measured on a
@@ -302,7 +306,8 @@ def pack_best(*args, n_max: int) -> PackResult:
             logger.exception(
                 "pallas kernel failed for shape %s; trying alternatives", shape
             )
-            _pallas_failed_shapes.add(shape)
+            with _failed_shapes_lock:
+                _pallas_failed_shapes.add(shape)
     # when v1 is unavailable (unroll budget exceeded, or its compile failed
     # for this shape): the v2 kernel (signature gathers as MXU matmuls over
     # a one-hot state; compile O(F), independent of S) keeps the batch on
@@ -324,7 +329,8 @@ def pack_best(*args, n_max: int) -> PackResult:
                     "pallas v2 kernel failed for shape %s; lax.scan for this shape",
                     v2_shape,
                 )
-                _pallas_failed_shapes.add(v2_shape)
+                with _failed_shapes_lock:
+                    _pallas_failed_shapes.add(v2_shape)
     if not pallas_available():
         from karpenter_tpu.solver import native
 

@@ -58,8 +58,8 @@ class CostRouter:
     def __init__(self, probe_every: int = PROBE_EVERY, alpha: float = EMA_ALPHA):
         self.probe_every = probe_every
         self.alpha = alpha
-        self._ema: Dict[Tuple[str, tuple], float] = {}
-        self._solves: Dict[tuple, int] = {}
+        self._ema: Dict[Tuple[str, tuple], float] = {}  # guarded-by: self._lock
+        self._solves: Dict[tuple, int] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     # EMAs within this factor are a NEAR-TIE: the run-to-run noise exceeds
@@ -128,18 +128,24 @@ class CostRouter:
 # Process-shared default: schedulers come and go (worker hot-swap on spec
 # change, consolidation's per-plan shadow scheduler) but the cost landscape
 # is a property of the machine — a fresh scheduler must not re-pay cold
-# start on shapes the process has already measured.
-_default: Optional[CostRouter] = None
+# start on shapes the process has already measured. Several workers boot
+# concurrently (provisioning Apply runs per-provisioner), so the lazy init
+# must be locked — two racing initializations would hand different workers
+# different routers and split the cost landscape they exist to share.
+_default_lock = threading.Lock()
+_default: Optional[CostRouter] = None  # guarded-by: _default_lock
 
 
 def default_router() -> CostRouter:
     global _default
-    if _default is None:
-        _default = CostRouter()
-    return _default
+    with _default_lock:
+        if _default is None:
+            _default = CostRouter()
+        return _default
 
 
 def reset_default() -> None:
     """Tests isolate router learning with this."""
     global _default
-    _default = None
+    with _default_lock:
+        _default = None

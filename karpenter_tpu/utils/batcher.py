@@ -28,16 +28,22 @@ class Batcher:
         self.idle_duration = idle_duration
         self.max_items = max_items
         self._queue: "queue.Queue" = queue.Queue()
-        self._gate = threading.Event()
+        self._gate = threading.Event()  # guarded-by: self._gate_lock
         self._gate_lock = threading.Lock()
-        self._stopped = False
+        self._stopped = False  # guarded-by: self._gate_lock
 
     def add(self, item) -> threading.Event:
         """Enqueue an item; returns the gate event the caller may wait on —
         it is set when the batch containing the item has been processed
-        (reference: batcher.go:61-69)."""
+        (reference: batcher.go:61-69). After stop() the returned gate is
+        pre-set: no flush will ever run again, and a caller handed the
+        live gate would park on it for its full wait timeout."""
         self._queue.put(item)
         with self._gate_lock:
+            if self._stopped:
+                done = threading.Event()
+                done.set()
+                return done
             return self._gate
 
     def flush(self) -> None:
@@ -49,7 +55,12 @@ class Batcher:
         old.set()
 
     def stop(self) -> None:
-        self._stopped = True
+        # under the gate lock, paired with add()'s check: once _stopped is
+        # visible, add() hands out pre-set gates, and the flush() below
+        # releases everyone already parked on the live gate — no waiter is
+        # ever left on a gate that no flush will set again
+        with self._gate_lock:
+            self._stopped = True
         self._queue.put(None)  # wake the waiter
         self.flush()
 

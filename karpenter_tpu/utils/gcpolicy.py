@@ -27,8 +27,8 @@ import gc
 import threading
 
 _lock = threading.Lock()
-_frozen = False
-_saved_thresholds = None
+_frozen = False  # guarded-by: _lock
+_saved_thresholds = None  # guarded-by: _lock
 
 
 def freeze_after_warmup(gen0_threshold: int = 50000, unless=None) -> None:

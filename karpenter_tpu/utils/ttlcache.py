@@ -16,7 +16,7 @@ class TTLCache:
         self.ttl = ttl
         self.clock = clock or time.time
         self._lock = threading.Lock()
-        self._items: Dict[Any, Tuple[float, Any]] = {}  # key -> (expiry, value)
+        self._items: Dict[Any, Tuple[float, Any]] = {}  # key -> (expiry, value); guarded-by: self._lock
 
     def get(self, key) -> Optional[Any]:
         now = self.clock()

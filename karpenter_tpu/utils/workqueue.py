@@ -26,18 +26,18 @@ class TokenBucket:
         self.qps = qps
         self.burst = burst
         self.clock = clock or time.monotonic
-        self._tokens = float(burst)
-        self._last = self.clock()
+        self._tokens = float(burst)  # guarded-by: self._lock
+        self._last = self.clock()  # guarded-by: self._lock
         self._lock = threading.Lock()
 
-    def _refill(self) -> None:
+    def _refill_locked(self) -> None:
         now = self.clock()
         self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
         self._last = now
 
     def try_take(self) -> bool:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             if self._tokens >= 1:
                 self._tokens -= 1
                 return True
@@ -45,7 +45,7 @@ class TokenBucket:
 
     def wait_time(self) -> float:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             if self._tokens >= 1:
                 return 0.0
             return (1 - self._tokens) / self.qps
@@ -71,7 +71,7 @@ class ExponentialBackoff:
     def __init__(self, base: float = 0.005, cap: float = 1000.0):
         self.base = base
         self.cap = cap
-        self._failures: Dict[Any, int] = {}
+        self._failures: Dict[Any, int] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def when(self, item) -> float:
@@ -104,13 +104,13 @@ class RateLimitingQueue:
     def __init__(self, backoff: Optional[ExponentialBackoff] = None):
         self.backoff = backoff or ExponentialBackoff()
         self._lock = threading.Condition()
-        self._queue: deque = deque()
-        self._queued: Set[Any] = set()
-        self._processing: Set[Any] = set()
-        self._dirty: Set[Any] = set()  # re-added while processing
-        self._delayed: List[Tuple[float, int, Any]] = []  # heap of (ready_at, seq, item)
-        self._seq = 0
-        self._shutdown = False
+        self._queue: deque = deque()  # guarded-by: self._lock
+        self._queued: Set[Any] = set()  # guarded-by: self._lock
+        self._processing: Set[Any] = set()  # guarded-by: self._lock
+        self._dirty: Set[Any] = set()  # re-added while processing; guarded-by: self._lock
+        self._delayed: List[Tuple[float, int, Any]] = []  # (ready_at, seq, item) heap; guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+        self._shutdown = False  # guarded-by: self._lock
 
     def add(self, item) -> None:
         with self._lock:

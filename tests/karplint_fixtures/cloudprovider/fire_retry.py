@@ -1,0 +1,33 @@
+# karplint-fixture: expect=retry-idempotent
+"""Retried callables without the marker, and the inverse crime: a
+create-path mutator carrying @idempotent."""
+from karpenter_tpu.resilience import RetryPolicy, idempotent
+
+_policy = RetryPolicy(max_attempts=3, dependency="fixture")
+
+
+def launch_mutation(x):
+    return x + 1
+
+
+def run():
+    return _policy.call(launch_mutation, 1)  # fires: retried, unmarked
+
+
+def run_lambda():
+    return _policy.call(lambda: 0)  # fires: anonymous retried callable
+
+
+class FixtureProvider:
+    @idempotent
+    def create(self, request):  # fires: create must NOT be idempotent
+        return request
+
+    def delete(self, node):  # fires: retried by the metered decorator, unmarked
+        return None
+
+    def get_instance_types(self, provider=None):  # fires: unmarked
+        return []
+
+    def poll_disruptions(self):  # fires: unmarked
+        return []

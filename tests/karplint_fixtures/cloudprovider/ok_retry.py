@@ -1,0 +1,53 @@
+# karplint-fixture: clean=retry-idempotent
+"""Near-misses: breaker-only policies (max_attempts=1) need no marker,
+marked callables pass, abstract interfaces are exempt, and unresolvable
+callables are skipped rather than guessed at."""
+import abc
+
+from karpenter_tpu.resilience import RetryPolicy, idempotent
+
+_create_policy = RetryPolicy(max_attempts=1, dependency="fixture:create")
+_read_policy = RetryPolicy(max_attempts=3, dependency="fixture:read")
+
+
+def launch_once(request):
+    return request
+
+
+@idempotent
+def describe(name):
+    return name
+
+
+def run(fn):
+    _create_policy.call(launch_once, 1)  # breaker-only: no marker needed
+    _read_policy.call(describe, "n")  # marked: fine
+    _read_policy.call(fn)  # a parameter: unresolvable, skipped
+
+
+class AbstractProvider(abc.ABC):
+    @abc.abstractmethod
+    def create(self, request): ...
+
+    @abc.abstractmethod
+    def delete(self, node): ...
+
+    @abc.abstractmethod
+    def get_instance_types(self, provider=None): ...
+
+
+class GoodProvider:
+    def create(self, request):  # unmarked create: correct
+        return request
+
+    @idempotent
+    def delete(self, node):
+        return None
+
+    @idempotent
+    def get_instance_types(self, provider=None):
+        return []
+
+    @idempotent
+    def poll_disruptions(self):
+        return []

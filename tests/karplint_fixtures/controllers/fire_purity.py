@@ -1,0 +1,18 @@
+# karplint-fixture: expect=reconcile-io
+"""Raw I/O inside reconcile/poll bodies — every banned shape."""
+import time
+
+import requests
+
+
+class NodeController:
+    def reconcile(self, name):
+        time.sleep(1.0)  # unmetered stall, no Budget
+        requests.get("http://metadata/computeMetadata/v1/")  # bare HTTP
+        return None
+
+    def poll_disruptions(self):
+        import socket  # raw socket import inside a poll body
+
+        s = socket.socket()
+        return s

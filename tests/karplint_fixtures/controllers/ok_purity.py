@@ -1,0 +1,16 @@
+# karplint-fixture: clean=reconcile-io
+"""Near-misses: clocks are fine, sleeps outside reconcile bodies are
+fine (worker loops own their cadence), metered calls are the sanctioned
+route."""
+import time
+
+
+class NodeController:
+    def reconcile(self, name):
+        start = time.monotonic()  # reading a clock is not sleeping
+        self.cloud_provider.poll_disruptions()  # metered provider call
+        return max(0.0, 5.0 - (time.monotonic() - start))
+
+    def _worker_loop(self):
+        # not a reconcile/poll body: a worker thread may pace itself
+        time.sleep(0.1)

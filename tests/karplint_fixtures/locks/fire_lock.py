@@ -1,0 +1,30 @@
+# karplint-fixture: expect=lock-guard
+"""Guarded state mutated outside its declared lock: the PR-1 lazy-init
+race class, both as an instance attribute and a module global."""
+import threading
+
+_cache_lock = threading.Lock()
+_cache = None  # guarded-by: _cache_lock
+
+
+def get_cache():
+    global _cache
+    if _cache is None:
+        _cache = {}  # fires: unguarded lazy init of a guarded global
+    return _cache
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = set()  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+
+    def add(self, item):
+        self._items.add(item)  # fires: mutating method outside the lock
+        self._count += 1  # fires: augmented assign outside the lock
+
+    def drop(self, item):
+        with self._lock:
+            self._items.discard(item)
+        self._count -= 1  # fires: mutation after the with block closed

@@ -1,0 +1,35 @@
+# karplint-fixture: clean=lock-guard
+"""Near-misses: mutations under the declared lock, the `_locked`-suffix
+caller-holds convention, __init__ construction, and unannotated state
+(the rule is opt-in by annotation)."""
+import threading
+
+_cache_lock = threading.Lock()
+_cache = None  # guarded-by: _cache_lock
+
+
+def get_cache():
+    global _cache
+    with _cache_lock:
+        if _cache is None:
+            _cache = {}
+        return _cache
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = set()  # guarded-by: self._lock
+        self._stats = {}  # unannotated: the rule has no opinion
+
+    def add(self, item):
+        with self._lock:
+            self._items.add(item)
+            self._grow_locked(item)
+
+    def _grow_locked(self, item):
+        # `_locked` suffix: the caller holds self._lock
+        self._items.add(("grown", item))
+
+    def note(self, k, v):
+        self._stats[k] = v  # unannotated → clean

@@ -1,0 +1,14 @@
+# karplint-fixture: clean=lock-guard
+"""A real violation silenced by the per-line suppression comment — the
+escape hatch for deliberate single-writer phases (documented inline)."""
+import threading
+
+
+class Boot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phase = "cold"  # guarded-by: self._lock
+
+    def single_threaded_warmup(self):
+        # only the boot thread exists at this point
+        self._phase = "warm"  # karplint: disable=lock-guard

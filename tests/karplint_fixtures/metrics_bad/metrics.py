@@ -1,0 +1,9 @@
+# karplint-fixture: expect=metric-name
+"""Every naming-convention violation plus an undocumented metric."""
+from prometheus_client import Counter, Gauge, Histogram
+
+LAUNCHES = Counter("launches", "Launches.", namespace="karpenter")  # no _total
+NODES = Gauge("nodes_total", "Nodes.", namespace="karpenter")  # gauge ends _total
+SOLVE = Histogram("solve_time", "Solve time.", namespace="karpenter")  # no unit
+GHOST = Counter("karpenter_ghost_total", "Not in docs/metrics.md.")
+WEIRD = Gauge("Karpenter__weird_", "Bad charset.")

@@ -1,0 +1,7 @@
+# karplint-fixture: clean=metric-name
+"""Convention-conformant, documented metrics (see ../docs/metrics.md)."""
+from prometheus_client import Counter, Gauge, Histogram
+
+THINGS = Counter("ok_things_total", "Things that happened.", namespace="karpenter")
+DEPTH = Gauge("ok_queue_depth", "Items queued.", namespace="karpenter")
+DURATION = Histogram("ok_op_duration_seconds", "Op latency.", namespace="karpenter")

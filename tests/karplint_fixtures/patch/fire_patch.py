@@ -1,0 +1,36 @@
+# karplint-fixture: expect=patch-literal-list
+"""List-valued merge-patch fields written with literals — the RFC 7386
+wholesale-replace clobber, in every literal shape."""
+
+
+def set_active(cluster, name, cond):
+    cluster.patch_status(
+        "provisioners", name,
+        {"conditions": [cond]},  # fires: literal list erases other writers
+    )
+
+
+def taint(cluster, node_name, wire, extra):
+    cluster.merge_patch(
+        "nodes", node_name,
+        {
+            "spec": {
+                "unschedulable": True,
+                "taints": [wire] + extra,  # fires: concatenation literal
+            }
+        },
+    )
+
+
+def rebuild(cluster, pod, conds):
+    cluster.merge_patch(
+        "pods", pod,
+        {"status": {"conditions": [c for c in conds if c]}},  # fires: comprehension
+    )
+
+
+def finalize(cluster, name, fin):
+    cluster.merge_patch(
+        "nodes", name,
+        {"metadata": {"finalizers": [fin]}},  # fires: literal finalizers list
+    )

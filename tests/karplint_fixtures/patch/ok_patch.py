@@ -1,0 +1,20 @@
+# karplint-fixture: clean=patch-literal-list
+"""The sanctioned shapes: RMW helper calls and names built above."""
+from karpenter_tpu.kube.patch import upsert_condition, upsert_taint
+
+
+def set_active(cluster, name, base_wire, cond):
+    cluster.patch_status(
+        "provisioners", name,
+        {"conditions": upsert_condition(base_wire, cond)},
+    )
+
+
+def taint(cluster, node, wire):
+    full = upsert_taint([t for t in node.spec.taints], wire)
+    cluster.merge_patch("nodes", node.name, {"spec": {"taints": full}})
+
+
+def other_fields(cluster, name):
+    # non-list fields may be literals; scalar-only patches are fine
+    cluster.merge_patch("nodes", name, {"spec": {"unschedulable": True}})

@@ -1,0 +1,11 @@
+# karplint-fixture: expect=tracer-dtype
+"""Casts that disagree with the signature.py wire contract."""
+import numpy as np
+
+
+def upload(batch):
+    frontiers = np.asarray(batch.frontiers, np.int32)  # contract says f32
+    mask = batch.sig_type_mask.astype(np.int8)  # contract says bool
+    join = batch.join_table.astype(np.float32)  # contract says i32
+    usable = batch.usable.astype(np.float64)  # contract says f32
+    return frontiers, mask, join, usable

@@ -1,0 +1,32 @@
+# karplint-fixture: expect=tracer-branch, tracer-host-sync
+"""Every way the tracer rules must fire: data-dependent Python control
+flow and host syncs inside jit-reachable code, both directly in a jitted
+def and in a helper reached through the call graph."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n_max",))
+def bad_pack(pod_req, n_max):
+    total = jnp.sum(pod_req)
+    if total > 0:  # tracer-branch: Python `if` on a traced value
+        pod_req = pod_req + 1.0
+    host = float(total)  # tracer-host-sync: float() on a traced value
+    arr = np.asarray(pod_req)  # tracer-host-sync: numpy op on a traced value
+    count = total.item()  # tracer-host-sync: .item()
+    return pod_req, host, arr, count
+
+
+def _drain(x):
+    # reachable only through `entry` below — the cross-function graph
+    while x.sum() > 0:  # tracer-branch via reachability
+        x = x - 1
+    return x
+
+
+@jax.jit
+def entry(x):
+    return _drain(x)

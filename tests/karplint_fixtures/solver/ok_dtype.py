@@ -1,0 +1,12 @@
+# karplint-fixture: clean=tracer-dtype
+"""Contract-conformant casts, plus names outside the contract."""
+import numpy as np
+
+
+def upload(batch):
+    frontiers = np.asarray(batch.frontiers, np.float32)  # matches f32
+    join = batch.join_table.astype(np.int32)  # matches i32
+    usable = batch.usable.astype(np.float32)  # matches f32
+    pod_tab = batch.pod_core.astype(np.int16)  # not a contract name
+    other = np.asarray(batch.scratch, np.int64)  # not a contract name
+    return frontiers, join, usable, pod_tab, other

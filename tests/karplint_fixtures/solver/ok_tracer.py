@@ -1,0 +1,32 @@
+# karplint-fixture: clean=tracer-branch, tracer-host-sync
+"""Near-misses the tracer rules must NOT flag: static branches (shapes,
+static_argnames, module constants), jnp data flow, and host helpers that
+are not reachable from any jit root."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK = 8
+
+
+@partial(jax.jit, static_argnames=("n_max", "kernel"))
+def good_pack(pod_req, n_max, kernel):
+    P, R = pod_req.shape  # shape reads are static under tracing
+    if P % BLOCK != 0:  # static: shape arithmetic vs a module constant
+        raise ValueError("pad me")
+    if kernel == "scan":  # static: named in static_argnames
+        out = jnp.cumsum(pod_req, axis=0)
+    else:
+        out = pod_req
+    n = max(BLOCK, n_max)  # static arithmetic
+    return jnp.where(out > 0, out, 0.0)[:n]  # data-dependence via where, not `if`
+
+
+def host_decode(buf, n):
+    # NOT reachable from a jit root: host numpy and float() are the point
+    arr = np.asarray(buf)
+    if arr.sum() > 0:
+        return float(arr[0]), int(n)
+    return 0.0, int(n)

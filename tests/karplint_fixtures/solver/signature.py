@@ -1,0 +1,18 @@
+# karplint-fixture: clean=tracer-dtype
+"""Minimal dtype-contract source: the tracer-dtype rule parses the
+``# [shape] dtype`` trailing comments off this file (the corpus stand-in
+for karpenter_tpu/solver/signature.py)."""
+
+
+class Signature:
+    sig_id: int
+    type_mask: object  # [T] bool — types surviving requirement compat
+    frontier: object  # [F, R] f32 — Pareto-max usable capacities
+
+
+class SignatureTable:
+    def __init__(
+        self,
+        usable_capacity,  # [T, R] capacity - overhead, f32
+    ):
+        self.usable = usable_capacity

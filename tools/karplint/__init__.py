@@ -1,0 +1,30 @@
+"""karplint — project-invariant static analysis for karpenter-tpu.
+
+Stdlib-only (pure ``ast``): it must run in any build stage — the slim
+Docker image, CI before dependencies install, a contributor's bare
+checkout — without importing the package under analysis.
+
+Rule families (docs/static-analysis.md has the catalog and the incident
+each rule descends from):
+
+- ``tracer-*``   — tracer safety inside jit/vmap/pallas-reachable solver code
+- ``lock-guard`` — ``# guarded-by:`` lock discipline for shared state
+- ``reconcile-io`` — no raw sleeps/sockets/HTTP inside controller reconciles
+- ``retry-idempotent`` — retried callables carry ``@idempotent``; create-path
+  mutators must not
+- ``patch-literal-list`` — RFC 7386 list-valued patches go through the RMW
+  helpers
+- ``metric-name`` — Prometheus naming conventions + docs listing
+"""
+
+from tools.karplint.core import (  # noqa: F401
+    Analyzer,
+    Baseline,
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    rule_names,
+)
+
+__version__ = "1.0"

@@ -1,0 +1,177 @@
+"""karplint CLI.
+
+Usage (from the repo root)::
+
+    python -m tools.karplint karpenter_tpu           # analyze the tree
+    python -m tools.karplint --list-rules
+    python -m tools.karplint --selftest tests/karplint_fixtures
+    python -m tools.karplint --write-baseline karpenter_tpu
+
+Exit codes: 0 clean, 1 findings (or a failed selftest), 2 usage/config
+error. ``--selftest`` runs the analyzer over the fixture corpus and checks
+each fixture's expectation header::
+
+    # karplint-fixture: expect=rule-a,rule-b   (each rule must fire here)
+    # karplint-fixture: clean=rule-a           (rule must NOT fire here)
+
+and additionally requires every registered rule to be demonstrated by at
+least one ``expect`` fixture — a rule nobody can make fire is a rule that
+is silently broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+from pathlib import Path
+
+# allow `python tools/karplint` as well as `python -m tools.karplint`
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from tools.karplint.core import Analyzer, Baseline, all_rules  # noqa: E402
+
+FIXTURE_RE = re.compile(r"#\s*karplint-fixture:\s*(expect|clean)=([A-Za-z0-9_\-, ]+)")
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="karplint")
+    ap.add_argument("paths", nargs="*", default=[], help="files/dirs to analyze")
+    ap.add_argument("--root", default=".", help="project root (docs + relative paths)")
+    ap.add_argument("--rules", help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE))
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--allow-p0-baseline", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--selftest", metavar="CORPUS",
+                    help="run the fixture corpus and verify every rule fires")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:22s} [{rule.severity}] {rule.doc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    root = Path(args.root)
+
+    if args.selftest:
+        return _selftest(Path(args.selftest), rules)
+
+    paths = args.paths or ["karpenter_tpu"]
+    try:
+        analyzer = Analyzer(root, paths, rules=rules)
+    except ValueError as e:
+        print(f"karplint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        all_pairs = analyzer.fingerprints()
+        pairs = [
+            (f, fp) for f, fp in all_pairs
+            if f.severity != "P0" or args.allow_p0_baseline
+        ]
+        Baseline.from_findings(pairs).save(Path(args.baseline))
+        print(f"karplint: wrote {len(pairs)} entries to {args.baseline}")
+        skipped = len(all_pairs) - len(pairs)
+        if skipped:
+            print(
+                f"karplint: {skipped} P0 finding(s) NOT baselined — fix them",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    t0 = time.perf_counter()
+    baseline = None if args.no_baseline else Baseline.load(Path(args.baseline))
+    active, baselined = analyzer.run(
+        baseline=baseline, allow_p0_baseline=args.allow_p0_baseline
+    )
+    elapsed = time.perf_counter() - t0
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.__dict__ for f in active],
+                "baselined": len(baselined),
+                "parse_errors": analyzer.parse_errors,
+                "elapsed_s": round(elapsed, 3),
+            },
+            indent=2,
+        ))
+    else:
+        for f in active:
+            print(f.render())
+        for err in analyzer.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        summary = (
+            f"karplint: {len(active)} finding(s), {len(baselined)} baselined, "
+            f"{len(analyzer.rules)} rules, {elapsed:.2f}s"
+        )
+        print(summary, file=sys.stderr)
+    return 1 if active or analyzer.parse_errors else 0
+
+
+def _selftest(corpus: Path, rules=None) -> int:
+    if not corpus.is_dir():
+        print(f"karplint: no fixture corpus at {corpus}", file=sys.stderr)
+        return 2
+    analyzer = Analyzer(corpus, ["."], rules=rules)
+    active, _ = analyzer.run(baseline=None)
+    by_file: dict = {}
+    for f in active:
+        by_file.setdefault(f.path, []).append(f)
+
+    failures = []
+    demonstrated = set()
+    fixture_count = 0
+    for src_path in sorted(p.relative_to(corpus).as_posix() for p in corpus.rglob("*.py")):
+        text = (corpus / src_path).read_text()
+        expects, cleans = set(), set()
+        for kind, names in FIXTURE_RE.findall(text):
+            names = {n.strip() for n in names.split(",") if n.strip()}
+            (expects if kind == "expect" else cleans).update(names)
+        if not expects and not cleans:
+            continue
+        fixture_count += 1
+        fired = {f.rule for f in by_file.get(src_path, [])}
+        for rule in sorted(expects):
+            demonstrated.add(rule)
+            if rule not in fired:
+                failures.append(f"{src_path}: expected `{rule}` to fire; it did not")
+        for rule in sorted(cleans):
+            if rule in fired:
+                lines = [
+                    str(f.line) for f in by_file[src_path] if f.rule == rule
+                ]
+                failures.append(
+                    f"{src_path}: `{rule}` fired on a near-miss "
+                    f"(line {', '.join(lines)})"
+                )
+
+    registered = {r.name for r in analyzer.rules}
+    for rule in sorted(registered - demonstrated):
+        failures.append(
+            f"rule `{rule}` has no firing fixture in {corpus} — add one"
+        )
+
+    if failures:
+        for msg in failures:
+            print(f"selftest FAIL: {msg}")
+        return 1
+    print(
+        f"karplint selftest: {fixture_count} fixtures, "
+        f"{len(registered)} rules demonstrated, corpus behaves"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,355 @@
+"""karplint core: project model, rule registry, suppressions, baseline.
+
+The analyzer parses every ``*.py`` under the scan paths ONCE into a
+:class:`Project` (source text + ast + per-line suppressions), then hands the
+whole project to each registered :class:`Rule`. Rules are project-scoped —
+the tracer rules need a cross-file call graph, the metric rule needs the
+docs tree — and file-local rules simply iterate ``project.files``.
+
+Suppression syntax (per line, same line as the finding)::
+
+    something_suspect()  # karplint: disable=rule-name
+    something_else()     # karplint: disable          (all rules)
+
+Baseline: a checked-in JSON of grandfathered finding fingerprints
+(``tools/karplint/baseline.json``). A fingerprint hashes (rule, path,
+normalized source line) — not the line NUMBER — so unrelated edits above a
+grandfathered finding don't resurrect it. P0 findings are never
+baselineable: the baseline exists to stage P1 cleanups, not to silence
+races and host syncs.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*karplint:\s*disable(?:=([A-Za-z0-9_\-, ]+))?")
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+P0 = "P0"  # must fix — never baselineable
+P1 = "P1"  # should fix — baselineable while staged
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix, relative to the project root
+    line: int
+    severity: str
+    message: str
+
+    def fingerprint(self, source_line: str) -> str:
+        basis = f"{self.rule}|{self.path}|{' '.join(source_line.split())}"
+        return hashlib.sha1(basis.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.severity}] {self.rule}: {self.message}"
+
+
+class SourceFile:
+    def __init__(self, root: Path, abspath: Path):
+        self.abspath = abspath
+        self.path = abspath.relative_to(root).as_posix()
+        self.text = abspath.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(abspath))
+        # line -> None (all rules) or set of rule names
+        self.suppressions: Dict[int, Optional[Set[str]]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                names = m.group(1)
+                self.suppressions[lineno] = (
+                    {n.strip() for n in names.split(",") if n.strip()}
+                    if names
+                    else None
+                )
+        # parent links: rules need lexical enclosure (with-blocks, classes)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressions.get(finding.line, False)
+        if rules is False:
+            return False
+        return rules is None or finding.rule in rules
+
+    def guarded_by(self, lineno: int) -> Optional[str]:
+        """The ``# guarded-by: <lock>`` annotation on this line, if any."""
+        m = GUARDED_BY_RE.search(self.line_at(lineno))
+        return m.group(1) if m else None
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+class Project:
+    def __init__(self, root: Path, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self.by_path = {f.path: f for f in self.files}
+
+    def matching(self, pred: Callable[[str], bool]) -> List[SourceFile]:
+        return [f for f in self.files if pred(f.path)]
+
+
+class Rule:
+    """One invariant. Subclasses set ``name``/``severity``/``doc`` and
+    implement ``run(project)``. ``path_must_contain`` (when set) restricts
+    which files the convenience ``files()`` iterator yields — the rule
+    itself decides whether to use it."""
+
+    name: str = ""
+    severity: str = P1
+    doc: str = ""
+    path_must_contain: Optional[Tuple[str, ...]] = None
+
+    def files(self, project: Project) -> List[SourceFile]:
+        if not self.path_must_contain:
+            return project.files
+        return project.matching(
+            lambda p: any(s in p for s in self.path_must_contain)
+        )
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str, severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.name, path=path, line=line,
+            severity=severity or self.severity, message=message,
+        )
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: type) -> type:
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"rule {rule_cls.__name__} has no name")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name}")
+    _REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    _load_rules()
+    return [_REGISTRY[n] for n in sorted(_REGISTRY)]
+
+
+def rule_names() -> List[str]:
+    _load_rules()
+    return sorted(_REGISTRY)
+
+
+_rules_loaded = False
+
+
+def _load_rules() -> None:
+    global _rules_loaded
+    if _rules_loaded:
+        return
+    # import for side effect: each module registers its rules
+    from tools.karplint.rules import (  # noqa: F401
+        locks,
+        metric_names,
+        patch,
+        purity,
+        retry,
+        tracer,
+    )
+
+    _rules_loaded = True
+
+
+class Baseline:
+    """Checked-in set of grandfathered finding fingerprints."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+        self._index = {(e["rule"], e["path"], e["fingerprint"]) for e in self.entries}
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        return cls(data.get("findings", []))
+
+    def save(self, path: Path) -> None:
+        path.write_text(
+            json.dumps(
+                {"version": 1, "findings": self.entries}, indent=2, sort_keys=True
+            )
+            + "\n"
+        )
+
+    def contains(self, finding: Finding, fingerprint: str) -> bool:
+        return (finding.rule, finding.path, fingerprint) in self._index
+
+    @classmethod
+    def from_findings(cls, pairs: List[Tuple[Finding, str]]) -> "Baseline":
+        entries = [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "fingerprint": fp,
+                "justification": "TODO: why this finding is grandfathered",
+            }
+            for f, fp in sorted(pairs, key=lambda p: (p[0].path, p[0].line))
+        ]
+        return cls(entries)
+
+
+def _iter_py_files(root: Path, paths: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        target = (root / p).resolve()
+        if target.is_file() and target.suffix == ".py":
+            out.append(target)
+        elif target.is_dir():
+            for f in sorted(target.rglob("*.py")):
+                if "__pycache__" in f.parts or any(
+                    part.startswith(".") for part in f.parts
+                ):
+                    continue
+                out.append(f)
+    return out
+
+
+class Analyzer:
+    def __init__(
+        self,
+        root: Path,
+        paths: Sequence[str],
+        rules: Optional[Sequence[str]] = None,
+    ):
+        self.root = root.resolve()
+        self.paths = list(paths)
+        wanted = set(rules) if rules else None
+        self.rules = [
+            r for r in all_rules() if wanted is None or r.name in wanted
+        ]
+        if wanted:
+            unknown = wanted - {r.name for r in self.rules}
+            if unknown:
+                raise ValueError(f"unknown rules: {sorted(unknown)}")
+        self.parse_errors: List[str] = []
+
+    def load(self) -> Project:
+        files = []
+        for abspath in _iter_py_files(self.root, self.paths):
+            try:
+                files.append(SourceFile(self.root, abspath))
+            except SyntaxError as e:
+                self.parse_errors.append(f"{abspath}: {e}")
+        return Project(self.root, files)
+
+    def run(
+        self, baseline: Optional[Baseline] = None, allow_p0_baseline: bool = False
+    ) -> Tuple[List[Finding], List[Finding]]:
+        """Returns (active findings, baselined findings)."""
+        project = self.load()
+        active: List[Finding] = []
+        baselined: List[Finding] = []
+        for rule in self.rules:
+            for f in rule.run(project):
+                src = project.by_path[f.path]
+                if src.suppressed(f):
+                    continue
+                fp = f.fingerprint(src.line_at(f.line))
+                if (
+                    baseline is not None
+                    and baseline.contains(f, fp)
+                    and (f.severity != P0 or allow_p0_baseline)
+                ):
+                    baselined.append(f)
+                else:
+                    active.append(f)
+        active.sort(key=lambda f: (f.path, f.line, f.rule))
+        baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+        return active, baselined
+
+    def fingerprints(self) -> List[Tuple[Finding, str]]:
+        """(finding, fingerprint) for every unsuppressed finding — the
+        ``--write-baseline`` surface."""
+        project = self.load()
+        out = []
+        for rule in self.rules:
+            for f in rule.run(project):
+                src = project.by_path[f.path]
+                if src.suppressed(f):
+                    continue
+                out.append((f, f.fingerprint(src.line_at(f.line))))
+        return out
+
+
+# --- shared ast helpers used by several rules -------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    names = []
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = dotted_name(target)
+        if dn:
+            names.append(dn)
+    return names
+
+
+def import_tables(tree: ast.Module) -> Tuple[Dict[str, str], Dict[str, Tuple[str, str]]]:
+    """(module alias -> dotted module, symbol alias -> (dotted module, symbol)).
+
+    ``import a.b as c`` -> modules['c'] = 'a.b'
+    ``from a import b as c`` -> symbols['c'] = ('a', 'b')  AND, because
+    ``b`` may itself be a module, modules['c'] = 'a.b'.
+    """
+    modules: Dict[str, str] = {}
+    symbols: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                modules[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                local = alias.asname or alias.name
+                symbols[local] = (node.module, alias.name)
+                modules[local] = f"{node.module}.{alias.name}"
+    return modules, symbols

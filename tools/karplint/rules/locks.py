@@ -1,0 +1,194 @@
+"""Lock discipline: ``# guarded-by:`` annotations.
+
+Shared state is declared at its initialization site::
+
+    self._gauged: set = set()        # guarded-by: self._lock
+    _default: Optional[T] = None     # guarded-by: _default_lock   (module)
+
+Every MUTATION of a guarded attribute (assignment, augmented assignment,
+subscript store/delete, or a call to a known mutating method — ``add``,
+``pop``, ``append``, ...) must then sit lexically inside ``with <lock>:``
+on the declared lock. This is the PR-1 lazy-init-race class made
+un-reintroducible: the annotation is the contract, the analyzer is the
+enforcement.
+
+Accepted hold-proofs (lexical, intentionally conservative):
+
+- a ``with self._lock:`` / ``with _lock:`` ancestor matching the declared
+  lock expression;
+- the enclosing function's name ends in ``_locked`` (the codebase's
+  caller-holds-the-lock convention, e.g. ``_pump_delayed_locked``);
+- the mutation is in ``__init__`` (for instance attributes) or at module
+  level (for globals) — construction precedes sharing.
+
+Reads are NOT checked: lock-free reads of monotonic or GIL-atomic state
+are a deliberate pattern here (breaker fast paths, double-checked init),
+and flagging them would teach people to suppress the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.karplint.core import (
+    P0,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+MUTATING_METHODS = {
+    "add", "append", "appendleft", "extend", "insert", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault",
+    "put", "put_nowait", "push", "sort", "reverse",
+}
+
+
+def _lock_matches(context_expr: ast.AST, lock: str) -> bool:
+    dn = dotted_name(context_expr)
+    return dn == lock
+
+
+def _held(src: SourceFile, node: ast.AST, lock: str) -> bool:
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if _lock_matches(item.context_expr, lock):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if anc.name.endswith("_locked"):
+                return True
+    return False
+
+
+def _enclosing_function(src: SourceFile, node: ast.AST) -> Optional[ast.AST]:
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _enclosing_class(src: SourceFile, node: ast.AST) -> Optional[ast.ClassDef]:
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+@register
+class LockGuardRule(Rule):
+    name = "lock-guard"
+    severity = P0
+    doc = (
+        "An attribute or module global declared `# guarded-by: <lock>` is "
+        "mutated outside a `with <lock>:` block — the unguarded lazy-init/"
+        "shared-mutation race class."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            findings.extend(self._check_file(src))
+        return findings
+
+    def _check_file(self, src: SourceFile) -> List[Finding]:
+        # class qualname -> {attr -> lock}; "" -> module globals
+        guarded: Dict[str, Dict[str, str]] = {}
+        for node in ast.walk(src.tree):
+            target = None
+            if isinstance(node, ast.AnnAssign):
+                target = node.target
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            if target is None:
+                continue
+            lock = src.guarded_by(node.lineno)
+            if lock is None:
+                continue
+            cls = _enclosing_class(src, node)
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and cls is not None
+            ):
+                guarded.setdefault(cls.name, {})[target.attr] = lock
+            elif isinstance(target, ast.Name) and cls is None and (
+                _enclosing_function(src, node) is None
+            ):
+                guarded.setdefault("", {})[target.id] = lock
+
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            hit = self._mutation(src, node, guarded)
+            if hit is None:
+                continue
+            name, lock, mut_node = hit
+            fn = _enclosing_function(src, mut_node)
+            if fn is None:
+                continue  # module-level / class-body init
+            if fn.name == "__init__" and name.startswith("self."):
+                continue  # construction precedes sharing
+            if _held(src, mut_node, lock):
+                continue
+            findings.append(
+                self.finding(
+                    src.path, mut_node.lineno,
+                    f"`{name}` is declared guarded-by `{lock}` but is mutated "
+                    f"outside `with {lock}:` (in `{fn.name}`)",
+                )
+            )
+        return findings
+
+    def _mutation(
+        self, src: SourceFile, node: ast.AST, guarded: Dict[str, Dict[str, str]]
+    ) -> Optional[Tuple[str, str, ast.AST]]:
+        """(display name, lock, node) when ``node`` mutates a guarded target."""
+
+        def lookup(target: ast.AST) -> Optional[Tuple[str, str]]:
+            # self.attr (class scope) / bare Name (module scope); also
+            # self.attr[k] and name[k] subscript stores
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                cls = _enclosing_class(src, target)
+                if cls is not None:
+                    lock = guarded.get(cls.name, {}).get(target.attr)
+                    if lock:
+                        return f"self.{target.attr}", lock
+                return None
+            if isinstance(target, ast.Name):
+                lock = guarded.get("", {}).get(target.id)
+                if lock:
+                    return target.id, lock
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                hit = lookup(t)
+                if hit:
+                    return hit[0], hit[1], node
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                hit = lookup(t)
+                if hit:
+                    return hit[0], hit[1], node
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in MUTATING_METHODS:
+                hit = lookup(node.func.value)
+                if hit:
+                    return f"{hit[0]}.{node.func.attr}()", hit[1], node
+        return None

@@ -1,0 +1,184 @@
+"""Metric naming + documentation listing (the folded
+``hack/check_metrics_names.py`` pass).
+
+Scans every ``metrics.py`` (the two registries: ``karpenter_tpu/metrics.py``
+and ``karpenter_tpu/cloudprovider/metrics.py``) for
+``Counter``/``Gauge``/``Histogram`` constructions, computes the full
+exposed name (``namespace_subsystem_name``), and asserts:
+
+- Prometheus naming: ``[a-z][a-z0-9_]*``, no ``__``, no leading/trailing
+  underscore;
+- counters end ``_total``; gauges don't; histograms end in a unit suffix
+  (``_seconds``, ``_bytes``, ...);
+- no two metrics expose the same full name;
+- every full name is listed in ``docs/metrics.md`` — an undocumented
+  metric is a dashboard nobody can find and a rename nobody will notice.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    dotted_name,
+    register,
+)
+
+METRIC_TYPES = ("Counter", "Gauge", "Histogram", "Summary")
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+HISTOGRAM_UNITS = (
+    "_seconds", "_bytes", "_pods", "_ratio", "_items", "_size", "_count",
+)
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _resolve_kwarg(call: ast.Call, name: str, module_consts: dict) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            s = _const_str(kw.value)
+            if s is not None:
+                return s
+            if isinstance(kw.value, ast.Name):
+                return module_consts.get(kw.value.id)
+    return None
+
+
+@register
+class MetricNameRule(Rule):
+    name = "metric-name"
+    severity = P1
+    doc = (
+        "A registered Prometheus metric violates naming conventions "
+        "(charset, _total on counters, unit suffix on histograms), "
+        "collides with another metric, or is missing from docs/metrics.md."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        metric_files = [
+            f for f in project.files if f.path.rsplit("/", 1)[-1] == "metrics.py"
+        ]
+        if not metric_files:
+            return []
+        docs_path = project.root / "docs" / "metrics.md"
+        docs_text = docs_path.read_text() if docs_path.exists() else None
+
+        findings: List[Finding] = []
+        seen: dict = {}
+        for src in metric_files:
+            module_consts = {
+                t.id: node.value.value
+                for node in src.tree.body
+                if isinstance(node, ast.Assign)
+                for t in node.targets
+                if isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            }
+            if docs_text is None:
+                findings.append(
+                    self.finding(
+                        src.path, 1,
+                        "docs/metrics.md is missing — every registered metric "
+                        "must be listed there",
+                    )
+                )
+            # single-level helpers (def _node_gauge(name, doc): return
+            # Gauge(name, ...)): calls to them register metrics too
+            helpers = {}
+            for fn in src.tree.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                for stmt in fn.body:
+                    if (
+                        isinstance(stmt, ast.Return)
+                        and isinstance(stmt.value, ast.Call)
+                        and (dotted_name(stmt.value.func) or "").rsplit(".", 1)[-1]
+                        in METRIC_TYPES
+                        and stmt.value.args
+                        and isinstance(stmt.value.args[0], ast.Name)
+                        and fn.args.args
+                        and stmt.value.args[0].id == fn.args.args[0].arg
+                    ):
+                        helpers[fn.name] = (
+                            (dotted_name(stmt.value.func) or "").rsplit(".", 1)[-1],
+                            stmt.value,
+                        )
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func) or ""
+                mtype = dn.rsplit(".", 1)[-1]
+                inner = node
+                if mtype in helpers:
+                    mtype, inner = helpers[mtype]
+                elif mtype not in METRIC_TYPES:
+                    continue
+                base = _const_str(node.args[0]) if node.args else None
+                if base is None:
+                    continue  # the helper's own inner Call carries a Name arg
+                ns = _resolve_kwarg(inner, "namespace", module_consts) or ""
+                ss = _resolve_kwarg(inner, "subsystem", module_consts) or ""
+                full = "_".join(p for p in (ns, ss, base) if p)
+                line = node.lineno
+
+                if not NAME_RE.match(full) or "__" in full or full.endswith("_"):
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"metric `{full}` violates Prometheus naming "
+                            "([a-z][a-z0-9_]*, no __, no trailing _)",
+                        )
+                    )
+                if mtype == "Counter" and not full.endswith("_total"):
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"counter `{full}` must end in `_total`",
+                        )
+                    )
+                if mtype == "Gauge" and full.endswith("_total"):
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"gauge `{full}` must not end in `_total` "
+                            "(reads as a counter)",
+                        )
+                    )
+                if mtype == "Histogram" and not full.endswith(HISTOGRAM_UNITS):
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"histogram `{full}` should end in a unit suffix "
+                            f"({', '.join(HISTOGRAM_UNITS)})",
+                        )
+                    )
+                prior = seen.get(full)
+                if prior is not None:
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"metric `{full}` already registered at "
+                            f"{prior[0]}:{prior[1]}",
+                        )
+                    )
+                else:
+                    seen[full] = (src.path, line)
+                if docs_text is not None and full not in docs_text:
+                    findings.append(
+                        self.finding(
+                            src.path, line,
+                            f"metric `{full}` is not listed in docs/metrics.md",
+                        )
+                    )
+        return findings

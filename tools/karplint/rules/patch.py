@@ -1,0 +1,97 @@
+"""Patch safety: RFC 7386 list-valued writes go through the RMW helpers.
+
+JSON merge patch replaces arrays WHOLESALE: a patch carrying
+``{"conditions": [mine]}`` erases every condition owned by another writer
+(the PR-1 ``_set_active`` clobber). List-valued fields with multiple
+writers — ``conditions``, ``taints`` — must be written as a
+read-modify-write of the freshest cached object, through the helpers in
+``karpenter_tpu.kube.patch`` (``upsert_condition`` / ``upsert_keyed`` /
+``without_keyed``).
+
+The rule inspects dict literals passed to ``merge_patch`` /
+``patch_status`` (recursing through nested literals): a ``conditions`` /
+``taints`` / ``finalizers`` key may carry
+
+- a bare name (the builder pattern: the full RMW'd list built above), or
+- a call to one of the RMW helpers;
+
+a list literal, comprehension, or concatenation directly in the patch is
+the clobber shape and fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from tools.karplint.core import (
+    P0,
+    Finding,
+    Project,
+    Rule,
+    dotted_name,
+    register,
+)
+
+PATCH_METHODS = ("merge_patch", "patch_status")
+LIST_FIELDS = ("conditions", "taints", "finalizers")
+RMW_HELPERS = {
+    "upsert_condition", "upsert_keyed", "without_keyed", "without_value",
+    "upsert_taint", "merge_conditions",
+}
+
+
+def _list_fields_in(d: ast.Dict) -> Iterator[Tuple[str, ast.AST]]:
+    for key, value in zip(d.keys, d.values):
+        if isinstance(key, ast.Constant) and key.value in LIST_FIELDS:
+            yield key.value, value
+        if isinstance(value, ast.Dict):
+            yield from _list_fields_in(value)
+
+
+def _is_rmw_value(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Name, ast.Attribute)):
+        return True  # built (and RMW'd) above; the reader can audit one name
+    if isinstance(value, ast.Call):
+        dn = dotted_name(value.func) or ""
+        return dn.rsplit(".", 1)[-1] in RMW_HELPERS
+    return False
+
+
+@register
+class PatchLiteralListRule(Rule):
+    name = "patch-literal-list"
+    severity = P0
+    doc = (
+        "A merge-patch writes a list-valued field (conditions/taints) with "
+        "a literal list — RFC 7386 replaces arrays wholesale, erasing other "
+        "writers' entries; go through kube.patch's RMW helpers."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            if src.path.endswith("kube/patch.py"):
+                continue  # the helpers themselves build the lists
+            for node in ast.walk(src.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in PATCH_METHODS
+                ):
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if not isinstance(arg, ast.Dict):
+                        continue
+                    for field, value in _list_fields_in(arg):
+                        if not _is_rmw_value(value):
+                            findings.append(
+                                self.finding(
+                                    src.path, value.lineno,
+                                    f"`{field}` written with a literal list in a "
+                                    f"{node.func.attr} payload — RFC 7386 replaces "
+                                    "arrays wholesale; build the full list via "
+                                    "kube.patch.upsert_keyed/upsert_condition",
+                                )
+                            )
+        return findings

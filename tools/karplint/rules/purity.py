@@ -1,0 +1,122 @@
+"""Reconcile purity: controller reconcile/poll bodies do no raw I/O.
+
+A reconcile round runs under a wall-clock Budget and behind per-dependency
+circuit breakers; a bare ``time.sleep`` or a direct ``socket`` /
+``http.client`` / ``requests`` call bypasses all of it — unmetered latency
+with no deadline, no retry classification, no breaker. I/O must route
+through the metered cloud decorator (``cloudprovider.metrics.decorate``)
+or ``resilience.RetryPolicy``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.karplint.core import (
+    P0,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+RECONCILE_NAMES = ("reconcile", "poll")
+
+BANNED_CALLS = {
+    "time.sleep": "`time.sleep` stalls the reconcile round outside any Budget",
+}
+BANNED_PREFIXES = {
+    "socket.": "raw socket I/O",
+    "requests.": "bare `requests` call",
+    "http.client": "raw `http.client` use",
+    "urllib.request": "raw `urllib.request` use",
+}
+
+
+def _is_reconcile(fn: ast.AST) -> bool:
+    name = getattr(fn, "name", "")
+    return any(name == n or name.startswith(n + "_") for n in RECONCILE_NAMES)
+
+
+@register
+class ReconcileIORule(Rule):
+    name = "reconcile-io"
+    severity = P0
+    doc = (
+        "time.sleep / raw socket / bare HTTP call inside a controller "
+        "reconcile or poll body — I/O must go through the metered cloud "
+        "decorator or resilience.RetryPolicy."
+    )
+    path_must_contain = ("controllers/",)
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in self.files(project):
+            sleep_aliases = self._sleep_aliases(src)
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and _is_reconcile(node):
+                    self._check_body(src, node, sleep_aliases, findings)
+        return findings
+
+    @staticmethod
+    def _sleep_aliases(src: SourceFile) -> set:
+        out = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "sleep":
+                        out.add(alias.asname or "sleep")
+        return out
+
+    def _check_body(
+        self, src: SourceFile, fn: ast.AST, sleep_aliases: set, findings: List[Finding]
+    ) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    mods = [node.module]
+                for mod in mods:
+                    for prefix, why in BANNED_PREFIXES.items():
+                        if mod == prefix.rstrip(".") or mod.startswith(prefix):
+                            findings.append(
+                                self.finding(
+                                    src.path, node.lineno,
+                                    f"{why} imported inside `{fn.name}` — route "
+                                    "through the metered provider or RetryPolicy",
+                                )
+                            )
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            if dn in BANNED_CALLS:
+                findings.append(
+                    self.finding(
+                        src.path, node.lineno,
+                        f"{BANNED_CALLS[dn]} (in `{fn.name}`)",
+                    )
+                )
+            elif dn in sleep_aliases:
+                findings.append(
+                    self.finding(
+                        src.path, node.lineno,
+                        f"`time.sleep` stalls the reconcile round outside any "
+                        f"Budget (in `{fn.name}`)",
+                    )
+                )
+            else:
+                for prefix, why in BANNED_PREFIXES.items():
+                    if dn.startswith(prefix):
+                        findings.append(
+                            self.finding(
+                                src.path, node.lineno,
+                                f"{why} in `{fn.name}` — route through the "
+                                "metered provider or RetryPolicy",
+                            )
+                        )
+                        break

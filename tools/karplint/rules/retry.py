@@ -1,0 +1,190 @@
+"""Retry/idempotency discipline.
+
+``resilience.RetryPolicy`` re-invokes its callable on transient failure.
+That is only sound when the callable is idempotent — and the codebase
+marks that property explicitly with ``@idempotent``
+(``karpenter_tpu.resilience.idempotent``). Two enforcement surfaces:
+
+1. **Direct call sites**: ``policy.call(fn, ...)`` where ``policy`` is a
+   ``RetryPolicy`` constructed with ``max_attempts > 1`` and ``fn``
+   resolves to a def in the same file — the def must carry
+   ``@idempotent``. Unresolvable callables (parameters, bound methods of
+   arbitrary objects) are skipped, not guessed at.
+
+2. **The provider interface**: concrete ``CloudProvider`` implementations
+   (classes under ``cloudprovider/`` defining both ``create`` and
+   ``delete``) are wrapped by the metered decorator, whose policy table
+   retries ``delete`` / ``get_instance_types`` / ``poll_disruptions`` —
+   those methods must be ``@idempotent``. ``create`` is breaker-only by
+   design (a replayed create orphans instances), so a ``create`` marked
+   ``@idempotent`` is itself a finding: the marker would invite someone
+   to raise ``max_attempts`` on the create policy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tools.karplint.core import (
+    P0,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    decorator_names,
+    dotted_name,
+    register,
+)
+
+RETRIED_PROVIDER_METHODS = ("delete", "get_instance_types", "poll_disruptions")
+
+
+def _has_idempotent(fn: ast.AST) -> bool:
+    return any(dn.rsplit(".", 1)[-1] == "idempotent" for dn in decorator_names(fn))
+
+
+def _is_abstract(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        dn = dotted_name(base) or ""
+        if dn.rsplit(".", 1)[-1] == "ABC":
+            return True
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(
+                dn.rsplit(".", 1)[-1] == "abstractmethod"
+                for dn in decorator_names(node)
+            ):
+                return True
+    return False
+
+
+def _max_attempts(call: ast.Call) -> int:
+    for kw in call.keywords:
+        if kw.arg == "max_attempts":
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, int):
+                return kw.value.value
+            return 99  # dynamic — assume retrying
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, int
+    ):
+        return call.args[0].value
+    return 3  # RetryPolicy's default
+
+
+@register
+class RetryIdempotentRule(Rule):
+    name = "retry-idempotent"
+    severity = P0
+    doc = (
+        "A callable retried by RetryPolicy lacks the @idempotent marker, "
+        "or a create-path mutator carries it — retrying a non-idempotent "
+        "mutator double-applies it; marking create invites retries that "
+        "orphan instances."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            self._check_call_sites(src, findings)
+            if "cloudprovider/" in src.path:
+                self._check_providers(src, findings)
+        return findings
+
+    def _check_call_sites(self, src: SourceFile, findings: List[Finding]) -> None:
+        # policy name -> max_attempts, from RetryPolicy(...) constructions
+        policies: Dict[str, int] = {}
+        local_defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, node)
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target, value = node.targets[0], node.value
+            tname = dotted_name(target)
+            if tname is None:
+                continue
+            if isinstance(value, ast.Call) and (dotted_name(value.func) or "").endswith(
+                "RetryPolicy"
+            ):
+                policies[tname] = _max_attempts(value)
+            elif isinstance(value, ast.Dict):
+                # a policy table: dict of RetryPolicy values; dynamic keying
+                # means any retrying entry makes the table "retrying"
+                attempts = [
+                    _max_attempts(v)
+                    for v in value.values
+                    if isinstance(v, ast.Call)
+                    and (dotted_name(v.func) or "").endswith("RetryPolicy")
+                ]
+                if attempts:
+                    policies[tname] = max(attempts)
+
+        if not policies:
+            return
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "call"
+                and node.args
+            ):
+                continue
+            receiver = node.func.value
+            if isinstance(receiver, ast.Subscript):
+                receiver = receiver.value
+            rname = dotted_name(receiver)
+            if rname is None or rname not in policies:
+                continue
+            if policies[rname] <= 1:
+                continue  # breaker-only policy: no retry, no marker needed
+            callee = node.args[0]
+            if isinstance(callee, ast.Name) and callee.id in local_defs:
+                if not _has_idempotent(local_defs[callee.id]):
+                    findings.append(
+                        self.finding(
+                            src.path, node.lineno,
+                            f"`{callee.id}` is retried by `{rname}` "
+                            "(max_attempts > 1) but is not marked @idempotent",
+                        )
+                    )
+            elif isinstance(callee, ast.Lambda):
+                findings.append(
+                    self.finding(
+                        src.path, node.lineno,
+                        f"a lambda is retried by `{rname}` — retried callables "
+                        "must be named, @idempotent functions",
+                    )
+                )
+
+    def _check_providers(self, src: SourceFile, findings: List[Finding]) -> None:
+        for node in src.tree.body:
+            if not isinstance(node, ast.ClassDef) or _is_abstract(node):
+                continue
+            methods = {
+                m.name: m
+                for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            if not all(m in methods for m in ("create", "delete", "get_instance_types")):
+                continue  # not a CloudProvider implementation
+            for name in RETRIED_PROVIDER_METHODS:
+                m = methods.get(name)
+                if m is not None and not _has_idempotent(m):
+                    findings.append(
+                        self.finding(
+                            src.path, m.lineno,
+                            f"`{node.name}.{name}` is retried by the metered "
+                            "cloud decorator but is not marked @idempotent",
+                        )
+                    )
+            create = methods["create"]
+            if _has_idempotent(create):
+                findings.append(
+                    self.finding(
+                        src.path, create.lineno,
+                        f"`{node.name}.create` is marked @idempotent — create "
+                        "is breaker-only by design (a replayed create orphans "
+                        "instances); remove the marker",
+                    )
+                )

@@ -1,0 +1,609 @@
+"""Tracer-safety rules for the solver package.
+
+Functions reachable from a ``jax.jit`` / ``jax.vmap`` / ``pl.pallas_call``
+entry point execute under tracing: Python control flow on traced values
+raises ``TracerBoolConversionError`` at best, and host conversions
+(``float()`` / ``.item()`` / ``np.asarray``) silently serialize the device
+pipeline — the exact class of regression that erases the <100ms solve
+target without failing any correctness test.
+
+Reachability is a cross-file call graph over ``solver/``:
+
+- roots: defs decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``, names
+  passed to ``jit``/``vmap``/``pmap`` calls, and kernels passed (bare or
+  via ``partial``) to ``pallas_call``;
+- edges: direct calls resolved through each file's import table (local
+  defs, ``from x import f`` symbols, ``mod.f`` where ``mod`` is an
+  imported module). Unresolvable receivers (``self.x``, arbitrary objects)
+  are skipped — under-approximate, never noisy;
+- lexical nesting: closures of a reachable function are reachable (that is
+  how ``lax.scan``/``fori_loop`` bodies enter the graph).
+
+Static values (safe to branch on): parameters named by ``static_argnames``,
+keyword-only parameters (the ``partial``-bound kernel convention),
+module-level constants, ``.shape``/``.ndim``/``.dtype`` reads, and
+arithmetic thereof. A small forward taint pass propagates both sets through
+straight-line assignments; anything derived from a non-static parameter is
+traced.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.karplint.core import (
+    P0,
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    import_tables,
+    register,
+)
+
+JIT_WRAPPERS = ("jit", "vmap", "pmap")
+STATIC_CALLS = {
+    "len", "max", "min", "abs", "int", "float", "bool", "range", "tuple",
+    "divmod", "sorted", "isinstance",
+}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+
+def walk_no_funcs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+@dataclass
+class FuncInfo:
+    file: SourceFile
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    qualname: str
+    parent: Optional["FuncInfo"]
+    children: List["FuncInfo"] = field(default_factory=list)
+    static_argnames: Set[str] = field(default_factory=set)
+    is_root: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class CallGraph:
+    """Function defs + best-effort resolved call edges across the fileset."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.funcs: List[FuncInfo] = []
+        self.by_file_name: Dict[Tuple[str, str], List[FuncInfo]] = {}
+        self.module_of: Dict[str, SourceFile] = {}
+        self.imports: Dict[str, Tuple[dict, dict]] = {}
+        self.module_consts: Dict[str, Set[str]] = {}
+        for f in self.files:
+            self.module_of[f.path[:-3].replace("/", ".")] = f
+            self.imports[f.path] = import_tables(f.tree)
+            self.module_consts[f.path] = {
+                t.id
+                for node in f.tree.body
+                if isinstance(node, ast.Assign)
+                for t in node.targets
+                if isinstance(t, ast.Name) and isinstance(node.value, ast.Constant)
+            }
+            self._collect_funcs(f)
+        self._mark_roots()
+
+    def _collect_funcs(self, f: SourceFile) -> None:
+        def visit(node: ast.AST, parent: Optional[FuncInfo], prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = FuncInfo(
+                        file=f, node=child,
+                        qualname=f"{prefix}{child.name}", parent=parent,
+                    )
+                    info.static_argnames = _decorator_statics(child)
+                    if _decorated_jit(child):
+                        info.is_root = True
+                    self.funcs.append(info)
+                    if parent:
+                        parent.children.append(info)
+                    self.by_file_name.setdefault((f.path, child.name), []).append(info)
+                    visit(child, info, f"{info.qualname}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, parent, f"{prefix}{child.name}.")
+                else:
+                    visit(child, parent, prefix)
+
+        visit(f.tree, None, "")
+
+    def _mark_roots(self) -> None:
+        """Names passed to jit/vmap/pmap or pallas_call become roots."""
+        for f in self.files:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func) or ""
+                tail = dn.rsplit(".", 1)[-1]
+                if tail in JIT_WRAPPERS or tail == "pallas_call":
+                    for target in _callable_args(node):
+                        for info in self.by_file_name.get((f.path, target), []):
+                            info.is_root = True
+                            if tail in JIT_WRAPPERS:
+                                info.static_argnames |= _call_statics(node)
+
+    def resolve_call(self, f: SourceFile, call: ast.Call) -> List[FuncInfo]:
+        modules, symbols = self.imports[f.path]
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self.by_file_name.get((f.path, func.id))
+            if local:
+                return local
+            if func.id in symbols:
+                mod, sym = symbols[func.id]
+                target = self._file_for_module(mod)
+                if target:
+                    return self.by_file_name.get((target.path, sym), [])
+            return []
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            alias = func.value.id
+            if alias in modules:
+                target = self._file_for_module(modules[alias])
+                if target:
+                    return self.by_file_name.get((target.path, func.attr), [])
+        return []
+
+    def _file_for_module(self, dotted: str) -> Optional[SourceFile]:
+        for mod, f in self.module_of.items():
+            if mod == dotted or mod.endswith("." + dotted) or dotted.endswith("." + mod):
+                return f
+        return None
+
+    def reachable(self) -> List[FuncInfo]:
+        seen: Set[int] = set()
+        work = [fn for fn in self.funcs if fn.is_root]
+        out: List[FuncInfo] = []
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.append(fn)
+            work.extend(fn.children)
+            for node in walk_no_funcs(fn.node):
+                if isinstance(node, ast.Call):
+                    work.extend(self.resolve_call(fn.file, node))
+            # calls inside nested defs traverse when the child pops
+        return out
+
+
+def _callable_args(call: ast.Call) -> List[str]:
+    """Simple names passed as callables: bare ``f`` or ``partial(f, ...)``."""
+    out = []
+    for arg in call.args[:1] or []:
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Call):
+            dn = dotted_name(arg.func) or ""
+            if dn.rsplit(".", 1)[-1] == "partial" and arg.args:
+                first = arg.args[0]
+                if isinstance(first, ast.Name):
+                    out.append(first.id)
+    return out
+
+
+def _statics_from_value(value: ast.AST) -> Set[str]:
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return {value.value}
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in value.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _call_statics(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            return _statics_from_value(kw.value)
+    return set()
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = dotted_name(target) or ""
+        tail = dn.rsplit(".", 1)[-1]
+        if tail in JIT_WRAPPERS:
+            return True
+        if tail == "partial" and isinstance(dec, ast.Call) and dec.args:
+            inner = dotted_name(dec.args[0]) or ""
+            if inner.rsplit(".", 1)[-1] in JIT_WRAPPERS:
+                return True
+    return False
+
+
+def _decorator_statics(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            out |= _call_statics(dec)
+    return out
+
+
+class _TaintScope:
+    def __init__(self, static: Set[str], traced: Set[str], consts: Set[str]):
+        self.static = set(static)
+        self.traced = set(traced)
+        self.consts = consts
+
+    def is_static_expr(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Constant):
+            return True
+        if isinstance(e, ast.Name):
+            return (
+                e.id in self.static
+                or e.id in self.consts
+                or e.id.isupper()
+                or e.id in ("True", "False", "None")
+            )
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return True
+            return self.is_static_expr(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.is_static_expr(e.value) and self.is_static_expr(e.slice)
+        if isinstance(e, ast.BinOp):
+            return self.is_static_expr(e.left) and self.is_static_expr(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.is_static_expr(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return all(self.is_static_expr(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self.is_static_expr(e.left) and all(
+                self.is_static_expr(c) for c in e.comparators
+            )
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return all(self.is_static_expr(v) for v in e.elts)
+        if isinstance(e, ast.IfExp):
+            return all(
+                self.is_static_expr(v) for v in (e.test, e.body, e.orelse)
+            )
+        if isinstance(e, ast.Call):
+            dn = dotted_name(e.func) or ""
+            tail = dn.rsplit(".", 1)[-1]
+            if tail in STATIC_CALLS or tail == "bit_length":
+                return all(self.is_static_expr(a) for a in e.args)
+            return False
+        return False
+
+    def traced_names(self, e: ast.AST) -> Set[str]:
+        # a name inside a static sub-expression (x.shape, len(x)) is not a
+        # traced USE — collect names only from non-static subtrees
+        if self.is_static_expr(e):
+            return set()
+        out: Set[str] = set()
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.Name):
+                if child.id in self.traced:
+                    out.add(child.id)
+            else:
+                out |= self.traced_names(child)
+        if isinstance(e, ast.Name) and e.id in self.traced:
+            out.add(e.id)
+        return out
+
+    def assign(self, targets: List[ast.AST], value: ast.AST) -> None:
+        names: List[str] = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+        if not names:
+            return
+        if self.is_static_expr(value):
+            for n in names:
+                self.static.add(n)
+                self.traced.discard(n)
+        elif self.traced_names(value):
+            for n in names:
+                self.traced.add(n)
+                self.static.discard(n)
+        else:
+            for n in names:
+                self.static.discard(n)
+                self.traced.discard(n)
+
+
+@register
+class TracerBranchRule(Rule):
+    name = "tracer-branch"
+    severity = P0
+    doc = (
+        "Python if/while on a traced value inside jit/vmap/pallas-reachable "
+        "solver code — use lax.cond/jnp.where; data-dependent host control "
+        "flow either crashes tracing or forces a device sync."
+    )
+    path_must_contain = ("solver/",)
+
+    def run(self, project: Project) -> List[Finding]:
+        return _run_tracer(self, project, check="branch")
+
+
+@register
+class TracerHostSyncRule(Rule):
+    name = "tracer-host-sync"
+    severity = P0
+    doc = (
+        "Host conversion (float()/int()/bool() on a traced value, .item(), "
+        "numpy op on a traced array, block_until_ready) inside "
+        "jit/vmap/pallas-reachable solver code — serializes the device "
+        "pipeline on the solve hot path."
+    )
+    path_must_contain = ("solver/",)
+
+    def run(self, project: Project) -> List[Finding]:
+        return _run_tracer(self, project, check="host-sync")
+
+
+def _run_tracer(rule: Rule, project: Project, check: str) -> List[Finding]:
+    files = rule.files(project)
+    if not files:
+        return []
+    graph = CallGraph(files)
+    reachable = graph.reachable()
+    reachable_ids = {id(fn) for fn in reachable}
+    findings: List[Finding] = []
+    for fn in reachable:
+        if fn.parent is not None and id(fn.parent) in reachable_ids:
+            continue  # analyzed inline under the outermost reachable def
+        _analyze_function(
+            fn.node, fn.file, fn.static_argnames,
+            graph.module_consts.get(fn.file.path, set()),
+            graph.imports[fn.file.path][0],
+            rule, check, findings,
+            inherited=None,
+        )
+    return findings
+
+
+def _params(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(positional-ish params, keyword-only params) minus self/cls."""
+    a = fn.args
+    pos = {p.arg for p in list(a.posonlyargs) + list(a.args)} - {"self", "cls"}
+    if a.vararg:
+        pos.add(a.vararg.arg)
+    kwonly = {p.arg for p in a.kwonlyargs}
+    if a.kwarg:
+        kwonly.add(a.kwarg.arg)
+    return pos, kwonly
+
+
+def _analyze_function(
+    fn_node: ast.AST,
+    src: SourceFile,
+    static_argnames: Set[str],
+    consts: Set[str],
+    module_imports: dict,
+    rule: Rule,
+    check: str,
+    findings: List[Finding],
+    inherited: Optional[_TaintScope],
+) -> None:
+    pos, kwonly = _params(fn_node)
+    static = set(kwonly) | (static_argnames & (pos | kwonly))
+    traced = pos - static
+    if inherited is not None:
+        static |= inherited.static - traced
+        traced |= inherited.traced - static
+    scope = _TaintScope(static, traced, consts)
+    numpy_aliases = {
+        alias for alias, mod in module_imports.items() if mod in ("numpy", "np")
+    } | {"np", "numpy"}
+
+    def flag(node: ast.AST, msg: str) -> None:
+        findings.append(rule.finding(src.path, node.lineno, msg))
+
+    def check_calls(stmt: ast.AST) -> None:
+        if check != "host-sync":
+            return
+        for node in walk_no_funcs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item" and not node.args:
+                    flag(node, "`.item()` forces a device→host sync in jit-reachable code")
+                elif func.attr == "block_until_ready":
+                    flag(node, "`.block_until_ready()` stalls the device pipeline in jit-reachable code")
+                elif (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in numpy_aliases
+                    and any(scope.traced_names(a) for a in node.args)
+                ):
+                    flag(
+                        node,
+                        f"host numpy op `np.{func.attr}` on a traced value — use jnp",
+                    )
+                elif func.attr == "device_get" and any(
+                    scope.traced_names(a) for a in node.args
+                ):
+                    flag(node, "`device_get` on a traced value inside jit-reachable code")
+            elif isinstance(func, ast.Name) and func.id in ("float", "int", "bool"):
+                if any(
+                    scope.traced_names(a) and not scope.is_static_expr(a)
+                    for a in node.args
+                ):
+                    flag(
+                        node,
+                        f"`{func.id}()` on a traced value forces a device→host sync",
+                    )
+
+    def process(stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _analyze_function(
+                stmt, src, set(), consts, module_imports, rule, check,
+                findings, inherited=scope,
+            )
+            return
+        check_calls(stmt)
+        if isinstance(stmt, ast.Assign):
+            scope.assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            scope.assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            scope.assign([stmt.target], stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if check == "branch":
+                tn = scope.traced_names(stmt.test)
+                if tn and not scope.is_static_expr(stmt.test):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    flag(
+                        stmt,
+                        f"Python `{kind}` on traced value(s) {sorted(tn)} — "
+                        "use lax.cond/jnp.where or hoist to a static argument",
+                    )
+            for s in stmt.body + stmt.orelse:
+                process(s)
+            return
+        elif isinstance(stmt, ast.For):
+            if isinstance(stmt.target, (ast.Name, ast.Tuple, ast.List)):
+                scope.assign([stmt.target], stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                process(s)
+            return
+        elif isinstance(stmt, ast.With):
+            for s in stmt.body:
+                process(s)
+            return
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                process(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    process(s)
+            return
+
+    for s in fn_node.body:
+        process(s)
+
+
+# --- dtype contract ---------------------------------------------------------
+
+import re as _re
+
+_CONTRACT_RE = _re.compile(r"#.*\[[^\]]*\].*?\b(f32|f64|bf16|i64|i32|i16|i8|u8|bool|b8)\b")
+
+_DTYPE_TOKENS = {
+    "float32": "f32", "float64": "f64", "bfloat16": "bf16",
+    "int64": "i64", "int32": "i32", "int16": "i16", "int8": "i8",
+    "uint8": "u8", "bool_": "bool", "bool": "bool",
+}
+
+_ALIASES = {
+    "frontiers": "frontier",
+    "sig_type_mask": "type_mask",
+    "usable": "usable_capacity",
+}
+
+_BUILTIN_CONTRACT = {"join_table": "i32"}  # kernel.pack's wire contract
+
+
+def _parse_contract(sig_file: Optional[SourceFile]) -> Dict[str, str]:
+    contract = dict(_BUILTIN_CONTRACT)
+    if sig_file is None:
+        return contract
+    for node in ast.walk(sig_file.tree):
+        name = None
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            name = node.target.id
+        elif isinstance(node, ast.arg):
+            name = node.arg
+        if not name:
+            continue
+        m = _CONTRACT_RE.search(sig_file.line_at(node.lineno))
+        if m:
+            contract[name] = m.group(1)
+    return contract
+
+
+def _dtype_token(e: ast.AST) -> Optional[str]:
+    if isinstance(e, ast.Attribute):
+        return _DTYPE_TOKENS.get(e.attr)
+    if isinstance(e, ast.Name):
+        return _DTYPE_TOKENS.get(e.id)
+    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+        return _DTYPE_TOKENS.get(e.value)
+    return None
+
+
+def _base_name(e: ast.AST) -> Optional[str]:
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):
+        return e.attr
+    return None
+
+
+@register
+class TracerDtypeRule(Rule):
+    name = "tracer-dtype"
+    severity = P1
+    doc = (
+        "A dtype cast of a contract array (frontier/type_mask/usable/"
+        "join_table) disagrees with the wire contract declared in "
+        "solver/signature.py — a silent f32→i32 (or bool→i8) here corrupts "
+        "the kernel's fit comparisons."
+    )
+    path_must_contain = ("solver/",)
+
+    def run(self, project: Project) -> List[Finding]:
+        files = self.files(project)
+        sig = next(
+            (f for f in project.files if f.path.endswith("solver/signature.py")),
+            None,
+        )
+        contract = _parse_contract(sig)
+        findings: List[Finding] = []
+        for f in files:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                base = token = None
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and len(node.args) == 1
+                ):
+                    base = _base_name(node.func.value)
+                    token = _dtype_token(node.args[0])
+                else:
+                    dn = dotted_name(node.func) or ""
+                    tail = dn.rsplit(".", 1)[-1]
+                    if tail in ("asarray", "array") and len(node.args) >= 2:
+                        base = _base_name(node.args[0])
+                        token = _dtype_token(node.args[1])
+                if base is None or token is None:
+                    continue
+                key = _ALIASES.get(base, base)
+                want = contract.get(key) or contract.get(key.rstrip("s"))
+                if want is not None and token != want:
+                    findings.append(
+                        self.finding(
+                            f.path, node.lineno,
+                            f"`{base}` cast to {token} but the signature.py "
+                            f"contract declares {want}",
+                        )
+                    )
+        return findings
