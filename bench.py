@@ -193,7 +193,7 @@ def onchip_parity_check(n_pods: int = 500) -> str:
     route = tsched._fused_route(tbatch, 256)
     if route != "v2":
         raise AssertionError(f"tradeoff batch routed {route}, not fused-v2")
-    fres2, _ = tsched._pack_fused(tbatch, 256, "v2")
+    fres2, _ = tsched._pack_fused_begin(tbatch, 256, "v2")()
     ref2 = K.pack(*tbatch.pack_args(), n_max=256)
     assert_equal("fused-v2", fres2, ref2)
     checked.append("fused-v2")
@@ -276,6 +276,11 @@ def bench_once(
         from karpenter_tpu.utils.gcpolicy import freeze_after_warmup
 
         freeze_after_warmup()
+        # steady-state catalog residency window: the warmup's one
+        # unavoidable upload must not dilute the reported hit rate
+        from karpenter_tpu.solver import session_stats
+
+        session_stats.reset()
 
         probe = RttProbe() if breakdown else None
         if probe:
@@ -334,6 +339,10 @@ def bench_once(
         if any(backends):
             out["packer_backend"] = max(set(b for b in backends if b),
                                         key=backends.count)
+    sess = session_stats.snapshot()
+    if sess["hit_rate"] is not None:
+        # steady-state Pack payloads exclude catalog bytes iff this ≈ 1.0
+        out["session_catalog_hit_rate"] = round(sess["hit_rate"], 4)
     if breakdown and any(profiles):
         rtt = probe.floor
         rtt_p50 = statistics.median(probe.samples)
@@ -445,6 +454,11 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
             unexplained += len(verdict["unexplained"])
             expected_drops += verdict["dropped"] - len(verdict["unexplained"])
 
+        # steady-state catalog-residency window (see bench_once)
+        from karpenter_tpu.solver import session_stats
+
+        session_stats.reset()
+
         start_gate = threading.Barrier(streams + 1)
         done = []
 
@@ -482,7 +496,7 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
     total_scheduled = sum(scheduled_per_stream) * iters
     cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
     n_solves = streams * iters
-    return {
+    out = {
         "streams": streams,
         "iters": iters,
         "scheduled_total": total_scheduled,
@@ -493,6 +507,10 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
         "unschedulable_expected": expected_drops,
         "unexplained": unexplained,
     }
+    sess = session_stats.snapshot()
+    if sess["hit_rate"] is not None:
+        out["session_catalog_hit_rate"] = round(sess["hit_rate"], 4)
+    return out
 
 
 def bench_selection_storm(n_pods: int):
@@ -1682,6 +1700,9 @@ def main():
                     line[f"device_{k}"] = (
                         round(dev[k], 4) if isinstance(dev[k], float) else dev[k]
                     )
+            if "session_catalog_hit_rate" in dev:
+                # the device_pipelined leg refines this with its own window
+                line["session_catalog_hit_rate"] = dev["session_catalog_hit_rate"]
         except Exception as e:
             line["device_error"] = str(e)[:120]
         # apples-to-apples: the same scenario through the native C++ packer
@@ -1709,6 +1730,10 @@ def main():
             line["device_pipelined_pods_per_sec"] = dev_pipe["pods_per_sec"]
             cpu_per_solve["device"] = dev_pipe["controller_cpu_seconds_per_solve"]
             cpu_util["device"] = dev_pipe["controller_cpu_utilization"]
+            if "session_catalog_hit_rate" in dev_pipe:
+                # steady-state session residency on the device-forced
+                # continuous-load leg — the ≥0.95 acceptance bar
+                line["session_catalog_hit_rate"] = dev_pipe["session_catalog_hit_rate"]
         except Exception as e:
             line["device_pipelined_error"] = str(e)[:120]
         try:

@@ -211,6 +211,7 @@ class ProvisionerWorker:
                     self.provisioner.spec.constraints.provider
                 )
                 nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
+                self._observe_stages()
                 # parallel launch per virtual node (reference: provisioner.go:113)
                 with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
                     # executor threads don't inherit contextvars: each launch
@@ -239,6 +240,16 @@ class ProvisionerWorker:
                 self._pending_keys -= set(batch_keys) - self._requeued_keys
                 self._requeued_keys.clear()
             self.batcher.flush()
+
+    def _observe_stages(self) -> None:
+        """Plumb the solve's per-stage timings onto the scrape: the <100ms
+        p99 is judged on scheduling_duration_seconds, but only the stage
+        histogram says WHERE a regression landed (host encode vs wire
+        serialization vs the in-flight pack_fetch vs decode)."""
+        prof = self.scheduler.last_stage_profile()
+        for stage, seconds in prof.items():
+            if stage.endswith("_s") and isinstance(seconds, float):
+                metrics.SOLVER_STAGE_DURATION.labels(stage=stage[:-2]).observe(seconds)
 
     def _launch(self, vnode: VirtualNode, budget=None) -> bool:
         """Returns whether a node was actually created."""

@@ -240,3 +240,67 @@ SOLVER_BATCH_SIZE = Histogram(
     buckets=[1, 10, 50, 100, 500, 1000, 2000, 5000, 10000],
     registry=REGISTRY,
 )
+
+# Session-based solver transport (v3 wire / docs/solver-transport.md): the
+# steady-state Pack must ship only pod deltas — catalog residency has to be
+# visible on the scrape, or a silently-thrashing session cache re-pays the
+# catalog upload every solve with nothing flagging it.
+SOLVER_SESSION_UPLOADS = Counter(
+    "session_catalog_uploads_total",
+    "Catalog-side tensor uploads to the device side (OpenSession or an "
+    "in-process invariants device_put) — steady state approaches zero.",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_SESSION_HIT_RATE = Gauge(
+    "session_catalog_hit_rate",
+    "Fraction of solves served against already-resident catalog tensors "
+    "(no catalog bytes shipped) since process start.",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_SESSION_EVICTIONS = Counter(
+    "session_evictions_total",
+    "Resident catalog entries evicted (session LRU pressure or TTL expiry).",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+# Encode-cache effectiveness: the signature table / capacity matrix rebuild
+# is ~40ms of the 10k-pod budget, so a thrashing EncodeCache is a latency
+# regression the p99 alone can't attribute.
+SOLVER_ENCODE_CACHE_HITS = Counter(
+    "encode_cache_hits_total",
+    "Solves that reused a cached (signature table, usable-capacity) entry.",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_ENCODE_CACHE_MISSES = Counter(
+    "encode_cache_misses_total",
+    "Solves that had to rebuild the signature table / capacity matrix.",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+# Per-stage solve latency, observed by the provisioning worker after each
+# batch (sort / inject / encode / wire_ser / pack_fetch / wire_deser /
+# decode) — the <100ms p99 target's attribution on the scrape, not only in
+# bench output.
+SOLVER_STAGE_DURATION = Histogram(
+    "stage_duration_seconds",
+    "Per-stage duration of one accelerated solve, by stage "
+    "(sort/inject/encode/wire_ser/pack_fetch/wire_deser/decode).",
+    ["stage"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)

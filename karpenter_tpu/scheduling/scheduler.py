@@ -40,6 +40,22 @@ class Scheduler:
             )
         return self._tpu
 
+    def last_stage_profile(self) -> dict:
+        """Per-stage timings of the most recent accelerated solve (sort /
+        inject / encode / wire_ser / pack_fetch / wire_deser / decode
+        seconds, plus packer_backend) — {} when the FFD backend served.
+        The provisioning worker plumbs these into
+        ``karpenter_solver_stage_duration_seconds`` after each batch.
+
+        Reads the CALLING THREAD's completed profile (published atomically
+        after a solve's final stage write; scheduler-wide latest as the
+        fallback) — never the begin-published ``last_profile`` a
+        concurrent solve may still be filling in, and never another
+        worker's solve when the scheduler is shared."""
+        if self._tpu is None:
+            return {}
+        return self._tpu.completed_profile()
+
     def solve(
         self,
         provisioner: Provisioner,

@@ -146,61 +146,103 @@ class TpuScheduler:
         self._device_cache_lock = threading.Lock()
         self._solve_lock = threading.Lock()
         # per-stage timings of the most recent solve (bench surfaces these
-        # as the latency breakdown the <100ms target is judged against)
+        # as the latency breakdown the <100ms target is judged against);
+        # published at solve BEGIN, so it may be mid-flight
         self.last_profile: Dict[str, float] = {}
+        # the most recent COMPLETED solve's profile, published atomically
+        # after its last stage write — what observers (the provisioning
+        # stage histogram) snapshot, so they never see a concurrent
+        # solve's partial dict. The thread-local holds the SAME thing per
+        # calling thread: a worker sharing this scheduler must observe its
+        # OWN solve's stages, not whichever solve completed last.
+        self.last_completed_profile: Dict[str, float] = {}
+        self._completed_tl = threading.local()
         # measured-cost backend routing (VERDICT r4 weak #3: `auto` used to
         # prefer the device by platform, never by cost)
         from karpenter_tpu.solver.router import default_router
 
         self.router = default_router()
-        self._probe_thread: Optional[threading.Thread] = None
+        # probe starts now happen in the finish phase, OFF the solve lock:
+        # two batches finishing together must not double-spawn a probe
+        self._probe_thread: Optional[threading.Thread] = None  # guarded-by: self._probe_lock
+        self._probe_lock = threading.Lock()
 
     def _pack(self, batch: enc.EncodedBatch):
-        """Run the packing kernel, routing by MEASURED cost when more than
-        one backend can serve the batch: the device path (sidecar / fused /
-        Pallas ladder) and the native C++ packer are both first-class
-        contenders, and the per-shape EMA of end-to-end pack time decides —
-        ``solver: tpu`` must never be slower than its own CPU path
-        (solver/router.py). ``KARPENTER_PACKER`` forces still bypass.
-        Returns ``(PackResult, typemask-or-None)`` with HOST numpy arrays
-        (one device→host transfer)."""
+        """BEGIN the packing solve (called under the solve lock): route by
+        MEASURED cost when more than one backend can serve the batch — the
+        device path (sidecar / fused / Pallas ladder) and the native C++
+        packer are both first-class contenders, and the per-shape EMA of
+        end-to-end pack time decides (``solver: tpu`` must never be slower
+        than its own CPU path, solver/router.py) — and dispatch the chosen
+        backend WITHOUT blocking. Returns ``finish()`` →
+        ``(PackResult, typemask-or-None)`` with HOST numpy arrays (one
+        device→host transfer): only ``finish`` blocks on the fetch/RPC, so
+        the caller releases the solve lock between the two phases and the
+        next batch's encode overlaps this solve's in-flight device time
+        (the double-buffered pipeline, docs/solver-transport.md).
+        ``KARPENTER_PACKER`` forces still bypass routing."""
         import os
 
+        # captured under the lock: by finish time a concurrent solve may
+        # have re-published last_profile, and this solve's bookkeeping must
+        # not land in that solve's dict
+        prof = self.last_profile
         if os.environ.get("KARPENTER_PACKER", "auto").lower() == "auto":
             candidates = self._pack_candidates()
             if len(candidates) > 1:
                 key = self._route_key(batch)
                 backend = self.router.choose(key, candidates)
                 t0 = time.perf_counter()
+                if backend == "native":
+                    # synchronous host compute — nothing in flight to
+                    # overlap, so it runs wholly in the finish phase and
+                    # the solve lock is held only for the dispatch-shaped
+                    # begin, same as the device path
+                    def finish_native():
+                        try:
+                            out = self._pack_native(batch, prof=prof)
+                        except Exception:
+                            # a failed pack must record a PENALTY, not its
+                            # (tiny) elapsed time — a fast-failing backend
+                            # would otherwise win the EMA and pin every
+                            # future solve to the broken path. Probes
+                            # rehabilitate it once it works again.
+                            self.router.record_failure(key, backend)
+                            # containment parity with the old pack_best
+                            # ladder: a broken native lib degrades to the
+                            # device path, never crashes the reconcile
+                            logger.exception(
+                                "routed native pack failed; device ladder fallback"
+                            )
+                            out = self._pack_device(batch, prof=prof)()
+                        else:
+                            self.router.record(key, backend, time.perf_counter() - t0)
+                        # packer_backend is set by the path that actually
+                        # served (the fallback may differ from the route)
+                        if self.router.should_probe(key):
+                            self._shadow_probe(batch, key, candidates, backend)
+                        return out
+
+                    return finish_native
                 try:
-                    out = (
-                        self._pack_native(batch)
-                        if backend == "native"
-                        else self._pack_device(batch)
-                    )
+                    device_finish = self._pack_device(batch, prof=prof)
                 except Exception:
-                    # a failed pack must record a PENALTY, not its (tiny)
-                    # elapsed time — a fast-failing backend would otherwise
-                    # win the EMA and pin every future solve to the broken
-                    # path. Probes rehabilitate it once it works again.
                     self.router.record_failure(key, backend)
-                    if backend != "native":
-                        raise  # the device ladder already ends in lax.scan
-                    # containment parity with the old pack_best ladder: a
-                    # broken native lib degrades to the device path, never
-                    # crashes the reconcile
-                    logger.exception(
-                        "routed native pack failed; device ladder fallback"
-                    )
-                    out = self._pack_device(batch)
-                else:
+                    raise  # the device ladder already ends in lax.scan
+
+                def finish_device():
+                    try:
+                        out = device_finish()
+                    except Exception:
+                        self.router.record_failure(key, backend)
+                        raise
                     self.router.record(key, backend, time.perf_counter() - t0)
-                # packer_backend is set by the path that actually served
-                # (the fallback above may differ from the routed choice)
-                if self.router.should_probe(key):
-                    self._shadow_probe(batch, key, candidates, backend)
-                return out
-        return self._pack_device(batch)
+                    if self.router.should_probe(key):
+                        self._shadow_probe(batch, key, candidates, backend)
+                    return out
+
+                return finish_device
+        return self._pack_device(batch, prof=prof)
 
     def _shadow_probe(self, batch, key, candidates, winner: str) -> None:
         """Re-measure the losing backend(s) OFF the critical path — on a
@@ -212,8 +254,6 @@ class TpuScheduler:
         losers = [c for c in candidates if c != winner]
         if not losers:
             return
-        if self._probe_thread is not None and self._probe_thread.is_alive():
-            return  # previous probe still running; next cadence hit retries
 
         def probe():
             nonlocal batch
@@ -224,7 +264,9 @@ class TpuScheduler:
                         if loser == "native":
                             self._pack_native(batch, prof={})
                         else:
-                            self._pack_device(batch, prof={})
+                            self._pack_device(
+                                batch, prof={}, record_session=False
+                            )()
                     except Exception:
                         logger.debug("%s shadow probe failed", loser, exc_info=True)
                     else:
@@ -236,10 +278,17 @@ class TpuScheduler:
                 # multi-MB EncodedBatch indefinitely
                 batch = None
 
-        self._probe_thread = threading.Thread(
-            target=probe, name="karpenter-router-probe", daemon=True
-        )
-        self._probe_thread.start()
+        with self._probe_lock:
+            if self._probe_thread is not None and self._probe_thread.is_alive():
+                return  # previous probe still running; next cadence hit retries
+            t = threading.Thread(
+                target=probe, name="karpenter-router-probe", daemon=True
+            )
+            self._probe_thread = t
+            # started under the lock: is_alive() is False for an assigned-
+            # but-unstarted thread, so a concurrent finisher checking the
+            # guard before this start() would spawn a second probe
+            t.start()
 
     @staticmethod
     def _route_key(batch: enc.EncodedBatch) -> tuple:
@@ -249,12 +298,21 @@ class TpuScheduler:
         key per reconcile mix, re-paying cold start on production solves
         and growing the process-shared EMA tables without bound. Pow2
         bucketing keeps the landscape to a few dozen classes whose cost is
-        smooth within each."""
+        smooth within each.
+
+        The last element is CONSTRAINT DENSITY: whether affinity/topology
+        decisions pinned any pod to a hostname. BENCH_r05's blindspot —
+        affinity-dense solves (device pack_fetch 220ms vs native 1.7ms)
+        shared an EMA with hostname-free batches of the same (P, S, F), so
+        the device path's win on the sparse shape routed the dense one
+        blind. Splitting the class lets dense solves route to native until
+        the device path earns them back."""
         S, F = batch.frontiers.shape[0], batch.frontiers.shape[1]
         return (
             len(batch.pod_valid),
             1 << max(S - 1, 0).bit_length(),
             1 << max(F - 1, 0).bit_length(),
+            int(bool((batch.pod_host >= 0).any())),
         )
 
     def _pack_candidates(self) -> List[str]:
@@ -292,52 +350,97 @@ class TpuScheduler:
                 return result, None
             n_max = p
 
-    def _pack_device(self, batch: enc.EncodedBatch, prof: Optional[dict] = None):
-        """The device-path ladder: sidecar when configured, fused
-        single-dispatch when eligible, then the pack_best kernel ladder.
+    def _pack_device(
+        self,
+        batch: enc.EncodedBatch,
+        prof: Optional[dict] = None,
+        record_session: bool = True,
+    ):
+        """BEGIN the device-path ladder — sidecar when configured, fused
+        single-dispatch when eligible, then the pack_best kernel ladder —
+        and return ``finish()``. The begin phase dispatches the first
+        attempt (async — JAX dispatch and the gRPC future both return
+        before the solve lands); only ``finish`` blocks on the fetch.
+
+        ``record_session=False`` (shadow probes) keeps the catalog-residency
+        stats solve-only; saturation re-dispatches within one solve are
+        likewise counted once.
 
         The node table starts small (512 slots — per-pod kernel cost is
         linear in the table size, and real packings open far fewer nodes
         than pods) and retries at full P on saturation (table full with
-        unscheduled pods)."""
+        unscheduled pods); the rare retry re-dispatches inside ``finish``,
+        off the solve lock."""
         prof = self.last_profile if prof is None else prof
         p = len(batch.pod_valid)
-        route = self._fused_route(batch, min(p, 512))
-        n_max = min(p, 512) if route else max(256, p // 4)
+        route0 = self._fused_route(batch, min(p, 512))
+        n_max0 = min(p, 512) if route0 else max(256, p // 4)
         prof["pack_dispatches"] = 0
-        args = None
-        while True:
+        args_box: list = [None]
+        rec_box: list = [record_session]  # consumed by the first fused lookup
+
+        def dispatch(n_max: int, route: Optional[str]):
+            """One async dispatch → ``(fetch, route-or-None)``. A fused
+            DISPATCH failure (trace/compile) blacklists the shape and falls
+            straight to the unfused ladder."""
             prof["pack_dispatches"] += 1
-            result = typemask = None
+            rec, rec_box[0] = rec_box[0], False
             if route:
                 try:
-                    result, typemask = self._pack_fused(batch, n_max, route)
-                    prof["packer_backend"] = "device"
+                    fetch = self._pack_fused_begin(batch, n_max, route, record=rec)
                 except Exception:
+                    self._fused_blacklist(batch, n_max, route)
+                else:
+                    prof["packer_backend"] = "device"
+                    return fetch, route
+            if args_box[0] is None:
+                args_box[0] = batch.pack_args()
+            return self._pack_once_begin(args_box[0], p, n_max, prof, record=rec), None
+
+        fetch0, taken0 = dispatch(n_max0, route0)
+
+        def finish():
+            n_max, fetch, taken = n_max0, fetch0, taken0
+            while True:
+                try:
+                    result, typemask = fetch()
+                except Exception:
+                    if taken is None:
+                        raise
                     # same containment contract as pack_best: one
-                    # pathological shape must not crash the batch or degrade
-                    # other shapes — record it and take the unfused ladder
-                    # (which has its own v1→v2→scan fallbacks)
-                    shape = self._fused_shape(batch, n_max)
-                    logger.exception(
-                        "fused %s solve failed for shape %s; unfused ladder",
-                        route, shape,
+                    # pathological shape must not crash the batch or
+                    # degrade other shapes — record it and take the
+                    # unfused ladder (which has its own v1→v2→scan
+                    # fallbacks)
+                    self._fused_blacklist(batch, n_max, taken)
+                    if args_box[0] is None:
+                        args_box[0] = batch.pack_args()
+                    prof["pack_dispatches"] += 1
+                    # record=False: this solve already counted at dispatch
+                    fetch = self._pack_once_begin(
+                        args_box[0], p, n_max, prof, record=False
                     )
-                    with _fused_failed_lock:
-                        _fused_failed_shapes.add(shape)
-            if result is None:
-                if args is None:
-                    args = batch.pack_args()
-                result, typemask = self._pack_once(args, p, n_max, prof), None
-            saturated = int(result.n_nodes) == n_max and bool(
-                (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
-            )
-            if not saturated or n_max >= p:
-                return result, typemask
-            n_max = p
-            # routing is n_max-dependent (the v2 VMEM gate): re-derive for
-            # the full-table retry
-            route = self._fused_route(batch, n_max)
+                    taken = None
+                    continue
+                saturated = int(result.n_nodes) == n_max and bool(
+                    (np.asarray(result.assignment)[: batch.n_pods] < 0).any()
+                )
+                if not saturated or n_max >= p:
+                    return result, typemask
+                n_max = p
+                # routing is n_max-dependent (the v2 VMEM gate): re-derive
+                # for the full-table retry
+                fetch, taken = dispatch(n_max, self._fused_route(batch, n_max))
+
+        return finish
+
+    def _fused_blacklist(self, batch: enc.EncodedBatch, n_max: int, route: str) -> None:
+        shape = self._fused_shape(batch, n_max)
+        logger.exception(
+            "fused %s solve failed for shape %s; unfused ladder", route, shape,
+        )
+        with _fused_failed_lock:
+            _fused_failed_shapes.add(shape)
 
     @staticmethod
     def _fused_shape(batch: enc.EncodedBatch, n_max: int) -> tuple:
@@ -390,11 +493,15 @@ class TpuScheduler:
             return "v2"
         return None
 
-    def _pack_fused(self, batch: enc.EncodedBatch, n_max: int, route: str):
-        """One compact upload + one dispatch + one fetch (solver/fused.py);
-        join table, frontiers, daemon, type masks and usable capacities —
-        and on the v2 route the per-core join tables — ride the
-        device-resident invariants cache."""
+    def _pack_fused_begin(
+        self, batch: enc.EncodedBatch, n_max: int, route: str, record: bool = True
+    ):
+        """Dispatch the fused single-dispatch solve (one compact upload,
+        solver/fused.py) and return ``fetch()`` — the one fused device→host
+        transfer, the only blocking step. Join table, frontiers, daemon,
+        type masks and usable capacities — and on the v2 route the per-core
+        join tables — ride the device-resident invariants cache (``record``
+        gates its session-residency stats — see DeviceInvariants.get)."""
         import jax
 
         from karpenter_tpu.solver import fused
@@ -407,70 +514,103 @@ class TpuScheduler:
         uniq = fused.pad_uniq_req(batch.uniq_req)
         if route == "v2":
             (front_j_d, compat_j_d, jvals_d, front_d, daemon_d, mask_d,
-             usable_d) = self._device_cache.get_v2(batch)
-            buf = jax.device_get(
-                fused.fused_solve_v2(
-                    pod_tab, open_by_core, bhh, uniq,
-                    front_j_d, compat_j_d, jvals_d, front_d, daemon_d,
-                    mask_d, usable_d,
-                    n_max=n_max,
-                    F=batch.frontiers.shape[1],
-                    R=batch.frontiers.shape[2],
-                )
+             usable_d) = self._device_cache.get_v2(batch, record=record)
+            out = fused.fused_solve_v2(
+                pod_tab, open_by_core, bhh, uniq,
+                front_j_d, compat_j_d, jvals_d, front_d, daemon_d,
+                mask_d, usable_d,
+                n_max=n_max,
+                F=batch.frontiers.shape[1],
+                R=batch.frontiers.shape[2],
             )
         else:
-            join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(batch)
+            join_d, front_d, daemon_d, mask_d, usable_d = self._device_cache.get(
+                batch, record=record
+            )
             from karpenter_tpu.solver.pallas_kernel import pallas_available
 
-            buf = jax.device_get(
-                fused.fused_solve(
-                    pod_tab, open_by_core, bhh, uniq,
-                    join_d, front_d, daemon_d, mask_d, usable_d,
-                    n_max=n_max, kernel="pallas" if pallas_available() else "scan",
-                )
+            out = fused.fused_solve(
+                pod_tab, open_by_core, bhh, uniq,
+                join_d, front_d, daemon_d, mask_d, usable_d,
+                n_max=n_max, kernel="pallas" if pallas_available() else "scan",
             )
-        return fused.split_fused(
-            buf, len(batch.pod_valid), n_max, batch.usable.shape[1],
-            batch.usable.shape[0],
+
+        def fetch():
+            buf = jax.device_get(out)
+            return fused.split_fused(
+                buf, len(batch.pod_valid), n_max, batch.usable.shape[1],
+                batch.usable.shape[0],
+            )
+
+        return fetch
+
+    def _remote_or_init(self):
+        if self._remote is None:
+            from karpenter_tpu.solver.service import RemoteSolver
+
+            # under-lock init: the router's device shadow probe can
+            # reach here concurrently with a cold-starting solve
+            with self._remote_init_lock:
+                if self._remote is None:
+                    self._remote = RemoteSolver(
+                        self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
+                    )
+        return self._remote
+
+    def _remote_failure(self, e: Exception) -> None:
+        # open the circuit: a dead sidecar must not stall every
+        # batch for a full RPC deadline; half-open probes re-admit
+        # it once it answers again
+        tripped = self._remote_breaker.record_failure()
+        metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(1)
+        if tripped:
+            metrics.SOLVER_BREAKER_TRIPS.labels(address=self.service_address).inc()
+        logger.error(
+            "solver service %s failed (%s); in-process kernel for %.0fs",
+            self.service_address, e, REMOTE_BREAKER_SECONDS,
         )
 
-    def _pack_once(
-        self, args, p: int, n_max: int, prof: Optional[dict] = None
-    ) -> kernel.PackResult:
-        prof = self.last_profile if prof is None else prof
-        r = args[6].shape[1]  # pod_req
+    def _pack_once_begin(
+        self, args, p: int, n_max: int, prof: dict, record: bool = True
+    ):
+        """Dispatch one unfused solve — sidecar RPC future when configured,
+        in-process kernel otherwise — returning ``fetch()`` →
+        ``(PackResult, None)``. An RPC failure discovered at fetch time
+        trips the breaker and re-dispatches in-process inside the same
+        fetch, preserving the v2 containment contract. ``record`` rides to
+        the sidecar so probes/retries stay out of its hit-rate stats."""
         if self.service_address and self._remote_breaker.allow():
             try:
-                if self._remote is None:
-                    from karpenter_tpu.solver.service import RemoteSolver
-
-                    # under-lock init: the router's device shadow probe can
-                    # reach here concurrently with a cold-starting solve
-                    with self._remote_init_lock:
-                        if self._remote is None:
-                            self._remote = RemoteSolver(
-                                self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
-                            )
-                result = self._remote.pack(*args, n_max=n_max)
-                self._remote_breaker.record_success()
-                # unconditional: the gauge is process-global per address, and
-                # another scheduler instance (worker hot-swap, second
-                # provisioner) may have set it
-                metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(0)
-                prof["packer_backend"] = "device"  # sidecar owns the chip
-                return result
-            except Exception as e:
-                # open the circuit: a dead sidecar must not stall every
-                # batch for a full RPC deadline; half-open probes re-admit
-                # it once it answers again
-                tripped = self._remote_breaker.record_failure()
-                metrics.SOLVER_BREAKER_OPEN.labels(address=self.service_address).set(1)
-                if tripped:
-                    metrics.SOLVER_BREAKER_TRIPS.labels(address=self.service_address).inc()
-                logger.error(
-                    "solver service %s failed (%s); in-process kernel for %.0fs",
-                    self.service_address, e, REMOTE_BREAKER_SECONDS,
+                # pack_begin serializes + opens the session (host work,
+                # cheap in steady state) and dispatches the RPC future
+                pending = self._remote_or_init().pack_begin(
+                    *args, n_max=n_max, prof=prof, record=record
                 )
+            except Exception as e:
+                self._remote_failure(e)
+            else:
+                def fetch_remote():
+                    try:
+                        result = pending()
+                    except Exception as e:
+                        self._remote_failure(e)
+                        return self._pack_local_begin(args, p, n_max, prof)()
+                    self._remote_breaker.record_success()
+                    # unconditional: the gauge is process-global per
+                    # address, and another scheduler instance (worker
+                    # hot-swap, second provisioner) may have set it
+                    metrics.SOLVER_BREAKER_OPEN.labels(
+                        address=self.service_address
+                    ).set(0)
+                    prof["packer_backend"] = "device"  # sidecar owns the chip
+                    return result, None
+
+                return fetch_remote
+        return self._pack_local_begin(args, p, n_max, prof)
+
+    def _pack_local_begin(self, args, p: int, n_max: int, prof: dict):
+        """Dispatch the in-process kernel ladder; fetch is the one fused
+        device→host transfer (a no-op for the native CPU result)."""
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
         result = pack_best(*args, n_max=n_max)
@@ -478,12 +618,17 @@ class TpuScheduler:
             # native CPU packer (forced, or the ladder's no-TPU branch):
             # already host arrays, and no wire was crossed
             prof["packer_backend"] = "native"
-            return result
+            return lambda: (result, None)
         prof["packer_backend"] = "device"
-        import jax
+        buf = kernel.fuse_result(result)  # still on device; async
 
-        buf = jax.device_get(kernel.fuse_result(result))
-        return kernel.split_result(buf, p, n_max, r)
+        def fetch():
+            import jax
+
+            host = jax.device_get(buf)
+            return kernel.split_result(host, p, n_max, args[6].shape[1]), None
+
+        return fetch
 
     def solve(
         self,
@@ -493,12 +638,44 @@ class TpuScheduler:
     ) -> List[VirtualNode]:
         if not pods:
             return []
-        prof = {}
+        prof: Dict[str, float] = {}
+        try:
+            return self._solve(constraints, instance_types, pods, prof)
+        finally:
+            # every stage write (including the degrade paths') precedes
+            # this; the assignment itself is atomic, so a reader copying
+            # last_completed_profile never races a writer. finish() runs on
+            # the calling thread, so the thread-local binds each caller to
+            # its own solve's profile.
+            self.last_completed_profile = prof
+            self._completed_tl.profile = prof
+
+    def completed_profile(self) -> Dict[str, float]:
+        """This THREAD's most recently completed solve profile (falling
+        back to the scheduler-wide latest) — what per-batch observers
+        should read under concurrent solves."""
+        prof = getattr(self._completed_tl, "profile", None)
+        return dict(prof if prof is not None else self.last_completed_profile)
+
+    def _solve(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        pods: Sequence[Pod],
+        prof: Dict[str, float],
+    ) -> List[VirtualNode]:
         t0 = time.perf_counter()
         constraints = constraints.clone()
         pods, sts = sort_pods_ffd_with_statics(pods)
         instance_types = sorted(instance_types, key=lambda it: it.effective_price())
         prof["sort_s"] = time.perf_counter() - t0
+        # Double-buffered host pipeline (docs/solver-transport.md): the
+        # solve lock covers only the HOST-side prepare stages
+        # (inject/encode) and the non-blocking dispatch. The blocking
+        # fused-result fetch and the decode run OFF the lock — JAX dispatch
+        # (and the sidecar RPC future) is async, so while solve i is in
+        # flight on the device/wire, the next batch's encode proceeds under
+        # the freed lock instead of queueing behind the fetch.
         with self._solve_lock:
             # published under the lock: a concurrent warmup solve must
             # not clobber the profile observers read
@@ -532,7 +709,7 @@ class TpuScheduler:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             t0 = time.perf_counter()
             try:
-                result, typemask = self._pack(batch)
+                pending = self._pack(batch)
             except Exception:
                 breaker.record_failure()
                 metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
@@ -541,12 +718,34 @@ class TpuScheduler:
                 )
                 prof["packer_backend"] = "ffd-degraded"
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
-            breaker.record_success()
-            prof["pack_fetch_s"] = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            nodes = self._decode(batch, result, typemask, constraints, instance_types)
-            prof["decode_s"] = time.perf_counter() - t0
-            return nodes
+        # lock released: solve i is in flight; only its fetch blocks here
+        try:
+            result, typemask = pending()
+        except Exception:
+            breaker.record_failure()
+            metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
+            logger.exception(
+                "accelerated pack failed; FFD fallback serves this batch"
+            )
+            prof["packer_backend"] = "ffd-degraded"
+            # the FFD floor shares per-scheduler state (the fallback
+            # scheduler, pod selector snapshots): take the lock back
+            with self._solve_lock:
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
+        breaker.record_success()
+        # wire serialization is attributed separately (wire_ser_s /
+        # wire_deser_s, set by RemoteSolver) so pack_fetch_s is the
+        # in-flight dispatch+fetch wait alone
+        prof["pack_fetch_s"] = max(
+            time.perf_counter() - t0
+            - prof.get("wire_ser_s", 0.0)
+            - prof.get("wire_deser_s", 0.0),
+            0.0,
+        )
+        t0 = time.perf_counter()
+        nodes = self._decode(batch, result, typemask, constraints, instance_types)
+        prof["decode_s"] = time.perf_counter() - t0
+        return nodes
 
     def _ffd_degrade(self, constraints, instance_types, pods, daemon, plan) -> List[VirtualNode]:
         """The degradation ladder's floor: materialize the topology plan

@@ -12,35 +12,81 @@ Wire format: **flat little-endian buffers, not protobuf message trees**
     magic "KTPU" | u16 version | u16 array count
     per array: u8 dtype code | u8 ndim | u32 dims... | raw C-order bytes
 
-The RPC surface is one unary method ``/karpenter.solver.v1.Solver/Pack``
-registered through gRPC's generic handler with identity (bytes) serializers,
-so no generated stubs are needed. Request = the 10 ``kernel.pack`` inputs
-(+ n_max as a scalar array); response = ONE fused i32 buffer (see
-``kernel.fuse_result``) the client splits back into a ``PackResult``.
+The RPC surface is served through gRPC's generic handler with identity
+(bytes) serializers, so no generated stubs are needed.
+
+**v3 — session-based transport** (BENCH_r05: the wire, not the kernel, gates
+the <100ms target — ``transport_rtt_floor_ms=106`` and 114.9ms of the worst
+iteration in ``pack_fetch``). The catalog-side tensors (join table,
+frontiers, daemon vector) are solve-INVARIANT per catalog generation, yet v2
+shipped them with every Pack. v3 makes the sidecar stateful per catalog
+fingerprint:
+
+- ``/Solver/OpenSession`` uploads the catalog-side tensors once, keyed by a
+  content fingerprint (:func:`catalog_session_key` — the closure of
+  ``encode.catalog_fingerprint`` materialized as arrays); the sidecar pins
+  them on device (``jax.device_put``) in a bounded LRU with TTL eviction;
+- each ``Pack`` carries the 16-byte session key plus ONLY the pod-side
+  arrays — the steady-state payload excludes catalog bytes entirely;
+- a fingerprint miss (LRU/TTL eviction, or a restarted sidecar whose store
+  is empty) answers ``NEEDS_CATALOG`` and the client transparently re-opens
+  and retries once;
+- version skew fails LOUDLY, exactly as the v1→v2 bump did: a v2 frame hits
+  ``unsupported version 2`` server-side, never a silent mis-parse.
+
+Every response leads with an i32 status array (``STATUS_OK`` /
+``STATUS_NEEDS_CATALOG``) so transport-level errors stay distinguishable
+from in-band protocol state.
+
+The client half (:class:`RemoteSolver`) splits dispatch from fetch
+(``pack_begin`` → ``wait()``): the RPC goes out as a gRPC future, so the
+scheduler can release its solve lock and encode batch i+1 while solve i is
+in flight — only the fused-result fetch blocks (docs/solver-transport.md
+has the pipeline diagram).
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import struct
 import threading
 import time
+from collections import OrderedDict
 from concurrent import futures
-from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 logger = logging.getLogger("karpenter.solver.service")
 
 MAGIC = b"KTPU"
-# v2: response switched from 5 per-field arrays to one fused buffer — a
-# version skew must fail loudly, not degrade into a silent parse error
-VERSION = 2
+# v2: response switched from 5 per-field arrays to one fused buffer.
+# v3: stateful sessions — Pack carries a session key + pod-side arrays only,
+# responses lead with a status word, OpenSession uploads the catalog side.
+# A version skew must fail loudly, not degrade into a silent parse error.
+VERSION = 3
 METHOD = "/karpenter.solver.v1.Solver/Pack"
+OPEN_SESSION_METHOD = "/karpenter.solver.v1.Solver/OpenSession"
 HEALTH_METHOD = "/karpenter.solver.v1.Solver/Health"
 SERVING = b"SERVING"
 NOT_SERVING = b"NOT_SERVING"
+
+# in-band response status (first i32 array of every v3 response)
+STATUS_OK = 0
+STATUS_NEEDS_CATALOG = 1
+
+# sidecar session store bounds: one entry per live catalog generation —
+# a handful of provisioners each see one catalog at a time, so a small LRU
+# holds the working set; TTL reclaims device memory for catalogs no client
+# has touched in a while (a dropped controller never closes its session).
+SESSION_MAX = 8
+SESSION_TTL_S = 900.0
+
+# ``kernel.pack`` takes 7 pod-side arrays then the 3 catalog-side ones
+# (join_table, frontiers, daemon) — the split the session protocol is
+# built around (see EncodedBatch.pack_args).
+N_POD_ARRAYS = 7
 
 _DTYPES = {0: np.dtype(np.bool_), 1: np.dtype(np.int32), 2: np.dtype(np.float32)}
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
@@ -95,6 +141,35 @@ def unpack_arrays(data: bytes) -> List[np.ndarray]:
 
 
 # ---------------------------------------------------------------------------
+# session keys
+# ---------------------------------------------------------------------------
+
+
+def catalog_session_key(
+    join_table: np.ndarray, frontiers: np.ndarray, daemon: np.ndarray
+) -> bytes:
+    """16-byte content fingerprint of the catalog-side tensors — the
+    signature closure that ``encode.catalog_fingerprint``'s table produced,
+    materialized. Content-addressed (not identity-addressed) so two clients
+    of one sidecar converge on one resident copy, and a catalog-generation
+    flip simply mints a new key."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in (join_table, frontiers, daemon):
+        a = np.asarray(a)
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def _key_array(key: bytes) -> np.ndarray:
+    return np.frombuffer(key, np.int32)
+
+
+def _status_response(status: int, payload: Sequence[np.ndarray] = ()) -> bytes:
+    return pack_arrays([np.array([status], np.int32), *payload])
+
+
+# ---------------------------------------------------------------------------
 # server (the JAX/TPU sidecar)
 # ---------------------------------------------------------------------------
 
@@ -102,13 +177,110 @@ def unpack_arrays(data: bytes) -> List[np.ndarray]:
 class SolverService:
     """Owns the jitted kernel; one Pack call = one batched solve.
 
+    Stateful per catalog fingerprint (v3): ``open_session_bytes`` pins a
+    catalog generation's tensors on device, ``solve_bytes`` serves delta
+    solves against them. The session store is an in-memory LRU — a restart
+    empties it, and clients recover through NEEDS_CATALOG, so no durability
+    machinery is needed.
+
     Readiness = the backend compiled and executed one tiny solve (warmup);
     liveness = the process responds at all. Round 1 shipped neither — a hung
     sidecar was only discovered via the 5s client deadline per batch
     (VERDICT weak #7)."""
 
-    def __init__(self):
+    def __init__(
+        self,
+        session_max: int = SESSION_MAX,
+        session_ttl: float = SESSION_TTL_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.ready = threading.Event()
+        self.session_max = session_max
+        self.session_ttl = session_ttl
+        self._clock = clock
+        # key -> [device-resident (join, frontiers, daemon), last_used, fresh];
+        # Pack handler threads race OpenSession handler threads on it.
+        # ``fresh`` marks a just-uploaded session: the upload itself is the
+        # recorded MISS, and the first solve against it must not count as a
+        # hit — otherwise a store thrashing on every solve (miss → open →
+        # retry) would report ~0.5 hit rate instead of ~0.
+        self._sessions: "OrderedDict[bytes, list]" = OrderedDict()  # guarded-by: self._sessions_lock
+        self._sessions_lock = threading.Lock()
+
+    # -- sessions -----------------------------------------------------------
+
+    def _evict_sessions_locked(self) -> None:
+        """LRU + TTL eviction; caller holds ``_sessions_lock``."""
+        from karpenter_tpu.solver import session_stats
+
+        now = self._clock()
+        evicted = 0
+        stale = [
+            k for k, v in self._sessions.items()
+            if now - v[1] > self.session_ttl
+        ]
+        for k in stale:
+            del self._sessions[k]
+            evicted += 1
+        while len(self._sessions) > self.session_max:
+            self._sessions.popitem(last=False)
+            evicted += 1
+        if evicted:
+            session_stats.record_eviction(evicted)
+
+    def open_session_bytes(self, request: bytes) -> bytes:
+        """Pin one catalog generation's tensors on device under its key.
+
+        Idempotent for an already-resident key (another client of this
+        sidecar, or a client whose own opened-LRU forgot it): the store is
+        just touched — no re-upload to HBM, no spurious miss, and the
+        session's fresh/aged state is left alone. The optional trailing
+        flags array (``[record]``) keeps probe traffic out of the hit-rate
+        stats, mirroring the in-process DeviceInvariants contract."""
+        import jax
+
+        from karpenter_tpu.solver import session_stats
+
+        key_arr, join_table, frontiers, daemon, *rest = unpack_arrays(request)
+        key = key_arr.tobytes()
+        record = bool(rest[0].reshape(-1)[0]) if rest else True
+        with self._sessions_lock:
+            hit = self._sessions.get(key)
+            if hit is not None:
+                hit[1] = self._clock()
+                self._sessions.move_to_end(key)
+                self._evict_sessions_locked()
+        if hit is not None:
+            return _status_response(STATUS_OK)
+        resident = tuple(jax.device_put(a) for a in (join_table, frontiers, daemon))
+        # re-check under the lock: two clients racing to open the same new
+        # key both pass the miss check above and both device_put — the
+        # FIRST insert wins (preserving any fresh state a Pack already
+        # consumed), the loser's tensors are dropped, and the stats count
+        # one residency miss per logical open, not per racer
+        with self._sessions_lock:
+            won = key not in self._sessions
+            if won:
+                self._sessions[key] = [resident, self._clock(), True]
+            else:
+                self._sessions[key][1] = self._clock()
+            self._sessions.move_to_end(key)
+            self._evict_sessions_locked()
+        if won:
+            session_stats.record_upload()
+            if record:
+                # the upload IS the residency miss: catalog bytes crossed
+                # for the solve that triggered this open (proactive or
+                # NEEDS_CATALOG retry)
+                session_stats.record(False)
+            logger.info("solver session opened (catalog key %s)", key.hex()[:12])
+        return _status_response(STATUS_OK)
+
+    def session_count(self) -> int:
+        with self._sessions_lock:
+            return len(self._sessions)
+
+    # -- lifecycle ----------------------------------------------------------
 
     def warmup(self) -> None:
         """Compile + run a minimal solve so readiness implies a working
@@ -133,12 +305,20 @@ class SolverService:
             batch = enc.encode(
                 constraints, catalog, pods, daemon_overhead(cluster, constraints)
             )
-            self.solve_bytes(
+            args = [np.asarray(a) for a in batch.pack_args()]
+            key = catalog_session_key(*args[N_POD_ARRAYS:])
+            self.open_session_bytes(
+                pack_arrays([_key_array(key)] + args[N_POD_ARRAYS:])
+            )
+            response = self.solve_bytes(
                 pack_arrays(
-                    [np.asarray(a) for a in batch.pack_args()]
-                    + [np.asarray([len(batch.pod_valid)], np.int32)]
+                    [_key_array(key), np.asarray([len(batch.pod_valid)], np.int32)]
+                    + args[:N_POD_ARRAYS]
                 )
             )
+            status = int(unpack_arrays(response)[0].reshape(-1)[0])
+            if status != STATUS_OK:
+                raise RuntimeError(f"warmup solve answered status {status}")
             logger.info("solver warmup complete")
         except Exception:
             logger.exception("solver warmup failed; staying unready")
@@ -164,20 +344,49 @@ class SolverService:
         return SERVING if self.ready.is_set() else NOT_SERVING
 
     def solve_bytes(self, request: bytes) -> bytes:
+        """One delta solve: session key + n_max + the 7 pod-side arrays.
+        Unknown key → ``NEEDS_CATALOG`` (the client re-opens and retries)."""
         import jax
 
-        from karpenter_tpu.solver import kernel
+        from karpenter_tpu.solver import kernel, session_stats
 
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
         arrays = unpack_arrays(request)
-        *inputs, n_max_arr = arrays
-        n_max = int(n_max_arr.reshape(-1)[0])
-        result = pack_best(*inputs, n_max=n_max)
+        key_arr, n_max_arr, *pod_arrays = arrays
+        key = key_arr.tobytes()
+        vals = n_max_arr.reshape(-1)
+        n_max = int(vals[0])
+        # optional second word: 0 = keep this Pack out of the hit-rate
+        # stats (shadow probes, saturation re-dispatches — one logical
+        # solve must count once, matching the in-process path)
+        record = bool(vals[1]) if vals.size > 1 else True
+        record_hit = False
+        with self._sessions_lock:
+            hit = self._sessions.get(key)
+            if hit is not None:
+                hit[1] = self._clock()
+                self._sessions.move_to_end(key)
+                resident = hit[0]
+                if record:
+                    record_hit = not hit[2]  # fresh upload was the miss
+                    hit[2] = False
+            # store maintenance rides the hot path too: in steady state no
+            # further OpenSession ever arrives, and TTL-expired catalog
+            # generations must still release their pinned HBM (this solve's
+            # own session was just touched, so it can never be the victim)
+            self._evict_sessions_locked()
+        if hit is None:
+            # no record here: the client's re-open is the one miss this
+            # logical solve contributes (open_session_bytes records it)
+            return _status_response(STATUS_NEEDS_CATALOG)
+        if record_hit:
+            session_stats.record(True)
+        result = pack_best(*pod_arrays, *resident, n_max=n_max)
         # one fused device→host transfer on the sidecar too — per-array
         # fetches each pay the full device round trip
         buf = jax.device_get(kernel.fuse_result(result))
-        return pack_arrays([np.asarray(buf)])
+        return _status_response(STATUS_OK, [np.asarray(buf)])
 
 
 def serve(
@@ -185,6 +394,7 @@ def serve(
     max_workers: int = 4,
     health_port: int = 0,
     warmup: bool = False,
+    service=None,
 ):
     """Start the sidecar server; returns the grpc server object.
 
@@ -192,10 +402,11 @@ def serve(
     always 200 once the process is up) and ``/readyz`` (503 until the warmup
     solve completes) for kubelet probes (deploy/solver.yaml). ``warmup``
     runs the compile-warming solve in the background; without it readiness
-    is immediate (tests, in-process use)."""
+    is immediate (tests, in-process use). ``service`` lets a caller hand in
+    a pre-built (or chaos-wrapped — testing/chaos.py) ``SolverService``."""
     import grpc
 
-    service = SolverService()
+    service = service if service is not None else SolverService()
 
     def handler_fn(method_name, unused_handler_call_details=None):
         if method_name.method == METHOD:
@@ -203,6 +414,12 @@ def serve(
                 lambda request, ctx: service.solve_bytes(request),
                 request_deserializer=None,  # raw bytes in
                 response_serializer=None,  # raw bytes out
+            )
+        if method_name.method == OPEN_SESSION_METHOD:
+            return grpc.unary_unary_rpc_method_handler(
+                lambda request, ctx: service.open_session_bytes(request),
+                request_deserializer=None,
+                response_serializer=None,
             )
         if method_name.method == HEALTH_METHOD:
             return grpc.unary_unary_rpc_method_handler(
@@ -238,7 +455,10 @@ def serve(
 
 
 def _serve_health(service: SolverService, port: int):
-    """Plain-HTTP probe endpoints for kubelet."""
+    """Plain-HTTP probe endpoints for kubelet, plus ``/metrics``: the
+    session store lives in THIS process, so its catalog-residency counters
+    (session_catalog_uploads/hit_rate/evictions) are only observable on the
+    sidecar's own scrape — the controller's registry never sees them."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Probe(BaseHTTPRequestHandler):
@@ -250,6 +470,12 @@ def _serve_health(service: SolverService, port: int):
                     code, body = 200, b"ok"
                 else:
                     code, body = 503, b"warming"
+            elif self.path == "/metrics":
+                from prometheus_client import generate_latest
+
+                from karpenter_tpu import metrics as _m
+
+                code, body = 200, generate_latest(_m.REGISTRY)
             else:
                 code, body = 404, b"not found"
             self.send_response(code)
@@ -272,7 +498,24 @@ def _serve_health(service: SolverService, port: int):
 
 class RemoteSolver:
     """Drop-in for ``kernel.pack``: ships the arrays to the sidecar and
-    returns the PackResult tuple as host numpy arrays."""
+    returns the PackResult tuple as host numpy arrays.
+
+    v3: the catalog-side arrays are uploaded once per fingerprint
+    (``OpenSession``); every ``pack`` ships the session key plus only the
+    pod-side arrays. ``pack_begin`` dispatches without blocking (gRPC
+    future) and returns ``wait()`` — the double-buffer seam: the scheduler
+    releases its solve lock between the two, so encode(i+1) overlaps
+    solve(i)'s wire+device time."""
+
+    # fingerprint memos retained (catalog-side array identity -> key);
+    # bounded like encode's _fp_cache, and holding the array refs so the
+    # ids stay valid for each entry's lifetime
+    KEY_MEMO_MAX = 8
+    # opened-session keys retained: a churning catalog fingerprint mints a
+    # new 16-byte key per generation and must not grow the set for the
+    # process lifetime; evicting a LIVE key merely costs one redundant
+    # re-open on its next use
+    OPENED_MAX = 64
 
     def __init__(self, address: str, timeout: float = 30.0, cold_timeout: float = 180.0):
         import grpc
@@ -282,7 +525,14 @@ class RemoteSolver:
         # first call per (P, n_max) shape must cover the sidecar's XLA
         # compile; later calls get the short deadline
         self.cold_timeout = cold_timeout
-        self._warm_shapes = set()
+        self._warm_shapes = set()  # guarded-by: self._lock
+        # catalog keys this client has uploaded (bounded LRU); a sidecar
+        # restart orphans them server-side — NEEDS_CATALOG triggers the
+        # transparent re-open
+        self._opened: "OrderedDict[bytes, bool]" = OrderedDict()  # guarded-by: self._lock
+        self._key_memo: "OrderedDict[tuple, tuple]" = OrderedDict()  # guarded-by: self._lock
+        self.session_uploads = 0  # guarded-by: self._lock
+        self._lock = threading.Lock()
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -291,6 +541,7 @@ class RemoteSolver:
             ],
         )
         self._call = self._channel.unary_unary(METHOD)
+        self._open_call = self._channel.unary_unary(OPEN_SESSION_METHOD)
         self._health_call = self._channel.unary_unary(HEALTH_METHOD)
 
     def health(self, timeout: float = 2.0) -> bool:
@@ -300,20 +551,128 @@ class RemoteSolver:
         except Exception:
             return False
 
-    def pack(self, *inputs, n_max: int):
+    # -- sessions -----------------------------------------------------------
+
+    def _catalog_key(self, catalog_side: Tuple) -> bytes:
+        """Fingerprint the catalog-side arrays, memoized by identity: the
+        encode closure memo freezes and reuses these arrays across solves,
+        so the steady state never re-hashes the multi-MB join table."""
+        id_key = tuple(map(id, catalog_side))
+        with self._lock:
+            hit = self._key_memo.get(id_key)
+            if hit is not None:
+                self._key_memo.move_to_end(id_key)
+                return hit[1]
+        key = catalog_session_key(*catalog_side)
+        with self._lock:
+            self._key_memo[id_key] = (tuple(catalog_side), key)
+            while len(self._key_memo) > self.KEY_MEMO_MAX:
+                self._key_memo.popitem(last=False)
+        return key
+
+    def _open_session(
+        self,
+        key: bytes,
+        catalog_side: Tuple,
+        timeout: float,
+        force: bool = False,
+        record: bool = True,
+    ) -> None:
+        with self._lock:
+            if not force and key in self._opened:
+                self._opened.move_to_end(key)
+                return
+        request = pack_arrays(
+            [_key_array(key)]
+            + [np.asarray(a) for a in catalog_side]
+            + [np.asarray([1 if record else 0], np.int32)]
+        )
+        self._open_call(request, timeout=timeout)
+        with self._lock:
+            self._opened[key] = True
+            self._opened.move_to_end(key)
+            while len(self._opened) > self.OPENED_MAX:
+                self._opened.popitem(last=False)
+            self.session_uploads += 1
+
+    @staticmethod
+    def _split_status(response: bytes) -> Tuple[int, List[np.ndarray]]:
+        status_arr, *payload = unpack_arrays(response)
+        return int(status_arr.reshape(-1)[0]), payload
+
+    # -- solves -------------------------------------------------------------
+
+    def pack_begin(
+        self, *inputs, n_max: int, prof: Optional[dict] = None, record: bool = True
+    ):
+        """Serialize the pod-side delta, ensure the session is open, and
+        dispatch the Pack RPC WITHOUT blocking. Returns ``wait()`` →
+        PackResult (host arrays). ``prof`` (the scheduler's per-solve stage
+        dict) receives ``wire_ser_s``/``wire_deser_s`` so the bench can
+        attribute serialization separately from the in-flight wait.
+        ``record=False`` keeps this Pack out of the sidecar's hit-rate
+        stats (shadow probes, saturation re-dispatches)."""
         from karpenter_tpu.solver.kernel import split_result
 
-        request = pack_arrays(
-            [np.asarray(a) for a in inputs] + [np.asarray([n_max], np.int32)]
-        )
+        pod_side, catalog_side = inputs[:N_POD_ARRAYS], inputs[N_POD_ARRAYS:]
+        key = self._catalog_key(catalog_side)
         p = len(inputs[0])
-        shape = (p, n_max)
-        timeout = self.timeout if shape in self._warm_shapes else self.cold_timeout
-        response = self._call(request, timeout=timeout)
-        self._warm_shapes.add(shape)
-        (buf,) = unpack_arrays(response)
         r = inputs[6].shape[1]  # pod_req
-        return split_result(buf, p, n_max, r)
+        shape = (p, n_max)
+        with self._lock:
+            warm = shape in self._warm_shapes
+        timeout = self.timeout if warm else self.cold_timeout
+        # proactive open: the steady state short-circuits on the _opened
+        # set; only a fresh catalog generation pays the upload RTT here
+        self._open_session(key, catalog_side, timeout, record=record)
+        t0 = time.perf_counter()
+        request = pack_arrays(
+            [_key_array(key), np.asarray([n_max, 1 if record else 0], np.int32)]
+            + [np.asarray(a) for a in pod_side]
+        )
+        if prof is not None:
+            prof["wire_ser_s"] = (
+                prof.get("wire_ser_s", 0.0) + time.perf_counter() - t0
+            )
+        future = self._call.future(request, timeout=timeout)
+
+        def wait():
+            response = future.result()
+            status, payload = self._split_status(response)
+            if status == STATUS_NEEDS_CATALOG:
+                # sidecar restarted or evicted this catalog: re-open and
+                # retry ONCE, synchronously (the overlap is already lost)
+                logger.info(
+                    "solver session %s not resident; re-opening", key.hex()[:12]
+                )
+                self._open_session(key, catalog_side, timeout, force=True, record=record)
+                status, payload = self._split_status(
+                    self._call(request, timeout=timeout)
+                )
+                if status == STATUS_NEEDS_CATALOG:
+                    # fail loud: something is evicting faster than we open
+                    # (session_max=0, or a thrashing key) — the caller's
+                    # breaker turns this into the in-process fallback
+                    raise RuntimeError(
+                        "solver session re-open did not take "
+                        f"(catalog key {key.hex()[:12]})"
+                    )
+            with self._lock:
+                self._warm_shapes.add(shape)
+            t1 = time.perf_counter()
+            (buf,) = payload
+            out = split_result(buf, p, n_max, r)
+            if prof is not None:
+                prof["wire_deser_s"] = (
+                    prof.get("wire_deser_s", 0.0) + time.perf_counter() - t1
+                )
+            return out
+
+        return wait
+
+    def pack(self, *inputs, n_max: int):
+        """Synchronous convenience wrapper over ``pack_begin``."""
+        return self.pack_begin(*inputs, n_max=n_max)()
 
     def close(self) -> None:
         self._channel.close()
@@ -328,9 +687,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--address", default="127.0.0.1:50051")
     ap.add_argument("--max-workers", type=int, default=4)
     ap.add_argument("--health-port", type=int, default=8081)
+    ap.add_argument("--session-max", type=int, default=SESSION_MAX)
+    ap.add_argument("--session-ttl", type=float, default=SESSION_TTL_S)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    server = serve(args.address, args.max_workers, health_port=args.health_port, warmup=True)
+    server = serve(
+        args.address, args.max_workers, health_port=args.health_port, warmup=True,
+        service=SolverService(session_max=args.session_max, session_ttl=args.session_ttl),
+    )
     try:
         while True:
             time.sleep(3600)

@@ -1,0 +1,85 @@
+"""Process-wide catalog-residency accounting for the solver transport.
+
+The v3 transport makes catalog-side tensors *resident* on the device side —
+pinned by the sidecar per session (``service.SolverService``) or by the
+in-process invariants cache (``fused.DeviceInvariants``). Both funnel their
+hit/miss/upload/eviction events through this module so one gauge answers the
+question the BENCH acceptance bar asks: *does the steady-state solve ship
+catalog bytes, or only pod deltas?*
+
+Semantics:
+
+- a **hit** = a solve served against already-resident catalog tensors (no
+  catalog bytes crossed the wire/PCIe for it);
+- a **miss** = the solve found its catalog non-resident (fingerprint unknown,
+  evicted, or a restarted sidecar) and an upload had to happen;
+- ``solver_session_catalog_hit_rate`` = hits / (hits + misses) since process
+  start (or the last ``reset()`` — bench resets after warmup so the reported
+  rate is the steady-state one).
+
+Counters are process-global because the sidecar and the in-process fused
+path never run in the same solve: a configured sidecar owns the device
+(``backend._fused_route`` yields to it), so the stream of events is one
+transport's story at a time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_hits = 0  # guarded-by: _lock
+_misses = 0  # guarded-by: _lock
+
+
+def record(hit: bool) -> None:
+    """One solve consulted the resident catalog: hit (tensors already on
+    device) or miss (an upload had to happen first)."""
+    global _hits, _misses
+    from karpenter_tpu import metrics
+
+    with _lock:
+        if hit:
+            _hits += 1
+        else:
+            _misses += 1
+        # the gauge is set under the lock so two racing records cannot
+        # publish their snapshots out of order and leave a stale value
+        metrics.SOLVER_SESSION_HIT_RATE.set(_hits / (_hits + _misses))
+
+
+def record_upload() -> None:
+    """Catalog-side tensors crossed to the device (OpenSession upload or a
+    DeviceInvariants device_put)."""
+    from karpenter_tpu import metrics
+
+    metrics.SOLVER_SESSION_UPLOADS.inc()
+
+
+def record_eviction(n: int = 1) -> None:
+    """Resident catalog entries dropped (LRU pressure or TTL expiry)."""
+    from karpenter_tpu import metrics
+
+    metrics.SOLVER_SESSION_EVICTIONS.inc(n)
+
+
+def snapshot() -> Dict[str, float]:
+    """Bench surface: the counters plus the derived hit rate."""
+    with _lock:
+        hits, misses = _hits, _misses
+    total = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": (hits / total) if total else None,
+    }
+
+
+def reset() -> None:
+    """Bench/tests: restart the window (e.g. after warmup, so the reported
+    rate is the steady state's, not the cold start's)."""
+    global _hits, _misses
+    with _lock:
+        _hits = 0
+        _misses = 0

@@ -140,6 +140,12 @@ class SignatureTable:
         # base-invariant (set_base only refreshes hostname state, which is
         # deliberately outside signatures)
         self._closure_memo: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        # per-(closure, daemon, active-axes) TRIMMED catalog-side arrays —
+        # filled by encode so steady-state solves return identity-stable
+        # frontiers/daemon objects (the session transport fingerprints the
+        # catalog side by id; a fresh array per solve would re-hash the
+        # full tensors under the solve lock every batch)
+        self._trim_memo: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         # signature 0 is the base itself
         self._base_hostnames = base.requirements.get(lbl.HOSTNAME)
         self._intern(self._strip_hostname(base.requirements))

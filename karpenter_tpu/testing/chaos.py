@@ -41,6 +41,10 @@ CHAOS_METHODS = frozenset({
     "describe_instances", "terminate_instances", "poll_disruptions",
     # SimGkeAPI
     "create_node_pool", "delete_node_pool", "delete_instance",
+    # solver sidecar (service.SolverService) — a chaos-wrapped service
+    # handed to service.serve() simulates a slow/failing device solve, the
+    # pipeline-smoke test's way of proving encode(i+1) hides under solve(i)
+    "solve_bytes", "open_session_bytes",
 })
 
 # exponential p95 = mean * ln(20); invert to calibrate the mean from a p95
@@ -64,6 +68,10 @@ class ChaosPolicy:
 
     error_rate: float = 0.0          # per-call failure probability
     latency_p95: float = 0.0         # seconds; 0 = no injected latency
+    # deterministic per-call latency floor (seconds), added before any
+    # random draw: overlap tests need a KNOWN in-flight time to hide host
+    # work under, which an exponential draw can't guarantee
+    latency_floor: float = 0.0
     throttle_fraction: float = 0.25  # this share of injected errors throttle (429)
     ice_storms: Sequence[ChaosWindow] = ()
     blackouts: Sequence[ChaosWindow] = ()
@@ -146,6 +154,7 @@ class ChaosProxy:
                     self._rng.expovariate(_LN20 / policy.latency_p95),
                     policy.latency_p95 * policy.latency_cap_factor,
                 )
+        delay += policy.latency_floor
         if delay > 0.0:
             self._note(self.delayed, method)
             time.sleep(delay)

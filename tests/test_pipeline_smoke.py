@@ -1,0 +1,136 @@
+"""Pipeline smoke: encode(i+1) must actually hide under solve(i).
+
+The double-buffered host pipeline's one load-bearing property is that the
+scheduler's solve lock covers only the host prepare stages (sort / inject /
+encode) and the non-blocking dispatch — the in-flight device/wire wait and
+the decode run OFF the lock. This test pins that with the chaos harness
+(testing/chaos.py): the sidecar's ``solve_bytes`` is slowed by a
+deterministic ``latency_floor``, two batches are driven through ONE
+TpuScheduler concurrently, and the wall clock proves the second batch's
+host work ran while the first solve was in flight.
+
+Serialized (the v2 shape: fetch under the solve lock), the two solves cost
+at least 2× the floor back-to-back. Overlapped, both floors tick
+concurrently and the wall stays well under 2×.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tests.test_solver_service import free_port
+
+# long enough to dwarf warm host stages (a 32-pod encode is ~ms) yet keep
+# the test comfortably inside tier-1 time
+FLOOR_S = 0.5
+
+
+@pytest.fixture()
+def sidecar_env(monkeypatch):
+    """A chaos-slowed sidecar + a scheduler forced onto it.
+
+    KARPENTER_PACKER=fused pins the device path deterministically (with a
+    configured sidecar the fused route yields to it), so the router can't
+    send a timed solve to the native packer mid-test."""
+    monkeypatch.setenv("KARPENTER_PACKER", "fused")
+    from karpenter_tpu.solver.service import SolverService, serve
+    from karpenter_tpu.testing.chaos import ChaosPolicy, chaos_wrap
+
+    policy = ChaosPolicy(
+        latency_floor=FLOOR_S, methods=frozenset({"solve_bytes"})
+    )
+    service = chaos_wrap(SolverService(), policy)
+    address = f"127.0.0.1:{free_port()}"
+    server = serve(address, service=service)
+    yield address, service
+    server.stop(grace=1)
+
+
+def test_encode_overlaps_inflight_solve(sidecar_env):
+    address, service = sidecar_env
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.solver.backend import TpuScheduler
+    from karpenter_tpu.testing import make_pod, make_provisioner
+
+    catalog = instance_types(8)
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    sched = TpuScheduler(Cluster(), rng=random.Random(0), service_address=address)
+
+    def batch(tag):
+        return [
+            make_pod(name=f"{tag}-{i}", requests={"cpu": "0.25"})
+            for i in range(32)
+        ]
+
+    # warm serially: XLA compile, session open, statics — the timed round
+    # must measure the pipeline, not cold starts
+    warm_a = sched.solve(constraints, catalog, batch("warm-a"))
+    assert sum(len(v.pods) for v in warm_a) == 32
+    assert sched.last_profile.get("packer_backend") == "device"
+    sched.solve(constraints, catalog, batch("warm-b"))
+    # the catalog crossed the wire exactly once across both warm solves
+    assert sched._remote is not None and sched._remote.session_uploads == 1
+    assert service.delayed.get("solve_bytes", 0) >= 2  # chaos actually fired
+
+    results = {}
+
+    def run(tag):
+        results[tag] = sched.solve(constraints, catalog, batch(tag))
+
+    threads = [
+        threading.Thread(target=run, args=(t,), daemon=True) for t in ("i", "i+1")
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    wall = time.perf_counter() - t0
+
+    for tag in ("i", "i+1"):
+        assert tag in results, f"solve {tag} never finished"
+        assert sum(len(v.pods) for v in results[tag]) == 32
+    # overlap bar: serialized execution pays >= 2 floors (1.0s); the
+    # double-buffered pipeline pays ~1 floor + host work. 1.75x leaves slack
+    # for a loaded CI host while still failing any re-serialization.
+    assert wall < 1.75 * FLOOR_S, (
+        f"two concurrent solves took {wall:.3f}s — encode(i+1) did not "
+        f"overlap the in-flight solve(i) ({FLOOR_S}s floor each)"
+    )
+    # steady state held: no further catalog upload during the timed round
+    assert sched._remote.session_uploads == 1
+
+
+def test_stage_timings_split_wire_from_fetch(sidecar_env):
+    """The profile attributes wire serialization separately from the
+    in-flight wait, and the in-flight wait dominates under the chaos floor."""
+    address, _service = sidecar_env
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.solver.backend import TpuScheduler
+    from karpenter_tpu.testing import make_pod, make_provisioner
+
+    catalog = instance_types(8)
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    sched = TpuScheduler(Cluster(), rng=random.Random(0), service_address=address)
+    pods = [make_pod(requests={"cpu": "0.25"}) for _ in range(16)]
+    sched.solve(constraints, catalog, list(pods))  # warm
+    sched.solve(constraints, catalog, list(pods))
+    prof = sched.last_profile
+    assert prof.get("packer_backend") == "device"
+    assert "wire_ser_s" in prof and "wire_deser_s" in prof
+    # pack_fetch_s excludes the wire codec stages by construction
+    assert prof["pack_fetch_s"] >= FLOOR_S * 0.9
+    assert prof["wire_ser_s"] < FLOOR_S / 2
+    assert prof["wire_deser_s"] < FLOOR_S / 2

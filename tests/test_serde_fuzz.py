@@ -194,6 +194,100 @@ def test_fuzzed_provisioner_round_trip_and_schema(seed):
     assert wire1 == wire2, "to_wire → from_wire → to_wire must be a fixed point"
 
 
+# -- v3 solver wire framing --------------------------------------------------
+#
+# The session transport (solver/service.py) bumped the flat-buffer framing
+# to v3: fuzzed arrays must survive pack → unpack bit-identically, session
+# frames (key + delta arrays) must round-trip, and EVERY other version word
+# must fail loudly — a v2 client against a v3 server (or vice versa) gets
+# "unsupported version", never a silent mis-parse.
+
+
+def _random_arrays(rng: random.Random):
+    import numpy as np
+
+    nprng = np.random.default_rng(rng.randrange(2**31))
+    arrays = []
+    for _ in range(rng.randint(1, 6)):
+        ndim = rng.randint(0, 3)
+        shape = tuple(rng.randint(0, 5) for _ in range(ndim))
+        kind = rng.choice(["bool", "i32", "f32"])
+        if kind == "bool":
+            arrays.append(nprng.random(shape) < 0.5)
+        elif kind == "i32":
+            arrays.append(
+                nprng.integers(-(2**31), 2**31 - 1, shape).astype(np.int32)
+            )
+        else:
+            arrays.append(nprng.standard_normal(shape).astype(np.float32))
+    return arrays
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_v3_framing_fuzzed_arrays_round_trip(seed):
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    arrays = _random_arrays(random.Random(seed))
+    out = service.unpack_arrays(service.pack_arrays(arrays))
+    assert len(out) == len(arrays)
+    for a, b in zip(arrays, out):
+        assert np.asarray(a).dtype == b.dtype and np.asarray(a).shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_v3_session_frame_round_trip(seed):
+    """A session frame — 16-byte key as i32[4] + delta arrays — survives
+    the codec with the key bytes intact."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    arrays = _random_arrays(rng)
+    key = bytes(rng.randrange(256) for _ in range(16))
+    frame = service.pack_arrays([np.frombuffer(key, np.int32)] + arrays)
+    key_arr, *rest = service.unpack_arrays(frame)
+    assert key_arr.tobytes() == key
+    assert len(rest) == len(arrays)
+
+
+@pytest.mark.parametrize("version", [0, 1, 2, 4, 255, 65535])
+def test_v3_version_skew_fails_loudly(version):
+    import struct
+
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    frame = bytearray(service.pack_arrays([np.arange(4, dtype=np.int32)]))
+    struct.pack_into("<H", frame, 4, version)
+    with pytest.raises(ValueError, match=f"unsupported version {version}"):
+        service.unpack_arrays(bytes(frame))
+
+
+def test_v3_catalog_key_content_addressed():
+    """Same content → same key; any tensor perturbation → new key (a stale
+    session can never serve a drifted catalog)."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    join = np.arange(6, dtype=np.int32).reshape(2, 3)
+    front = np.ones((2, 1, 2), np.float32)
+    daemon = np.zeros(2, np.float32)
+    k1 = service.catalog_session_key(join, front, daemon)
+    k2 = service.catalog_session_key(join.copy(), front.copy(), daemon.copy())
+    assert k1 == k2 and len(k1) == 16
+    join2 = join.copy()
+    join2[0, 0] = 99
+    assert service.catalog_session_key(join2, front, daemon) != k1
+    # shape perturbation with identical bytes must also miss
+    assert service.catalog_session_key(join.reshape(3, 2), front, daemon) != k1
+
+
 def test_known_bad_documents_rejected():
     base = serde.to_wire("provisioners", random_provisioner(random.Random(1)))
     bad_op = json.loads(json.dumps(base))

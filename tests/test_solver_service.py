@@ -1,15 +1,24 @@
-"""Solver service tests: the flat-buffer codec and a live in-process gRPC
+"""Solver service tests: the flat-buffer codec, a live in-process gRPC
 round trip of the packing kernel (SURVEY §5.8 — the reconcile-loop → JAX
-sidecar transport)."""
+sidecar transport), and the v3 session lifecycle (fingerprint miss →
+NEEDS_CATALOG → transparent re-open, restart recovery, LRU/TTL eviction,
+loud version-skew failure)."""
 
 import random
 import socket
+import struct
 
 import numpy as np
 import pytest
 
 from karpenter_tpu.solver.service import (
+    N_POD_ARRAYS,
+    SESSION_MAX,
+    STATUS_NEEDS_CATALOG,
+    STATUS_OK,
     RemoteSolver,
+    SolverService,
+    catalog_session_key,
     pack_arrays,
     serve,
     unpack_arrays,
@@ -22,6 +31,30 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def encoded_args(n_types: int = 8, n_pods: int = 6, seed: int = 3):
+    """A real encoded batch's ``pack_args`` tuple + its n_max."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(n_types), key=lambda it: it.effective_price())
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cluster = Cluster()
+    Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+    batch = enc.encode(
+        constraints, catalog, pods, daemon_overhead(cluster, constraints)
+    )
+    return batch.pack_args(), len(batch.pod_valid)
 
 
 class TestCodec:
@@ -133,6 +166,230 @@ class TestRemoteSolve:
         assert sum(len(v.pods) for v in vnodes) == 4  # fallback worked
 
 
+class TestSessions:
+    """The v3 session lifecycle: catalog tensors cross the wire once per
+    fingerprint; everything else is delta solves + recovery paths."""
+
+    def test_steady_state_pack_excludes_catalog_bytes(self):
+        """Two solves, one OpenSession: the second Pack ships only the
+        pod-side arrays, and the wire stage timings land in the profile."""
+        args, n_max = encoded_args()
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            client = RemoteSolver(address, timeout=30)
+            prof = {}
+            first = client.pack_begin(*args, n_max=n_max, prof=prof)()
+            second = client.pack_begin(*args, n_max=n_max, prof=prof)()
+            assert client.session_uploads == 1
+            assert "wire_ser_s" in prof and "wire_deser_s" in prof
+            for a, b in zip(tuple(first), tuple(second)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            # and the delta frame really is smaller than the v2-equivalent
+            # full frame by at least the catalog bytes
+            from karpenter_tpu.solver.service import _key_array
+
+            key = catalog_session_key(*args[N_POD_ARRAYS:])
+            delta = pack_arrays(
+                [_key_array(key), np.asarray([n_max], np.int32)]
+                + [np.asarray(a) for a in args[:N_POD_ARRAYS]]
+            )
+            full = pack_arrays([np.asarray(a) for a in args])
+            catalog_bytes = sum(
+                np.asarray(a).nbytes for a in args[N_POD_ARRAYS:]
+            )
+            assert len(full) - len(delta) >= catalog_bytes - 64
+            client.close()
+        finally:
+            server.stop(grace=1)
+
+    def test_fingerprint_miss_needs_catalog_then_transparent_reopen(self):
+        """Server-side eviction (or any fingerprint miss) answers
+        NEEDS_CATALOG; the client re-opens and the solve still succeeds."""
+        args, n_max = encoded_args()
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            client = RemoteSolver(address, timeout=30)
+            first = client.pack(*args, n_max=n_max)
+            assert client.session_uploads == 1
+            # evict everything server-side; the client still believes its
+            # session is open — exactly the LRU/TTL-eviction shape
+            svc = server.solver_service
+            with svc._sessions_lock:
+                svc._sessions.clear()
+            second = client.pack(*args, n_max=n_max)
+            assert client.session_uploads == 2  # transparent re-open happened
+            for a, b in zip(tuple(first), tuple(second)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            client.close()
+        finally:
+            server.stop(grace=1)
+
+    def test_sidecar_restart_recovery(self):
+        """A restarted sidecar has an empty session store; the same client
+        object recovers through NEEDS_CATALOG without caller involvement."""
+        args, n_max = encoded_args()
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        client = RemoteSolver(address, timeout=30)
+        try:
+            first = client.pack(*args, n_max=n_max)
+        finally:
+            server.stop(grace=1)
+        server2 = serve(address)  # fresh process-equivalent: no sessions
+        try:
+            second = client.pack(*args, n_max=n_max)
+            assert client.session_uploads == 2
+            for a, b in zip(tuple(first), tuple(second)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            client.close()
+        finally:
+            server2.stop(grace=1)
+
+    def test_session_lru_eviction_under_many_catalogs(self):
+        """More live catalog generations than session_max: the LRU holds the
+        cap and evictions are counted."""
+        from prometheus_client import generate_latest
+
+        from karpenter_tpu import metrics
+        from karpenter_tpu.solver.service import _key_array
+
+        svc = SolverService(session_max=2)
+        rng = np.random.default_rng(0)
+        keys = []
+        for i in range(4):
+            join = rng.integers(-1, 5, (3, 2)).astype(np.int32)
+            front = rng.random((3, 1, 2)).astype(np.float32)
+            daemon = np.zeros(2, np.float32)
+            key = catalog_session_key(join, front, daemon)
+            keys.append(key)
+            svc.open_session_bytes(
+                pack_arrays([_key_array(key), join, front, daemon])
+            )
+        assert svc.session_count() == 2
+        with svc._sessions_lock:
+            assert set(svc._sessions) == set(keys[-2:])  # LRU order kept
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert "karpenter_solver_session_evictions_total" in out
+
+    def test_session_ttl_eviction(self):
+        """Catalog generations nobody touched within the TTL release their
+        device memory on the next store maintenance."""
+        from karpenter_tpu.solver.service import _key_array
+
+        now = [0.0]
+        svc = SolverService(session_ttl=10.0, clock=lambda: now[0])
+        join = np.zeros((2, 2), np.int32)
+        front = np.zeros((2, 1, 1), np.float32)
+        daemon = np.zeros(1, np.float32)
+        key = catalog_session_key(join, front, daemon)
+        svc.open_session_bytes(pack_arrays([_key_array(key), join, front, daemon]))
+        assert svc.session_count() == 1
+        now[0] = 11.0
+        join2 = np.ones((2, 2), np.int32)
+        key2 = catalog_session_key(join2, front, daemon)
+        svc.open_session_bytes(pack_arrays([_key_array(key2), join2, front, daemon]))
+        with svc._sessions_lock:
+            assert key not in svc._sessions and key2 in svc._sessions
+        # store maintenance also rides the SOLVE path: in steady state no
+        # further OpenSession arrives, yet stale generations must still
+        # release their pinned tensors
+        now[0] = 30.0
+        response = svc.solve_bytes(
+            pack_arrays([_key_array(key), np.asarray([4], np.int32)])
+        )
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_NEEDS_CATALOG
+        assert svc.session_count() == 0  # key2 TTL-swept by the solve path
+
+    def test_thrashing_store_reports_low_hit_rate(self):
+        """More live catalogs than session_max: every solve re-pays the
+        upload, and the hit rate must say ~0 — the NEEDS_CATALOG retry
+        must not double-count as one miss plus one hit."""
+        from karpenter_tpu.solver import session_stats
+
+        args_a, n_max_a = encoded_args(n_types=8)
+        args_b, n_max_b = encoded_args(n_types=12)
+        key_a = catalog_session_key(*args_a[N_POD_ARRAYS:])
+        key_b = catalog_session_key(*args_b[N_POD_ARRAYS:])
+        assert key_a != key_b, "test needs two distinct catalog generations"
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address, service=SolverService(session_max=1))
+        try:
+            client = RemoteSolver(address, timeout=60)
+            session_stats.reset()
+            for _ in range(3):
+                client.pack(*args_a, n_max=n_max_a)
+                client.pack(*args_b, n_max=n_max_b)
+            snap = session_stats.snapshot()
+            # every round evicted the other generation: all misses after
+            # the store's one slot flips, no phantom hits from retries
+            assert snap["misses"] >= 5, snap
+            assert snap["hit_rate"] < 0.2, snap
+            client.close()
+        finally:
+            server.stop(grace=1)
+
+    def test_reopen_of_resident_key_is_idempotent(self):
+        """A client whose opened-LRU forgot a live key (or a second client
+        of the same sidecar) re-opens it: no re-upload to HBM, no spurious
+        miss, fresh state untouched."""
+        from karpenter_tpu.solver import session_stats
+        from karpenter_tpu.solver.service import _key_array
+
+        svc = SolverService()
+        join = np.arange(4, dtype=np.int32).reshape(2, 2)
+        front = np.ones((2, 1, 1), np.float32)
+        daemon = np.zeros(1, np.float32)
+        key = catalog_session_key(join, front, daemon)
+        frame = pack_arrays([_key_array(key), join, front, daemon])
+        session_stats.reset()
+        svc.open_session_bytes(frame)
+        first = session_stats.snapshot()
+        svc.open_session_bytes(frame)
+        assert session_stats.snapshot() == first  # nothing re-counted
+        assert svc.session_count() == 1
+        with svc._sessions_lock:
+            assert svc._sessions[key][2] is True  # still fresh
+
+    def test_unknown_key_answers_needs_catalog(self):
+        args, n_max = encoded_args()
+        from karpenter_tpu.solver.service import _key_array
+
+        svc = SolverService()
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        response = svc.solve_bytes(
+            pack_arrays(
+                [_key_array(key), np.asarray([n_max], np.int32)]
+                + [np.asarray(a) for a in args[:N_POD_ARRAYS]]
+            )
+        )
+        status = int(unpack_arrays(response)[0].reshape(-1)[0])
+        assert status == STATUS_NEEDS_CATALOG
+
+    def test_v2_client_v3_server_skew_fails_loudly(self):
+        """A v2 frame (version word 2) must be REJECTED with the version in
+        the error — never mis-parsed as a session frame."""
+        args, n_max = encoded_args()
+        frame = bytearray(
+            pack_arrays([np.asarray(a) for a in args]
+                        + [np.asarray([n_max], np.int32)])
+        )
+        struct.pack_into("<H", frame, 4, 2)  # the v2 client's version word
+        svc = SolverService()
+        with pytest.raises(ValueError, match="unsupported version 2"):
+            svc.solve_bytes(bytes(frame))
+        # and symmetrically: a v3 client unpacking a v2-framed response
+        with pytest.raises(ValueError, match="unsupported version 2"):
+            unpack_arrays(bytes(frame))
+
+    def test_default_session_bounds_sane(self):
+        svc = SolverService()
+        assert svc.session_max == SESSION_MAX > 0
+        assert svc.session_ttl > 0
+        assert STATUS_OK != STATUS_NEEDS_CATALOG
+
+
 class TestHealth:
     def test_grpc_and_http_health_flip_on_readiness(self):
         """Readiness is gated on the warmup solve; a not-yet-warm sidecar
@@ -154,6 +411,12 @@ class TestHealth:
             assert (
                 urllib.request.urlopen(f"http://127.0.0.1:{hport}/readyz").status == 200
             )
+            # the session store's metrics are scrapeable from the SIDECAR
+            # process (the controller's registry never sees them)
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{hport}/metrics"
+            ).read().decode()
+            assert "karpenter_solver_session_catalog_uploads_total" in scrape
             client.close()
         finally:
             server.health_server.shutdown()
