@@ -35,6 +35,9 @@ deflake:  ## run the suite 10x to shake out flakes (reference: Makefile:38-39)
 benchmark:  ## headline solve benchmark (prints one JSON line)
 	$(PY) bench.py
 
+benchmark-notrace:  ## tracing-overhead comparison run (acceptance bar: native leg within 3%)
+	$(PY) bench.py --no-trace
+
 benchmark-grid:  ## the reference's full batch grid
 	$(PY) bench.py --grid
 
@@ -88,6 +91,6 @@ run:  ## start the controller process against the in-memory cluster
 solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
-.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-grid \
+.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-notrace benchmark-grid \
 	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -281,6 +281,11 @@ def bench_once(
         from karpenter_tpu.solver import session_stats
 
         session_stats.reset()
+        # fresh trace window: the measured iterations' span trees line up
+        # 1:1 with the iteration index (one solver.solve root per solve)
+        from karpenter_tpu import obs
+
+        obs.exporter().clear()
 
         probe = RttProbe() if breakdown else None
         if probe:
@@ -343,6 +348,13 @@ def bench_once(
     if sess["hit_rate"] is not None:
         # steady-state Pack payloads exclude catalog bytes iff this ≈ 1.0
         out["session_catalog_hit_rate"] = round(sess["hit_rate"], 4)
+    if obs.enabled():
+        # self-time attribution down the worst iteration's span tree — the
+        # trace-backed answer to "where did the tail iteration's time go"
+        trees = obs.exporter().trees()
+        if len(trees) == len(times):
+            worst_tree = trees[max(range(len(times)), key=times.__getitem__)]
+            out["trace_critical_path_ms"] = obs.critical_path(worst_tree)
     if breakdown and any(profiles):
         rtt = probe.floor
         rtt_p50 = statistics.median(probe.samples)
@@ -458,6 +470,10 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
         from karpenter_tpu.solver import session_stats
 
         session_stats.reset()
+        # fresh trace window for the overlap invariant below
+        from karpenter_tpu import obs
+
+        obs.exporter().clear()
 
         start_gate = threading.Barrier(streams + 1)
         done = []
@@ -488,6 +504,67 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
             t.join()
         wall = time.perf_counter() - t0
         ru1 = resource.getrusage(resource.RUSAGE_SELF)
+
+        # the PR-4 double-buffer claim as a CHECKED invariant. The
+        # cross-stream pair count is reported for color, but per-stream
+        # schedulers cannot detect the regression the claim is about
+        # (their solve locks never contend), so the assertion runs on a
+        # dedicated probe: ONE scheduler, two concurrent solvers. encode
+        # runs under the solve lock and the fetch off it — B's encode can
+        # only overlap A's in-flight fetch if solve() really releases the
+        # lock before fetching. Asserted only where it is meaningful: not
+        # on the native-forced leg (its fetch IS the synchronous pack),
+        # and only when the probe's fetches are long enough to overlap.
+        overlap_pairs = shared_pairs = None
+        if obs.enabled():
+            overlap_pairs = obs.overlapping_pairs(obs.exporter().trees())
+            sched0, pods_a = streams_state[0]
+            pods_b = streams_state[1][1] if streams > 1 else pods_a
+            # warm pods_b's shape on the SHARED scheduler first: a compile
+            # landing inside the probe runs under the solve lock and
+            # legitimately serializes the threads — which would read as a
+            # lock regression that isn't there
+            sched0.solve(provisioner, catalog, pods_b)
+            obs.exporter().clear()
+            gate2 = threading.Barrier(2)
+
+            def shared_run(pods_s):
+                gate2.wait()
+                for _ in range(3):
+                    sched0.solve(provisioner, catalog, pods_s)
+
+            threads2 = [
+                threading.Thread(target=shared_run, args=(p,), daemon=True)
+                for p in (pods_a, pods_b)
+            ]
+            for t in threads2:
+                t.start()
+            for t in threads2:
+                t.join()
+            strees = obs.exporter().trees()
+            shared_pairs = obs.overlapping_pairs(strees)
+            # MEDIAN fetch gates the assert: on a CPU rig fetches are ~0
+            # and nothing can overlap them (a single >1ms outlier is just
+            # a compile landing in the probe, during which the other
+            # thread legitimately ran to completion alone); a device/wire
+            # rig has every steady-state fetch in the milliseconds, and
+            # there zero overlap really does mean the lock is held
+            # through the fetch
+            fetches = [
+                s["duration_ms"]
+                for t in strees
+                for s in obs.spans_named(t, "solve.pack_fetch")
+            ]
+            if (
+                packer != "native"
+                and len(fetches) >= 4
+                and statistics.median(fetches) >= 1.0
+            ):
+                assert shared_pairs > 0, (
+                    "shared-scheduler probe shows NO encode/fetch overlap — "
+                    "the solve lock is held through the fetch again (the "
+                    "double-buffered pipeline has regressed to serial)"
+                )
     finally:
         if prev_packer is None:
             os.environ.pop("KARPENTER_PACKER", None)
@@ -510,6 +587,9 @@ def bench_pipelined(n_pods: int, streams: int, iters: int, packer: str = "auto")
     sess = session_stats.snapshot()
     if sess["hit_rate"] is not None:
         out["session_catalog_hit_rate"] = round(sess["hit_rate"], 4)
+    if overlap_pairs is not None:
+        out["trace_overlap_pairs"] = overlap_pairs
+        out["trace_shared_sched_overlap_pairs"] = shared_pairs
     return out
 
 
@@ -1468,7 +1548,16 @@ def main():
     ap.add_argument("--profile", metavar="OUT", default="",
                     help="write cProfile stats for one solve (the pprof-harness analog, "
                          "reference: scheduling_benchmark_test.go:76-108)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable span tracing entirely — the overhead "
+                         "acceptance bar compares a traced run's native leg "
+                         "against this mode (within 3%%)")
     args = ap.parse_args()
+
+    from karpenter_tpu import obs
+
+    if args.no_trace:
+        obs.set_enabled(False)
 
     if args.profile:
         import cProfile
@@ -1662,7 +1751,9 @@ def main():
         "unschedulable_expected": r["unschedulable_expected"],
         "unexplained": r["unexplained"],
     }
+    line["trace_enabled"] = obs.enabled()
     for k in ("packer_backend", "wire_in_path", "breakdown_ms", "worst_iter",
+              "trace_critical_path_ms",
               "transport_rtt_floor_ms", "rtt_samples", "rtt_p50_ms",
               "rtt_per_solve_samples", "p99_minus_rtt_each_s",
               "p90_minus_rtt_each_s", "mean_minus_rtt_each_s",
@@ -1695,7 +1786,7 @@ def main():
             for k in ("pods_per_sec", "mean_s", "p99_s",
                       "rtt_per_solve_samples", "mean_minus_rtt_each_s",
                       "p90_minus_rtt_each_s", "p99_minus_rtt_each_s",
-                      "worst_iter"):
+                      "worst_iter", "trace_critical_path_ms"):
                 if k in dev:
                     line[f"device_{k}"] = (
                         round(dev[k], 4) if isinstance(dev[k], float) else dev[k]
@@ -1719,6 +1810,9 @@ def main():
         pipe = bench_pipelined(args.pods, streams=3, iters=max(2, args.iters // 2))
         line["pipelined_pods_per_sec"] = pipe["pods_per_sec"]
         line["pipelined_streams"] = pipe["streams"]
+        if "trace_overlap_pairs" in pipe:
+            # nonzero = the encode(i+1)/solve(i) overlap invariant held
+            line["pipelined_trace_overlap_pairs"] = pipe["trace_overlap_pairs"]
         line["pipelined_unschedulable_expected"] = pipe["unschedulable_expected"]
         line["pipelined_unexplained"] = pipe["unexplained"]
         cpu_per_solve = {"auto": pipe["controller_cpu_seconds_per_solve"]}

@@ -115,7 +115,22 @@ class _JsonApiServer:
 
             def _dispatch(self, method: str):
                 try:
-                    outer._route(self, method)
+                    # traceparent-style propagation: a traced client call
+                    # (the metered provider's cloud.<method> span) parents
+                    # this server's request span, so the control plane's
+                    # share of a launch is attributable in one trace
+                    from karpenter_tpu import obs
+
+                    ctx = obs.from_traceparent(self.headers.get("traceparent"))
+                    if ctx is not None:
+                        with obs.tracer().span(
+                            "cloudapi.request",
+                            parent=ctx,
+                            attrs={"method": method, "path": self.path},
+                        ):
+                            outer._route(self, method)
+                    else:
+                        outer._route(self, method)
                 except ThrottlingError as e:
                     self._error(429, CODE_THROTTLE, str(e),
                                 headers=[("Retry-After", f"{e.retry_after:.3f}")])
@@ -378,10 +393,17 @@ class _WireTransport:
             time.sleep(seconds)
             return True
 
+        from karpenter_tpu import obs
+
+        span = obs.tracer().current()
         for attempt in range(self.max_attempts):
             final = attempt + 1 >= self.max_attempts
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Content-Type", "application/json")
+            if span is not None:
+                # traceparent-style header: the far side opens a child span
+                # under the caller's trace (see CloudAPIServer._dispatch)
+                req.add_header("traceparent", obs.to_traceparent(span))
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     return json.loads(resp.read() or b"{}")

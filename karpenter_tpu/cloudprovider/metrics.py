@@ -91,34 +91,53 @@ class MeteredCloudProvider(CloudProvider):
     def _guarded(self, method: str, fn, *args):
         """breaker(retry(fn)): the retry absorbs transient flakes inside ONE
         logical call; the breaker sees the logical outcome, so a dependency
-        that only ever succeeds via retries still counts as healthy."""
-        start = time.perf_counter()
-        try:
-            if not self.resilient:
-                return fn(*args)
-            breaker = self.breakers.get(f"{self.delegate.name()}:{method}")
-            if not breaker.allow():
-                raise BreakerOpen(breaker.dependency, breaker.open_seconds)
-            try:
-                result = self._policies[method].call(fn, *args)
-            except BreakerOpen:
-                raise
-            except Exception as e:
-                # breaker state tracks AVAILABILITY: a deterministic answer
-                # (ICE/stockout, validation) means the dependency responded —
-                # an ICE storm must sideline offerings (the 45s cache), never
-                # open the create breaker and block the recovery launches
-                from karpenter_tpu.resilience import default_retryable
+        that only ever succeeds via retries still counts as healthy.
 
-                if default_retryable(e):
-                    breaker.record_failure()
-                else:
-                    breaker.record_success()
-                raise
-            breaker.record_success()
-            return result
-        finally:
-            self._observe(method, start)
+        Every call runs under a ``cloud.<method>`` span. A breaker-open
+        fast-fail never reaches the control plane, so it VANISHES from the
+        duration histogram — it is counted
+        (``karpenter_cloudprovider_breaker_shortcircuit_total``) and tagged
+        ``short_circuit=true`` on both this span and its parent, so a
+        traced launch with a gap explains itself."""
+        from karpenter_tpu import obs
+
+        start = time.perf_counter()
+        with obs.tracer().span(
+            f"cloud.{method}",
+            attrs={"provider": self.delegate.name(), "method": method},
+        ) as span:
+            try:
+                if not self.resilient:
+                    return fn(*args)
+                breaker = self.breakers.get(f"{self.delegate.name()}:{method}")
+                if not breaker.allow():
+                    metrics.CLOUDPROVIDER_BREAKER_SHORTCIRCUIT.labels(
+                        provider=self.delegate.name(), method=method
+                    ).inc()
+                    span.set_attribute("short_circuit", True)
+                    if span.parent is not None:
+                        span.parent.set_attribute("short_circuit", True)
+                    raise BreakerOpen(breaker.dependency, breaker.open_seconds)
+                try:
+                    result = self._policies[method].call(fn, *args)
+                except BreakerOpen:
+                    raise
+                except Exception as e:
+                    # breaker state tracks AVAILABILITY: a deterministic answer
+                    # (ICE/stockout, validation) means the dependency responded —
+                    # an ICE storm must sideline offerings (the 45s cache), never
+                    # open the create breaker and block the recovery launches
+                    from karpenter_tpu.resilience import default_retryable
+
+                    if default_retryable(e):
+                        breaker.record_failure()
+                    else:
+                        breaker.record_success()
+                    raise
+                breaker.record_success()
+                return result
+            finally:
+                self._observe(method, start)
 
     def create(self, request: NodeRequest) -> Node:
         return self._guarded("create", self.delegate.create, request)

@@ -60,6 +60,27 @@ class Initialization:
             self.cluster.delete("nodes", node.metadata.name, namespace="")
             return None
         node.spec.taints = [t for t in node.spec.taints if t.key != lbl.NOT_READY_TAINT_KEY]
+        # node-ready closes the provisioning lifecycle: a zero-work span,
+        # parented (via the annotation provisioning stamped at launch) into
+        # the launch trace — time-from-creation is the attribute that
+        # matters, the ready transition itself is instantaneous
+        from karpenter_tpu import obs
+
+        ctx = obs.from_traceparent(
+            node.metadata.annotations.get(obs.TRACE_ANNOTATION)
+        )
+        if ctx is not None:
+            with obs.tracer().span(
+                "node.ready",
+                parent=ctx,
+                attrs={
+                    "node": node.metadata.name,
+                    "since_creation_s": round(
+                        self.cluster.clock() - node.metadata.creation_timestamp, 3
+                    ),
+                },
+            ):
+                pass
         return None
 
 

@@ -183,6 +183,31 @@ class ProvisionerWorker:
         try:
             pods, _window = self.batcher.wait()
             batch_keys = {p.key for p in pods}
+            return self._provision_batch(pods, _window)
+        finally:
+            with self._pending_lock:
+                # fast-requeued pods are back in the batcher: keep them
+                # pending so is_pending() holds through the next round
+                self._pending_keys -= set(batch_keys) - self._requeued_keys
+                self._requeued_keys.clear()
+            self.batcher.flush()
+
+    def _provision_batch(self, pods: List[Pod], window: float) -> List[VirtualNode]:
+        from karpenter_tpu import obs
+
+        # the round's root span starts AFTER the batcher hands over its
+        # window (the idle wait is not latency anyone is owed); the
+        # admission window happened BEFORE this span existed, so it rides
+        # along as an attribute — a backdated child record would put an
+        # interval outside the parent and corrupt self-time attribution
+        with obs.tracer().span(
+            "provision.round",
+            attrs={
+                "provisioner": self.provisioner.name,
+                "batch": len(pods),
+                "admission_window_s": round(max(window, 0.0), 6),
+            },
+        ) as round_sp:
             # dedupe by key: watch-event storms and verify requeues can
             # enqueue the same (or a replaced) pod object twice; double
             # inclusion would double its requests in the solve. Keep the
@@ -216,9 +241,12 @@ class ProvisionerWorker:
                 with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
                     # executor threads don't inherit contextvars: each launch
                     # re-activates the SHARED round budget in its own thread
+                    # and parents its span on the round explicitly
                     launched = list(
-                        pool.map(lambda v: self._launch(v, budget), nodes)
+                        pool.map(lambda v: self._launch(v, budget, round_sp), nodes)
                     )
+            round_sp.set_attribute("nodes", len(nodes))
+            round_sp.set_attribute("launched", sum(map(bool, launched)))
             if any(launched):  # only actual creations count as a scale event
                 from karpenter_tpu.kube import serde
 
@@ -233,13 +261,6 @@ class ProvisionerWorker:
                 except Exception:
                     logger.debug("lastScaleTime write failed", exc_info=True)
             return nodes
-        finally:
-            with self._pending_lock:
-                # fast-requeued pods are back in the batcher: keep them
-                # pending so is_pending() holds through the next round
-                self._pending_keys -= set(batch_keys) - self._requeued_keys
-                self._requeued_keys.clear()
-            self.batcher.flush()
 
     def _observe_stages(self) -> None:
         """Plumb the solve's per-stage timings onto the scrape: the <100ms
@@ -251,16 +272,25 @@ class ProvisionerWorker:
             if stage.endswith("_s") and isinstance(seconds, float):
                 metrics.SOLVER_STAGE_DURATION.labels(stage=stage[:-2]).observe(seconds)
 
-    def _launch(self, vnode: VirtualNode, budget=None) -> bool:
+    def _launch(self, vnode: VirtualNode, budget=None, parent_span=None) -> bool:
         """Returns whether a node was actually created."""
         from contextlib import nullcontext
 
+        from karpenter_tpu import obs
         from karpenter_tpu.cloudprovider.metrics import reconciling_controller
 
-        # executor threads don't inherit the worker's context
+        # executor threads don't inherit the worker's context: the budget
+        # re-activates and the launch span parents on the round explicitly
         reconciling_controller.set("provisioning")
         with budget.activate() if budget is not None else nullcontext():
-            return self._launch_one(vnode)
+            with obs.tracer().span(
+                "provision.launch",
+                parent=parent_span,
+                attrs={"pods": len(vnode.pods)},
+            ) as sp:
+                created = self._launch_one(vnode)
+                sp.set_attribute("created", created)
+                return created
 
     def _launch_one(self, vnode: VirtualNode) -> bool:
         try:
@@ -285,6 +315,16 @@ class ProvisionerWorker:
             template = vnode.constraints.to_node()
             node.metadata.labels = {**template.metadata.labels, **node.metadata.labels}
             node.metadata.labels[lbl.PROVISIONER_NAME_LABEL] = self.provisioner.name
+            # stamp the launch trace onto the Node: the ready transition
+            # happens minutes later in another reconcile, and this
+            # annotation is how node.ready joins the launch trace
+            from karpenter_tpu import obs
+
+            launch_span = obs.tracer().current()
+            if launch_span is not None:
+                node.metadata.annotations[obs.TRACE_ANNOTATION] = (
+                    obs.to_traceparent(launch_span)
+                )
             node.metadata.finalizers = list(
                 set(node.metadata.finalizers) | set(template.metadata.finalizers)
             )
@@ -327,14 +367,20 @@ class ProvisionerWorker:
             return False
 
     def _bind(self, pods: List[Pod], node_name: str) -> None:
+        from karpenter_tpu import obs
+
         start = time.perf_counter()
         ok = True
-        for pod in pods:
-            try:
-                self.cluster.bind(pod, node_name)
-            except Exception:
-                ok = False
-                logger.exception("binding pod %s", pod.key)
+        with obs.tracer().span(
+            "provision.bind", attrs={"node": node_name, "pods": len(pods)}
+        ) as sp:
+            for pod in pods:
+                try:
+                    self.cluster.bind(pod, node_name)
+                except Exception:
+                    ok = False
+                    logger.exception("binding pod %s", pod.key)
+            sp.set_attribute("ok", ok)
         metrics.BIND_DURATION.labels(result="success" if ok else "error").observe(
             time.perf_counter() - start
         )

@@ -78,20 +78,39 @@ class Orchestrator:
         self.notices_handled += 1
         now = self.cluster.clock()
         deadline = now + max(float(notice.grace_period_seconds), 0.0)
-        self._taint_and_cordon(node, notice)
-        from karpenter_tpu.kube.events import recorder_for
+        from karpenter_tpu import obs
 
-        recorder_for(self.cluster).event(
-            "Node", node.metadata.name, "InterruptionNotice",
-            f"{notice.kind} notice ({notice.reason or 'cloud-initiated'}): "
-            f"grace {notice.grace_period_seconds:g}s; replacing pods proactively",
-            type="Warning",
-        )
-        migrated, blocked = self._migrate(node, on_release)
-        # only AFTER the replacement injection does the node enter the
-        # termination path — this delete is the ordering guarantee's fence
-        self.cluster.delete("nodes", node.metadata.name, namespace="")
-        metrics.INTERRUPTION_DRAINS_STARTED.inc()
+        # the taint→replace→drain response as one trace: each step is a
+        # child span, and the replacement solves the migrated pods trigger
+        # nest under interruption.replace via the contextvar
+        with obs.tracer().span(
+            "interruption.notice",
+            attrs={
+                "kind": notice.kind,
+                "node": node.metadata.name,
+                "grace_s": float(notice.grace_period_seconds),
+            },
+        ) as sp:
+            with obs.tracer().span("interruption.taint_cordon"):
+                self._taint_and_cordon(node, notice)
+            from karpenter_tpu.kube.events import recorder_for
+
+            recorder_for(self.cluster).event(
+                "Node", node.metadata.name, "InterruptionNotice",
+                f"{notice.kind} notice ({notice.reason or 'cloud-initiated'}): "
+                f"grace {notice.grace_period_seconds:g}s; replacing pods proactively",
+                type="Warning",
+            )
+            with obs.tracer().span("interruption.replace") as rep_sp:
+                migrated, blocked = self._migrate(node, on_release)
+                rep_sp.set_attribute("migrated", len(migrated))
+                rep_sp.set_attribute("blocked", len(blocked))
+            # only AFTER the replacement injection does the node enter the
+            # termination path — this delete is the ordering guarantee's fence
+            with obs.tracer().span("interruption.drain_handoff"):
+                self.cluster.delete("nodes", node.metadata.name, namespace="")
+            metrics.INTERRUPTION_DRAINS_STARTED.inc()
+            sp.set_attribute("migrated", len(migrated))
         logger.info(
             "interruption: %s on %s (grace %gs) — %d pod(s) injected for "
             "replacement, %d blocked",
@@ -213,10 +232,16 @@ class Orchestrator:
             "forcing termination",
             type="Warning",
         )
-        terminator = self.termination.terminator
-        terminator.cordon(node)
-        terminator.drain(node, force=True)
-        terminator.terminate(node)
+        from karpenter_tpu import obs
+
+        with obs.tracer().span(
+            "interruption.force_terminate",
+            attrs={"node": node.metadata.name, "pods_left": len(left)},
+        ):
+            terminator = self.termination.terminator
+            terminator.cordon(node)
+            terminator.drain(node, force=True)
+            terminator.terminate(node)
         logger.warning(
             "interruption deadline: force-terminated %s (%d pod(s) without "
             "replacement)", node.metadata.name, len(left),

@@ -26,6 +26,11 @@ logger = logging.getLogger("karpenter.events")
 
 AGGREGATION_WINDOW = 600.0  # repeats inside this window bump count
 
+# annotation linking an emitted Event to the trace of the action that
+# emitted it: `kubectl describe` output becomes greppable into
+# /debug/traces (and the flight dir) by trace id
+TRACE_ID_ANNOTATION = "karpenter.sh/trace-id"
+
 
 class EventRecorder:
     def __init__(self, cluster: Cluster, component: str = "karpenter-tpu"):
@@ -101,8 +106,17 @@ class EventRecorder:
             with self._lock:
                 self._counter += 1
                 name = f"{involved_name}.{self._counter:x}.{int(now)}"
+            meta = ObjectMeta(name=name, namespace=namespace or "default")
+            # annotate with the active trace id — inside the same guarded
+            # region as the write: tracing trouble must never fail the
+            # traced action (recording is fire-and-forget all the way down)
+            from karpenter_tpu import obs
+
+            span = obs.tracer().current()
+            if span is not None:
+                meta.annotations[TRACE_ID_ANNOTATION] = span.trace_id
             ev = Event(
-                metadata=ObjectMeta(name=name, namespace=namespace or "default"),
+                metadata=meta,
                 involved_kind=involved_kind,
                 involved_name=involved_name,
                 involved_namespace=namespace,

@@ -24,12 +24,44 @@ LEVELS = {
 
 ROOT_LOGGER = "karpenter"
 
+LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s [%(trace_id)s/%(span_id)s] %(message)s"
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``trace_id``/``span_id`` (or ``-``) on every record passing
+    through the handler it is attached to, so a log line from anywhere in
+    the ``karpenter`` hierarchy can be grepped straight into its trace at
+    ``/debug/traces``. Attached to HANDLERS, not loggers: logger-level
+    filters don't apply to child loggers' records, and the point is every
+    record, not just ones logged on the root name."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from karpenter_tpu import obs
+
+            span = obs.tracer().current()
+        except Exception:
+            span = None
+        record.trace_id = span.trace_id if span is not None else "-"
+        record.span_id = span.span_id if span is not None else "-"
+        return True
+
+
+_trace_filter = TraceContextFilter()
+
+
+def install_trace_filter() -> None:
+    """Attach the trace filter to every root handler; idempotent (live
+    level reload and repeated setup_logging calls must not stack copies)."""
+    for handler in logging.getLogger().handlers:
+        if not any(isinstance(f, TraceContextFilter) for f in handler.filters):
+            handler.addFilter(_trace_filter)
+
 
 def setup_logging(level: str = "info") -> None:
     """Named-logger hierarchy under ``karpenter``; idempotent."""
-    logging.basicConfig(
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    logging.basicConfig(format=LOG_FORMAT)
+    install_trace_filter()
     apply_log_level(level)
 
 

@@ -131,6 +131,33 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 self.send_response(200 if ok else 503)
                 self.end_headers()
                 self.wfile.write(b"ok" if ok else b"unhealthy")
+            elif self.path.startswith("/debug/traces"):
+                # the in-memory trace ring: recent span trees, newest first
+                import json
+
+                from karpenter_tpu import obs
+
+                body = json.dumps({"traces": obs.exporter().snapshot()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/flight"):
+                # recorded slow-solve incidents (empty when no --flight-dir)
+                import json
+
+                from karpenter_tpu import obs
+
+                rec = obs.flight_recorder()
+                body = json.dumps(
+                    {"records": rec.recent() if rec is not None else []}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -264,6 +291,16 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     from karpenter_tpu.logging_config import LogLevelWatcher, setup_logging
 
     setup_logging(runtime.options.log_level)
+    # tracing + the slow-solve flight recorder (karpenter_tpu/obs):
+    # /debug/traces and /debug/flight on the health port serve these
+    from karpenter_tpu import obs
+
+    obs.set_enabled(runtime.options.trace_enabled)
+    if runtime.options.flight_dir:
+        obs.configure_flight(
+            runtime.options.flight_dir,
+            budget_s=runtime.options.flight_budget_ms / 1e3,
+        )
     if runtime.options.log_config_file:
         runtime.log_watcher = LogLevelWatcher(runtime.options.log_config_file)
         runtime.log_watcher.start()

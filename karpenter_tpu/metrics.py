@@ -290,6 +290,46 @@ SOLVER_ENCODE_CACHE_MISSES = Counter(
     registry=REGISTRY,
 )
 
+# Tracing subsystem (karpenter_tpu/obs): span volume and ring-buffer loss
+# must be observable — a silently-dropping exporter reads as "nothing slow
+# happened", and the flight recorder's write rate IS the slow-solve rate.
+TRACE_SPANS = Counter(
+    "spans_total",
+    "Spans completed and exported by the in-process tracer.",
+    namespace=NAMESPACE,
+    subsystem="trace",
+    registry=REGISTRY,
+)
+
+TRACE_DROPPED = Counter(
+    "dropped_total",
+    "Spans evicted from the in-memory trace ring before anyone read them.",
+    namespace=NAMESPACE,
+    subsystem="trace",
+    registry=REGISTRY,
+)
+
+FLIGHT_RECORDS = Counter(
+    "flight_records_total",
+    "Slow-solve incidents written to the on-disk flight ring (a watched "
+    "span exceeded its latency budget).",
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+# Breaker-open fast-fails on the metered cloud path: these calls never run,
+# so they vanish from the duration histogram — without this counter a
+# launch gap during an outage has no latency attribution at all.
+CLOUDPROVIDER_BREAKER_SHORTCIRCUIT = Counter(
+    "breaker_shortcircuit_total",
+    "Cloud-provider calls answered by an open circuit breaker without "
+    "reaching the control plane, by provider and method.",
+    ["provider", "method"],
+    namespace=NAMESPACE,
+    subsystem="cloudprovider",
+    registry=REGISTRY,
+)
+
 # Per-stage solve latency, observed by the provisioning worker after each
 # batch (sort / inject / encode / wire_ser / pack_fetch / wire_deser /
 # decode) — the <100ms p99 target's attribution on the scrape, not only in

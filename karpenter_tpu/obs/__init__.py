@@ -1,0 +1,104 @@
+"""karpenter_tpu.obs — end-to-end provisioning traces.
+
+Public surface:
+
+- ``tracer()`` — the process-default :class:`Tracer` (ring exporter
+  attached); ``with obs.tracer().span("name") as sp:`` is the ONE way to
+  open a span (karplint ``span-closed``).
+- ``set_enabled(bool)`` — kill switch (bench ``--no-trace``).
+- ``configure_flight(dir, budget_s)`` — install the slow-solve flight
+  recorder on the default tracer; ``flight_recorder()`` reads it back.
+- ``register_state(name, fn)`` — contribute a state panel to future
+  flight records.
+- ``to_traceparent`` / ``from_traceparent`` — the cross-process id form
+  (HTTP header, node annotation, v3 wire trailer).
+
+Never import this package from jit/vmap/pallas-reachable solver code —
+karplint's ``span-closed`` tracer-safety check enforces it (a host-side
+span call inside traced code would serialize the device pipeline).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from karpenter_tpu.obs.export import (  # noqa: F401
+    RingExporter,
+    critical_path,
+    overlapping_pairs,
+    spans_named,
+)
+from karpenter_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    register_state,
+    state_snapshot,
+)
+from karpenter_tpu.obs.trace import (  # noqa: F401
+    TRACE_ANNOTATION,
+    Span,
+    SpanContext,
+    Tracer,
+    from_traceparent,
+    to_traceparent,
+)
+
+_lock = threading.Lock()
+_tracer = Tracer(exporter=RingExporter())
+_flight: Optional[FlightRecorder] = None  # guarded-by: _lock
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def exporter() -> RingExporter:
+    return _tracer.exporter
+
+
+def set_enabled(enabled: bool) -> None:
+    _tracer.enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def configure_flight(
+    directory: str,
+    budget_s: Optional[float] = None,
+    cap: Optional[int] = None,
+    watch=None,
+) -> FlightRecorder:
+    """Install (or replace) the flight recorder on the default tracer."""
+    global _flight
+    kwargs = {}
+    if budget_s is not None:
+        kwargs["budget_s"] = budget_s
+    if cap is not None:
+        kwargs["cap"] = cap
+    if watch is not None:
+        kwargs["watch"] = watch
+    rec = FlightRecorder(directory, **kwargs)
+    with _lock:
+        if _flight is not None:
+            _tracer.remove_hook(_flight)
+        _flight = rec
+    _tracer.add_hook(rec)
+    return rec
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    with _lock:
+        return _flight
+
+
+def reset_for_tests() -> None:
+    """Drop collected traces and detach any flight recorder."""
+    global _flight
+    with _lock:
+        if _flight is not None:
+            _tracer.remove_hook(_flight)
+        _flight = None
+    _tracer.exporter.clear()
+    _tracer.enabled = True

@@ -50,6 +50,16 @@ class Options:
     # static level from LOG_LEVEL
     log_config_file: str = field(default_factory=lambda: _env("LOG_CONFIG_FILE", ""))
     log_level: str = field(default_factory=lambda: _env("LOG_LEVEL", "info"))
+    # end-to-end tracing (karpenter_tpu/obs): span pipeline + /debug/traces
+    trace_enabled: bool = field(
+        default_factory=lambda: _env("KARPENTER_TRACE", "true").lower() == "true"
+    )
+    # slow-solve flight recorder: capped on-disk ring of over-budget solve
+    # traces + router/breaker/session state; empty = disabled
+    flight_dir: str = field(default_factory=lambda: _env("KARPENTER_FLIGHT_DIR", ""))
+    flight_budget_ms: float = field(
+        default_factory=lambda: float(_env("KARPENTER_FLIGHT_BUDGET_MS", "100"))
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -63,6 +73,8 @@ class Options:
             errs.append("kube client burst must be positive")
         if self.consolidation_wave_size <= 0:
             errs.append("consolidation wave size must be positive")
+        if self.flight_budget_ms <= 0:
+            errs.append("flight budget must be positive milliseconds")
         if self.default_solver not in ("ffd", "tpu"):
             errs.append(f"solver must be ffd|tpu, got {self.default_solver}")
         from karpenter_tpu.logging_config import validate_log_config
@@ -90,6 +102,22 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
     ap.add_argument("--log-config-file", default=opts.log_config_file)
     ap.add_argument("--log-level", default=opts.log_level)
+    ap.add_argument(
+        "--trace",
+        action=argparse.BooleanOptionalAction,
+        default=opts.trace_enabled,
+        help="end-to-end span tracing (--no-trace disables; /debug/traces "
+        "on the health port serves the ring)",
+    )
+    ap.add_argument(
+        "--flight-dir", default=opts.flight_dir,
+        help="capped on-disk ring for slow-solve flight records "
+        "('' disables; served at GET /debug/flight)",
+    )
+    ap.add_argument(
+        "--flight-budget-ms", type=float, default=opts.flight_budget_ms,
+        help="solver.solve spans over this budget are flight-recorded",
+    )
     ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
@@ -119,6 +147,9 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         leader_election_lease=ns.leader_election_lease,
         log_config_file=ns.log_config_file,
         log_level=ns.log_level,
+        trace_enabled=ns.trace,
+        flight_dir=ns.flight_dir,
+        flight_budget_ms=ns.flight_budget_ms,
     )
     errs = out.validate()
     if errs:

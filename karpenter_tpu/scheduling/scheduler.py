@@ -62,6 +62,8 @@ class Scheduler:
         instance_types: Sequence[InstanceType],
         pods: Sequence[Pod],
     ) -> List[VirtualNode]:
+        from karpenter_tpu import obs
+
         start = time.perf_counter()
         # Layer the live catalog's supported values into the constraints; the
         # provisioning controller also refreshes these at apply (reference:
@@ -71,11 +73,27 @@ class Scheduler:
         constraints.requirements = constraints.requirements.merge(
             catalog_requirements(instance_types)
         )
-        try:
-            if provisioner.spec.solver == SOLVER_TPU:
-                return self._tpu_scheduler().solve(constraints, instance_types, pods)
-            return self.ffd.solve(constraints, instance_types, pods)
-        finally:
-            metrics.SCHEDULING_DURATION.labels(provisioner=provisioner.name).observe(
-                time.perf_counter() - start
-            )
+        # the end-to-end solve span: what the flight recorder watches
+        # against the 100ms budget, and the root the stage spans hang off
+        with obs.tracer().span(
+            "solver.solve",
+            attrs={
+                "provisioner": provisioner.name,
+                "solver": provisioner.spec.solver,
+                "pods": len(pods),
+                "types": len(instance_types),
+            },
+        ) as sp:
+            try:
+                if provisioner.spec.solver == SOLVER_TPU:
+                    nodes = self._tpu_scheduler().solve(
+                        constraints, instance_types, pods
+                    )
+                else:
+                    nodes = self.ffd.solve(constraints, instance_types, pods)
+                sp.set_attribute("nodes", len(nodes))
+                return nodes
+            finally:
+                metrics.SCHEDULING_DURATION.labels(
+                    provisioner=provisioner.name
+                ).observe(time.perf_counter() - start)

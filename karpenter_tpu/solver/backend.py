@@ -166,6 +166,18 @@ class TpuScheduler:
         # two batches finishing together must not double-spawn a probe
         self._probe_thread: Optional[threading.Thread] = None  # guarded-by: self._probe_lock
         self._probe_lock = threading.Lock()
+        # flight-recorder state panels: when a slow solve is recorded, its
+        # incident file carries the router's beliefs, the breaker states,
+        # and the session cache's disposition AT THAT MOMENT — the three
+        # questions a human asks first. Names are stable across scheduler
+        # hot-swaps (re-registering replaces the provider).
+        from karpenter_tpu import obs
+        from karpenter_tpu.solver import session_stats
+
+        obs.register_state("router_ema", self.router.report)
+        obs.register_state("pack_breakers_open", self._pack_breakers.open_dependencies)
+        obs.register_state("remote_breaker", lambda: self._remote_breaker.state)
+        obs.register_state("session_cache", session_stats.snapshot)
 
     def _pack(self, batch: enc.EncodedBatch):
         """BEGIN the packing solve (called under the solve lock): route by
@@ -192,6 +204,19 @@ class TpuScheduler:
             if len(candidates) > 1:
                 key = self._route_key(batch)
                 backend = self.router.choose(key, candidates)
+                # the router's decision and its inputs land on the active
+                # span (solve.pack_begin): a trace of a slow solve shows
+                # which backend served it and what the EMAs believed
+                from karpenter_tpu import obs
+
+                cur = obs.tracer().current()
+                if cur is not None:
+                    cur.set_attribute("router_backend", backend)
+                    cur.set_attribute("router_key", "x".join(map(str, key)))
+                    for c in candidates:
+                        ema = self.router.ema(key, c)
+                        if ema is not None:
+                            cur.set_attribute(f"router_ema_{c}_ms", round(ema * 1e3, 3))
                 t0 = time.perf_counter()
                 if backend == "native":
                     # synchronous host compute — nothing in flight to
@@ -664,11 +689,23 @@ class TpuScheduler:
         pods: Sequence[Pod],
         prof: Dict[str, float],
     ) -> List[VirtualNode]:
-        t0 = time.perf_counter()
-        constraints = constraints.clone()
-        pods, sts = sort_pods_ffd_with_statics(pods)
-        instance_types = sorted(instance_types, key=lambda it: it.effective_price())
-        prof["sort_s"] = time.perf_counter() - t0
+        from karpenter_tpu import obs
+
+        tr = obs.tracer()
+        # stage spans mirror the prof dict: the prof clock runs INSIDE
+        # each span, so both bracket the same region and the exported
+        # trace agrees with Scheduler.last_stage_profile() to within the
+        # span enter/exit slivers (tests hold them to 1ms — a prof window
+        # opened outside the span would let a 1-core GIL preemption land
+        # between the two clocks and break that)
+        with tr.span("solve.sort"):
+            t0 = time.perf_counter()
+            constraints = constraints.clone()
+            pods, sts = sort_pods_ffd_with_statics(pods)
+            instance_types = sorted(
+                instance_types, key=lambda it: it.effective_price()
+            )
+            prof["sort_s"] = time.perf_counter() - t0
         # Double-buffered host pipeline (docs/solver-transport.md): the
         # solve lock covers only the HOST-side prepare stages
         # (inject/encode) and the non-blocking dispatch. The blocking
@@ -680,22 +717,25 @@ class TpuScheduler:
             # published under the lock: a concurrent warmup solve must
             # not clobber the profile observers read
             self.last_profile = prof
-            t0 = time.perf_counter()
             # decision-plan injection: topology choices land in the plan,
             # NOT in the pods' nodeSelectors — the TPU path never mutates
             # (and never restores) pod objects. `pods` is already this
             # solve's own sorted list; passing it (not a copy) lets encode
             # reuse the plan's statics pass (plan._pods identity check).
-            plan = self.topology.inject_plan(constraints, pods, sts=sts)
-            daemon = daemon_overhead(self.cluster, constraints)
-            prof["inject_s"] = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            try:
-                batch = self._encode_retry(constraints, instance_types, pods, daemon, plan)
-            except SignatureOverflow as e:
-                logger.warning("falling back to FFD: %s", e)
-                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
-            prof["encode_s"] = time.perf_counter() - t0
+            with tr.span("solve.inject"):
+                t0 = time.perf_counter()
+                plan = self.topology.inject_plan(constraints, pods, sts=sts)
+                daemon = daemon_overhead(self.cluster, constraints)
+                prof["inject_s"] = time.perf_counter() - t0
+            with tr.span("solve.encode") as enc_sp:
+                t0 = time.perf_counter()
+                try:
+                    batch = self._encode_retry(constraints, instance_types, pods, daemon, plan)
+                except SignatureOverflow as e:
+                    logger.warning("falling back to FFD: %s", e)
+                    enc_sp.set_attribute("signature_overflow", True)
+                    return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
+                prof["encode_s"] = time.perf_counter() - t0
             # the shape class's pack breaker: while open, the batch routes
             # to FFD immediately — pods still schedule, and nobody re-pays
             # the accelerated path's failure latency every solve. A closed
@@ -707,9 +747,11 @@ class TpuScheduler:
                 metrics.SOLVER_DEGRADED.labels(reason="breaker_open").inc()
                 prof["packer_backend"] = "ffd-degraded"
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
-            t0 = time.perf_counter()
             try:
-                pending = self._pack(batch)
+                with tr.span("solve.pack_begin"):
+                    t0 = time.perf_counter()
+                    pending = self._pack(batch)
+                    begin_s = time.perf_counter() - t0
             except Exception:
                 breaker.record_failure()
                 metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
@@ -720,7 +762,11 @@ class TpuScheduler:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
         # lock released: solve i is in flight; only its fetch blocks here
         try:
-            result, typemask = pending()
+            with tr.span("solve.pack_fetch") as fetch_sp:
+                t0 = time.perf_counter()
+                result, typemask = pending()
+                fetch_wait_s = time.perf_counter() - t0
+                fetch_sp.set_attribute("backend", prof.get("packer_backend"))
         except Exception:
             breaker.record_failure()
             metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
@@ -735,16 +781,18 @@ class TpuScheduler:
         breaker.record_success()
         # wire serialization is attributed separately (wire_ser_s /
         # wire_deser_s, set by RemoteSolver) so pack_fetch_s is the
-        # in-flight dispatch+fetch wait alone
+        # in-flight dispatch+fetch wait alone; both windows ran inside
+        # their spans, so trace and profile agree by construction
         prof["pack_fetch_s"] = max(
-            time.perf_counter() - t0
+            begin_s + fetch_wait_s
             - prof.get("wire_ser_s", 0.0)
             - prof.get("wire_deser_s", 0.0),
             0.0,
         )
-        t0 = time.perf_counter()
-        nodes = self._decode(batch, result, typemask, constraints, instance_types)
-        prof["decode_s"] = time.perf_counter() - t0
+        with tr.span("solve.decode"):
+            t0 = time.perf_counter()
+            nodes = self._decode(batch, result, typemask, constraints, instance_types)
+            prof["decode_s"] = time.perf_counter() - t0
         return nodes
 
     def _ffd_degrade(self, constraints, instance_types, pods, daemon, plan) -> List[VirtualNode]:

@@ -43,6 +43,23 @@ The client half (:class:`RemoteSolver`) splits dispatch from fetch
 scheduler can release its solve lock and encode batch i+1 while solve i is
 in flight — only the fused-result fetch blocks (docs/solver-transport.md
 has the pipeline diagram).
+
+**Trace-context trailer** (docs/observability.md): when the client has an
+active span, ``Pack``/``OpenSession`` requests carry one extra i32 array —
+the 24-byte trace context (trace id + span id) — AFTER the protocol's
+fixed arrays. A frame without it is a perfectly valid v3 frame (absent =
+no trace), and the Pack trailer is CAPABILITY-gated for rolling upgrades:
+the sidecar advertises ``PROTO_TRACE_TRAILER`` in its OpenSession response
+payload (which old clients never read, over a frame old servers already
+tolerate growing), and a client only appends the Pack trailer after seeing
+the bit — old/new peers interop cleanly in either deploy order, while
+actual version skew still fails loudly at the codec. A
+traced ``Pack`` response appends an f32 ``[solve_s, fetch_s, serialize_s]``
+trailer so the sidecar's half of the RTT becomes attributable client-side;
+the sidecar also opens real child spans (``sidecar.pack`` →
+``sidecar.solve``/``sidecar.fetch``/``sidecar.serialize``,
+``sidecar.device_put`` on session open) into its OWN trace ring, served at
+``GET /debug/traces`` on its health port.
 """
 
 from __future__ import annotations
@@ -75,6 +92,15 @@ NOT_SERVING = b"NOT_SERVING"
 # in-band response status (first i32 array of every v3 response)
 STATUS_OK = 0
 STATUS_NEEDS_CATALOG = 1
+
+# capability bits a sidecar advertises in its OpenSession RESPONSE payload
+# (old clients never read that payload; old servers never send it — the one
+# frame both sides already tolerate growing). A client may only append the
+# Pack trace-context trailer after seeing this bit: an old sidecar's
+# `*pod_arrays` unpack would swallow the trailer as an extra pod array and
+# crash the solve mid-rolling-upgrade.
+PROTO_TRACE_TRAILER = 1
+PROTO_FEATURES = PROTO_TRACE_TRAILER
 
 # sidecar session store bounds: one entry per live catalog generation —
 # a handful of provisioners each see one catalog at a time, so a small LRU
@@ -170,6 +196,32 @@ def _status_response(status: int, payload: Sequence[np.ndarray] = ()) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# trace-context trailer (optional on Pack/OpenSession requests)
+# ---------------------------------------------------------------------------
+
+# 16-byte trace id + 8-byte span id as six little-endian i32 words
+TRACE_CTX_WORDS = 6
+
+
+def _trace_ctx_array(ctx) -> np.ndarray:
+    """SpanContext → the 6-word i32 trailer array."""
+    raw = bytes.fromhex(ctx.trace_id) + bytes.fromhex(ctx.span_id)
+    return np.frombuffer(raw, np.int32)
+
+
+def _ctx_from_array(arr: np.ndarray):
+    """Trailer array → SpanContext, or None on anything off-shape — a
+    malformed trailer degrades to an untraced solve, never an error."""
+    from karpenter_tpu.obs import SpanContext
+
+    a = np.asarray(arr).reshape(-1)
+    if a.dtype != np.int32 or a.size != TRACE_CTX_WORDS:
+        return None
+    raw = a.tobytes()
+    return SpanContext(raw[:16].hex(), raw[16:24].hex())
+
+
+# ---------------------------------------------------------------------------
 # server (the JAX/TPU sidecar)
 # ---------------------------------------------------------------------------
 
@@ -239,11 +291,13 @@ class SolverService:
         stats, mirroring the in-process DeviceInvariants contract."""
         import jax
 
+        from karpenter_tpu import obs
         from karpenter_tpu.solver import session_stats
 
         key_arr, join_table, frontiers, daemon, *rest = unpack_arrays(request)
         key = key_arr.tobytes()
         record = bool(rest[0].reshape(-1)[0]) if rest else True
+        ctx = _ctx_from_array(rest[1]) if len(rest) > 1 else None
         with self._sessions_lock:
             hit = self._sessions.get(key)
             if hit is not None:
@@ -251,8 +305,26 @@ class SolverService:
                 self._sessions.move_to_end(key)
                 self._evict_sessions_locked()
         if hit is not None:
-            return _status_response(STATUS_OK)
-        resident = tuple(jax.device_put(a) for a in (join_table, frontiers, daemon))
+            return _status_response(
+                STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+            )
+        if ctx is not None:
+            # the catalog upload is the session protocol's one heavy moment —
+            # traced as the sidecar's own child span (linked to the client's
+            # trace by the trailer ids) so a slow open attributes to HBM
+            # placement, not "the wire was slow"
+            with obs.tracer().span(
+                "sidecar.device_put",
+                parent=ctx,
+                attrs={"session": key.hex()[:12]},
+            ):
+                resident = tuple(
+                    jax.device_put(a) for a in (join_table, frontiers, daemon)
+                )
+        else:
+            resident = tuple(
+                jax.device_put(a) for a in (join_table, frontiers, daemon)
+            )
         # re-check under the lock: two clients racing to open the same new
         # key both pass the miss check above and both device_put — the
         # FIRST insert wins (preserving any fresh state a Pack already
@@ -274,7 +346,11 @@ class SolverService:
                 # NEEDS_CATALOG retry)
                 session_stats.record(False)
             logger.info("solver session opened (catalog key %s)", key.hex()[:12])
-        return _status_response(STATUS_OK)
+        # capability advertisement rides every OpenSession response: the
+        # client gates its Pack trace trailer on PROTO_TRACE_TRAILER
+        return _status_response(
+            STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+        )
 
     def session_count(self) -> int:
         with self._sessions_lock:
@@ -344,16 +420,21 @@ class SolverService:
         return SERVING if self.ready.is_set() else NOT_SERVING
 
     def solve_bytes(self, request: bytes) -> bytes:
-        """One delta solve: session key + n_max + the 7 pod-side arrays.
-        Unknown key → ``NEEDS_CATALOG`` (the client re-opens and retries)."""
+        """One delta solve: session key + n_max + the 7 pod-side arrays
+        (+ an optional trace-context trailer). Unknown key →
+        ``NEEDS_CATALOG`` (the client re-opens and retries)."""
         import jax
 
+        from karpenter_tpu import obs
         from karpenter_tpu.solver import kernel, session_stats
 
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
         arrays = unpack_arrays(request)
-        key_arr, n_max_arr, *pod_arrays = arrays
+        key_arr, n_max_arr = arrays[0], arrays[1]
+        pod_arrays = arrays[2:2 + N_POD_ARRAYS]
+        trailer = arrays[2 + N_POD_ARRAYS:]
+        ctx = _ctx_from_array(trailer[0]) if trailer else None
         key = key_arr.tobytes()
         vals = n_max_arr.reshape(-1)
         n_max = int(vals[0])
@@ -382,11 +463,42 @@ class SolverService:
             return _status_response(STATUS_NEEDS_CATALOG)
         if record_hit:
             session_stats.record(True)
-        result = pack_best(*pod_arrays, *resident, n_max=n_max)
-        # one fused device→host transfer on the sidecar too — per-array
-        # fetches each pay the full device round trip
-        buf = jax.device_get(kernel.fuse_result(result))
-        return _status_response(STATUS_OK, [np.asarray(buf)])
+        if ctx is None:
+            result = pack_best(*pod_arrays, *resident, n_max=n_max)
+            # one fused device→host transfer on the sidecar too — per-array
+            # fetches each pay the full device round trip
+            buf = jax.device_get(kernel.fuse_result(result))
+            return _status_response(STATUS_OK, [np.asarray(buf)])
+        # traced solve: child spans around solve/fetch/serialize make the
+        # sidecar's half of the RTT attributable. The spans land in THIS
+        # process's trace ring (GET /debug/traces on the sidecar health
+        # port), and the response grows an f32 [solve_s, fetch_s,
+        # serialize_s] trailer so the client can graft the same numbers
+        # into its own tree without a trace collector.
+        with obs.tracer().span(
+            "sidecar.pack", parent=ctx, attrs={"session": key.hex()[:12]}
+        ) as sp:
+            t0 = time.perf_counter()
+            with obs.tracer().span("sidecar.solve"):
+                result = pack_best(*pod_arrays, *resident, n_max=n_max)
+            solve_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with obs.tracer().span("sidecar.fetch"):
+                buf = jax.device_get(kernel.fuse_result(result))
+            fetch_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            response = _status_response(
+                STATUS_OK, [np.asarray(buf), np.zeros(3, np.float32)]
+            )
+            serialize_s = time.perf_counter() - t0
+            sp.add_child_record("sidecar.serialize", serialize_s)
+            # the trailer is the LAST array: its 12 payload bytes end the
+            # message, so the measured durations (serialize included —
+            # which by then has happened) patch in place
+            response = response[:-12] + struct.pack(
+                "<3f", solve_s, fetch_s, serialize_s
+            )
+        return response
 
 
 def serve(
@@ -455,10 +567,12 @@ def serve(
 
 
 def _serve_health(service: SolverService, port: int):
-    """Plain-HTTP probe endpoints for kubelet, plus ``/metrics``: the
-    session store lives in THIS process, so its catalog-residency counters
-    (session_catalog_uploads/hit_rate/evictions) are only observable on the
-    sidecar's own scrape — the controller's registry never sees them."""
+    """Plain-HTTP probe endpoints for kubelet, plus ``/metrics`` and the
+    trace debug surface: the session store AND the sidecar's span ring live
+    in THIS process, so its catalog-residency counters and its half of
+    every traced solve are only observable on the sidecar's own ports —
+    the controller's registry and trace ring never see them."""
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Probe(BaseHTTPRequestHandler):
@@ -476,6 +590,21 @@ def _serve_health(service: SolverService, port: int):
                 from karpenter_tpu import metrics as _m
 
                 code, body = 200, generate_latest(_m.REGISTRY)
+            elif self.path.startswith("/debug/traces"):
+                from karpenter_tpu import obs
+
+                code = 200
+                body = _json.dumps(
+                    {"traces": obs.exporter().snapshot()}
+                ).encode()
+            elif self.path.startswith("/debug/flight"):
+                from karpenter_tpu import obs
+
+                rec = obs.flight_recorder()
+                code = 200
+                body = _json.dumps(
+                    {"records": rec.recent() if rec is not None else []}
+                ).encode()
             else:
                 code, body = 404, b"not found"
             self.send_response(code)
@@ -526,6 +655,11 @@ class RemoteSolver:
         # compile; later calls get the short deadline
         self.cold_timeout = cold_timeout
         self._warm_shapes = set()  # guarded-by: self._lock
+        # capability bits the sidecar advertised in its OpenSession
+        # response; 0 (an old sidecar, or no open yet) means the Pack
+        # trace trailer is never sent — an old server's `*pod_arrays`
+        # unpack would swallow it as an extra pod array mid-upgrade
+        self._server_features = 0  # guarded-by: self._lock
         # catalog keys this client has uploaded (bounded LRU); a sidecar
         # restart orphans them server-side — NEEDS_CATALOG triggers the
         # transparent re-open
@@ -578,16 +712,29 @@ class RemoteSolver:
         force: bool = False,
         record: bool = True,
     ) -> None:
+        from karpenter_tpu import obs
+
         with self._lock:
             if not force and key in self._opened:
                 self._opened.move_to_end(key)
                 return
-        request = pack_arrays(
+        arrays = (
             [_key_array(key)]
             + [np.asarray(a) for a in catalog_side]
             + [np.asarray([1 if record else 0], np.int32)]
         )
-        self._open_call(request, timeout=timeout)
+        span = obs.tracer().current()
+        if span is not None:
+            # safe on ANY server: old sidecars unpack the open request with
+            # a variadic tail and ignore extra arrays
+            arrays.append(_trace_ctx_array(span.context))
+        request = pack_arrays(arrays)
+        with obs.tracer().span("solver.wire_open", attrs={"address": self.address}):
+            response = self._open_call(request, timeout=timeout)
+        _status, payload = self._split_status(response)
+        features = int(payload[0].reshape(-1)[0]) if payload else 0
+        with self._lock:
+            self._server_features = features
         with self._lock:
             self._opened[key] = True
             self._opened.move_to_end(key)
@@ -625,11 +772,23 @@ class RemoteSolver:
         # proactive open: the steady state short-circuits on the _opened
         # set; only a fresh catalog generation pays the upload RTT here
         self._open_session(key, catalog_side, timeout, record=record)
+        from karpenter_tpu import obs
+
         t0 = time.perf_counter()
-        request = pack_arrays(
-            [_key_array(key), np.asarray([n_max, 1 if record else 0], np.int32)]
-            + [np.asarray(a) for a in pod_side]
-        )
+        arrays = [
+            _key_array(key), np.asarray([n_max, 1 if record else 0], np.int32)
+        ] + [np.asarray(a) for a in pod_side]
+        # trace-context trailer: the span active at DISPATCH time parents
+        # the sidecar's child spans. Sent ONLY to a sidecar that advertised
+        # PROTO_TRACE_TRAILER in its OpenSession response — an untraced (or
+        # old-peer) frame is byte-identical to before, so rolling upgrades
+        # in either order keep solving
+        span = obs.tracer().current()
+        with self._lock:
+            trailer_ok = bool(self._server_features & PROTO_TRACE_TRAILER)
+        if span is not None and trailer_ok:
+            arrays.append(_trace_ctx_array(span.context))
+        request = pack_arrays(arrays)
         if prof is not None:
             prof["wire_ser_s"] = (
                 prof.get("wire_ser_s", 0.0) + time.perf_counter() - t0
@@ -637,36 +796,52 @@ class RemoteSolver:
         future = self._call.future(request, timeout=timeout)
 
         def wait():
-            response = future.result()
-            status, payload = self._split_status(response)
-            if status == STATUS_NEEDS_CATALOG:
-                # sidecar restarted or evicted this catalog: re-open and
-                # retry ONCE, synchronously (the overlap is already lost)
-                logger.info(
-                    "solver session %s not resident; re-opening", key.hex()[:12]
-                )
-                self._open_session(key, catalog_side, timeout, force=True, record=record)
-                status, payload = self._split_status(
-                    self._call(request, timeout=timeout)
-                )
+            with obs.tracer().span(
+                "solver.wire", attrs={"address": self.address}
+            ) as wsp:
+                response = future.result()
+                status, payload = self._split_status(response)
                 if status == STATUS_NEEDS_CATALOG:
-                    # fail loud: something is evicting faster than we open
-                    # (session_max=0, or a thrashing key) — the caller's
-                    # breaker turns this into the in-process fallback
-                    raise RuntimeError(
-                        "solver session re-open did not take "
-                        f"(catalog key {key.hex()[:12]})"
+                    # sidecar restarted or evicted this catalog: re-open and
+                    # retry ONCE, synchronously (the overlap is already lost)
+                    logger.info(
+                        "solver session %s not resident; re-opening", key.hex()[:12]
                     )
-            with self._lock:
-                self._warm_shapes.add(shape)
-            t1 = time.perf_counter()
-            (buf,) = payload
-            out = split_result(buf, p, n_max, r)
-            if prof is not None:
-                prof["wire_deser_s"] = (
-                    prof.get("wire_deser_s", 0.0) + time.perf_counter() - t1
-                )
-            return out
+                    wsp.set_attribute("needs_catalog_retry", True)
+                    self._open_session(
+                        key, catalog_side, timeout, force=True, record=record
+                    )
+                    status, payload = self._split_status(
+                        self._call(request, timeout=timeout)
+                    )
+                    if status == STATUS_NEEDS_CATALOG:
+                        # fail loud: something is evicting faster than we open
+                        # (session_max=0, or a thrashing key) — the caller's
+                        # breaker turns this into the in-process fallback
+                        raise RuntimeError(
+                            "solver session re-open did not take "
+                            f"(catalog key {key.hex()[:12]})"
+                        )
+                with self._lock:
+                    self._warm_shapes.add(shape)
+                t1 = time.perf_counter()
+                buf = payload[0]
+                if len(payload) > 1:
+                    # the sidecar's stage trailer: graft its half of the RTT
+                    # into this tree as completed child records — the
+                    # remainder of the wire span is pure transport
+                    vals = np.asarray(payload[1]).reshape(-1)
+                    for name, seconds in zip(
+                        ("sidecar.solve", "sidecar.fetch", "sidecar.serialize"),
+                        vals[:3],
+                    ):
+                        wsp.add_child_record(name, float(seconds))
+                out = split_result(buf, p, n_max, r)
+                if prof is not None:
+                    prof["wire_deser_s"] = (
+                        prof.get("wire_deser_s", 0.0) + time.perf_counter() - t1
+                    )
+                return out
 
         return wait
 
@@ -689,8 +864,21 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--health-port", type=int, default=8081)
     ap.add_argument("--session-max", type=int, default=SESSION_MAX)
     ap.add_argument("--session-ttl", type=float, default=SESSION_TTL_S)
+    ap.add_argument("--flight-dir", default="",
+                    help="capped on-disk ring for slow-solve flight records "
+                         "('' disables; served at GET /debug/flight)")
+    ap.add_argument("--flight-budget-ms", type=float, default=100.0,
+                    help="sidecar.pack spans over this budget are recorded")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.flight_dir:
+        from karpenter_tpu import obs
+
+        # the sidecar's end-to-end unit is its own pack span
+        obs.configure_flight(
+            args.flight_dir, budget_s=args.flight_budget_ms / 1e3,
+            watch=("sidecar.pack",),
+        )
     server = serve(
         args.address, args.max_workers, health_port=args.health_port, warmup=True,
         service=SolverService(session_max=args.session_max, session_ttl=args.session_ttl),

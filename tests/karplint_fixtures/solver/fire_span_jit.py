@@ -1,0 +1,12 @@
+# karplint-fixture: expect=span-closed
+"""An obs call inside jit-traced code: host-side span machinery inside
+the traced kernel serializes the device pipeline on every solve."""
+import jax
+
+from karpenter_tpu import obs
+
+
+@jax.jit
+def traced_kernel(pod_req):
+    with obs.tracer().span("kernel.pack"):  # span-closed: obs in jit
+        return pod_req + 1.0

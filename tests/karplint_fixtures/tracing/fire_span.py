@@ -1,0 +1,10 @@
+# karplint-fixture: expect=span-closed
+"""A bare start_span call: the span never closes, never exports, and
+mis-parents every later span in this context."""
+from karpenter_tpu import obs
+
+
+def leaky_instrumentation(batch):
+    span = obs.tracer().start_span("solve.encode")  # span-closed: bare open
+    span.set_attribute("pods", len(batch))
+    return batch

@@ -1,0 +1,16 @@
+# karplint-fixture: clean=span-closed
+"""Near-misses: the sanctioned context-manager API, and unrelated names
+that merely end in `span`."""
+from karpenter_tpu import obs
+
+
+def traced_instrumentation(batch):
+    with obs.tracer().span("solve.encode") as sp:  # the one sanctioned way
+        sp.set_attribute("pods", len(batch))
+    return batch
+
+
+def unrelated_names(widget):
+    widget.restart_spanner()  # not start_span
+    lifespan = widget.span  # attribute read, not a call
+    return lifespan

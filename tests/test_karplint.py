@@ -47,7 +47,7 @@ def test_full_repo_analyze_under_10s():
     assert time.perf_counter() - t0 < 10.0
 
 
-def test_all_eight_rules_registered():
+def test_all_nine_rules_registered():
     from tools.karplint import rule_names
 
     assert rule_names() == [
@@ -56,6 +56,7 @@ def test_all_eight_rules_registered():
         "patch-literal-list",
         "reconcile-io",
         "retry-idempotent",
+        "span-closed",
         "tracer-branch",
         "tracer-dtype",
         "tracer-host-sync",

@@ -1,0 +1,731 @@
+"""End-to-end provisioning traces (karpenter_tpu/obs): span lifecycle,
+contextvar propagation, the ring exporter, traceparent propagation across
+the HTTP cloud wire and the v3 solver wire (sidecar child spans linked by
+trace id + the response stage trailer), the slow-solve flight recorder,
+the /debug endpoints, and the satellite wiring (logging filter, event
+annotations, breaker short-circuit attribution, stage/profile agreement).
+"""
+
+import json
+import logging
+import random
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import metrics, obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# span core
+# ---------------------------------------------------------------------------
+
+
+class TestSpanCore:
+    def test_nesting_follows_contextvar(self):
+        tr = obs.tracer()
+        with tr.span("root") as root:
+            assert tr.current() is root
+            with tr.span("child") as child:
+                assert tr.current() is child
+                assert child.parent is root
+                assert child.trace_id == root.trace_id
+            assert tr.current() is root
+        assert tr.current() is None
+        assert [c.name for c in root.children] == ["child"]
+
+    def test_root_exports_whole_tree(self):
+        tr = obs.tracer()
+        before = obs.exporter().exported_spans
+        with tr.span("root"):
+            with tr.span("a"):
+                with tr.span("aa"):
+                    pass
+            with tr.span("b"):
+                pass
+        trees = obs.exporter().snapshot()
+        assert len(trees) == 1
+        tree = trees[0]
+        assert tree["name"] == "root"
+        assert {c["name"] for c in tree["children"]} == {"a", "b"}
+        assert tree["children"][0]["children"][0]["name"] == "aa"
+        # child spans are NOT separately exported
+        assert obs.exporter().exported_spans - before == 4
+
+    def test_error_recorded_and_reraised(self):
+        tr = obs.tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("nope")
+        tree = obs.exporter().snapshot()[0]
+        assert "ValueError" in tree["error"]
+
+    def test_explicit_parent_across_threads(self):
+        tr = obs.tracer()
+        with tr.span("round") as round_sp:
+            def work():
+                # executor threads don't inherit the contextvar: parent
+                # must be passed explicitly (the provisioning launch idiom)
+                assert tr.current() is None
+                with tr.span("launch", parent=round_sp):
+                    pass
+
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        tree = obs.exporter().snapshot()[0]
+        assert [c["name"] for c in tree["children"]] == ["launch"]
+
+    def test_remote_parent_makes_local_root(self):
+        tr = obs.tracer()
+        ctx = obs.SpanContext("ab" * 16, "cd" * 8)
+        with tr.span("sidecar.pack", parent=ctx) as sp:
+            assert sp.trace_id == ctx.trace_id
+            assert sp.parent_id == ctx.span_id
+            assert sp.parent is None
+        # exported as its own tree, joined to the caller's by ids
+        assert obs.exporter().snapshot()[0]["trace_id"] == ctx.trace_id
+
+    def test_child_record_attaches_completed_span(self):
+        tr = obs.tracer()
+        with tr.span("wire") as sp:
+            sp.add_child_record("sidecar.solve", 0.004, attrs={"k": 1})
+        child = obs.exporter().snapshot()[0]["children"][0]
+        assert child["name"] == "sidecar.solve"
+        assert child["duration_ms"] == pytest.approx(4.0, abs=0.1)
+
+    def test_disabled_tracer_is_noop(self):
+        obs.set_enabled(False)
+        tr = obs.tracer()
+        with tr.span("root") as sp:
+            sp.set_attribute("x", 1)  # absorbed
+            sp.add_child_record("y", 0.1)
+            assert tr.current() is None
+        assert obs.exporter().snapshot() == []
+
+    def test_ring_eviction_counts_drops(self):
+        exp = obs.RingExporter(capacity=2)
+        tr = obs.Tracer(exporter=exp)
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(exp.snapshot()) == 2
+        assert exp.dropped_spans == 3
+        assert [t["name"] for t in exp.snapshot()] == ["s4", "s3"]
+
+    def test_dump_jsonl(self, tmp_path):
+        tr = obs.tracer()
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        path = tmp_path / "traces.jsonl"
+        assert obs.exporter().dump_jsonl(str(path)) == 2
+        lines = path.read_text().strip().splitlines()
+        assert [json.loads(ln)["name"] for ln in lines] == ["a", "b"]
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        tr = obs.tracer()
+        with tr.span("x") as sp:
+            header = obs.to_traceparent(sp)
+            ctx = obs.from_traceparent(header)
+            assert ctx == sp.context
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "00-short-id-01", "zz", "00-" + "g" * 32 + "-" + "1" * 16 + "-01",
+        "00-" + "a" * 31 + "-" + "1" * 16 + "-01",
+    ])
+    def test_malformed_degrades_to_none(self, bad):
+        assert obs.from_traceparent(bad) is None
+
+
+class TestAnalysis:
+    def test_critical_path_self_times(self):
+        tree = {
+            "name": "root", "duration_ms": 10.0,
+            "children": [
+                {"name": "fast", "duration_ms": 2.0, "children": []},
+                {"name": "slow", "duration_ms": 6.0, "children": [
+                    {"name": "inner", "duration_ms": 5.0, "children": []},
+                ]},
+            ],
+        }
+        path = obs.critical_path(tree)
+        assert [p["name"] for p in path] == ["root", "slow", "inner"]
+        assert path[0]["self_ms"] == pytest.approx(2.0)
+        assert path[1]["self_ms"] == pytest.approx(1.0)
+
+    def test_overlapping_pairs_cross_trace_only(self):
+        def tree(tid, name, t0, t1):
+            return {"trace_id": tid, "name": name, "t0": t0, "t1": t1,
+                    "duration_ms": (t1 - t0) * 1e3, "children": []}
+
+        trees = [
+            tree("t1", "solve.encode", 0.0, 1.0),
+            tree("t2", "solve.pack_fetch", 0.5, 1.5),  # overlaps t1's encode
+            tree("t3", "solve.pack_fetch", 2.0, 3.0),  # does not
+        ]
+        assert obs.overlapping_pairs(trees) == 1
+        # same-trace overlap never counts
+        same = [tree("t1", "solve.encode", 0.0, 1.0),
+                tree("t1", "solve.pack_fetch", 0.0, 1.0)]
+        assert obs.overlapping_pairs(same) == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_over_budget_watched_span_is_recorded(self, tmp_path):
+        rec = obs.configure_flight(str(tmp_path), budget_s=0.0)
+        obs.register_state("panel", lambda: {"hello": 1})
+        with obs.tracer().span("solver.solve"):
+            pass
+        with obs.tracer().span("not.watched"):
+            pass
+        records = rec.recent()
+        assert len(records) == 1
+        assert records[0]["name"] == "solver.solve"
+        assert records[0]["state"]["panel"] == {"hello": 1}
+        assert records[0]["trace"]["name"] == "solver.solve"
+
+    def test_under_budget_not_recorded(self, tmp_path):
+        rec = obs.configure_flight(str(tmp_path), budget_s=30.0)
+        with obs.tracer().span("solver.solve"):
+            pass
+        assert rec.recent() == []
+
+    def test_capped_on_disk_ring(self, tmp_path):
+        rec = obs.configure_flight(str(tmp_path), budget_s=0.0, cap=3)
+        for _ in range(7):
+            with obs.tracer().span("solver.solve"):
+                pass
+        files = [p for p in tmp_path.iterdir() if p.name.startswith("flight-")]
+        assert len(files) == 3
+        assert rec.records_written == 7
+
+    def test_raising_state_provider_contained(self, tmp_path):
+        rec = obs.configure_flight(str(tmp_path), budget_s=0.0)
+        obs.register_state("broken", lambda: 1 / 0)
+        with obs.tracer().span("solver.solve"):
+            pass
+        state = rec.recent()[0]["state"]
+        assert "state provider failed" in state["broken"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: logging filter
+# ---------------------------------------------------------------------------
+
+
+class TestLoggingTraceContext:
+    def _record(self):
+        return logging.LogRecord(
+            name="karpenter.test", level=logging.INFO, pathname="", lineno=0,
+            msg="hello %s", args=("there",), exc_info=None,
+        )
+
+    def test_stamps_ids_inside_span_and_dash_outside(self):
+        from karpenter_tpu.logging_config import TraceContextFilter
+
+        f = TraceContextFilter()
+        rec = self._record()
+        f.filter(rec)
+        assert rec.trace_id == "-" and rec.span_id == "-"
+        with obs.tracer().span("x") as sp:
+            rec2 = self._record()
+            f.filter(rec2)
+            assert rec2.trace_id == sp.trace_id
+            assert rec2.span_id == sp.span_id
+
+    def test_format_renders_through_filtered_handler(self):
+        from karpenter_tpu.logging_config import LOG_FORMAT, TraceContextFilter
+
+        handler = logging.Handler()
+        rendered = []
+        handler.emit = lambda r: rendered.append(
+            logging.Formatter(LOG_FORMAT).format(r)
+        )
+        handler.addFilter(TraceContextFilter())
+        lg = logging.getLogger("karpenter.fmt-test")
+        lg.addHandler(handler)
+        try:
+            with obs.tracer().span("y") as sp:
+                lg.warning("traced line")
+            assert sp.trace_id in rendered[0]
+        finally:
+            lg.removeHandler(handler)
+
+    def test_live_level_reload_still_works(self, tmp_path):
+        # the regression the satellite demands: the filter must not break
+        # the config-logging live reload path
+        from karpenter_tpu.logging_config import (
+            LogLevelWatcher,
+            install_trace_filter,
+            setup_logging,
+        )
+
+        setup_logging("info")
+        install_trace_filter()  # idempotent double-install
+        root = logging.getLogger()
+        for h in root.handlers:
+            from karpenter_tpu.logging_config import TraceContextFilter
+
+            assert sum(isinstance(x, TraceContextFilter) for x in h.filters) <= 1
+        level_file = tmp_path / "loglevel"
+        level_file.write_text("debug")
+        watcher = LogLevelWatcher(str(level_file), interval=60)
+        watcher._apply_once()
+        assert logging.getLogger("karpenter").level == logging.DEBUG
+        level_file.write_text("warning")
+        watcher._apply_once()
+        assert logging.getLogger("karpenter").level == logging.WARNING
+        logging.getLogger("karpenter").setLevel(logging.INFO)
+
+
+# ---------------------------------------------------------------------------
+# satellite: event recorder annotation
+# ---------------------------------------------------------------------------
+
+
+class _StubCluster:
+    def __init__(self, fail: bool = False):
+        self.fail = fail
+        self.created = []
+
+    def clock(self):
+        return time.time()
+
+    def create(self, kind, obj):
+        if self.fail:
+            raise RuntimeError("apiserver down")
+        self.created.append(obj)
+
+    def update(self, kind, obj):
+        if self.fail:
+            raise RuntimeError("apiserver down")
+
+
+class TestEventTraceAnnotation:
+    def test_event_carries_active_trace_id(self):
+        from karpenter_tpu.kube.events import TRACE_ID_ANNOTATION, EventRecorder
+
+        rec = EventRecorder(_StubCluster())
+        with obs.tracer().span("launch") as sp:
+            ev = rec.event("Node", "n1", "Launched", "ok")
+        assert ev.metadata.annotations[TRACE_ID_ANNOTATION] == sp.trace_id
+
+    def test_no_span_no_annotation(self):
+        from karpenter_tpu.kube.events import TRACE_ID_ANNOTATION, EventRecorder
+
+        ev = EventRecorder(_StubCluster()).event("Node", "n1", "Launched", "ok")
+        assert TRACE_ID_ANNOTATION not in ev.metadata.annotations
+
+    def test_write_failure_never_fails_traced_action(self):
+        # the satellite's double assertion: annotation path active AND an
+        # event write failure still never raises into the caller
+        from karpenter_tpu.kube.events import EventRecorder
+
+        rec = EventRecorder(_StubCluster(fail=True))
+        with obs.tracer().span("launch") as sp:
+            out = rec.event("Node", "n1", "Launched", "ok")
+        assert out is None  # swallowed, not raised
+        assert sp.error is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: breaker short-circuit attribution
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerShortCircuit:
+    def test_shortcircuit_counted_and_tagged(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.cloudprovider.metrics import MeteredCloudProvider
+        from karpenter_tpu.resilience import BreakerOpen
+
+        provider = MeteredCloudProvider(FakeCloudProvider(instance_types(4)))
+        breaker = provider.breakers.get(f"{provider.name()}:get_instance_types")
+        for _ in range(10):
+            breaker.record_failure()
+        assert not breaker.allow()
+        counter = metrics.CLOUDPROVIDER_BREAKER_SHORTCIRCUIT.labels(
+            provider=provider.name(), method="get_instance_types"
+        )
+        before = counter._value.get()
+        with obs.tracer().span("provision.launch") as parent:
+            with pytest.raises(BreakerOpen):
+                provider.get_instance_types(None)
+        assert counter._value.get() == before + 1
+        # the fast-fail is attributable: the cloud span AND its parent are
+        # tagged, so a traced launch with a gap explains itself
+        assert parent.attrs.get("short_circuit") is True
+        cloud = [c for c in parent.children if c.name == "cloud.get_instance_types"]
+        assert cloud and cloud[0].attrs.get("short_circuit") is True
+
+
+# ---------------------------------------------------------------------------
+# scheduler stage spans vs last_stage_profile
+# ---------------------------------------------------------------------------
+
+
+class TestStageSpanAgreement:
+    def test_stage_spans_agree_with_profile_within_1ms(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.scheduling.scheduler import Scheduler
+        from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+        catalog = instance_types(8)
+        provisioner = make_provisioner(solver="tpu")
+        pods = diverse_pods(16, random.Random(3))
+        scheduler = Scheduler(Cluster(), rng=random.Random(1))
+        scheduler.solve(provisioner, catalog, pods)  # warmup/compile
+        obs.exporter().clear()
+        nodes = scheduler.solve(provisioner, catalog, pods)
+        assert nodes
+        prof = scheduler.last_stage_profile()
+        trees = obs.exporter().trees()
+        assert len(trees) == 1 and trees[0]["name"] == "solver.solve"
+        stages = {c["name"]: c["duration_ms"] for c in trees[0]["children"]}
+        for span_name, prof_key in [
+            ("solve.sort", "sort_s"), ("solve.inject", "inject_s"),
+            ("solve.encode", "encode_s"), ("solve.decode", "decode_s"),
+        ]:
+            assert abs(stages[span_name] - prof[prof_key] * 1e3) < 1.0, (
+                span_name, stages[span_name], prof[prof_key] * 1e3
+            )
+        # dispatch + fetch spans bracket exactly what pack_fetch_s times
+        # (no wire in play in-process, so no wire_ser/deser subtraction)
+        packed = stages.get("solve.pack_begin", 0.0) + stages.get(
+            "solve.pack_fetch", 0.0
+        )
+        assert abs(packed - prof["pack_fetch_s"] * 1e3) < 1.0
+        # router attributes landed on the dispatch span when routing ran
+        tree_attrs = [
+            c["attrs"] for c in trees[0]["children"]
+            if c["name"] == "solve.pack_begin"
+        ]
+        assert tree_attrs  # the span exists even when only one candidate
+
+
+# ---------------------------------------------------------------------------
+# the v3 wire: sidecar child spans linked across process boundary
+# ---------------------------------------------------------------------------
+
+
+def encoded_args(n_types: int = 8, n_pods: int = 6, seed: int = 3):
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(n_types), key=lambda it: it.effective_price())
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cluster = Cluster()
+    Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+    batch = enc.encode(
+        constraints, catalog, pods, daemon_overhead(cluster, constraints)
+    )
+    return batch.pack_args(), len(batch.pod_valid)
+
+
+class TestWirePropagation:
+    def test_trace_ctx_array_round_trip(self):
+        from karpenter_tpu.solver.service import _ctx_from_array, _trace_ctx_array
+
+        ctx = obs.SpanContext("ab" * 16, "12" * 8)
+        arr = _trace_ctx_array(ctx)
+        assert arr.dtype == np.int32 and arr.size == 6
+        assert _ctx_from_array(arr) == ctx
+        assert _ctx_from_array(np.zeros(5, np.int32)) is None
+        assert _ctx_from_array(np.zeros(6, np.float32)) is None
+
+    def test_untraced_frame_unchanged_and_no_trailer(self):
+        from karpenter_tpu.solver import service as svc
+
+        args, p = encoded_args()
+        args = [np.asarray(a) for a in args]
+        service = svc.SolverService()
+        key = svc.catalog_session_key(*args[svc.N_POD_ARRAYS:])
+        service.open_session_bytes(svc.pack_arrays(
+            [svc._key_array(key)] + args[svc.N_POD_ARRAYS:]
+        ))
+        response = service.solve_bytes(svc.pack_arrays(
+            [svc._key_array(key), np.asarray([8], np.int32)]
+            + args[:svc.N_POD_ARRAYS]
+        ))
+        arrays = svc.unpack_arrays(response)
+        assert int(arrays[0].reshape(-1)[0]) == svc.STATUS_OK
+        assert len(arrays) == 2  # status + fused buffer, NO stage trailer
+
+    def test_traced_solve_returns_stage_trailer_and_sidecar_spans(self):
+        from karpenter_tpu.solver import service as svc
+
+        args, p = encoded_args()
+        args = [np.asarray(a) for a in args]
+        service = svc.SolverService()
+        key = svc.catalog_session_key(*args[svc.N_POD_ARRAYS:])
+        ctx = obs.SpanContext("cd" * 16, "34" * 8)
+        service.open_session_bytes(svc.pack_arrays(
+            [svc._key_array(key)] + args[svc.N_POD_ARRAYS:]
+            + [np.asarray([1], np.int32), svc._trace_ctx_array(ctx)]
+        ))
+        response = service.solve_bytes(svc.pack_arrays(
+            [svc._key_array(key), np.asarray([8], np.int32)]
+            + args[:svc.N_POD_ARRAYS] + [svc._trace_ctx_array(ctx)]
+        ))
+        arrays = svc.unpack_arrays(response)
+        assert int(arrays[0].reshape(-1)[0]) == svc.STATUS_OK
+        trailer = arrays[-1]
+        assert trailer.dtype == np.float32 and trailer.size == 3
+        assert all(v >= 0.0 for v in trailer)
+        # the sidecar's own ring holds its half of the trace, joined to
+        # the caller by the propagated ids
+        names = {t["name"]: t for t in obs.exporter().snapshot(limit=None)}
+        assert names["sidecar.pack"]["trace_id"] == ctx.trace_id
+        assert names["sidecar.pack"]["parent_id"] == ctx.span_id
+        assert {c["name"] for c in names["sidecar.pack"]["children"]} >= {
+            "sidecar.solve", "sidecar.fetch", "sidecar.serialize",
+        }
+        assert names["sidecar.device_put"]["trace_id"] == ctx.trace_id
+
+    def test_remote_solver_grafts_sidecar_stages(self):
+        grpc = pytest.importorskip("grpc")  # noqa: F841
+        from karpenter_tpu.solver.service import RemoteSolver, serve
+
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            client = RemoteSolver(address, timeout=30)
+            args, p = encoded_args()
+            with obs.tracer().span("test.root"):
+                result = client.pack(*args, n_max=8)
+            assert int(result.n_nodes) >= 1
+            trees = {t["name"]: t for t in obs.exporter().snapshot(limit=None)}
+            root = trees["test.root"]
+            wire = [c for c in root["children"] if c["name"] == "solver.wire"]
+            assert wire, [c["name"] for c in root["children"]]
+            grafted = {c["name"] for c in wire[0]["children"]}
+            assert grafted >= {"sidecar.solve", "sidecar.fetch", "sidecar.serialize"}
+            # the sidecar's real spans share the trace id (in-process server
+            # shares the default tracer here — one ring, same join)
+            assert trees["sidecar.pack"]["trace_id"] == root["trace_id"]
+        finally:
+            server.stop(grace=0)
+
+    def test_old_sidecar_never_sees_pack_trailer(self):
+        # rolling-upgrade interop: a pre-trailer sidecar does not advertise
+        # PROTO_TRACE_TRAILER, so a traced client must keep its Pack frames
+        # trailer-free (an old server's `*pod_arrays` unpack would swallow
+        # the trailer as an extra pod array and crash the solve)
+        grpc = pytest.importorskip("grpc")  # noqa: F841
+        from karpenter_tpu.solver import service as svc
+
+        class OldSidecar(svc.SolverService):
+            def open_session_bytes(self, request):
+                super().open_session_bytes(request)
+                return svc._status_response(svc.STATUS_OK)  # no capabilities
+
+            def solve_bytes(self, request):
+                # the old unpack: a trailer would land in pod_arrays here
+                arrays = svc.unpack_arrays(request)
+                assert len(arrays) == 2 + svc.N_POD_ARRAYS, len(arrays)
+                return super().solve_bytes(request)
+
+        address = f"127.0.0.1:{free_port()}"
+        server = svc.serve(address, service=OldSidecar())
+        try:
+            client = svc.RemoteSolver(address, timeout=30)
+            args, p = encoded_args()
+            with obs.tracer().span("test.root"):
+                result = client.pack(*args, n_max=8)
+            assert int(result.n_nodes) >= 1
+            assert client._server_features == 0
+            trees = {t["name"] for t in obs.exporter().snapshot(limit=None)}
+            assert "sidecar.pack" not in trees  # nothing traced server-side
+        finally:
+            server.stop(grace=0)
+
+    def test_http_wire_traceparent_parents_server_span(self):
+        from karpenter_tpu.cloudprovider.httpapi import CloudAPIServer, HttpCloudAPI
+
+        with CloudAPIServer() as srv:
+            client = HttpCloudAPI(srv.url)
+            with obs.tracer().span("cloud.get_instance_types") as sp:
+                client.describe_instance_types()
+            trees = obs.exporter().snapshot(limit=None)
+            server_spans = [t for t in trees if t["name"] == "cloudapi.request"]
+            assert server_spans
+            assert server_spans[0]["trace_id"] == sp.trace_id
+            assert server_spans[0]["parent_id"] == sp.span_id
+
+
+# ---------------------------------------------------------------------------
+# lifecycle traces: provisioning round, node-ready, interruption
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycleTraces:
+    def _provision(self):
+        from karpenter_tpu.cloudprovider import metrics as cpmetrics
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        cluster = Cluster()
+        provider = cpmetrics.decorate(FakeCloudProvider(instance_types(6)))
+        controller = ProvisioningController(cluster, provider, start_workers=False)
+        provisioner = make_provisioner()
+        cluster.create("provisioners", provisioner)
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(3)]
+        for p in pods:
+            cluster.create("pods", p)
+        controller.apply(provisioner)
+        worker = controller.workers[provisioner.name]
+        for p in pods:
+            worker.batcher.add(p)
+        worker.batcher.idle_duration = 0.01
+        nodes = worker.provision_once()
+        controller.stop()
+        return cluster, nodes
+
+    def test_provision_round_tree_covers_lifecycle(self):
+        cluster, nodes = self._provision()
+        assert nodes
+        trees = obs.exporter().snapshot(limit=None)
+        rounds = [t for t in trees if t["name"] == "provision.round"]
+        assert rounds
+        tree = rounds[0]
+        names = {c["name"] for c in tree["children"]}
+        assert {"solver.solve", "provision.launch"} <= names
+        assert tree["attrs"]["admission_window_s"] >= 0.0
+        launch = next(c for c in tree["children"] if c["name"] == "provision.launch")
+        launch_children = {c["name"] for c in launch["children"]}
+        assert "cloud.create" in launch_children
+        assert "provision.bind" in launch_children
+        # the launch trace is stamped on the Node for node.ready to join
+        node = cluster.nodes()[0]
+        header = node.metadata.annotations.get(obs.TRACE_ANNOTATION)
+        assert obs.from_traceparent(header) is not None
+        assert obs.from_traceparent(header).trace_id == tree["trace_id"]
+
+    def test_node_ready_joins_launch_trace(self):
+        from karpenter_tpu.api import labels as lbl
+        from karpenter_tpu.api.objects import PodCondition, Taint
+        from karpenter_tpu.controllers.node import Initialization
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.testing.factories import make_node, make_provisioner
+
+        cluster = Cluster()
+        node = make_node(name="n1", provisioner_name="default")
+        node.spec.taints.append(
+            Taint(key=lbl.NOT_READY_TAINT_KEY, value="", effect="NoSchedule")
+        )
+        node.status.conditions.append(PodCondition(type="Ready", status="True"))
+        ctx = obs.SpanContext("ef" * 16, "56" * 8)
+        node.metadata.annotations[obs.TRACE_ANNOTATION] = obs.to_traceparent(ctx)
+        cluster.create("nodes", node)
+        Initialization(cluster).reconcile(make_provisioner(), node)
+        assert not any(
+            t.key == lbl.NOT_READY_TAINT_KEY for t in node.spec.taints
+        )
+        ready = [
+            t for t in obs.exporter().snapshot(limit=None)
+            if t["name"] == "node.ready"
+        ]
+        assert ready and ready[0]["trace_id"] == ctx.trace_id
+
+    def test_interruption_notice_tree(self):
+        from karpenter_tpu.interruption.orchestrator import Orchestrator
+        from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.testing.factories import make_node, make_pod
+
+        cluster = Cluster()
+        node = make_node(name="victim", provisioner_name="default")
+        cluster.create("nodes", node)
+        cluster.create(
+            "pods",
+            make_pod(name="p1", node_name="victim", unschedulable=False),
+        )
+        orch = Orchestrator(cluster, None, None, None)
+        response = orch.handle(DisruptionNotice(
+            kind=PREEMPTION, node_name="victim", grace_period_seconds=30.0,
+        ))
+        assert response is not None and len(response.migrated) == 1
+        trees = [
+            t for t in obs.exporter().snapshot(limit=None)
+            if t["name"] == "interruption.notice"
+        ]
+        assert trees
+        names = [c["name"] for c in trees[0]["children"]]
+        assert names == [
+            "interruption.taint_cordon", "interruption.replace",
+            "interruption.drain_handoff",
+        ]
+        assert trees[0]["attrs"]["kind"] == PREEMPTION
+
+
+# ---------------------------------------------------------------------------
+# /debug endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def test_sidecar_health_serves_traces_and_flight(self, tmp_path):
+        from karpenter_tpu.solver.service import SolverService, _serve_health
+
+        obs.configure_flight(str(tmp_path), budget_s=0.0)
+        with obs.tracer().span("solver.solve", attrs={"pods": 5}):
+            pass
+        service = SolverService()
+        service.ready.set()
+        port = free_port()
+        httpd = _serve_health(service, port)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["traces"][0]["name"] == "solver.solve"
+            assert body["traces"][0]["attrs"]["pods"] == 5
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/flight", timeout=5
+            ) as resp:
+                flight = json.loads(resp.read())
+            assert flight["records"][0]["name"] == "solver.solve"
+            assert "state" in flight["records"][0]
+        finally:
+            httpd.shutdown()

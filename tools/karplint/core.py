@@ -174,6 +174,7 @@ def _load_rules() -> None:
         patch,
         purity,
         retry,
+        spans,
         tracer,
     )
 

@@ -1,0 +1,133 @@
+"""Span discipline for the tracing subsystem (karpenter_tpu/obs).
+
+Two invariants, one rule name (``span-closed``):
+
+1. **Context-manager only.** Spans may only be opened via
+   ``with tracer.span(...)``. A bare ``start_span`` call anywhere outside
+   ``karpenter_tpu/obs/`` is a finding: the Span it returns never resets
+   the ambient contextvar and never exports — every later span in that
+   context silently mis-parents, which is exactly the class of corruption
+   no test notices until a trace tree looks wrong in an incident.
+
+2. **Tracer safety.** No ``obs`` call may be reachable from jit/vmap/
+   pallas-traced solver code (reusing the tracer rules' cross-file call
+   graph). A span is host-side Python — inside traced code it either
+   breaks tracing outright or silently forces a host sync per solve,
+   erasing the <100ms target while every correctness test stays green.
+   P0, like the other tracer-safety rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.karplint.core import (
+    P0,
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+from tools.karplint.rules.tracer import CallGraph, walk_no_funcs
+
+OBS_MODULE = "karpenter_tpu.obs"
+
+
+def _in_obs_package(path: str) -> bool:
+    # segment match, not substring: a future jobs/ or blobs/ directory
+    # must NOT inherit the obs implementation's exemption
+    parts = path.split("/")
+    return "obs" in parts[:-1] or parts[-1] == "obs.py"
+
+
+def _obs_aliases(f: SourceFile) -> set:
+    """Local names that refer to the obs package or its members."""
+    from tools.karplint.core import import_tables
+
+    modules, symbols = import_tables(f.tree)
+    out = set()
+    for alias, mod in modules.items():
+        if mod == OBS_MODULE or mod.startswith(OBS_MODULE + "."):
+            out.add(alias)
+    for alias, (mod, _sym) in symbols.items():
+        if mod == OBS_MODULE or mod.startswith(OBS_MODULE + "."):
+            out.add(alias)
+    return out
+
+
+@register
+class SpanClosedRule(Rule):
+    name = "span-closed"
+    severity = P1
+    doc = (
+        "Spans must be opened via `with tracer.span(...)` — a bare "
+        "start_span call leaks an open span (P1); and no obs call may be "
+        "reachable from jit/vmap/pallas-traced solver code (P0)."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        self._check_start_span(project, findings)
+        self._check_jit_reachable(project, findings)
+        return findings
+
+    # -- invariant 1: no bare start_span ------------------------------------
+    def _check_start_span(self, project: Project, findings: List[Finding]) -> None:
+        for f in project.files:
+            if _in_obs_package(f.path):
+                continue  # the implementation (and its tests' fixtures)
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # match the attribute/name directly, not via dotted_name:
+                # the receiver is usually itself a call (obs.tracer()),
+                # which a Name/Attribute chain walk cannot resolve
+                func = node.func
+                called = (
+                    func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name)
+                    else ""
+                )
+                if called != "start_span":
+                    continue
+                findings.append(
+                    self.finding(
+                        f.path, node.lineno,
+                        "bare `start_span` call — spans may only be opened "
+                        "via `with tracer.span(...)` (an unmanaged span "
+                        "never closes, never exports, and mis-parents every "
+                        "later span in this context)",
+                    )
+                )
+
+    # -- invariant 2: obs unreachable from traced code ----------------------
+    def _check_jit_reachable(self, project: Project, findings: List[Finding]) -> None:
+        files = project.matching(lambda p: "solver/" in p)
+        if not files:
+            return
+        graph = CallGraph(files)
+        reachable = graph.reachable()
+        for fn in reachable:
+            aliases = _obs_aliases(fn.file)
+            if not aliases:
+                continue
+            for node in walk_no_funcs(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func) or ""
+                root = dn.split(".", 1)[0]
+                if root in aliases:
+                    findings.append(
+                        self.finding(
+                            fn.file.path, node.lineno,
+                            f"obs call `{dn}` reachable from jit/vmap/pallas-"
+                            f"traced code (via `{fn.qualname}`) — host-side "
+                            "span machinery inside traced code serializes "
+                            "the device pipeline",
+                            severity=P0,
+                        )
+                    )
