@@ -60,6 +60,10 @@ chaos:  ## seeded chaos suite + the bench chaos leg (success-rate done-bar: 1.0)
 	$(PY) -m pytest tests/test_chaos.py tests/test_resilience.py -q $(TESTFLAGS)
 	$(PY) bench.py --chaos 300
 
+fleet-chaos:  ## fleet HA proof: shard/pool suites + the replica+sidecar-kill storm leg
+	$(PY) -m pytest tests/test_fleet.py tests/test_fleet_pool.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --fleet-storm 120 --solver tpu
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -92,5 +96,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-notrace benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -997,6 +997,226 @@ def bench_chaos(
         rt.stop()
 
 
+def bench_fleet_storm(
+    n_pods: int = 400,
+    n_provisioners: int = 8,
+    n_replicas: int = 3,
+    pool_size: int = 2,
+    lease_duration: float = 2.0,
+    renew_interval: float = 0.4,
+    kill_replica: bool = True,
+    kill_sidecar: bool = True,
+    solver: str = "tpu",
+):
+    """Fleet-scale HA storm (docs/fleet.md): N controller replicas share one
+    cluster and one shard-lease file, provisioners partition across them by
+    rendezvous placement, and solves route through a consistent-hash pool
+    of solver sidecars. Mid-storm a shard OWNER replica is killed (crash —
+    its leases expire, survivors rebalance) and a sidecar pool member is
+    killed (solves fail over through the ring, NEEDS_CATALOG re-uploads on
+    the survivor). The leg reports the acceptance numbers: aggregate
+    pods/sec, p99 time-to-bind, duplicate launches (must be 0), and
+    rebalance time vs the 2x-lease-duration bar."""
+    import tempfile
+    import threading
+
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.testing.chaos import ReplicaChaos, SidecarChaos
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+    t_start = time.perf_counter()
+    # pin the device path for the leg: the cost router would (correctly)
+    # send these small batches to the native backend, and a storm that
+    # never touches the sidecars proves nothing about pool failover
+    packer_before = os.environ.get("KARPENTER_PACKER")
+    if pool_size and solver == "tpu":
+        os.environ["KARPENTER_PACKER"] = "device"
+    sidecars = SidecarChaos(n=pool_size) if pool_size else None
+    lease_path = tempfile.mktemp(prefix="karpenter-fleet-lease-")
+    cluster = Cluster()
+    api = SimCloudAPI()
+    fleet = ReplicaChaos()
+    # duplicate-launch detector: any pod whose nodeName flips from one
+    # non-empty value to another was double-provisioned (no preemption in
+    # this leg, so there is no legitimate re-bind)
+    rebinds = []
+    last_node = {}
+    bound_at = {}
+    t0_box = [0.0]
+    watch_mu = threading.Lock()
+
+    def on_pod(event, pod):
+        if event == "DELETED" or not pod.spec.node_name:
+            return
+        with watch_mu:
+            prev = last_node.get(pod.metadata.name)
+            if prev and prev != pod.spec.node_name:
+                rebinds.append((pod.metadata.name, prev, pod.spec.node_name))
+            last_node[pod.metadata.name] = pod.spec.node_name
+            if pod.metadata.name not in bound_at:
+                bound_at[pod.metadata.name] = time.perf_counter() - t0_box[0]
+
+    cluster.watch("pods", on_pod)
+
+    opts_kwargs = dict(
+        shard_lease=lease_path,
+        shard_lease_duration=lease_duration,
+        solver_service_address=sidecars.address_spec if sidecars else "",
+    )
+    try:
+        for i in range(n_replicas):
+            rt = build_runtime(
+                Options(**opts_kwargs),
+                cluster=cluster,
+                cloud_provider=SimulatedCloudProvider(api=api),
+                shard_identity=f"replica-{i}",
+            )
+            rt.ownership.renew_interval = renew_interval
+            rt.ownership.start()
+            rt.manager.start()
+            fleet.add(f"replica-{i}", rt)
+
+        for i in range(n_provisioners):
+            cluster.create("provisioners", make_provisioner(
+                name=f"fleet-{i}", solver=solver,
+                requirements=[NodeSelectorRequirement(
+                    key="fleet", operator="In", values=[f"fleet-{i}"],
+                )],
+            ))
+
+        # wait until every shard has exactly one live owner + worker
+        deadline = time.time() + 30
+        names = [f"fleet-{i}" for i in range(n_provisioners)]
+        while time.time() < deadline:
+            owners = {
+                name: fleet.owner_named(name) for name in names
+            }
+            workers_ready = all(
+                rt is not None and name in rt.provisioning.workers
+                for name, (_, rt) in owners.items()
+            )
+            if workers_ready:
+                break
+            time.sleep(0.05)
+        assert all(fleet.owner_named(n)[0] for n in names), "shards never all owned"
+        for rt in fleet.replicas.values():
+            for w in rt.provisioning.workers.values():
+                w.batcher.idle_duration = 0.1
+
+        shard_counts_before = {
+            name: len(shards) for name, shards in fleet.owned_shards().items()
+        }
+
+        t0_box[0] = time.perf_counter()
+        for i in range(n_pods):
+            cluster.create("pods", make_pod(
+                name=f"storm-{i}", requests={"cpu": "0.25"},
+                node_selector={"fleet": f"fleet-{i % n_provisioners}"},
+            ))
+
+        # mid-storm: first kill the session-bearing sidecar member (a cold
+        # spare would exercise nothing — wait until a catalog session
+        # actually lives somewhere; the warmup compiles delay the first
+        # remote solve), then CRASH the owner of shard fleet-0 (leases
+        # expire, never released) and time the rebalance.
+        rebalance_s = None
+        victim_shards = frozenset()
+        if kill_sidecar and sidecars:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if any(
+                    s.solver_service.session_count()
+                    for s in sidecars.servers.values()
+                ):
+                    break
+                time.sleep(0.05)
+            sidecars.kill(sidecars.busiest())
+        if kill_replica:
+            time.sleep(0.3)  # let the storm engage
+            victim, victim_rt = fleet.owner_named("fleet-0")
+            victim_shards = frozenset(victim_rt.ownership.owned())
+            t_kill = time.perf_counter()
+            fleet.kill(victim)
+            deadline = time.time() + lease_duration * 10
+            while time.time() < deadline:
+                survivors_own = set()
+                for rt in fleet.replicas.values():
+                    survivors_own |= rt.ownership.owned()
+                if victim_shards <= survivors_own:
+                    rebalance_s = time.perf_counter() - t_kill
+                    break
+                time.sleep(0.05)
+
+        # settle: every created pod bound
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            pods = [p for p in cluster.pods() if p.metadata.name.startswith("storm-")]
+            if pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        pods = [p for p in cluster.pods() if p.metadata.name.startswith("storm-")]
+        bound = [p for p in pods if p.spec.node_name]
+        latencies = sorted(bound_at[p.metadata.name] for p in bound if p.metadata.name in bound_at)
+        elapsed = max(latencies) if latencies else float("nan")
+        failovers = _sample(m, "karpenter_solver_pool_failovers_total")
+        guard_hits = _sample(m, "karpenter_fleet_duplicate_launch_guard_total")
+        return {
+            "pods": n_pods,
+            "provisioners": n_provisioners,
+            "replicas": n_replicas,
+            "pool_size": pool_size,
+            "solver": solver,
+            "lease_duration_s": lease_duration,
+            "chaos_provision_success_rate": round(len(bound) / max(n_pods, 1), 4),
+            "aggregate_pods_per_sec": round(len(bound) / elapsed, 1) if latencies else None,
+            "p99_time_to_bind_s": round(_p99(latencies), 4) if latencies else None,
+            "p50_time_to_bind_s": round(latencies[len(latencies) // 2], 4) if latencies else None,
+            "duplicate_launches": len(rebinds),
+            "duplicate_rebinds": rebinds[:5],
+            "duplicate_launch_guard_hits": guard_hits,
+            "replica_killed": kill_replica,
+            "sidecar_killed": bool(kill_sidecar and sidecars),
+            "rebalance_s": round(rebalance_s, 3) if rebalance_s is not None else None,
+            "rebalance_bar_s": round(2 * lease_duration, 3),
+            "rebalance_within_bar": (
+                rebalance_s is not None and rebalance_s <= 2 * lease_duration
+                if kill_replica else None
+            ),
+            "shards_per_replica_before_kill": shard_counts_before,
+            "shards_per_replica_after": {
+                name: len(s) for name, s in fleet.owned_shards().items()
+            },
+            "pool_failovers_total": failovers,
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        if packer_before is None:
+            os.environ.pop("KARPENTER_PACKER", None)
+        else:
+            os.environ["KARPENTER_PACKER"] = packer_before
+        fleet.stop_all()
+        if sidecars:
+            sidecars.stop_all()
+        try:
+            os.remove(lease_path)
+        except OSError:
+            pass
+
+
+def _sample(m, name: str) -> float:
+    """Sum a metric family's samples from the process registry."""
+    total = 0.0
+    for metric in m.REGISTRY.collect():
+        for s in metric.samples:
+            if s.name == name:
+                total += s.value
+    return total
+
+
 def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
     """BASELINE config 4: many provisioners' batches solved concurrently —
     stacked on the batch axis and sharded over the device mesh
@@ -1535,6 +1755,16 @@ def main():
     ap.add_argument("--chaos-error-rate", type=float, default=0.1)
     ap.add_argument("--chaos-latency-p95", type=float, default=0.05)
     ap.add_argument("--chaos-seed", type=int, default=20260803)
+    ap.add_argument("--fleet-storm", type=int, metavar="N_PODS", default=0,
+                    help="multi-tenant HA storm: provisioners sharded across "
+                         "controller replicas over a solver sidecar pool, "
+                         "with a mid-storm replica crash + sidecar kill; "
+                         "reports aggregate pods/sec, p99 time-to-bind, "
+                         "duplicate_launches (bar: 0) and rebalance_s "
+                         "(bar: 2x lease duration)")
+    ap.add_argument("--fleet-provisioners", type=int, default=8)
+    ap.add_argument("--fleet-replicas", type=int, default=3)
+    ap.add_argument("--fleet-pool", type=int, default=2)
     ap.add_argument("--config", type=int, default=0, metavar="1..5",
                     help="run one of BASELINE.json's five configs")
     ap.add_argument("--all-configs", action="store_true",
@@ -1608,6 +1838,32 @@ def main():
         return
     if args.config:
         print(json.dumps(bench_config(args.config, max(args.iters, 2))))
+        return
+
+    if args.fleet_storm:
+        r = bench_fleet_storm(
+            args.fleet_storm,
+            n_provisioners=args.fleet_provisioners,
+            n_replicas=args.fleet_replicas,
+            pool_size=args.fleet_pool,
+            solver=args.solver,
+        )
+        ok = (
+            r["chaos_provision_success_rate"] == 1.0
+            and r["duplicate_launches"] == 0
+            and (r["rebalance_within_bar"] in (True, None))
+        )
+        print(json.dumps({
+            "metric": (
+                f"fleet-storm ({r['provisioners']} provisioners x "
+                f"{r['replicas']} replicas x {r['pool_size']}-member pool, "
+                "replica+sidecar kill)"
+            ),
+            "value": r["aggregate_pods_per_sec"],
+            "unit": "aggregate pods/sec",
+            "fleet_ok": ok,
+            **{k: v for k, v in r.items() if k != "aggregate_pods_per_sec"},
+        }))
         return
 
     if args.chaos:

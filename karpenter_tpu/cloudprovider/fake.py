@@ -216,5 +216,9 @@ class FakeCloudProvider(CloudProvider):
     def poll_disruptions(self) -> List[DisruptionNotice]:
         return self.disruptions.drain()
 
+    def requeue_disruption(self, notice: DisruptionNotice) -> bool:
+        self.disruptions.push(notice)
+        return True
+
     def name(self) -> str:
         return "fake"

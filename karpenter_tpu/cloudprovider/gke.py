@@ -466,5 +466,14 @@ class GkeCloudProvider(CloudProvider):
         call works over the wire via ``HttpGkeAPI``)."""
         return self.api.poll_disruptions()
 
+    def requeue_disruption(self, notice: DisruptionNotice) -> bool:
+        """Fleet routing: re-offer a wrong-replica notice to the event bus
+        (in-process double only — the wire client answers False)."""
+        sender = getattr(self.api, "send_disruption_notice", None)
+        if sender is None:
+            return False
+        sender(notice)
+        return True
+
     def name(self) -> str:
         return "gke"

@@ -166,6 +166,10 @@ class MeteredCloudProvider(CloudProvider):
         # breaker/retry layer here would only delay the reset-on-sighting
         return self.delegate.instance_gone(node)
 
+    def requeue_disruption(self, notice) -> bool:
+        # a local re-offer, not a metered control-plane call
+        return self.delegate.requeue_disruption(notice)
+
     # webhook hooks + name pass through unmetered, as in the reference
     def default(self, constraints: Constraints) -> None:
         return self.delegate.default(constraints)

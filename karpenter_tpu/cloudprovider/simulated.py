@@ -915,6 +915,17 @@ class SimulatedCloudProvider(CloudProvider):
         client's ``GET /v1/events``)."""
         return self.api.poll_disruptions()
 
+    def requeue_disruption(self, notice: DisruptionNotice) -> bool:
+        """Fleet routing: push a notice drained by the wrong replica back
+        onto the event bus for the shard owner's next poll. The HTTP client
+        has no re-offer endpoint, so the wire path answers False and the
+        draining replica handles the notice locally."""
+        sender = getattr(self.api, "send_disruption_notice", None)
+        if sender is None:
+            return False
+        sender(notice)
+        return True
+
     def instance_gone(self, node: Node) -> Optional[bool]:
         """Node liveness with flake debouncing. ``describe_instances``
         silently drops unknown ids, so a single missing id is ambiguous:

@@ -103,6 +103,16 @@ class CloudProvider(abc.ABC):
         Default: this vendor has no disruption stream."""
         return []
 
+    def requeue_disruption(self, notice) -> bool:
+        """Hand a drained disruption notice BACK to the stream — the fleet
+        routing hook: a sharded controller replica that polls a notice for
+        a node whose shard it does not own re-offers it so the owner's poll
+        picks it up (real queues get this via visibility timeouts; doubles
+        push back onto their in-memory queue). Returns False when this
+        vendor cannot requeue — the caller then handles the notice locally
+        (availability over strict sharding)."""
+        return False
+
     def instance_gone(self, node: Node):
         """Liveness probe for the instance backing ``node``: True when the
         cloud has confirmed it is gone (terminated state, a typed NotFound,

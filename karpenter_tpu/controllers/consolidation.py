@@ -99,11 +99,17 @@ class ConsolidationController:
         solver_service_address: Optional[str] = None,
         migration: Optional[str] = None,  # "bind" | "evict" | None = auto
         wave_size: int = EVICT_WAVE_SIZE,
+        ownership=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.enabled = enabled
         self.solver_service_address = solver_service_address
+        # fleet.ShardManager (or None): consolidation disrupts a
+        # provisioner's nodes, so only the shard owner may plan/execute a
+        # wave — N un-sharded replicas would each retire wave_size nodes
+        # concurrently (N× the configured disruption pacing)
+        self.ownership = ownership
         from karpenter_tpu.kube.apiserver import ApiCluster
 
         if migration is None:
@@ -341,6 +347,14 @@ class ConsolidationController:
         provisioner = self.cluster.try_get("provisioners", name, namespace="")
         if provisioner is None:
             return None
+        if self.ownership is not None and not self.ownership.owns(name):
+            # another replica's shard (docs/fleet.md): re-check on a
+            # lease-scale cadence so a rebalance picks the work up here
+            from karpenter_tpu.controllers.provisioning import (
+                OWNERSHIP_RECHECK_INTERVAL,
+            )
+
+            return OWNERSHIP_RECHECK_INTERVAL
         if not self.wave_settled(name):
             # the previous wave's pods have not all re-seated: no new
             # disruption yet, check back shortly

@@ -53,10 +53,17 @@ class InterruptionController:
         provisioning=None,
         termination=None,
         poll_interval: float = POLL_INTERVAL,
+        ownership=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.poll_interval = poll_interval
+        # fleet.ShardManager (or None = this replica handles everything):
+        # a notice for a node whose provisioner shard another replica owns
+        # is requeued to the provider stream — two replicas must never
+        # orchestrate (taint/drain/force-terminate) the same node
+        self.ownership = ownership
+        self.foreign_notices = 0  # requeued to the owner; test observability
         self.orchestrator = Orchestrator(
             cluster, cloud_provider, provisioning, termination
         )
@@ -106,7 +113,45 @@ class InterruptionController:
                     logger.exception("handling disruption notice %r", notice)
         return self.poll_interval
 
+    def _shard_for(self, node_name: str) -> str:
+        """The shard key that owns this node's lifecycle: its provisioner
+        label, or the fleet's default shard for unattributed nodes. A label
+        naming a DELETED provisioner also maps to the default shard — that
+        key leaves every replica's shard universe, so routing to it would
+        requeue the notice forever with no owner ever appearing."""
+        from karpenter_tpu.api import labels as lbl
+        from karpenter_tpu.fleet import DEFAULT_SHARD
+
+        node = self.cluster.try_get("nodes", node_name, namespace="")
+        if node is None:
+            return DEFAULT_SHARD
+        shard = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+        if not shard:
+            return DEFAULT_SHARD
+        if self.cluster.try_get("provisioners", shard, namespace="") is None:
+            return DEFAULT_SHARD
+        return shard
+
+    def _routed_away(self, notice: DisruptionNotice) -> bool:
+        """True when another replica owns this notice's shard AND the
+        provider accepted the requeue — the owner's next poll picks it up.
+        A provider that cannot requeue (the HTTP wire) answers False and
+        the notice is handled locally: availability beats strict sharding,
+        and the orchestrator's node-scoped actions stay exactly-once
+        because only THIS replica drained the notice."""
+        if self.ownership is None:
+            return False
+        if self.ownership.owns(self._shard_for(notice.node_name)):
+            return False
+        if not self.cloud_provider.requeue_disruption(notice):
+            return False
+        self.foreign_notices += 1
+        metrics.FLEET_FOREIGN_NOTICES.inc()
+        return True
+
     def handle_notice(self, notice: DisruptionNotice) -> None:
+        if self._routed_away(notice):
+            return
         metrics.INTERRUPTION_NOTICES.labels(
             kind=notice.kind, provider=self.cloud_provider.name()
         ).inc()

@@ -41,6 +41,11 @@ logger = logging.getLogger("karpenter.provisioning")
 # catalog drift (provisioning/controller.go:82).
 REQUEUE_INTERVAL = 300.0
 
+# How often a replica re-checks a provisioner it does NOT own: ownership can
+# arrive within one lease duration of the owner's death, so the recheck must
+# be of the same order (docs/fleet.md).
+OWNERSHIP_RECHECK_INTERVAL = 5.0
+
 # Wall-clock allowance for one provision round (catalog → solve → launches):
 # the resilience layer's retry deadlines are capped by what remains of this,
 # so a flaky control plane degrades the round as a whole instead of every
@@ -65,6 +70,7 @@ class ProvisionerWorker:
         scheduler: Optional[Scheduler] = None,
         batcher: Optional[Batcher] = None,
         solver_service_address: Optional[str] = None,
+        owned: Optional[callable] = None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
@@ -73,6 +79,12 @@ class ProvisionerWorker:
             cluster, solver_service_address=solver_service_address
         )
         self.batcher = batcher or Batcher()
+        # fleet split-brain guard: does this replica still hold the shard
+        # lease for this provisioner? Re-checked at solve time and again
+        # immediately before every cloud create — a replica that lost its
+        # lease mid-round must not launch (docs/fleet.md). Single-replica
+        # deployments run with the constant-True default.
+        self.owned = owned or (lambda: True)
         self._pending_lock = threading.Lock()
         self._pending_keys: set = set()
         # keys a failed launch re-queued THIS round: provision_once's
@@ -225,6 +237,15 @@ class ProvisionerWorker:
             pods = [latest[k] for k in key_order]
             if not pods:
                 return []
+            if not self.owned():
+                # shard lease gone: the new owner's selection loop re-routes
+                # these pods to ITS worker — solving here would race its
+                # launches (pending state clears in provision_once's finally)
+                metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                    reason="lost_ownership"
+                ).inc()
+                round_sp.set_attribute("skipped", "lost_ownership")
+                return []
             metrics.SOLVER_BATCH_SIZE.labels(backend=self.provisioner.spec.solver).observe(len(pods))
             # one time budget for the whole round: catalog, solve, and every
             # launch's retries all draw down the same allowance
@@ -294,6 +315,20 @@ class ProvisionerWorker:
 
     def _launch_one(self, vnode: VirtualNode) -> bool:
         try:
+            # the launch-side split-brain guard: re-checked as late as
+            # possible before the cloud create. Launches are tokened (the
+            # wire fleet POST dedupes), but a lost lease means another
+            # replica may ALREADY be solving these pods — creating here
+            # would double capacity and race its binds.
+            if not self.owned():
+                metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                    reason="lost_ownership"
+                ).inc()
+                logger.warning(
+                    "skipping launch for %s: shard lease lost",
+                    self.provisioner.name,
+                )
+                return False
             # fresh limits check against live status (reference:
             # provisioner.go:138-144 re-reads the provisioner)
             live = self.cluster.try_get("provisioners", self.provisioner.name, namespace="")
@@ -376,7 +411,22 @@ class ProvisionerWorker:
         ) as sp:
             for pod in pods:
                 try:
-                    self.cluster.bind(pod, node_name)
+                    # re-check against the LIVE pod: a rebalance can hand
+                    # the shard to another replica between this replica's
+                    # solve and its bind, and that replica may have bound
+                    # the pod already — binds are re-checked, never
+                    # duplicated (docs/fleet.md). A pod the cluster does
+                    # not know (test harnesses inject those) binds as-is.
+                    live = self.cluster.try_get(
+                        "pods", pod.metadata.name, namespace=pod.metadata.namespace
+                    )
+                    if live is not None and live.spec.node_name:
+                        if live.spec.node_name != node_name:
+                            metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                                reason="already_bound"
+                            ).inc()
+                        continue
+                    self.cluster.bind(live if live is not None else pod, node_name)
                 except Exception:
                     ok = False
                     logger.exception("binding pod %s", pod.key)
@@ -417,12 +467,17 @@ class ProvisioningController:
         start_workers: bool = True,
         default_solver: str = SOLVER_FFD,
         solver_service_address: Optional[str] = None,
+        ownership=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
         self.default_solver = default_solver
         self.solver_service_address = solver_service_address
+        # fleet.ShardManager (or None = this replica owns everything):
+        # reconcile only runs workers for owned shards, and each worker's
+        # launch path re-checks through the same manager
+        self.ownership = ownership
         self.workers: Dict[str, ProvisionerWorker] = {}  # guarded-by: self._lock
         self._hashes: Dict[str, int] = {}  # guarded-by: self._lock
         # provisioners with a live gauge series — a failed Apply never
@@ -438,6 +493,12 @@ class ProvisioningController:
         if provisioner is None or provisioner.metadata.deletion_timestamp is not None:
             self._teardown(name)
             return None
+        if self.ownership is not None and not self.ownership.owns(name):
+            # another replica's shard: never run a worker for it here (the
+            # split-brain P0 — two workers would double-launch its pods).
+            # Re-check on a lease-scale cadence so a rebalance lands fast.
+            self._teardown(name)
+            return OWNERSHIP_RECHECK_INTERVAL
         # Active condition lifecycle (reference: provisioner_status.go:38-41,
         # the knative living ``Active`` set): every Apply outcome lands in
         # status.conditions, and the status write happens only on change so
@@ -531,9 +592,14 @@ class ProvisioningController:
                 self.workers[provisioner.name].provisioner = enriched
                 return
             old = self.workers.pop(provisioner.name, None)
+            name = provisioner.name
             worker = ProvisionerWorker(
                 enriched, self.cluster, self.cloud_provider,
                 solver_service_address=self.solver_service_address,
+                owned=(
+                    (lambda: self.ownership.owns(name))
+                    if self.ownership is not None else None
+                ),
             )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h
@@ -574,6 +640,17 @@ class ProvisioningController:
             metrics.PROVISIONER_ACTIVE.remove(name)
         except KeyError:
             pass
+
+    def release_shard(self, name: str) -> None:
+        """``ShardManager.on_lost`` hook: stop this provisioner's worker
+        SYNCHRONOUSLY — by the time the lease duration elapses and a
+        survivor claims the shard, this replica must no longer be solving,
+        launching, or binding for it."""
+        with self._lock:
+            worker = self.workers.pop(name, None)
+            self._hashes.pop(name, None)
+        if worker:
+            worker.stop()
 
     def list_workers(self) -> List[ProvisionerWorker]:
         """Active workers sorted by provisioner name — selection priority

@@ -306,6 +306,16 @@ class SelectionController:
             w.is_pending(pod.key) for w in workers if hasattr(w, "is_pending")
         ):
             return True
+        if self._defer_to_foreign_owner(pod):
+            # fleet mode (docs/fleet.md): the FIRST provisioner (in the
+            # same sorted-name priority order single-replica selection
+            # uses) that admits this pod belongs to another replica's
+            # shard — that replica's selection loop serves it. Requeue
+            # quietly; proceeding here would double-provision pods two
+            # shards both admit, and raising would RELAX a preference per
+            # retry on a pod this replica must not touch (pods are shared
+            # objects).
+            return False
         self.preferences.relax(pod)
         self.volume_topology.inject(pod)
         if not workers:
@@ -323,6 +333,29 @@ class SelectionController:
         raise NoProvisionerMatched(
             f"pod {pod.key} matched 0/{len(workers)} provisioners: {'; '.join(errs)}"
         )
+
+    def _defer_to_foreign_owner(self, pod: Pod) -> bool:
+        """True when the FIRST cluster-wide provisioner (sorted by name —
+        the same priority order ``list_workers`` serves single-replica
+        selection in) that admits this pod belongs to another replica's
+        shard. Exactly ONE replica answers False per pod, so overlapping
+        provisioners split across shards never double-provision it. The
+        ownership check short-circuits first: non-fleet deployments pay
+        nothing here. The admission check runs against the raw spec — more
+        permissive than the owner's catalog-enriched view; on the rare
+        divergence the owner's own retry/relax loop still serves the pod."""
+        ownership = getattr(self.provisioners, "ownership", None)
+        if ownership is None:
+            return False
+        for prov in sorted(
+            self.cluster.provisioners(), key=lambda p: p.metadata.name
+        ):
+            if prov.metadata.deletion_timestamp is not None:
+                continue
+            if prov.spec.constraints.validate_pod(pod):
+                continue  # does not admit; next priority
+            return not ownership.owns(prov.metadata.name)
+        return False
 
 
 class NoProvisionerMatched(Exception):

@@ -1,0 +1,24 @@
+"""Fleet-scale horizontal availability (docs/fleet.md).
+
+Provisioners are partitioned across N controller replicas by per-shard
+leases (``utils.lease.FileLeaseSet`` / ``kube.leader.KubeLeaseSet``):
+each replica heartbeats its membership, claims the shards rendezvous
+hashing assigns it among the live members, and renews them on a cadence.
+A replica that stops renewing loses every shard within one lease duration
+and survivors take them over — losing a replica degrades capacity, never
+availability.
+"""
+
+from karpenter_tpu.fleet.ownership import (
+    DEFAULT_SHARD,
+    ShardManager,
+    build_lease_set,
+    rendezvous_owner,
+)
+
+__all__ = [
+    "DEFAULT_SHARD",
+    "ShardManager",
+    "build_lease_set",
+    "rendezvous_owner",
+]

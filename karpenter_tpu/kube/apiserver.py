@@ -394,6 +394,17 @@ class ApiCluster(Cluster):
             _raise_for(status, str(doc))
         return serde.from_wire(kind, doc)
 
+    def list_live(self, kind: str, namespace: Optional[str] = None):
+        """Uncached collection GET straight from the server. The fleet
+        shard-lease set (kube/leader.py ``KubeLeaseSet``) must see PEER
+        replicas' lease objects, and leases are deliberately not
+        informer-watched (WATCH_KINDS) — the cached ``list`` only ever
+        shows this process's own writes for those kinds."""
+        status, doc = self._request("GET", self._path(kind, namespace))
+        if status != 200:
+            _raise_for(status, str(doc))
+        return [serde.from_wire(kind, item) for item in doc.get("items") or []]
+
     # -- mutations (REST) --------------------------------------------------
     def create(self, kind: str, obj):
         status, doc = self._request(

@@ -51,6 +51,7 @@ class Runtime:
     webhook: Webhook
     servers: list = None  # HTTP servers (metrics, health) when serving
     elector: object = None  # LeaderElector when a lease is configured
+    ownership: object = None  # fleet.ShardManager when shard leases are configured
     log_watcher: object = None  # LogLevelWatcher when a config file is set
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
@@ -59,6 +60,11 @@ class Runtime:
             # cancel BEFORE restore: a freeze landing after restore() would
             # leak the frozen heap this stop exists to undo
             self._gc_freeze_cancel.set()
+        if self.ownership is not None:
+            # releases every shard lease (and fires on_lost per shard) so
+            # survivors rebalance immediately instead of waiting out the
+            # lease duration; a crash()-ed manager skips the release
+            self.ownership.stop()
         self.manager.stop()
         self.provisioning.stop()
         self.termination.stop()
@@ -196,6 +202,7 @@ def build_runtime(
     start_workers: bool = True,
     allow_pod_affinity: bool = True,
     consolidation_enabled: Optional[bool] = None,
+    shard_identity: Optional[str] = None,
 ) -> Runtime:
     """Assemble (but do not start) the full controller process."""
     options = options or Options()
@@ -208,6 +215,24 @@ def build_runtime(
     # (reference: cmd/controller/main.go:81 → metrics/cloudprovider.go:66)
     cloud_provider = cpmetrics.decorate(cloud_provider)
 
+    # fleet sharding (docs/fleet.md): this replica runs workers only for the
+    # provisioner shards whose lease it holds; the manager's claim/renew
+    # loop starts in run_controller_process (tests drive tick() inline)
+    ownership = None
+    if options.shard_lease:
+        from karpenter_tpu.fleet import ShardManager, build_lease_set
+
+        lease_set = build_lease_set(
+            options.shard_lease,
+            cluster=cluster,
+            identity=shard_identity,
+            duration=options.shard_lease_duration,
+        )
+        ownership = ShardManager(
+            lease_set,
+            keys_fn=lambda: [p.metadata.name for p in cluster.provisioners()],
+        )
+
     manager = Manager(cluster)
     provisioning = ProvisioningController(
         cluster,
@@ -215,6 +240,7 @@ def build_runtime(
         start_workers=start_workers,
         default_solver=options.default_solver,
         solver_service_address=options.solver_service_address or None,
+        ownership=ownership,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,
@@ -227,7 +253,8 @@ def build_runtime(
     )
     termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
     interruption = InterruptionController(
-        cluster, cloud_provider, provisioning=provisioning, termination=termination
+        cluster, cloud_provider, provisioning=provisioning, termination=termination,
+        ownership=ownership,
     )
     node = NodeController(cluster, cloud_provider=cloud_provider)
     consolidation = ConsolidationController(
@@ -236,6 +263,7 @@ def build_runtime(
         enabled=consolidation_enabled,
         solver_service_address=options.solver_service_address or None,
         wave_size=options.consolidation_wave_size,
+        ownership=ownership,
     )
     counter = CounterController(cluster)
     pvc = PVCController(cluster)
@@ -254,6 +282,13 @@ def build_runtime(
     manager.register("pvc", pvc.reconcile, concurrency=2)
     manager.register("metrics_node", metrics_node.reconcile, concurrency=2)
     manager.register("metrics_pod", metrics_pod.reconcile, concurrency=2)
+
+    if ownership is not None:
+        # a gained shard reconciles immediately (the worker must exist
+        # before the owner's selection loop can route pods to it); a lost
+        # shard stops its worker SYNCHRONOUSLY — the split-brain P0
+        ownership.on_acquired = lambda name: manager.enqueue("provisioning", name)
+        ownership.on_lost = provisioning.release_shard
 
     # watches
     cluster.watch(
@@ -281,6 +316,7 @@ def build_runtime(
         termination=termination,
         interruption=interruption,
         webhook=Webhook(cloud_provider, default_solver=options.default_solver),
+        ownership=ownership,
     )
 
 
@@ -350,6 +386,14 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
         runtime.elector.start()
         logger.info("waiting for leadership (%s)", spec)
         runtime.elector.wait_for_leadership()
+    if runtime.ownership is not None:
+        # unlike leader election there is nothing to wait for: the replica
+        # serves whatever shards it wins, starting with none
+        runtime.ownership.start()
+        logger.info(
+            "fleet sharding active (%s, identity %s)",
+            runtime.options.shard_lease, runtime.ownership.identity,
+        )
     runtime.manager.start()
     if serve:
         _serve_endpoints(runtime)

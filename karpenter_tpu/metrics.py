@@ -330,6 +330,79 @@ CLOUDPROVIDER_BREAKER_SHORTCIRCUIT = Counter(
     registry=REGISTRY,
 )
 
+# Fleet-scale HA (karpenter_tpu/fleet): per-provisioner shard leases across
+# controller replicas, and the failover-aware solver sidecar pool. Shard
+# ownership must be visible per replica — a rebalance storm or a stuck
+# duplicate-launch guard is invisible in logs at fleet scale.
+FLEET_SHARDS_OWNED = Gauge(
+    "shards_owned",
+    "Provisioner shards this controller replica currently holds the lease "
+    "for (the fleet's shard counts should sum to the provisioner count).",
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)
+
+FLEET_REBALANCES = Counter(
+    "shard_rebalances_total",
+    "Shard takeovers: acquisitions of a shard lease previously held by a "
+    "different replica (rebalance-on-death or membership change).",
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)
+
+FLEET_SHARD_LOSSES = Counter(
+    "shard_losses_total",
+    "Shard leases this replica failed to renew and released its workers "
+    "for (at most once per holding epoch).",
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)
+
+FLEET_DUPLICATE_LAUNCH_GUARD = Counter(
+    "duplicate_launch_guard_total",
+    "Launches or binds skipped by the fleet split-brain guards, by reason "
+    "(lost_ownership: shard lease gone mid-round; already_bound: the live "
+    "pod was bound by another replica between solve and bind).",
+    ["reason"],
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)
+
+FLEET_FOREIGN_NOTICES = Counter(
+    "foreign_notices_total",
+    "Disruption notices drained by a replica that does not own the node's "
+    "shard — requeued to the provider stream for the owner to pick up.",
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)
+
+# Solver sidecar pool: consistent-hash routing on the catalog session key
+# with per-member breakers — a failover means a catalog re-upload on the
+# next member, so the rate must be scrapeable next to the session metrics.
+SOLVER_POOL_FAILOVERS = Counter(
+    "pool_failovers_total",
+    "Solves rerouted off a dead or breaker-open sidecar pool member, "
+    "labeled by the FAILED member's address.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_POOL_MEMBERS = Gauge(
+    "pool_members_available",
+    "Sidecar pool members currently admitting solves (breaker closed or "
+    "probe-ready).",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
 # Per-stage solve latency, observed by the provisioning worker after each
 # batch (sort / inject / encode / wire_ser / pack_fetch / wire_deser /
 # decode) — the <100ms p99 target's attribution on the scrape, not only in

@@ -31,6 +31,8 @@ class Options:
     kube_api_server: str = field(default_factory=lambda: _env("KUBE_API_SERVER", ""))
     # solver knobs (new in this framework)
     default_solver: str = field(default_factory=lambda: _env("KARPENTER_SOLVER", "ffd"))
+    # one sidecar address, or a comma-separated POOL of them (consistent-hash
+    # session routing + per-member breakers + ring failover, solver/pool.py)
     solver_service_address: str = field(
         default_factory=lambda: _env("SOLVER_SERVICE_ADDRESS", "")
     )  # empty = in-process
@@ -45,6 +47,14 @@ class Options:
     # no election (reference: cmd/controller/main.go:84-85)
     leader_election_lease: str = field(
         default_factory=lambda: _env("LEADER_ELECTION_LEASE", "")
+    )
+    # fleet sharding (docs/fleet.md): per-provisioner shard leases instead
+    # of (not alongside) whole-process leader election. A shared lease-set
+    # file path, or kube:<namespace>/<prefix> for Lease objects; empty =
+    # this replica owns every provisioner.
+    shard_lease: str = field(default_factory=lambda: _env("SHARD_LEASE", ""))
+    shard_lease_duration: float = field(
+        default_factory=lambda: float(_env("SHARD_LEASE_DURATION", "15"))
     )
     # live log-level reload source (the mounted config-logging key); empty =
     # static level from LOG_LEVEL
@@ -73,6 +83,13 @@ class Options:
             errs.append("kube client burst must be positive")
         if self.consolidation_wave_size <= 0:
             errs.append("consolidation wave size must be positive")
+        if self.shard_lease_duration <= 0:
+            errs.append("shard lease duration must be positive seconds")
+        if self.shard_lease and self.leader_election_lease:
+            errs.append(
+                "shard leases replace leader election — set only one of "
+                "--shard-lease / --leader-election-lease"
+            )
         if self.flight_budget_ms <= 0:
             errs.append("flight budget must be positive milliseconds")
         if self.default_solver not in ("ffd", "tpu"):
@@ -100,6 +117,16 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
     ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
+    ap.add_argument(
+        "--shard-lease", default=opts.shard_lease,
+        help="fleet sharding: lease-set file path or kube:<ns>/<prefix> "
+        "('' = this replica owns every provisioner; replaces leader election)",
+    )
+    ap.add_argument(
+        "--shard-lease-duration", type=float, default=opts.shard_lease_duration,
+        help="seconds a shard lease lives without renewal (failover "
+        "completes within ~2x this)",
+    )
     ap.add_argument("--log-config-file", default=opts.log_config_file)
     ap.add_argument("--log-level", default=opts.log_level)
     ap.add_argument(
@@ -145,6 +172,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         consolidation_enabled=ns.consolidation,
         consolidation_wave_size=ns.consolidation_wave_size,
         leader_election_lease=ns.leader_election_lease,
+        shard_lease=ns.shard_lease,
+        shard_lease_duration=ns.shard_lease_duration,
         log_config_file=ns.log_config_file,
         log_level=ns.log_level,
         trace_enabled=ns.trace,

@@ -571,15 +571,27 @@ class TpuScheduler:
 
     def _remote_or_init(self):
         if self._remote is None:
-            from karpenter_tpu.solver.service import RemoteSolver
-
             # under-lock init: the router's device shadow probe can
             # reach here concurrently with a cold-starting solve
             with self._remote_init_lock:
                 if self._remote is None:
-                    self._remote = RemoteSolver(
-                        self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
-                    )
+                    if "," in self.service_address:
+                        # sidecar POOL: consistent-hash session routing with
+                        # per-member breakers and ring failover; this outer
+                        # breaker then only trips when the whole pool is
+                        # exhausted (solver/pool.py)
+                        from karpenter_tpu.solver.pool import SolverPool
+
+                        self._remote = SolverPool(
+                            self.service_address.split(","),
+                            timeout=REMOTE_SOLVE_TIMEOUT,
+                        )
+                    else:
+                        from karpenter_tpu.solver.service import RemoteSolver
+
+                        self._remote = RemoteSolver(
+                            self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
+                        )
         return self._remote
 
     def _remote_failure(self, e: Exception) -> None:

@@ -1,0 +1,287 @@
+"""Failover-aware solver sidecar pool (docs/fleet.md).
+
+``RemoteSolver`` talks to ONE sidecar; at fleet scale the controller fronts
+a POOL of them. Routing is a consistent-hash ring keyed on the PR-4
+``catalog_session_key`` — a catalog generation's pinned tensors live in
+exactly one member's HBM, so the steady state stays a delta solve against
+a resident session and members don't each burn HBM on every catalog.
+
+Failure handling is per member: each address gets its own circuit breaker
+(window 1 / min_volume 1, same any-failure-trips contract as the old
+single-address breaker in ``solver/backend.py``), and a dead or
+breaker-open member reroutes the solve to the next ring member — where the
+member's own ``RemoteSolver`` transparently re-uploads the catalog through
+the NEEDS_CATALOG path. Only when EVERY member refuses does the pool raise,
+which the scheduler's outer remote breaker turns into the in-process kernel
+and ultimately the FFD floor — the degradation ladder keeps its shape, the
+pool just adds rungs above it.
+
+The failover cost is attributed: each reroute increments
+``karpenter_solver_pool_failovers_total{address=<failed member>}`` and runs
+under a ``solver.pool.failover`` span carrying from/to, so a PR-5 trace of
+a slow solve shows exactly which member died and what the detour cost.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from bisect import bisect_right
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from karpenter_tpu import metrics
+from karpenter_tpu.solver.service import (
+    N_POD_ARRAYS,
+    CatalogKeyMemo,
+    RemoteSolver,
+)
+
+logger = logging.getLogger("karpenter.solver.pool")
+
+# per-member breaker: any failure sidelines the member (one bounded stall,
+# not one per solve), half-open probes re-admit it once it answers again
+MEMBER_BREAKER_SECONDS = 15.0
+
+# virtual nodes per member: enough that an 8-member pool's key space splits
+# within a few percent of even, cheap enough to rebuild on membership change
+RING_VNODES = 64
+
+
+class PoolExhausted(RuntimeError):
+    """Every pool member was dead or breaker-open for this solve."""
+
+
+class HashRing:
+    """Consistent-hash ring over member addresses. ``ordered(key)`` yields
+    every member exactly once, starting from the key's ring successor —
+    the failover ladder's member order."""
+
+    def __init__(self, members: Sequence[str], vnodes: int = RING_VNODES):
+        if not members:
+            raise ValueError("hash ring needs at least one member")
+        self.members = list(dict.fromkeys(members))  # stable order, deduped
+        points: List[Tuple[int, str]] = []
+        for member in self.members:
+            for i in range(vnodes):
+                digest = hashlib.blake2b(
+                    f"{member}#{i}".encode(), digest_size=8
+                ).digest()
+                points.append((int.from_bytes(digest, "big"), member))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    @staticmethod
+    def _key_point(key: bytes) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(key, digest_size=8).digest(), "big"
+        )
+
+    def route(self, key: bytes) -> str:
+        return self.ordered(key)[0]
+
+    def ordered(self, key: bytes) -> List[str]:
+        start = bisect_right(self._hashes, self._key_point(key))
+        seen: "OrderedDict[str, None]" = OrderedDict()
+        n = len(self._points)
+        for i in range(n):
+            _, member = self._points[(start + i) % n]
+            if member not in seen:
+                seen[member] = None
+                if len(seen) == len(self.members):
+                    break
+        return list(seen)
+
+
+class SolverPool:
+    """Drop-in for :class:`RemoteSolver` over N sidecar addresses: same
+    ``pack_begin(...) -> wait()`` / ``pack`` / ``health`` surface, so
+    ``TpuScheduler`` treats a pool and a single sidecar identically."""
+
+    KEY_MEMO_MAX = 8
+
+    def __init__(
+        self,
+        addresses: Sequence[str],
+        timeout: float = 30.0,
+        cold_timeout: float = 180.0,
+        breaker_open_seconds: float = MEMBER_BREAKER_SECONDS,
+        client_factory: Optional[Callable[[str], RemoteSolver]] = None,
+    ):
+        addresses = [a.strip() for a in addresses if a.strip()]
+        self.ring = HashRing(addresses)
+        self.addresses = self.ring.members
+        self._timeout = timeout
+        self._cold_timeout = cold_timeout
+        self._client_factory = client_factory or (
+            lambda addr: RemoteSolver(
+                addr, timeout=timeout, cold_timeout=cold_timeout
+            )
+        )
+        from karpenter_tpu.resilience import BreakerBoard
+
+        # one breaker per member address; the board handles lazy creation
+        self._breakers = BreakerBoard(
+            window=1, min_volume=1, failure_rate=0.5,
+            open_seconds=breaker_open_seconds,
+        )
+        self._clients: dict = {}  # guarded-by: self._mu
+        self._key_memo = CatalogKeyMemo(self.KEY_MEMO_MAX)
+        self.failovers = 0  # guarded-by: self._mu
+        self._mu = threading.Lock()
+
+    # -- members ------------------------------------------------------------
+    def _client(self, address: str) -> RemoteSolver:
+        with self._mu:
+            client = self._clients.get(address)
+            if client is None:
+                client = self._clients[address] = self._client_factory(address)
+            return client
+
+    def _breaker(self, address: str):
+        return self._breakers.get(f"solver-pool:{address}")
+
+    def _member_failure(self, address: str, exc: Exception) -> None:
+        tripped = self._breaker(address).record_failure()
+        metrics.SOLVER_BREAKER_OPEN.labels(address=address).set(1)
+        if tripped:
+            metrics.SOLVER_BREAKER_TRIPS.labels(address=address).inc()
+        logger.error(
+            "solver pool member %s failed (%s); rerouting", address, exc
+        )
+        self._publish_available()
+
+    def _member_success(self, address: str) -> None:
+        self._breaker(address).record_success()
+        metrics.SOLVER_BREAKER_OPEN.labels(address=address).set(0)
+        self._publish_available()
+
+    def _publish_available(self) -> None:
+        metrics.SOLVER_POOL_MEMBERS.set(len(self.available_members()))
+
+    def available_members(self) -> List[str]:
+        """Members currently admitting solves (breaker closed/probe-ready)."""
+        return [a for a in self.addresses if self._breaker(a).available()]
+
+    def health(self, timeout: float = 2.0) -> bool:
+        """True when ANY member reports SERVING."""
+        return any(
+            self._client(a).health(timeout=timeout) for a in self.addresses
+        )
+
+    # -- routing ------------------------------------------------------------
+    def _catalog_key(self, catalog_side: Tuple) -> bytes:
+        """Identity-memoized catalog fingerprint (shared
+        ``CatalogKeyMemo`` implementation) — the ring key must be the SAME
+        content key the member pins its session under."""
+        return self._key_memo.key(catalog_side)
+
+    # -- solves -------------------------------------------------------------
+    def pack_begin(
+        self, *inputs, n_max: int, prof: Optional[dict] = None, record: bool = True
+    ):
+        """Route by session affinity, dispatch on the first admitting
+        member, and return ``wait()``. A dispatch failure tries the next
+        ring member immediately; a FETCH failure (discovered inside
+        ``wait``) fails over synchronously — the overlap is already lost,
+        correctness wins."""
+        catalog_side = inputs[N_POD_ARRAYS:]
+        key = self._catalog_key(catalog_side)
+        order = self.ring.ordered(key)
+        last_exc: Optional[Exception] = None
+        for i, address in enumerate(order):
+            breaker = self._breaker(address)
+            if not breaker.allow():
+                # rerouted off a breaker-open member: the solve lands on a
+                # non-affine member, so it counts as a failover (the
+                # session re-homes there until the breaker re-admits)
+                self._count_failover(address)
+                continue
+            client = self._client(address)
+            try:
+                pending = client.pack_begin(
+                    *inputs, n_max=n_max, prof=prof, record=record
+                )
+            except Exception as e:
+                last_exc = e
+                self._member_failure(address, e)
+                self._count_failover(address)
+                continue
+            return self._wrap_wait(
+                pending, address, order[i + 1:], inputs, n_max, prof, record
+            )
+        raise PoolExhausted(
+            f"no solver pool member available (tried {order}): {last_exc}"
+        )
+
+    def _count_failover(self, failed: str) -> None:
+        metrics.SOLVER_POOL_FAILOVERS.labels(address=failed).inc()
+        with self._mu:
+            self.failovers += 1
+
+    def _wrap_wait(
+        self, pending, address: str, remaining: List[str],
+        inputs, n_max: int, prof: Optional[dict], record: bool,
+    ):
+        def wait():
+            try:
+                out = pending()
+            except Exception as e:
+                self._member_failure(address, e)
+                return self._failover(
+                    address, remaining, inputs, n_max, prof, record, e
+                )
+            self._member_success(address)
+            return out
+
+        return wait
+
+    def _failover(
+        self, failed: str, remaining: List[str],
+        inputs, n_max: int, prof: Optional[dict], record: bool,
+        cause: Exception,
+    ):
+        from karpenter_tpu import obs
+
+        last_exc: Exception = cause
+        for address in remaining:
+            breaker = self._breaker(address)
+            if not breaker.allow():
+                continue
+            self._count_failover(failed)
+            # synchronous on the surviving member: its RemoteSolver's
+            # NEEDS_CATALOG path re-uploads the session transparently
+            with obs.tracer().span(
+                "solver.pool.failover",
+                attrs={"from": failed, "to": address},
+            ):
+                client = self._client(address)
+                try:
+                    out = client.pack_begin(
+                        *inputs, n_max=n_max, prof=prof, record=record
+                    )()
+                except Exception as e:
+                    last_exc = e
+                    self._member_failure(address, e)
+                    failed = address
+                    continue
+            self._member_success(address)
+            return out
+        raise PoolExhausted(
+            f"solver pool exhausted after failover (last member error: {last_exc})"
+        )
+
+    def pack(self, *inputs, n_max: int):
+        """Synchronous convenience wrapper over ``pack_begin``."""
+        return self.pack_begin(*inputs, n_max=n_max)()
+
+    def close(self) -> None:
+        with self._mu:
+            clients = list(self._clients.values())
+        for client in clients:
+            try:
+                client.close()
+            except Exception:
+                pass

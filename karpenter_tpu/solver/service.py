@@ -191,6 +191,35 @@ def _key_array(key: bytes) -> np.ndarray:
     return np.frombuffer(key, np.int32)
 
 
+class CatalogKeyMemo:
+    """Identity-memoized :func:`catalog_session_key`: the encode closure
+    memo freezes and reuses the catalog-side arrays across solves, so the
+    steady state never re-hashes the multi-MB join table. Entries hold a
+    strong ref to the arrays so the memo ids stay valid for each entry's
+    lifetime. Shared by :class:`RemoteSolver` (per member) and the sidecar
+    pool's ring router (solver/pool.py) — one implementation, one drift
+    surface."""
+
+    def __init__(self, max_entries: int = 8):
+        self.max_entries = max_entries
+        self._memo: "OrderedDict[tuple, tuple]" = OrderedDict()  # guarded-by: self._lock
+        self._lock = threading.Lock()
+
+    def key(self, catalog_side: Tuple) -> bytes:
+        id_key = tuple(map(id, catalog_side))
+        with self._lock:
+            hit = self._memo.get(id_key)
+            if hit is not None:
+                self._memo.move_to_end(id_key)
+                return hit[1]
+        key = catalog_session_key(*[np.asarray(a) for a in catalog_side])
+        with self._lock:
+            self._memo[id_key] = (tuple(catalog_side), key)
+            while len(self._memo) > self.max_entries:
+                self._memo.popitem(last=False)
+        return key
+
+
 def _status_response(status: int, payload: Sequence[np.ndarray] = ()) -> bytes:
     return pack_arrays([np.array([status], np.int32), *payload])
 
@@ -664,7 +693,7 @@ class RemoteSolver:
         # restart orphans them server-side — NEEDS_CATALOG triggers the
         # transparent re-open
         self._opened: "OrderedDict[bytes, bool]" = OrderedDict()  # guarded-by: self._lock
-        self._key_memo: "OrderedDict[tuple, tuple]" = OrderedDict()  # guarded-by: self._lock
+        self._key_memo = CatalogKeyMemo(self.KEY_MEMO_MAX)
         self.session_uploads = 0  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._channel = grpc.insecure_channel(
@@ -688,21 +717,7 @@ class RemoteSolver:
     # -- sessions -----------------------------------------------------------
 
     def _catalog_key(self, catalog_side: Tuple) -> bytes:
-        """Fingerprint the catalog-side arrays, memoized by identity: the
-        encode closure memo freezes and reuses these arrays across solves,
-        so the steady state never re-hashes the multi-MB join table."""
-        id_key = tuple(map(id, catalog_side))
-        with self._lock:
-            hit = self._key_memo.get(id_key)
-            if hit is not None:
-                self._key_memo.move_to_end(id_key)
-                return hit[1]
-        key = catalog_session_key(*catalog_side)
-        with self._lock:
-            self._key_memo[id_key] = (tuple(catalog_side), key)
-            while len(self._key_memo) > self.KEY_MEMO_MAX:
-                self._key_memo.popitem(last=False)
-        return key
+        return self._key_memo.key(catalog_side)
 
     def _open_session(
         self,

@@ -184,3 +184,111 @@ def chaos_wrap(api, policy: ChaosPolicy, clock=time.monotonic) -> ChaosProxy:
     the bare double went — ``SimulatedCloudProvider(api=...)``,
     ``GkeCloudProvider(api=...)``, ``CloudAPIServer(api=...)``."""
     return ChaosProxy(api, policy, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale scenarios (docs/fleet.md): replica-kill and sidecar-kill
+# ---------------------------------------------------------------------------
+
+
+class SidecarChaos:
+    """A pool of in-process solver sidecars with kill/restart controls.
+
+    ``kill`` stops a member's gRPC server with zero grace — in-flight RPCs
+    fail exactly like a SIGKILL'd pod's would. ``restart`` serves the SAME
+    address again with a FRESH ``SolverService`` (empty session store), so
+    clients that remembered the address's sessions hit NEEDS_CATALOG, the
+    restart-recovery path the pool's failover ladder must absorb."""
+
+    def __init__(self, n: int = 2, max_workers: int = 4):
+        from karpenter_tpu.solver.service import serve
+
+        self._serve = serve
+        self._max_workers = max_workers
+        self.servers: Dict[str, object] = {}
+        self.addresses: list = []
+        for _ in range(n):
+            address = f"127.0.0.1:{self._free_port()}"
+            self.addresses.append(address)
+            self.servers[address] = serve(address, max_workers=max_workers)
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    @property
+    def address_spec(self) -> str:
+        """The comma-joined pool spec ``--solver-service-address`` takes."""
+        return ",".join(self.addresses)
+
+    def busiest(self) -> str:
+        """The member holding the most pinned sessions — killing IT (not a
+        cold spare) is what actually exercises failover + re-upload."""
+        return max(
+            self.servers,
+            key=lambda a: self.servers[a].solver_service.session_count(),
+        )
+
+    def kill(self, address: str) -> None:
+        server = self.servers.pop(address, None)
+        if server is not None:
+            server.stop(grace=0)
+
+    def restart(self, address: str) -> None:
+        """Fresh process-equivalent on the same address: empty session
+        store, immediate readiness."""
+        self.kill(address)
+        self.servers[address] = self._serve(
+            address, max_workers=self._max_workers
+        )
+
+    def stop_all(self) -> None:
+        for address in list(self.servers):
+            self.kill(address)
+
+
+class ReplicaChaos:
+    """Controller-replica kill/restart over a shared cluster + lease set.
+
+    Replicas are ``main.Runtime`` objects (each with a ``fleet.ShardManager``).
+    ``kill`` is a CRASH: the shard manager dies without releasing its
+    leases, so survivors must wait out the lease duration and take the dead
+    replica's shards over — the rebalance-on-death path the acceptance
+    criteria time-bound to 2x the lease duration."""
+
+    def __init__(self):
+        self.replicas: Dict[str, object] = {}
+        self.killed: Dict[str, object] = {}
+
+    def add(self, name: str, runtime) -> None:
+        self.replicas[name] = runtime
+
+    def kill(self, name: str) -> None:
+        runtime = self.replicas.pop(name)
+        self.killed[name] = runtime
+        if runtime.ownership is not None:
+            runtime.ownership.crash()  # no lease release: a real SIGKILL
+        runtime.stop()
+
+    def owner_named(self, shard: str):
+        """(replica name, runtime) currently owning ``shard`` among the
+        LIVE replicas, or (None, None)."""
+        for name, runtime in self.replicas.items():
+            if runtime.ownership is not None and runtime.ownership.owns(shard):
+                return name, runtime
+        return None, None
+
+    def owned_shards(self) -> Dict[str, frozenset]:
+        return {
+            name: frozenset(rt.ownership.owned())
+            for name, rt in self.replicas.items()
+            if rt.ownership is not None
+        }
+
+    def stop_all(self) -> None:
+        for name in list(self.replicas):
+            self.replicas.pop(name).stop()

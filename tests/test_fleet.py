@@ -1,0 +1,908 @@
+"""Fleet-scale HA tests (docs/fleet.md): the keyed lease set, the shard
+manager's claim/renew/handback/takeover protocol, the provisioning and
+interruption ownership guards, the duplicate-launch/bind guards, and the
+replica-kill chaos e2e — three live replicas over one cluster, the owner of
+a mid-storm shard crashed, every pod still binds exactly once."""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.fleet import DEFAULT_SHARD, ShardManager, build_lease_set, rendezvous_owner
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.utils.lease import FileLease, FileLeaseSet, LeaderElector
+from tests.factories import make_pod, make_provisioner
+
+pytestmark = pytest.mark.fleet
+
+
+def _lease_path(tmp_path):
+    return str(tmp_path / "shards.lease")
+
+
+class TestFileLeaseSatellites:
+    def test_holder_reads_under_the_flock(self, tmp_path, monkeypatch):
+        """holder() must serialize against writers — regression for the
+        torn-read satellite: it now enters the same flock as acquire/renew."""
+        path = str(tmp_path / "lease")
+        lease = FileLease(path, identity="a", duration=10)
+        assert lease.try_acquire()
+        entered = []
+        orig = FileLease._locked
+
+        def spying_locked(self):
+            entered.append(True)
+            return orig(self)
+
+        monkeypatch.setattr(FileLease, "_locked", spying_locked)
+        assert lease.holder() == "a"
+        assert entered, "holder() bypassed the flock"
+
+    def test_stale_tmp_files_swept_on_acquire(self, tmp_path):
+        path = str(tmp_path / "lease")
+        stale = f"{path}.dead-writer.tmp"
+        with open(stale, "w") as f:
+            f.write("{")
+        # age it past the sweep horizon
+        old = time.time() - 3600
+        os.utime(stale, (old, old))
+        fresh = f"{path}.live-writer.tmp"
+        with open(fresh, "w") as f:
+            f.write("{")
+        FileLease(path, identity="a", duration=10).try_acquire()
+        assert not os.path.exists(stale), "stale tmp survived the sweep"
+        assert os.path.exists(fresh), "a fresh (possibly mid-RMW) tmp was removed"
+
+
+class TestLeaderElectorAtMostOnce:
+    def test_on_lost_fires_once_per_epoch(self, tmp_path):
+        calls = []
+        elector = LeaderElector(
+            FileLease(str(tmp_path / "l"), identity="x"),
+            on_lost=lambda: calls.append(1),
+        )
+        elector._acquired()
+        # the failed-renew branch and the raising-backend branch race: both
+        # call _fire_lost for the same epoch — only one may fire
+        elector._fire_lost()
+        elector._fire_lost()
+        assert calls == [1]
+        # a fresh epoch fires again
+        elector._acquired()
+        elector._fire_lost()
+        assert calls == [1, 1]
+
+    def test_clean_stop_consumes_the_epoch_without_firing(self, tmp_path):
+        calls = []
+        lease = FileLease(str(tmp_path / "l"), identity="x")
+        elector = LeaderElector(lease, on_lost=lambda: calls.append(1))
+        assert lease.try_acquire()
+        elector._acquired()
+        elector.stop()
+        # a straggling elector-thread branch observing the loss afterwards
+        elector._fire_lost()
+        assert calls == []
+
+    def test_raising_backend_fires_once_and_thread_survives(self, tmp_path):
+        calls = []
+
+        class RaisingLease:
+            def __init__(self):
+                self.acquired = threading.Event()
+                self.raising = False
+
+            def try_acquire(self):
+                # while the backend is down nothing re-acquires: a fresh
+                # acquisition would legitimately start a NEW epoch
+                return not self.raising
+
+            def renew(self):
+                if self.raising:
+                    raise RuntimeError("backend down")
+                return True
+
+            def release(self):
+                pass
+
+        lease = RaisingLease()
+        elector = LeaderElector(
+            lease, renew_interval=0.02, on_lost=lambda: calls.append(1)
+        )
+        elector.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not elector.is_leader:
+            time.sleep(0.01)
+        assert elector.is_leader
+        lease.raising = True
+        deadline = time.time() + 5
+        while time.time() < deadline and not calls:
+            time.sleep(0.01)
+        time.sleep(0.1)  # more raising renew ticks pass
+        assert calls == [1], "on_lost fired more than once for one epoch"
+        elector.stop()
+
+
+class TestFileLeaseSet:
+    def test_acquire_renew_release_roundtrip(self, tmp_path):
+        now = [0.0]
+        a = FileLeaseSet(_lease_path(tmp_path), identity="a", duration=10, clock=lambda: now[0])
+        b = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        assert a.try_acquire("p0")
+        assert not b.try_acquire("p0")
+        assert a.holder("p0") == "a"
+        assert a.renew_many(["p0"]) == {"p0"}
+        a.release("p0")
+        assert b.try_acquire("p0")
+
+    def test_expired_hold_is_taken_over(self, tmp_path):
+        now = [0.0]
+        a = FileLeaseSet(_lease_path(tmp_path), identity="a", duration=10, clock=lambda: now[0])
+        b = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        assert a.try_acquire("p0")
+        now[0] = 11.0
+        assert a.holder("p0") is None
+        assert b.try_acquire("p0")
+        # the old holder's renew must now fail — takeover won
+        assert a.renew_many(["p0"]) == set()
+
+    def test_membership_heartbeat_and_expiry(self, tmp_path):
+        now = [0.0]
+        a = FileLeaseSet(_lease_path(tmp_path), identity="a", duration=10, clock=lambda: now[0])
+        b = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        assert a.heartbeat() == {"a"}
+        assert b.heartbeat() == {"a", "b"}
+        now[0] = 11.0
+        assert b.heartbeat() == {"b"}  # a stopped heartbeating and expired
+        b.resign()
+        now[0] = 12.0
+        assert a.heartbeat() == {"a"}
+
+    def test_renew_many_is_one_critical_section(self, tmp_path):
+        a = FileLeaseSet(_lease_path(tmp_path), identity="a", duration=10)
+        keys = [f"p{i}" for i in range(20)]
+        for k in keys:
+            assert a.try_acquire(k)
+        assert a.renew_many(keys) == set(keys)
+        assert set(a.snapshot()) == set(keys)
+        a.release_all()
+        assert a.snapshot() == {}
+
+
+class TestShardManager:
+    def _manager(self, path, ident, keys, now, **kw):
+        return ShardManager(
+            FileLeaseSet(path, identity=ident, duration=10, clock=lambda: now[0]),
+            keys_fn=lambda: keys,
+            **kw,
+        )
+
+    def test_single_replica_owns_everything(self, tmp_path):
+        now = [0.0]
+        m = self._manager(_lease_path(tmp_path), "a", ["p0", "p1"], now)
+        m.tick()
+        assert m.owned() == {"p0", "p1", DEFAULT_SHARD}
+
+    def test_fleet_partitions_disjoint_and_complete(self, tmp_path):
+        now = [0.0]
+        keys = [f"p{i}" for i in range(16)]
+        managers = [
+            self._manager(_lease_path(tmp_path), ident, keys, now)
+            for ident in ("a", "b", "c")
+        ]
+        for _ in range(4):
+            for m in managers:
+                m.tick()
+        owned = [m.owned() for m in managers]
+        union = set().union(*owned)
+        assert union == set(keys) | {DEFAULT_SHARD}
+        for i in range(3):
+            for j in range(i + 1, 3):
+                assert not (owned[i] & owned[j]), "two replicas own one shard"
+        assert all(o for o in owned), "a live replica ended up with zero shards"
+
+    def test_rendezvous_is_deterministic_and_minimal(self):
+        members = ["a", "b", "c"]
+        keys = [f"p{i}" for i in range(64)]
+        before = {k: rendezvous_owner(k, members) for k in keys}
+        # removing b re-homes ONLY b's keys
+        after = {k: rendezvous_owner(k, ["a", "c"]) for k in keys}
+        for k in keys:
+            if before[k] != "b":
+                assert after[k] == before[k]
+
+    def test_crash_takeover_within_two_lease_durations(self, tmp_path):
+        now = [0.0]
+        keys = [f"p{i}" for i in range(8)]
+        ma = self._manager(_lease_path(tmp_path), "a", keys, now)
+        mb = self._manager(_lease_path(tmp_path), "b", keys, now)
+        for _ in range(3):
+            ma.tick()
+            mb.tick()
+        dead_shards = mb.owned()
+        assert dead_shards
+        mb.crash()  # no release: holds must EXPIRE
+        # within one lease duration the survivor cannot steal (holds live)
+        now[0] += 5.0
+        ma.tick()
+        assert not (ma.owned() & dead_shards)
+        # past expiry (< 2 durations total) the survivor takes everything
+        now[0] += 6.0
+        ma.tick()
+        ma.tick()
+        assert ma.owned() == set(keys) | {DEFAULT_SHARD}
+
+    def test_on_lost_fires_when_renewal_fails(self, tmp_path):
+        now = [0.0]
+        lost = []
+        ma = self._manager(_lease_path(tmp_path), "a", ["p0"], now, on_lost=lost.append)
+        ma.tick()
+        assert ma.owns("p0")
+        # simulate a long stall: everything expired, b took the shard over
+        now[0] = 11.0
+        b = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        assert b.try_acquire("p0")
+        ma.tick()
+        assert "p0" in lost
+        assert not ma.owns("p0")
+
+    def test_handback_to_joining_replica(self, tmp_path):
+        now = [0.0]
+        keys = [f"p{i}" for i in range(12)]
+        ma = self._manager(_lease_path(tmp_path), "a", keys, now)
+        ma.tick()
+        assert len(ma.owned()) == 13  # everything, while alone
+        mb = self._manager(_lease_path(tmp_path), "b", keys, now)
+        for _ in range(3):
+            mb.tick()
+            ma.tick()
+        assert mb.owned(), "joining replica never received a share"
+        assert not (ma.owned() & mb.owned())
+
+    def test_renew_interval_derives_from_duration(self, tmp_path):
+        """A lease duration shorter than the default renew cadence must
+        pull the cadence down with it — renewing 3s leases every 5s would
+        expire every hold between ticks (perpetual churn)."""
+        now = [0.0]
+        short = ShardManager(
+            FileLeaseSet(_lease_path(tmp_path), identity="a", duration=3, clock=lambda: now[0]),
+            keys_fn=lambda: ["p0"],
+        )
+        assert short.renew_interval == pytest.approx(1.0)
+        long = ShardManager(
+            FileLeaseSet(_lease_path(tmp_path), identity="b", duration=60, clock=lambda: now[0]),
+            keys_fn=lambda: ["p0"],
+        )
+        assert long.renew_interval == pytest.approx(5.0)
+
+    def test_steal_from_wedged_winner_does_not_oscillate(self, tmp_path):
+        """A winner that heartbeats but never claims (wedged watch) loses
+        its keys to a loser after one tick of grace — and the loser KEEPS
+        them: handing back to the same wedged winner would re-orphan the
+        shard every other tick."""
+        now = [0.0]
+        keys = [f"p{i}" for i in range(8)]
+        ma = self._manager(_lease_path(tmp_path), "a", keys, now)
+        # "b" is wedged: it heartbeats membership but never runs a claim
+        # tick, so rendezvous assigns it keys nobody ever takes
+        b_leases = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        for _ in range(4):
+            b_leases.heartbeat()
+            ma.tick()
+        # a owns EVERYTHING despite b being a live member
+        assert ma.owned() == set(keys) | {DEFAULT_SHARD}
+        stolen = {
+            k for k in ma.owned() if rendezvous_owner(k, {"a", "b"}) == "b"
+        }
+        assert stolen, "rendezvous never assigned b anything (test vacuous)"
+        # stability: further ticks with b still wedged change nothing
+        for _ in range(4):
+            b_leases.heartbeat()
+            ma.tick()
+            assert ma.owned() == set(keys) | {DEFAULT_SHARD}
+        # b dies entirely → nothing to hand back to; a keeps serving
+        now[0] += 11.0
+        ma.tick()
+        assert ma.owned() == set(keys) | {DEFAULT_SHARD}
+
+    def test_handback_gives_the_winner_two_full_ticks(self, tmp_path):
+        """A handed-back key must not enter the releasing replica's OWN
+        steal-pending set in the same tick — a merely-slow winner would
+        lose it right back and _stolen_from would pin the misplacement."""
+        now = [0.0]
+        keys = [f"p{i}" for i in range(12)]
+        ma = self._manager(_lease_path(tmp_path), "a", keys, now)
+        ma.tick()  # alone: owns everything
+        # b joins (heartbeat only); a hands b's rendezvous share back
+        b_leases = FileLeaseSet(_lease_path(tmp_path), identity="b", duration=10, clock=lambda: now[0])
+        b_leases.heartbeat()
+        ma.tick()
+        b_share = {
+            k for k in keys + [DEFAULT_SHARD]
+            if rendezvous_owner(k, {"a", "b"}) == "b"
+        }
+        assert b_share and not (ma.owned() & b_share)
+        # ONE more a-tick while b is slow: a may mark pending but must not
+        # have re-stolen yet (the winner gets two full ticks)
+        ma.tick()
+        assert not (ma.owned() & b_share), (
+            "releasing replica re-stole a handed-back key after one tick"
+        )
+        # b finally claims on its first real tick
+        mb = ShardManager(b_leases, keys_fn=lambda: keys)
+        mb.tick()
+        assert mb.owned() == b_share
+        # and a's _stolen_from never pinned anything
+        for _ in range(3):
+            ma.tick()
+            mb.tick()
+        assert mb.owned() == b_share
+
+    def test_stop_fires_on_lost_before_releasing_the_lease(self, tmp_path):
+        """Shutdown ordering is the split-brain guard: the worker must be
+        stopped (on_lost) BEFORE the lease releases, or a survivor could
+        claim the shard while this replica's launch is still in flight."""
+        now = [0.0]
+        events = []
+        leases = FileLeaseSet(_lease_path(tmp_path), identity="a", duration=10, clock=lambda: now[0])
+        orig_release = leases.release
+        leases.release = lambda key: (events.append(("release", key)), orig_release(key))
+        m = ShardManager(
+            leases, keys_fn=lambda: ["p0"],
+            on_lost=lambda key: events.append(("on_lost", key)),
+            include_default_shard=False,
+        )
+        m.tick()
+        assert m.owns("p0")
+        m.stop()
+        assert events == [("on_lost", "p0"), ("release", "p0")]
+
+    def test_deleted_key_released(self, tmp_path):
+        now = [0.0]
+        keys = ["p0", "p1"]
+        ma = self._manager(_lease_path(tmp_path), "a", keys, now)
+        ma.tick()
+        assert ma.owns("p1")
+        keys.remove("p1")
+        ma.tick()
+        assert not ma.owns("p1")
+        assert ma.leases.holder("p1") is None
+
+    def test_clean_stop_releases_and_fires_on_lost(self, tmp_path):
+        now = [0.0]
+        lost = []
+        ma = self._manager(_lease_path(tmp_path), "a", ["p0"], now, on_lost=lost.append)
+        ma.tick()
+        ma.stop()
+        assert "p0" in lost
+        assert ma.leases.holder("p0") is None
+        assert ma.owned() == set()
+
+
+class TestBuildLeaseSet:
+    def test_file_spec(self, tmp_path):
+        ls = build_lease_set(_lease_path(tmp_path), identity="x", duration=7)
+        assert isinstance(ls, FileLeaseSet)
+        assert ls.identity == "x" and ls.duration == 7
+
+    def test_kube_spec(self):
+        from karpenter_tpu.kube.leader import KubeLeaseSet
+
+        ls = build_lease_set("kube:karpenter/shards", cluster=Cluster(), identity="x")
+        assert isinstance(ls, KubeLeaseSet)
+        assert ls.namespace == "karpenter" and ls.prefix == "shards"
+
+    def test_kube_member_lease_deleted_on_resign_and_stale_gc(self):
+        """Member Lease names embed the per-process identity, so a
+        kept-but-blanked object is permanent garbage: resign() must DELETE
+        it, and a peer's tick must GC long-expired member leases from
+        crashed replicas."""
+        now = [0.0]
+        cluster = Cluster(clock=lambda: now[0])
+        a = build_lease_set("kube:shards", cluster=cluster, identity="a", duration=10)
+        b = build_lease_set("kube:shards", cluster=cluster, identity="b", duration=10)
+        a.heartbeat()
+        b.heartbeat()
+        assert len(cluster.list("leases", namespace="kube-system")) == 2
+        a.resign()
+        assert len(cluster.list("leases", namespace="kube-system")) == 1
+        # b crashes (never resigns); once unambiguously stale a peer GCs it
+        now[0] += 10 * 4 + 11
+        assert a.heartbeat() == {"a"}
+        names = [
+            lease.metadata.name
+            for lease in cluster.list("leases", namespace="kube-system")
+        ]
+        assert not any("member-b" in n for n in names), names
+
+    def test_kube_snapshot_resolves_untouched_keys_via_one_list(self):
+        """A fresh replica must see peers' shard holders (its lazy lease
+        table knows nothing) — snapshot(keys) resolves through one LIST."""
+        cluster = Cluster()
+        a = build_lease_set("kube:shards", cluster=cluster, identity="a", duration=10)
+        b = build_lease_set("kube:shards", cluster=cluster, identity="b", duration=10)
+        assert a.try_acquire("p0") and a.try_acquire("p1")
+        # b never touched p0/p1; the keys hint resolves them
+        assert b.snapshot(["p0", "p1", "p2"]) == {"p0": "a", "p1": "a"}
+
+    def test_kube_lease_set_prefers_uncached_list_live(self):
+        """Against a real apiserver the informer plane does NOT watch
+        leases, so the cached list() only shows this process's own writes
+        — members()/snapshot() must go through list_live or every replica
+        believes it is alone and claims every shard."""
+        calls = {"live": 0, "cached": 0}
+        now = [0.0]
+
+        class SpyCluster(Cluster):
+            def list_live(self, kind, namespace=None):
+                calls["live"] += 1
+                return Cluster.list(self, kind, namespace)
+
+            def list(self, kind, namespace=None):
+                calls["cached"] += 1
+                return Cluster.list(self, kind, namespace)
+
+        cluster = SpyCluster(clock=lambda: now[0])
+        a = build_lease_set("kube:shards", cluster=cluster, identity="a", duration=10)
+        b = build_lease_set("kube:shards", cluster=cluster, identity="b", duration=10)
+        a.heartbeat()
+        assert b.heartbeat() == {"a", "b"}
+        assert a.try_acquire("p0")
+        now[0] += 2.0  # past the one-tick listing-reuse window
+        assert b.snapshot(["p0"]) == {"p0": "a"}
+        assert calls["live"] >= 3
+        assert calls["cached"] == 0, "shard discovery read the informer cache"
+
+    def test_kube_one_list_serves_heartbeat_and_snapshot(self):
+        """snapshot() right after heartbeat() must reuse the same listing
+        — two full namespace LISTs per replica per tick doubles apiserver
+        load for identical bytes."""
+        calls = {"live": 0}
+
+        class SpyCluster(Cluster):
+            def list_live(self, kind, namespace=None):
+                calls["live"] += 1
+                return Cluster.list(self, kind, namespace)
+
+        a = build_lease_set("kube:shards", cluster=SpyCluster(), identity="a", duration=10)
+        a.try_acquire("p0")
+        a.heartbeat()
+        before = calls["live"]
+        assert a.snapshot(["p0"]) == {"p0": "a"}
+        assert calls["live"] == before  # reused the heartbeat's listing
+
+    def test_kube_lease_set_coordinates(self):
+        cluster = Cluster()
+        a = build_lease_set("kube:shards", cluster=cluster, identity="a", duration=10)
+        b = build_lease_set("kube:shards", cluster=cluster, identity="b", duration=10)
+        assert a.heartbeat() == {"a"}
+        assert b.heartbeat() == {"a", "b"}
+        assert a.try_acquire("p0")
+        assert not b.try_acquire("p0")
+        assert a.renew_many(["p0"]) == {"p0"}
+        assert b.holder("p0") == "a"
+        a.release("p0")
+        assert b.try_acquire("p0")
+
+
+class _FixedOwnership:
+    """Test double for fleet.ShardManager: a fixed owned-set."""
+
+    def __init__(self, owned=()):
+        self.owned_set = set(owned)
+
+    def owns(self, key):
+        return key in self.owned_set
+
+
+class TestProvisioningOwnership:
+    def _controller(self, ownership):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+
+        cluster = Cluster()
+        pc = ProvisioningController(
+            cluster, FakeCloudProvider(instance_types(5)),
+            start_workers=False, ownership=ownership,
+        )
+        return cluster, pc
+
+    def test_unowned_provisioner_runs_no_worker(self):
+        ownership = _FixedOwnership()
+        cluster, pc = self._controller(ownership)
+        cluster.create("provisioners", make_provisioner())
+        requeue = pc.reconcile("default")
+        assert pc.workers == {}
+        assert requeue is not None  # re-checks on the lease cadence
+
+    def test_owned_provisioner_runs_worker_and_loss_tears_down(self):
+        ownership = _FixedOwnership({"default"})
+        cluster, pc = self._controller(ownership)
+        cluster.create("provisioners", make_provisioner())
+        pc.reconcile("default")
+        assert "default" in pc.workers
+        # the shard manager's on_lost hook
+        ownership.owned_set.clear()
+        pc.release_shard("default")
+        assert "default" not in pc.workers
+        # and the next reconcile stays worker-less
+        pc.reconcile("default")
+        assert pc.workers == {}
+
+    def test_launch_guard_blocks_after_ownership_loss(self):
+        ownership = _FixedOwnership({"default"})
+        cluster, pc = self._controller(ownership)
+        cluster.create("provisioners", make_provisioner())
+        pc.reconcile("default")
+        worker = pc.workers["default"]
+        worker.batcher.idle_duration = 0.01
+        pod = make_pod(name="guarded", requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        worker.add(pod)
+        ownership.owned_set.clear()  # lease lost mid-flight
+        worker.provision_once()
+        assert not pod.spec.node_name, "launched without the shard lease"
+        assert cluster.nodes() == []
+
+    def test_bind_recheck_never_duplicates(self):
+        from karpenter_tpu import metrics as m
+
+        ownership = _FixedOwnership({"default"})
+        cluster, pc = self._controller(ownership)
+        cluster.create("provisioners", make_provisioner())
+        pc.reconcile("default")
+        worker = pc.workers["default"]
+
+        def guard_hits():
+            return m.REGISTRY.get_sample_value(
+                "karpenter_fleet_duplicate_launch_guard_total",
+                {"reason": "already_bound"},
+            ) or 0.0
+
+        pod = make_pod(name="dup-bind", requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        # another replica bound it between this replica's solve and bind
+        cluster.bind(pod, "other-replicas-node")
+        before = guard_hits()
+        worker._bind([pod], "my-node")
+        assert pod.spec.node_name == "other-replicas-node"
+        assert guard_hits() == before + 1
+
+
+class TestInterruptionOwnership:
+    def _runtime_bits(self, ownership):
+        from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+        from karpenter_tpu.controllers.interruption import InterruptionController
+
+        api = SimCloudAPI()
+        provider = SimulatedCloudProvider(api=api)
+        cluster = Cluster()
+        controller = InterruptionController(
+            cluster, provider, ownership=ownership,
+        )
+        return api, provider, cluster, controller
+
+    def _node(self, cluster, name="n-1", provisioner="default"):
+        from karpenter_tpu.api import labels as lbl
+        from karpenter_tpu.api.objects import Node, NodeSpec, ObjectMeta
+
+        node = Node(
+            metadata=ObjectMeta(
+                name=name, namespace="",
+                labels={lbl.PROVISIONER_NAME_LABEL: provisioner},
+            ),
+            spec=NodeSpec(provider_id=f"sim:///z/{name}"),
+        )
+        cluster.create("nodes", node)
+        return node
+
+    def test_foreign_notice_requeued_for_the_owner(self):
+        from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+
+        ownership = _FixedOwnership()  # owns nothing
+        api, provider, cluster, controller = self._runtime_bits(ownership)
+        self._node(cluster)
+        notice = DisruptionNotice(kind=PREEMPTION, node_name="n-1")
+        controller.handle_notice(notice)
+        assert controller.foreign_notices == 1
+        # back on the provider stream for the owner's next poll
+        assert provider.poll_disruptions() == [notice]
+        assert controller.notices_handled == 0
+
+    def test_owned_notice_handled_locally(self):
+        from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+
+        ownership = _FixedOwnership({"default"})
+        api, provider, cluster, controller = self._runtime_bits(ownership)
+        cluster.create("provisioners", make_provisioner())  # the label is live
+        self._node(cluster)
+        controller.handle_notice(
+            DisruptionNotice(kind=PREEMPTION, node_name="n-1")
+        )
+        assert controller.foreign_notices == 0
+        assert provider.poll_disruptions() == []
+
+    def test_deleted_provisioner_label_routes_to_default_shard(self):
+        """A node whose provisioner was DELETED must route to the default
+        shard — its own key left every replica's universe, so routing to
+        it would requeue the notice forever with no owner appearing."""
+        from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+
+        ownership = _FixedOwnership({DEFAULT_SHARD})
+        api, provider, cluster, controller = self._runtime_bits(ownership)
+        self._node(cluster, provisioner="long-gone")  # no such provisioner
+        controller.handle_notice(
+            DisruptionNotice(kind=PREEMPTION, node_name="n-1")
+        )
+        # the default-shard owner handled it locally, no requeue ping-pong
+        assert controller.foreign_notices == 0
+        assert provider.poll_disruptions() == []
+
+    def test_unlabeled_node_routes_to_default_shard(self):
+        from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice
+
+        ownership = _FixedOwnership({DEFAULT_SHARD})
+        api, provider, cluster, controller = self._runtime_bits(ownership)
+        from karpenter_tpu.api.objects import Node, NodeSpec, ObjectMeta
+
+        cluster.create("nodes", Node(
+            metadata=ObjectMeta(name="bare", namespace=""),
+            spec=NodeSpec(provider_id="sim:///z/bare"),
+        ))
+        controller.handle_notice(
+            DisruptionNotice(kind=PREEMPTION, node_name="bare")
+        )
+        assert controller.foreign_notices == 0
+
+
+class TestSelectionOwnership:
+    def test_foreign_pod_requeues_quietly_without_relaxing(self):
+        """A pod admitted only by another replica's shard must NOT raise
+        NoProvisionerMatched here — the manager's retry loop would relax a
+        preference per retry on a SHARED pod object the owner never asked
+        to degrade."""
+        from karpenter_tpu.api.objects import (
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.controllers.selection import SelectionController
+
+        cluster = Cluster()
+        ownership = _FixedOwnership()  # this replica owns nothing
+        pc = ProvisioningController(
+            cluster, FakeCloudProvider(instance_types(5)),
+            start_workers=False, ownership=ownership,
+        )
+        selection = SelectionController(cluster, pc, wait=False)
+        cluster.create("provisioners", make_provisioner())
+        pod = make_pod(
+            name="foreign", requests={"cpu": "0.5"},
+            node_preferences=[PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(
+                        key="zone-pref", operator="In", values=["a"],
+                    ),
+                ]),
+            )],
+        )
+        cluster.create("pods", pod)
+        prefs_before = len(
+            pod.spec.affinity.node_affinity.preferred
+        )
+        # no raise, no relax: the owner replica's selection serves it
+        assert selection.reconcile("foreign", "default") is not None
+        assert len(pod.spec.affinity.node_affinity.preferred) == prefs_before
+        # once THIS replica owns the shard, selection proceeds normally
+        ownership.owned_set.add("default")
+        pc.reconcile("default")
+        selection.reconcile("foreign", "default")
+        assert pc.workers["default"].is_pending(pod.key)
+
+    def test_overlapping_shards_resolve_by_priority_exactly_once(self):
+        """A pod BOTH an owned and a foreign shard admit is served by
+        exactly ONE replica: the owner of the FIRST admitting provisioner
+        in sorted-name order (single-replica selection priority). Serving
+        it on every admitting replica would double-launch capacity;
+        serving it on none would livelock."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.controllers.selection import SelectionController
+
+        def replica(cluster, owned):
+            pc = ProvisioningController(
+                cluster, FakeCloudProvider(instance_types(5)),
+                start_workers=False, ownership=_FixedOwnership(owned),
+            )
+            return pc, SelectionController(cluster, pc, wait=False)
+
+        # "aa" sorts before "zz": the aa-owner wins the overlapping pod
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner(name="aa"))
+        cluster.create("provisioners", make_provisioner(name="zz"))
+        pc_a, sel_a = replica(cluster, {"aa"})
+        pc_z, sel_z = replica(cluster, {"zz"})
+        pc_a.reconcile("aa")
+        pc_z.reconcile("zz")
+        pod = make_pod(name="both", requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        sel_z.reconcile("both", "default")  # zz's replica defers...
+        assert not pc_z.workers["zz"].is_pending(pod.key)
+        sel_a.reconcile("both", "default")  # ...aa's replica serves
+        assert pc_a.workers["aa"].is_pending(pod.key)
+
+
+class TestConsolidationOwnership:
+    def test_unowned_shard_plans_no_wave(self):
+        """Consolidation disrupts a provisioner's nodes: only the shard
+        owner may plan/execute, or N replicas each retire wave_size nodes
+        concurrently (N x the configured pacing)."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.consolidation import ConsolidationController
+
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner())
+        controller = ConsolidationController(
+            cluster, FakeCloudProvider(instance_types(5)),
+            enabled=True, ownership=_FixedOwnership(),
+        )
+        planned = []
+        controller.plan = lambda p: planned.append(p)  # must never be called
+        requeue = controller.reconcile("default")
+        assert planned == []
+        assert requeue is not None  # re-checks on the lease cadence
+
+    def test_owned_shard_consolidates_normally(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.consolidation import ConsolidationController
+
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner())
+        controller = ConsolidationController(
+            cluster, FakeCloudProvider(instance_types(5)),
+            enabled=True, ownership=_FixedOwnership({"default"}),
+        )
+        assert controller.reconcile("default") is not None  # normal requeue
+
+
+class TestReplicaKillEndToEnd:
+    def test_three_replicas_survive_owner_crash_no_duplicate_binds(self, tmp_path):
+        """The acceptance e2e (fast lane): 3 controller replicas share one
+        cluster + lease file; mid-storm the owner of a shard is CRASHED
+        (leases expire, no release). Every pod still binds, no pod is ever
+        re-bound (zero duplicate launches), and the orphaned shards re-home
+        within 2x the lease duration."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+        from karpenter_tpu.main import build_runtime
+        from karpenter_tpu.options import Options
+        from karpenter_tpu.testing.chaos import ReplicaChaos
+
+        lease_path = _lease_path(tmp_path)
+        lease_duration = 1.5
+        cluster = Cluster()
+        api = SimCloudAPI()
+        fleet = ReplicaChaos()
+        rebinds = []
+        last_node = {}
+        mu = threading.Lock()
+
+        def on_pod(event, pod):
+            if event == "DELETED" or not pod.spec.node_name:
+                return
+            with mu:
+                prev = last_node.get(pod.metadata.name)
+                if prev and prev != pod.spec.node_name:
+                    rebinds.append((pod.metadata.name, prev, pod.spec.node_name))
+                last_node[pod.metadata.name] = pod.spec.node_name
+
+        cluster.watch("pods", on_pod)
+        n_prov, n_pods = 6, 36
+        try:
+            for i in range(3):
+                rt = build_runtime(
+                    Options(shard_lease=lease_path, shard_lease_duration=lease_duration),
+                    cluster=cluster,
+                    cloud_provider=SimulatedCloudProvider(api=api),
+                    shard_identity=f"replica-{i}",
+                )
+                rt.ownership.renew_interval = 0.15
+                rt.ownership.start()
+                rt.manager.start()
+                fleet.add(f"replica-{i}", rt)
+            names = [f"fleet-{i}" for i in range(n_prov)]
+            for name in names:
+                cluster.create("provisioners", make_provisioner(
+                    name=name, solver="ffd",
+                    requirements=[NodeSelectorRequirement(
+                        key="fleet", operator="In", values=[name],
+                    )],
+                ))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                owners = {n: fleet.owner_named(n) for n in names}
+                if all(
+                    rt is not None and n in rt.provisioning.workers
+                    for n, (_, rt) in owners.items()
+                ):
+                    break
+                time.sleep(0.05)
+            assert all(fleet.owner_named(n)[1] for n in names), "shards never owned"
+            for rt in fleet.replicas.values():
+                for w in rt.provisioning.workers.values():
+                    w.batcher.idle_duration = 0.05
+            pods = [
+                make_pod(
+                    name=f"ha-{i}", requests={"cpu": "0.25"},
+                    node_selector={"fleet": names[i % n_prov]},
+                )
+                for i in range(n_pods)
+            ]
+            for p in pods:
+                cluster.create("pods", p)
+            time.sleep(0.2)  # storm engages
+            victim, victim_rt = fleet.owner_named(names[0])
+            victim_shards = frozenset(victim_rt.ownership.owned())
+            t_kill = time.perf_counter()
+            fleet.kill(victim)
+            # rebalance: every orphaned shard re-owned within 2x duration
+            rebalanced_at = None
+            deadline = time.time() + lease_duration * 6
+            while time.time() < deadline:
+                survivors_own = set()
+                for rt in fleet.replicas.values():
+                    survivors_own |= rt.ownership.owned()
+                if victim_shards <= survivors_own:
+                    rebalanced_at = time.perf_counter() - t_kill
+                    break
+                time.sleep(0.05)
+            assert rebalanced_at is not None, "orphaned shards never re-owned"
+            # the bar is 2x the lease duration; the +2s margin absorbs
+            # in-process noise (all three "replicas" are threads of one
+            # pytest process sharing the GIL with the provisioning storm)
+            assert rebalanced_at <= 2 * lease_duration + 2.0, (
+                f"rebalance took {rebalanced_at:.2f}s "
+                f"(bar: {2 * lease_duration:.2f}s + scheduling margin)"
+            )
+            deadline = time.time() + 60
+            while time.time() < deadline and not all(p.spec.node_name for p in pods):
+                time.sleep(0.05)
+            bound = [p for p in pods if p.spec.node_name]
+            assert len(bound) == n_pods, (
+                f"chaos_provision_success_rate={len(bound) / n_pods:.3f} != 1.0"
+            )
+            assert rebinds == [], f"duplicate launches/binds: {rebinds}"
+        finally:
+            fleet.stop_all()
+
+
+@pytest.mark.slow
+class TestFleetStormSoak:
+    def test_storm_acceptance_bars(self):
+        """The slow-lane storm soak (the bench leg at acceptance scale):
+        8 provisioners x 3 replicas x a 2-member sidecar pool, replica
+        crash + session-bearing sidecar kill mid-storm. Bars: success rate
+        1.0, zero duplicate launches, rebalance within 2x lease duration,
+        and at least one attributed pool failover."""
+        import bench
+
+        # lease_duration 4s (not the bench default 2s): the soak's replicas
+        # are THREADS of one process, and 8 provisioners' XLA compiles
+        # GIL-starve the survivors' tick cadence — real replicas are
+        # separate processes. The 2x bar is still enforced, just against a
+        # duration that dwarfs in-process scheduling noise.
+        r = bench.bench_fleet_storm(
+            n_pods=120, n_provisioners=8, n_replicas=3, pool_size=2,
+            solver="tpu", lease_duration=4.0,
+        )
+        assert r["chaos_provision_success_rate"] == 1.0
+        assert r["duplicate_launches"] == 0
+        assert r["rebalance_within_bar"], r
+        assert r["pool_failovers_total"] >= 1
+        assert r["p99_time_to_bind_s"] is not None
+        assert r["aggregate_pods_per_sec"] and r["aggregate_pods_per_sec"] > 0

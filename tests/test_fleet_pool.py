@@ -1,0 +1,269 @@
+"""Solver sidecar pool tests (solver/pool.py): consistent-hash session
+affinity, per-member breakers, ring failover, the NEEDS_CATALOG re-upload
+on a DIFFERENT member, and the TpuScheduler integration — a dead member
+degrades capacity, a dead pool degrades to the in-process kernel, and the
+FFD floor still schedules everything."""
+
+import random
+import socket
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.solver.pool import HashRing, PoolExhausted, SolverPool
+
+pytestmark = pytest.mark.fleet
+
+grpc = pytest.importorskip("grpc")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _encoded_batch(n_pods=8, n_types=8, seed=0):
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import make_pod, make_provisioner
+
+    catalog = sorted(
+        instance_types(n_types), key=lambda it: it.effective_price()
+    )
+    provisioner = make_provisioner(solver="tpu")
+    constraints = provisioner.spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    pods = sort_pods_ffd(
+        [make_pod(requests={"cpu": "0.5"}) for _ in range(n_pods)]
+    )
+    cluster = Cluster()
+    Topology(cluster, rng=random.Random(seed)).inject(constraints, pods)
+    daemon = daemon_overhead(cluster, constraints)
+    batch = enc.encode(constraints, catalog, pods, daemon)
+    return batch, constraints, catalog, pods
+
+
+def _pack_args(batch):
+    return tuple(np.asarray(a) for a in batch.pack_args())
+
+
+class TestHashRing:
+    def test_deterministic_and_covers_members(self):
+        ring = HashRing(["a:1", "b:1", "c:1"])
+        key = b"\x01" * 16
+        assert ring.ordered(key) == ring.ordered(key)
+        assert set(ring.ordered(key)) == {"a:1", "b:1", "c:1"}
+
+    def test_member_removal_moves_only_its_keys(self):
+        members = ["a:1", "b:1", "c:1"]
+        ring = HashRing(members)
+        smaller = HashRing(["a:1", "c:1"])
+        keys = [bytes([i]) * 16 for i in range(64)]
+        for key in keys:
+            before = ring.route(key)
+            if before != "b:1":
+                assert smaller.route(key) == before
+
+    def test_distribution_roughly_even(self):
+        ring = HashRing(["a:1", "b:1"])
+        counts = {"a:1": 0, "b:1": 0}
+        for i in range(512):
+            counts[ring.route(i.to_bytes(4, "little") * 4)] += 1
+        assert min(counts.values()) > 512 * 0.25
+
+    def test_failover_order_starts_after_primary(self):
+        ring = HashRing(["a:1", "b:1", "c:1"])
+        key = b"\x07" * 16
+        order = ring.ordered(key)
+        assert order[0] == ring.route(key)
+        assert len(order) == len(set(order)) == 3
+
+
+class TestSolverPoolFailover:
+    def _serve(self, address):
+        from karpenter_tpu.solver.service import serve
+
+        return serve(address)
+
+    def test_routes_by_session_affinity_and_solves(self):
+        from karpenter_tpu.solver import kernel
+
+        addr_a = f"127.0.0.1:{free_port()}"
+        addr_b = f"127.0.0.1:{free_port()}"
+        server_a, server_b = self._serve(addr_a), self._serve(addr_b)
+        try:
+            batch, *_ = _encoded_batch()
+            args = _pack_args(batch)
+            n_max = len(batch.pod_valid)
+            pool = SolverPool([addr_a, addr_b], timeout=30)
+            result = pool.pack(*args, n_max=n_max)
+            import jax
+
+            local = jax.device_get(tuple(kernel.pack(*args, n_max=n_max)))
+            for l, r in zip(local, tuple(result)):
+                np.testing.assert_array_equal(np.asarray(l), np.asarray(r))
+            # affinity: only the ROUTED member's store holds the session
+            primary = pool.ring.route(pool._catalog_key(args[7:]))
+            primary_srv = server_a if primary == addr_a else server_b
+            other_srv = server_b if primary == addr_a else server_a
+            assert primary_srv.solver_service.session_count() == 1
+            assert other_srv.solver_service.session_count() == 0
+            pool.close()
+        finally:
+            server_a.stop(grace=0)
+            server_b.stop(grace=0)
+
+    def test_dead_member_fails_over_through_the_ring(self):
+        from karpenter_tpu import metrics as m
+
+        addr_a = f"127.0.0.1:{free_port()}"
+        addr_b = f"127.0.0.1:{free_port()}"
+        server_a, server_b = self._serve(addr_a), self._serve(addr_b)
+        servers = {addr_a: server_a, addr_b: server_b}
+        try:
+            batch, *_ = _encoded_batch()
+            args = _pack_args(batch)
+            n_max = len(batch.pod_valid)
+            pool = SolverPool([addr_a, addr_b], timeout=5)
+            pool.pack(*args, n_max=n_max)  # warm: session on the primary
+            primary = pool.ring.route(pool._catalog_key(args[7:]))
+            survivor = addr_b if primary == addr_a else addr_a
+
+            def failovers():
+                return m.REGISTRY.get_sample_value(
+                    "karpenter_solver_pool_failovers_total",
+                    {"address": primary},
+                ) or 0.0
+
+            before = failovers()
+            servers[primary].stop(grace=0)  # SIGKILL the routed member
+            result = pool.pack(*args, n_max=n_max)
+            assert int(np.asarray(result[4]).reshape(-1)[0]) >= 1
+            assert failovers() == before + 1
+            # the survivor now holds the re-uploaded session
+            assert servers[survivor].solver_service.session_count() == 1
+            # and the dead member's breaker is open
+            assert not pool._breaker(primary).available()
+            assert pool.available_members() == [survivor]
+            pool.close()
+        finally:
+            for s in servers.values():
+                s.stop(grace=0)
+
+    def test_needs_catalog_on_failover_member_reuploads_transparently(self):
+        """The satellite scenario: the solve fails over to a member whose
+        CLIENT remembers the session as open but whose server store is
+        empty (restart) — NEEDS_CATALOG must re-upload on the NEW member,
+        keep hit-rate accounting solve-true, and the old member's open
+        breaker must not poison subsequent solves."""
+        from karpenter_tpu.solver import session_stats
+
+        addr_a = f"127.0.0.1:{free_port()}"
+        addr_b = f"127.0.0.1:{free_port()}"
+        server_a, server_b = self._serve(addr_a), self._serve(addr_b)
+        servers = {addr_a: server_a, addr_b: server_b}
+        try:
+            batch, *_ = _encoded_batch()
+            args = _pack_args(batch)
+            n_max = len(batch.pod_valid)
+            pool = SolverPool([addr_a, addr_b], timeout=5)
+            key = pool._catalog_key(args[7:])
+            primary = pool.ring.route(key)
+            survivor = addr_b if primary == addr_a else addr_a
+            pool.pack(*args, n_max=n_max)
+            # open the session on the SURVIVOR too, then restart it: its
+            # server store empties but the pool's client still remembers
+            # the key as open — the classic restart-recovery skew
+            pool._client(survivor)._open_session(key, args[7:], timeout=30)
+            servers[survivor].stop(grace=0)
+            from karpenter_tpu.solver.service import serve
+
+            servers[survivor] = serve(survivor)
+            assert servers[survivor].solver_service.session_count() == 0
+            from karpenter_tpu import metrics as m
+
+            def uploads():
+                return m.REGISTRY.get_sample_value(
+                    "karpenter_solver_session_catalog_uploads_total"
+                ) or 0.0
+
+            uploads_before = uploads()
+            misses_before = session_stats.snapshot()["misses"]
+            servers[primary].stop(grace=0)  # kill the routed member
+            result = pool.pack(*args, n_max=n_max)
+            assert int(np.asarray(result[4]).reshape(-1)[0]) >= 1
+            # the NEEDS_CATALOG path re-uploaded on the survivor: exactly
+            # one more upload and ONE residency miss for this logical solve
+            # (solve-true accounting — the retry doesn't double-count)
+            assert servers[survivor].solver_service.session_count() == 1
+            assert uploads() == uploads_before + 1
+            assert session_stats.snapshot()["misses"] == misses_before + 1
+            # the dead primary's breaker stays its own: repeated solves
+            # keep routing to the survivor without touching the primary
+            for _ in range(3):
+                pool.pack(*args, n_max=n_max)
+            assert pool._breaker(survivor).available()
+            pool.close()
+        finally:
+            for s in servers.values():
+                s.stop(grace=0)
+
+    def test_all_members_dead_raises_pool_exhausted(self):
+        addr_a = f"127.0.0.1:{free_port()}"
+        addr_b = f"127.0.0.1:{free_port()}"
+        server_a, server_b = self._serve(addr_a), self._serve(addr_b)
+        batch, *_ = _encoded_batch()
+        args = _pack_args(batch)
+        n_max = len(batch.pod_valid)
+        pool = SolverPool([addr_a, addr_b], timeout=2)
+        pool.pack(*args, n_max=n_max)
+        server_a.stop(grace=0)
+        server_b.stop(grace=0)
+        with pytest.raises((PoolExhausted, Exception)):
+            pool.pack(*args, n_max=n_max)
+        # both breakers open: the next call is refused without an RPC stall
+        with pytest.raises(PoolExhausted):
+            pool.pack(*args, n_max=n_max)
+        pool.close()
+
+
+class TestSchedulerWithPool:
+    def test_scheduler_solves_through_pool_and_degrades_to_ffd(self):
+        """TpuScheduler with a comma-separated pool address solves through
+        the pool; with every member dead, the outer breaker + FFD floor
+        still schedule every pod (the last-resort degradation)."""
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.solver.pool import SolverPool
+        from karpenter_tpu.solver.service import serve
+
+        batch, constraints, catalog, pods = _encoded_batch()
+        addr_a = f"127.0.0.1:{free_port()}"
+        addr_b = f"127.0.0.1:{free_port()}"
+        server_a, server_b = serve(addr_a), serve(addr_b)
+        try:
+            sched = TpuScheduler(
+                Cluster(), rng=random.Random(0),
+                service_address=f"{addr_a},{addr_b}",
+            )
+            vnodes = sched.solve(constraints, catalog, pods)
+            assert sum(len(v.pods) for v in vnodes) == len(pods)
+            assert isinstance(sched._remote_or_init(), SolverPool)
+        finally:
+            server_a.stop(grace=0)
+            server_b.stop(grace=0)
+
+        dead = TpuScheduler(
+            Cluster(), rng=random.Random(0),
+            service_address=f"127.0.0.1:{free_port()},127.0.0.1:{free_port()}",
+        )
+        dead._remote_or_init()._timeout = 1
+        vnodes = dead.solve(constraints, catalog, pods)
+        assert sum(len(v.pods) for v in vnodes) == len(pods)

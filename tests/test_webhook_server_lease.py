@@ -267,10 +267,13 @@ class TestChartAndPackaging:
 
         docs = [d for doc in out.split("\n---\n") for d in yaml.safe_load_all(doc) if d]
         kinds = sorted(d["kind"] for d in docs)
-        assert kinds.count("Deployment") == 3  # controller, solver, webhook
+        assert kinds.count("Deployment") == 2  # controller, webhook
+        # the solver pool is a StatefulSet: ring routing needs stable
+        # per-member addresses (docs/fleet.md)
+        assert kinds.count("StatefulSet") == 1
         assert "CustomResourceDefinition" in kinds
         assert "ClusterRole" in kinds
-        # the controller points at the solver Service
+        # the controller points at the solver pool members
         controller = next(
             d for d in docs
             if d["kind"] == "Deployment" and "controller" in d["metadata"]["name"]
@@ -278,6 +281,9 @@ class TestChartAndPackaging:
         args = controller["spec"]["template"]["spec"]["containers"][0]["args"]
         assert any("solver-service-address=karpenter-tpu-solver" in a for a in args)
         assert any("kube-api-server=in-cluster" in a for a in args)
+        # fleet mode by default: shard leases on, whole-process election off
+        assert any(a.startswith("--shard-lease=kube:") for a in args)
+        assert not any(a.startswith("--leader-election-lease") for a in args)
 
     def test_chart_gates_render_conditionally(self):
         import subprocess
