@@ -64,6 +64,10 @@ fleet-chaos:  ## fleet HA proof: shard/pool suites + the replica+sidecar-kill st
 	$(PY) -m pytest tests/test_fleet.py tests/test_fleet_pool.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --fleet-storm 120 --solver tpu
 
+crash-chaos:  ## crash-consistency proof: journal/GC suites + the kill-mid-create storm leg
+	$(PY) -m pytest tests/test_launch_journal.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --crash-storm 200 --solver ffd
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -96,5 +100,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-notrace benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -1207,6 +1207,244 @@ def bench_fleet_storm(
             pass
 
 
+def bench_crash_storm(
+    n_pods: int = 200,
+    n_provisioners: int = 4,
+    n_replicas: int = 3,
+    lease_duration: float = 1.5,
+    renew_interval: float = 0.3,
+    gc_interval: float = 1.0,
+    solver: str = "ffd",
+):
+    """Crash-consistency storm (docs/launch-journal.md): N controller
+    replicas share one cluster, one shard-lease file, and one write-ahead
+    launch-journal file. Mid-storm one replica is killed BETWEEN the cloud
+    create and the Node write (the orphan the GC sweep must ADOPT), then a
+    second replica is killed BETWEEN the Node write and the bind (recovery
+    must confirm the Node already tracks the instance). The leg reports
+    the acceptance numbers: leaked_instances (bar: 0), duplicate_launches
+    (bar: 0), adoption latency vs the one-GC-period bar, and
+    chaos_provision_success_rate (bar: 1.0)."""
+    import tempfile
+    import threading
+
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.testing.chaos import LaunchCrashCluster, ReplicaChaos
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+    t_start = time.perf_counter()
+    lease_path = tempfile.mktemp(prefix="karpenter-crash-lease-")
+    journal_path = tempfile.mktemp(prefix="karpenter-crash-journal-")
+    cluster = Cluster()
+    api = SimCloudAPI()
+    fleet = ReplicaChaos()
+    crash_clusters = {}
+
+    adopted_before = _sample(m, "karpenter_launch_orphans_adopted_total")
+    leaked_before = _sample(m, "karpenter_launch_instances_leaked_total")
+
+    # duplicate-launch detector #1: a pod whose nodeName flips between two
+    # non-empty values was double-provisioned (no preemption in this leg)
+    rebinds = []
+    last_node = {}
+    watch_mu = threading.Lock()
+
+    def on_pod(event, pod):
+        if event == "DELETED" or not pod.spec.node_name:
+            return
+        with watch_mu:
+            prev = last_node.get(pod.metadata.name)
+            if prev and prev != pod.spec.node_name:
+                rebinds.append((pod.metadata.name, prev, pod.spec.node_name))
+            last_node[pod.metadata.name] = pod.spec.node_name
+
+    cluster.watch("pods", on_pod)
+
+    opts = dict(
+        shard_lease=lease_path,
+        shard_lease_duration=lease_duration,
+        launch_journal=journal_path,
+        gc_interval=gc_interval,
+        gc_grace_period=max(gc_interval * 4, 4.0),
+        default_solver=solver,
+    )
+    try:
+        for i in range(n_replicas):
+            # each replica launches through its OWN crash proxy over the
+            # shared cluster, so the scenario can kill exactly one mid-write
+            proxy = LaunchCrashCluster(cluster)
+            crash_clusters[f"replica-{i}"] = proxy
+            rt = build_runtime(
+                Options(**opts),
+                cluster=proxy,
+                cloud_provider=SimulatedCloudProvider(api=api),
+                shard_identity=f"replica-{i}",
+            )
+            rt.ownership.renew_interval = renew_interval
+            # the adoption bar is measured against eligibility: an entry
+            # must age replay_after before the sweep touches it
+            rt.garbage_collection.replay_after = gc_interval
+            rt.ownership.start()
+            rt.manager.start()
+            fleet.add(f"replica-{i}", rt)
+
+        names = [f"crash-{i}" for i in range(n_provisioners)]
+        for name in names:
+            cluster.create("provisioners", make_provisioner(
+                name=name, solver=solver,
+                requirements=[NodeSelectorRequirement(
+                    key="crashfleet", operator="In", values=[name],
+                )],
+            ))
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            owners = {name: fleet.owner_named(name) for name in names}
+            if all(
+                rt is not None and name in rt.provisioning.workers
+                for name, (_, rt) in owners.items()
+            ):
+                break
+            time.sleep(0.05)
+        assert all(fleet.owner_named(n)[0] for n in names), "shards never all owned"
+        for rt in fleet.replicas.values():
+            for w in rt.provisioning.workers.values():
+                w.batcher.idle_duration = 0.1
+
+        instances_before = len(api.list_instances())
+
+        def crash_phase(point: str, shard: str, first_pod: int, count: int):
+            """Arm ``point`` on the owner of ``shard``, drive pods at it,
+            kill the owner the moment the crash fires. Returns the kill
+            timestamp (perf_counter) and the victim's crash proxy."""
+            victim = None
+            deadline = time.time() + lease_duration * 10
+            while time.time() < deadline:
+                victim, _ = fleet.owner_named(shard)
+                if victim is not None:
+                    break
+                time.sleep(0.05)  # a prior phase's rebalance still settling
+            assert victim is not None, f"no live owner for {shard}"
+            proxy = crash_clusters[victim]
+            proxy.arm(point)
+            for i in range(first_pod, first_pod + count):
+                cluster.create("pods", make_pod(
+                    name=f"storm-{i}", requests={"cpu": "0.25"},
+                    node_selector={
+                        "crashfleet": f"crash-{i % n_provisioners}",
+                    },
+                ))
+            if not proxy.crashed.wait(timeout=60):
+                raise AssertionError(
+                    f"crash point {point} never fired on {victim}"
+                )
+            t_kill = time.perf_counter()
+            fleet.kill(victim)
+            return t_kill, proxy
+
+        half = n_pods // 2
+        # phase 1: die between the cloud create and the Node write — the
+        # instance exists, tokened and journaled, and nothing tracks it
+        t_kill_1, proxy_1 = crash_phase("before_node_write", "crash-0", 0, half)
+        # the orphan, identified by the interrupted write itself (the node
+        # is named after its instance): scanning the provider for "newest
+        # untracked instance" would race a survivor's healthy in-flight
+        # launch and could measure an ordinary Node write as the adoption
+        orphan_id = proxy_1.crash_nodes.get("before_node_write")
+
+        # wait for a survivor's GC sweep to adopt it (Node written)
+        adoption_s = None
+        if orphan_id:
+            deadline = time.time() + max(gc_interval * 10, 30)
+            while time.time() < deadline:
+                if cluster.try_get("nodes", orphan_id, namespace="") is not None:
+                    adoption_s = time.perf_counter() - t_kill_1
+                    break
+                time.sleep(0.05)
+
+        # phase 2: die between the Node write and the bind — the Node
+        # already tracks the instance; recovery resolves, pods re-enter
+        crash_phase("after_node_write", "crash-1", half, n_pods - half)
+
+        # settle: every storm pod bound by the survivors
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            pods = [p for p in cluster.pods() if p.metadata.name.startswith("storm-")]
+            if len(pods) == n_pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        pods = [p for p in cluster.pods() if p.metadata.name.startswith("storm-")]
+        bound = [p for p in pods if p.spec.node_name]
+
+        # let the journal drain (replays resolve confirmed entries)
+        journal = fleet.replicas[next(iter(fleet.replicas))].journal
+        deadline = time.time() + max(gc_interval * 10, 30)
+        while time.time() < deadline and journal.unresolved():
+            time.sleep(0.1)
+
+        # leak audit: every live instance must be tracked by a Node
+        node_names = {n.metadata.name for n in cluster.nodes()}
+        provider_ids = {n.spec.provider_id for n in cluster.nodes()}
+        live = [i for i in api.list_instances() if i.state != "terminated"]
+        leaked = [
+            i for i in live
+            if i.id not in node_names
+            and f"sim:///{i.zone}/{i.id}" not in provider_ids
+        ]
+        # duplicate-launch detector #2: one launch token, one instance
+        token_counts = {}
+        for inst in live:
+            if inst.launch_token:
+                token_counts[inst.launch_token] = (
+                    token_counts.get(inst.launch_token, 0) + 1
+                )
+        dup_tokens = {t: c for t, c in token_counts.items() if c > 1}
+
+        adoption_bar_s = gc_interval * 2  # age-in (replay_after) + one sweep
+        return {
+            "pods": n_pods,
+            "provisioners": n_provisioners,
+            "replicas": n_replicas,
+            "solver": solver,
+            "lease_duration_s": lease_duration,
+            "gc_interval_s": gc_interval,
+            "chaos_provision_success_rate": round(len(bound) / max(n_pods, 1), 4),
+            "crashes_fired": {
+                name: dict(proxy.crashes)
+                for name, proxy in crash_clusters.items() if proxy.crashes
+            },
+            "leaked_instances": len(leaked),
+            "duplicate_launches": len(rebinds) + len(dup_tokens),
+            "duplicate_rebinds": rebinds[:5],
+            "duplicate_tokens": list(dup_tokens)[:5],
+            "orphans_adopted": int(
+                _sample(m, "karpenter_launch_orphans_adopted_total") - adopted_before
+            ),
+            "leaks_terminated": int(
+                _sample(m, "karpenter_launch_instances_leaked_total") - leaked_before
+            ),
+            "adoption_s": round(adoption_s, 3) if adoption_s is not None else None,
+            "adoption_bar_s": round(adoption_bar_s + 1.0, 3),
+            "adopted_within_gc_period": (
+                adoption_s is not None and adoption_s <= adoption_bar_s + 1.0
+            ),
+            "journal_unresolved_after": len(journal.unresolved()),
+            "instances_launched": len(api.list_instances()) - instances_before,
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        fleet.stop_all()
+        for path in (lease_path, journal_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
 def _sample(m, name: str) -> float:
     """Sum a metric family's samples from the process registry."""
     total = 0.0
@@ -1762,9 +2000,19 @@ def main():
                          "reports aggregate pods/sec, p99 time-to-bind, "
                          "duplicate_launches (bar: 0) and rebalance_s "
                          "(bar: 2x lease duration)")
-    ap.add_argument("--fleet-provisioners", type=int, default=8)
+    # None = each storm's own default (fleet: 8, crash: 4) — a real default
+    # here would be indistinguishable from an explicit request for it
+    ap.add_argument("--fleet-provisioners", type=int, default=None)
     ap.add_argument("--fleet-replicas", type=int, default=3)
     ap.add_argument("--fleet-pool", type=int, default=2)
+    ap.add_argument("--crash-storm", type=int, metavar="N_PODS", default=0,
+                    help="crash-consistency storm: a replica is killed "
+                         "between the cloud create and the Node write, a "
+                         "second between the Node write and the bind; "
+                         "reports leaked_instances (bar: 0), "
+                         "duplicate_launches (bar: 0), adoption latency vs "
+                         "the one-GC-period bar, and "
+                         "chaos_provision_success_rate (bar: 1.0)")
     ap.add_argument("--config", type=int, default=0, metavar="1..5",
                     help="run one of BASELINE.json's five configs")
     ap.add_argument("--all-configs", action="store_true",
@@ -1843,7 +2091,7 @@ def main():
     if args.fleet_storm:
         r = bench_fleet_storm(
             args.fleet_storm,
-            n_provisioners=args.fleet_provisioners,
+            n_provisioners=args.fleet_provisioners or 8,
             n_replicas=args.fleet_replicas,
             pool_size=args.fleet_pool,
             solver=args.solver,
@@ -1863,6 +2111,33 @@ def main():
             "unit": "aggregate pods/sec",
             "fleet_ok": ok,
             **{k: v for k, v in r.items() if k != "aggregate_pods_per_sec"},
+        }))
+        return
+
+    if args.crash_storm:
+        r = bench_crash_storm(
+            args.crash_storm,
+            n_provisioners=args.fleet_provisioners or 4,
+            n_replicas=args.fleet_replicas,
+            solver=args.solver,
+        )
+        ok = (
+            r["chaos_provision_success_rate"] == 1.0
+            and r["leaked_instances"] == 0
+            and r["duplicate_launches"] == 0
+            and r["adopted_within_gc_period"]
+        )
+        print(json.dumps({
+            "metric": (
+                f"crash-storm ({r['pods']} pods, {r['replicas']} replicas, "
+                "kill mid-create + kill mid-bind)"
+            ),
+            "value": r["chaos_provision_success_rate"],
+            "unit": "provision success rate with zero leaks",
+            "crash_ok": ok,
+            **{k: v for k, v in r.items()
+               if k != "chaos_provision_success_rate"},
+            "chaos_provision_success_rate": r["chaos_provision_success_rate"],
         }))
         return
 

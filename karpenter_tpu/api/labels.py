@@ -25,6 +25,10 @@ PROVISIONER_NAME_LABEL = LABEL_DOMAIN + "/provisioner-name"
 NOT_READY_TAINT_KEY = LABEL_DOMAIN + "/not-ready"
 INTERRUPTION_TAINT_KEY = LABEL_DOMAIN + "/interruption"
 DO_NOT_EVICT_ANNOTATION = LABEL_DOMAIN + "/do-not-evict"
+# the client launch token stamped on both the cloud instance (tag/label)
+# and the Node object at create — the idempotency key that pairs them for
+# crash recovery (launch/journal.py) and the GC/adoption cross-check
+LAUNCH_TOKEN_ANNOTATION = LABEL_DOMAIN + "/launch-token"
 EMPTINESS_TIMESTAMP_ANNOTATION = LABEL_DOMAIN + "/emptiness-timestamp"
 TERMINATION_FINALIZER = LABEL_DOMAIN + "/termination"
 

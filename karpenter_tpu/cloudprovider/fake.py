@@ -11,11 +11,18 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Dict, FrozenSet, List, Optional
 
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
-from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    LiveInstance,
+    NodeRequest,
+    Offering,
+)
 from karpenter_tpu.interruption.types import PREEMPTION, DisruptionNotice, NoticeQueue
 from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
@@ -149,10 +156,19 @@ class FakeCloudProvider(CloudProvider):
         self.delete_calls: List[str] = []
         self.disruptions = NoticeQueue()
         self._mu = threading.Lock()
+        # launch-token ledger: token -> the node that create returned, and
+        # the live-instance inventory list_instances serves. Same token →
+        # same node, never a second launch (the idempotent-create contract).
+        self._token_nodes: Dict[str, Node] = {}  # guarded-by: self._mu
+        self._instances: Dict[str, LiveInstance] = {}  # guarded-by: self._mu
 
+    @idempotent
     def create(self, request: NodeRequest) -> Node:
+        token = request.launch_token
         with self._mu:
             self.create_calls.append(request)
+            if token and token in self._token_nodes:
+                return self._token_nodes[token]
         name = f"fake-node-{next(_name_counter)}"
         instance = request.instance_type_options[0]
         zone = capacity_type = ""
@@ -161,7 +177,7 @@ class FakeCloudProvider(CloudProvider):
             if reqs.capacity_types() and o.capacity_type in reqs.capacity_types() and o.zone in reqs.zones():
                 zone, capacity_type = o.zone, o.capacity_type
                 break
-        return Node(
+        node = Node(
             metadata=ObjectMeta(
                 name=name,
                 namespace="",
@@ -170,6 +186,9 @@ class FakeCloudProvider(CloudProvider):
                     lbl.INSTANCE_TYPE: instance.name,
                     lbl.CAPACITY_TYPE: capacity_type,
                 },
+                annotations=(
+                    {lbl.LAUNCH_TOKEN_ANNOTATION: token} if token else {}
+                ),
             ),
             spec=NodeSpec(provider_id=f"fake:///{name}/{zone}"),
             status=NodeStatus(
@@ -181,11 +200,36 @@ class FakeCloudProvider(CloudProvider):
                 capacity=dict(instance.resources),
             ),
         )
+        with self._mu:
+            if token:
+                # a racer with the same token committed first: ITS node is
+                # the one the token names (this fabricated double is dropped)
+                racer = self._token_nodes.get(token)
+                if racer is not None:
+                    return racer
+                self._token_nodes[token] = node
+            self._instances[name] = LiveInstance(
+                id=name,
+                launch_token=token,
+                instance_type=instance.name,
+                zone=zone,
+                capacity_type=capacity_type,
+                created_at=time.time(),
+                provider_id=node.spec.provider_id,
+            )
+        return node
 
     @idempotent
     def delete(self, node: Node) -> None:
         with self._mu:
             self.delete_calls.append(node.metadata.name)
+            live = self._instances.pop(node.metadata.name, None)
+            if live is not None and live.launch_token:
+                self._token_nodes.pop(live.launch_token, None)
+
+    def list_instances(self) -> List[LiveInstance]:
+        with self._mu:
+            return list(self._instances.values())
 
     @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:

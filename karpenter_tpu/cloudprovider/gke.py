@@ -29,13 +29,20 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, PodCondition
 from karpenter_tpu.api.provisioner import Constraints
-from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    LiveInstance,
+    NodeRequest,
+    Offering,
+)
 from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
 from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
@@ -85,6 +92,11 @@ class GkeInstance:
     zone: str
     spot: bool
     node_pool: str
+    # the launch token of the create() call this host was handed to (the
+    # GCE label analog): pool creation stamps the first host; pending
+    # multi-host siblings are stamped as later creates claim them
+    launch_token: str = ""
+    created_at: float = 0.0
 
 
 @dataclass
@@ -110,6 +122,10 @@ class SimGkeAPI:
         self.create_calls: List[GkeNodePool] = []
         self.delete_calls: List[str] = []
         self._stockouts: set = set()
+        # launch-token ledger: token -> pool name. A retried
+        # create_node_pool with a committed token replays the recorded pool
+        # instead of launching a second (possibly multi-host TPU) one.
+        self._token_pools: Dict[str, str] = {}  # guarded-by: self._lock
         # the disruption-event bus: GCE preemption / maintenance notices
         # tests inject and the interruption controller polls
         self.disruptions = NoticeQueue()
@@ -135,19 +151,27 @@ class SimGkeAPI:
         spot: bool,
         count: int,
         tpu_topology: str = "",
+        launch_token: str = "",
     ) -> GkeNodePool:
         """Create a node pool of ``count`` instances ATOMICALLY: a stockout
         yields zero instances, never a partial podslice (a partial slice is
-        useless to a multi-host workload)."""
+        useless to a multi-host workload). A ``launch_token`` the control
+        plane already committed replays the recorded pool — a transport
+        retry after a lost response cannot launch a second slice."""
         if count < 1:
             raise GkeApiError(f"node pool count must be >= 1, got {count}")
         ct = "spot" if spot else "on-demand"
         with self._lock:
+            if launch_token:
+                committed = self._token_pools.get(launch_token)
+                if committed is not None and committed in self.node_pools:
+                    return self.node_pools[committed]
             if (machine_type, zone, ct) in self._stockouts:
                 raise GkeStockoutError(
                     f"ZONAL_RESOURCE_POOL_EXHAUSTED: {machine_type} in {zone} ({ct})"
                 )
             n = next(self._counter)
+            now = time.time()
             pool = GkeNodePool(
                 name=f"np-{machine_type}-{n}",
                 machine_type=machine_type,
@@ -163,12 +187,39 @@ class SimGkeAPI:
                     zone=zone,
                     spot=spot,
                     node_pool=pool.name,
+                    # the creating call is handed host 0; pending siblings
+                    # stay token-less until claim_instance stamps them
+                    launch_token=launch_token if i == 0 else "",
+                    created_at=now,
                 )
                 for i in range(count)
             ]
             self.node_pools[pool.name] = pool
             self.create_calls.append(pool)
+            if launch_token:
+                self._token_pools[launch_token] = pool.name
             return pool
+
+    def claim_instance(self, name: str, launch_token: str) -> None:
+        """Stamp the claiming create's token onto a pending multi-host
+        sibling — each host of a slice carries the token of the create()
+        that returned it, so crash recovery can re-find ANY host by its
+        journal entry's token."""
+        with self._lock:
+            for pool in self.node_pools.values():
+                for inst in pool.instances:
+                    if inst.name == name:
+                        inst.launch_token = launch_token
+                        return
+
+    def list_instances(self) -> List[GkeInstance]:
+        """Full inventory across pools — the GC/recovery sweep surface."""
+        with self._lock:
+            return [
+                inst
+                for pool in self.node_pools.values()
+                for inst in pool.instances
+            ]
 
     def delete_node_pool(self, name: str) -> None:
         with self._lock:
@@ -284,6 +335,10 @@ class GkeCloudProvider(CloudProvider):
         # multi-host slices already launched whose remaining hosts are
         # waiting to be claimed by subsequent create() calls
         self._pending_hosts: Dict[Tuple[str, str, str], List[Node]] = {}
+        # launch-token replay: token -> the node this provider's create
+        # already returned for it. Covers the pending-host claim path the
+        # API-level pool ledger cannot see (a claim consumes no API call).
+        self._token_nodes: Dict[str, Node] = {}  # guarded-by: self._lock
 
     # -- catalog -----------------------------------------------------------
     @idempotent
@@ -316,9 +371,16 @@ class GkeCloudProvider(CloudProvider):
         return out
 
     # -- launch ------------------------------------------------------------
+    @idempotent
     def create(self, request: NodeRequest) -> Node:
+        # idempotent BY TOKEN: a token this provider (or the node-pool API)
+        # already committed returns the SAME node — a retried create after
+        # a timed-out first attempt yields exactly one host, never two
+        token = request.launch_token
         with self._lock:
             self.create_calls.append(request)
+            if token and token in self._token_nodes:
+                return self._token_nodes[token]
         if not request.instance_type_options:
             raise ValueError("no instance type options")
         reqs = request.template.requirements
@@ -350,6 +412,7 @@ class GkeCloudProvider(CloudProvider):
                         node = pending.pop(0)
                         if not pending:
                             del self._pending_hosts[key]
+                        self._stamp_token_locked(node, token)
                         return node
                     try:
                         pool = self.api.create_node_pool(
@@ -358,6 +421,7 @@ class GkeCloudProvider(CloudProvider):
                             spot=o.capacity_type == "spot",
                             count=hosts,
                             tpu_topology=it.labels.get(GKE_TPU_TOPOLOGY_LABEL, ""),
+                            launch_token=token,
                         )
                     except GkeStockoutError as e:
                         # classified capacity error: cache the offering out
@@ -369,6 +433,7 @@ class GkeCloudProvider(CloudProvider):
                     first = nodes.pop(0)
                     if nodes:
                         self._pending_hosts[key] = nodes
+                    self._stamp_token_locked(first, token, claim=False)
                     return first
         if last_err is not None:
             raise last_err
@@ -381,6 +446,46 @@ class GkeCloudProvider(CloudProvider):
         raise ValueError(
             "no offering satisfies the request's zone/capacity-type requirements"
         )
+
+    def _stamp_token_locked(self, node: Node, token: str, claim: bool = True) -> None:
+        """Pair ``node`` with the claiming create's token: annotation on the
+        Node, entry in the replay cache, and (for a pending-host claim) the
+        tag on the cloud instance itself so ``list_instances`` reports it.
+        Caller holds ``self._lock``."""
+        if not token:
+            return
+        node.metadata.annotations[lbl.LAUNCH_TOKEN_ANNOTATION] = token
+        self._token_nodes[token] = node
+        while len(self._token_nodes) > 4096:  # bound the long-lived ledger
+            self._token_nodes.pop(next(iter(self._token_nodes)))
+        if claim:
+            claimer = getattr(self.api, "claim_instance", None)
+            if claimer is not None:
+                claimer(node.metadata.name, token)
+
+    def list_instances(self) -> List[LiveInstance]:
+        """Live inventory for the GC/adoption cross-check. Hosts still
+        PENDING (launched as part of a slice, not yet claimed by a create)
+        carry no token — the GC grace period is what protects them while
+        their siblings' creates are in flight."""
+        lister = getattr(self.api, "list_instances", None)
+        if lister is None:
+            return NotImplemented
+        out: List[LiveInstance] = []
+        for inst in lister():
+            out.append(
+                LiveInstance(
+                    id=inst.name,
+                    launch_token=inst.launch_token,
+                    instance_type=inst.machine_type,
+                    zone=inst.zone,
+                    capacity_type="spot" if inst.spot else "on-demand",
+                    created_at=inst.created_at,
+                    provider_id=f"gce://sim-project/{inst.zone}/{inst.name}",
+                    labels={GKE_NODEPOOL_LABEL: inst.node_pool},
+                )
+            )
+        return out
 
     def _node(self, it: InstanceType, offering: Offering, inst: GkeInstance) -> Node:
         labels = {
@@ -420,6 +525,10 @@ class GkeCloudProvider(CloudProvider):
         purged: List[Node] = []
         with self._lock:
             self.delete_calls.append(node.metadata.name)
+            # a deleted node's token must not replay a dead instance
+            token = node.metadata.annotations.get(lbl.LAUNCH_TOKEN_ANNOTATION)
+            if token:
+                self._token_nodes.pop(token, None)
             if pool:
                 # a multi-host slice is dying: its unclaimed pending hosts
                 # must die with it — handing a stale sibling out later would
@@ -468,7 +577,8 @@ class GkeCloudProvider(CloudProvider):
 
     def requeue_disruption(self, notice: DisruptionNotice) -> bool:
         """Fleet routing: re-offer a wrong-replica notice to the event bus
-        (in-process double only — the wire client answers False)."""
+        (in-process via the double's injector, over the wire via POST
+        /gke/v1/events/requeue)."""
         sender = getattr(self.api, "send_disruption_notice", None)
         if sender is None:
             return False

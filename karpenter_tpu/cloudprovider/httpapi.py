@@ -215,9 +215,13 @@ class CloudAPIServer(_JsonApiServer):
       DELETE /v1/launch-templates/<name>
       POST   /v1/fleet     {"capacityType", "overrides"}   → instances + errors
       POST   /v1/instances/describe  {"ids": [...]}        → {"items": [...]}
+      GET    /v1/instances                                 → full inventory with
+                                                             launch tokens
       POST   /v1/instances/terminate {"ids": [...]}        → {}
       GET    /v1/events                                    → pending disruption
                                                              notices (drained)
+      POST   /v1/events/requeue      body=notice           → re-offer a drained
+                                                             notice (fleet routing)
     """
 
     def __init__(self, api: Optional[SimCloudAPI] = None, page_size: int = DEFAULT_PAGE_SIZE):
@@ -271,15 +275,33 @@ class CloudAPIServer(_JsonApiServer):
                 raise _BadRequest(f"fleet override missing {e}") from e
             # idempotency: a retried POST (lost response / timeout) with the
             # same client token replays the recorded answer instead of
-            # double-launching — the CreateFleet ClientToken contract
+            # double-launching — the CreateFleet ClientToken contract. The
+            # wire-level replay cache catches retries of THIS server; the
+            # token also rides down to the control-plane double, whose own
+            # ledger dedupes across server restarts and in-process callers.
             token = body.get("clientToken")
             if token is not None:
                 with self._fleet_mu:
                     cached = self._fleet_results.get(token)
                 if cached is not None:
-                    h._send(200, cached)
-                    return
-            instances, errors = api.create_fleet(body["capacityType"], overrides)
+                    # replay only while the recorded instances are still
+                    # live: a delete between the first attempt and this
+                    # retry must not resurrect a terminated instance as a
+                    # fresh create result — drop the stale record and fall
+                    # through (the control-plane ledger launches fresh)
+                    ids = [i["id"] for i in cached.get("instances", [])]
+                    live = {
+                        i.id for i in api.describe_instances(ids)
+                        if getattr(i, "state", "") != "terminated"
+                    }
+                    if all(i in live for i in ids):
+                        h._send(200, cached)
+                        return
+                    with self._fleet_mu:
+                        self._fleet_results.pop(token, None)
+            instances, errors = api.create_fleet(
+                body["capacityType"], overrides, client_token=token or ""
+            )
             out = {
                 "instances": [asdict(i) for i in instances],
                 "errors": [
@@ -296,6 +318,9 @@ class CloudAPIServer(_JsonApiServer):
         elif method == "POST" and path == "/v1/instances/describe":
             ids = h._body().get("ids", [])
             h._send(200, {"items": [asdict(i) for i in api.describe_instances(ids)]})
+        elif method == "GET" and path == "/v1/instances":
+            # full inventory with launch tokens — the GC/recovery sweep
+            h._send(200, {"items": [asdict(i) for i in api.list_instances()]})
         elif method == "POST" and path == "/v1/instances/terminate":
             api.terminate_instances(h._body().get("ids", []))
             h._send(200, {})
@@ -304,6 +329,12 @@ class CloudAPIServer(_JsonApiServer):
             # SQS receive-and-delete analog; the wire consumer is the only
             # reader, matching NoticeQueue's at-most-once contract)
             h._send(200, {"items": [n.to_wire() for n in api.poll_disruptions()]})
+        elif method == "POST" and path == "/v1/events/requeue":
+            # the re-offer endpoint (the SQS visibility-timeout analog): a
+            # sharded replica that drained a notice for a node it does not
+            # own hands it BACK so the owner's next poll picks it up
+            api.send_disruption_notice(DisruptionNotice.from_wire(h._body()))
+            h._send(200, {})
         else:
             h._error(404, CODE_NOT_FOUND, f"{method} {path}")
 
@@ -479,7 +510,10 @@ class HttpCloudAPI(_WireTransport):
         )
 
     def create_fleet(
-        self, capacity_type: str, overrides: Sequence[Tuple[str, str, str]]
+        self,
+        capacity_type: str,
+        overrides: Sequence[Tuple[str, str, str]],
+        client_token: str = "",
     ) -> Tuple[List[SimInstance], List[Tuple[str, str, str]]]:
         import uuid
 
@@ -489,11 +523,13 @@ class HttpCloudAPI(_WireTransport):
                 {"launchTemplate": lt, "instanceType": it, "zone": z}
                 for lt, it, z in overrides
             ],
-            # one token per LOGICAL launch: transport retries replay the
-            # recorded result instead of launching a second instance —
-            # which is what makes this POST idempotent for the transport's
-            # 5xx retry policy
-            "clientToken": uuid.uuid4().hex,
+            # one token per LOGICAL launch: the caller's launch token when
+            # it carries one (so PROVIDER-level retries of the whole create
+            # also replay), else a per-call token — either way transport
+            # retries replay the recorded result instead of launching a
+            # second instance, which is what makes this POST idempotent for
+            # the transport's 5xx retry policy
+            "clientToken": client_token or uuid.uuid4().hex,
         }, idempotent=True)
         instances = [_from_dict(SimInstance, d) for d in body.get("instances", [])]
         errors = [
@@ -507,12 +543,24 @@ class HttpCloudAPI(_WireTransport):
         body = self._request("POST", "/v1/instances/describe", {"ids": list(ids)})
         return [_from_dict(SimInstance, d) for d in body.get("items", [])]
 
+    def list_instances(self) -> List[SimInstance]:
+        body = self._request("GET", "/v1/instances")
+        return [_from_dict(SimInstance, d) for d in body.get("items", [])]
+
     def terminate_instances(self, ids: List[str]) -> None:
         self._request("POST", "/v1/instances/terminate", {"ids": list(ids)})
 
     def poll_disruptions(self) -> List[DisruptionNotice]:
         body = self._request("GET", "/v1/events")
         return [DisruptionNotice.from_wire(d) for d in body.get("items", [])]
+
+    def send_disruption_notice(self, notice: DisruptionNotice) -> None:
+        """Re-offer a drained notice to the server's event bus (POST
+        /v1/events/requeue) — the fleet-routing hook that lets a non-owner
+        replica hand a foreign notice back across processes. Present on the
+        wire client means ``SimulatedCloudProvider.requeue_disruption`` now
+        answers True over HTTP, not only in-process."""
+        self._request("POST", "/v1/events/requeue", notice.to_wire())
 
 
 def _tag_query(selector: Dict[str, str]) -> str:
@@ -581,8 +629,20 @@ class GkeAPIServer(_JsonApiServer):
             pool = self.api.create_node_pool(
                 b["machineType"], b["zone"], bool(b.get("spot")),
                 int(b.get("count", 1)), b.get("tpuTopology", ""),
+                launch_token=b.get("launchToken", ""),
             )
             h._send(200, _asdict(pool))
+        elif method == "GET" and path == "/gke/v1/instances":
+            # full inventory with launch tokens — the GC/recovery sweep
+            h._send(
+                200, {"items": [_asdict(i) for i in self.api.list_instances()]}
+            )
+        elif method == "POST" and path.endswith("/claim") and path.startswith(
+            "/gke/v1/instances/"
+        ):
+            name = urllib.parse.unquote(path.rsplit("/", 2)[1])
+            self.api.claim_instance(name, h._body().get("launchToken", ""))
+            h._send(200, {})
         elif method == "DELETE" and path.startswith("/gke/v1/node-pools/"):
             self.api.delete_node_pool(urllib.parse.unquote(path.rsplit("/", 1)[1]))
             h._send(200, {})
@@ -593,6 +653,11 @@ class GkeAPIServer(_JsonApiServer):
             h._send(
                 200, {"items": [n.to_wire() for n in self.api.poll_disruptions()]}
             )
+        elif method == "POST" and path == "/gke/v1/events/requeue":
+            # the re-offer endpoint: foreign notices requeue across
+            # processes so the shard owner's next poll sees them
+            self.api.send_disruption_notice(DisruptionNotice.from_wire(h._body()))
+            h._send(200, {})
         else:
             h._error(404, CODE_NOT_FOUND, f"{method} {path}")
 
@@ -619,20 +684,36 @@ class HttpGkeAPI(_WireTransport):
         return GkeApiError(f"{code or status}: {message}")
 
     def create_node_pool(self, machine_type: str, zone: str, spot: bool,
-                         count: int, tpu_topology: str = ""):
+                         count: int, tpu_topology: str = "",
+                         launch_token: str = ""):
         from karpenter_tpu.cloudprovider.gke import GkeInstance, GkeNodePool
 
-        # NOT idempotent: unlike /v1/fleet there is no client token or
-        # replay cache — a transport retry after a committed create would
-        # leave an orphaned (possibly multi-host TPU) pool behind
+        # idempotent ONLY when tokened: with a launch token the server's
+        # pool ledger replays a committed create, so transport retries are
+        # safe; a token-less create keeps the conservative no-retry policy
+        # (a replayed commit would orphan a possibly multi-host TPU pool)
         d = self._request("POST", "/gke/v1/node-pools", {
             "machineType": machine_type, "zone": zone, "spot": spot,
             "count": count, "tpuTopology": tpu_topology,
-        }, idempotent=False)
+            "launchToken": launch_token,
+        }, idempotent=bool(launch_token))
         instances = [_from_dict(GkeInstance, i) for i in d.pop("instances", [])]
         pool = _from_dict(GkeNodePool, d)
         pool.instances = instances
         return pool
+
+    def claim_instance(self, name: str, launch_token: str) -> None:
+        self._request(
+            "POST",
+            f"/gke/v1/instances/{urllib.parse.quote(name, safe='')}/claim",
+            {"launchToken": launch_token},
+        )
+
+    def list_instances(self):
+        from karpenter_tpu.cloudprovider.gke import GkeInstance
+
+        body = self._request("GET", "/gke/v1/instances")
+        return [_from_dict(GkeInstance, d) for d in body.get("items", [])]
 
     def delete_node_pool(self, name: str) -> None:
         self._request(
@@ -647,3 +728,8 @@ class HttpGkeAPI(_WireTransport):
     def poll_disruptions(self) -> List[DisruptionNotice]:
         body = self._request("GET", "/gke/v1/events")
         return [DisruptionNotice.from_wire(d) for d in body.get("items", [])]
+
+    def send_disruption_notice(self, notice: DisruptionNotice) -> None:
+        """Re-offer a drained notice (POST /gke/v1/events/requeue) — lets
+        ``GkeCloudProvider.requeue_disruption`` answer True over the wire."""
+        self._request("POST", "/gke/v1/events/requeue", notice.to_wire())

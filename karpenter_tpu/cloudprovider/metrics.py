@@ -19,13 +19,20 @@ On top of the histograms, the decorator is where the resilience layer
   signals (``InsufficientCapacityError``/stockouts) and validation errors
   are never retried — the ICE caches own those.
 
-``create`` is NOT retried here: a provider-level retry that lands after a
+``create`` is retried here since the launch-token work — for delegates
+whose own ``create`` carries the ``@idempotent`` marker (which karplint
+ties to token awareness); a token-unaware delegate keeps the old
+breaker-only, no-retry contract. Every request is stamped with a client
+launch token before it reaches the vendor (the provisioning worker
+journals the token first; this decorator backstops direct callers), and
+all four in-tree providers replay a committed token instead of launching
+twice — so a provider-level retry that lands after a
 partially-completed launch (fleet committed, follow-up describe flaked)
-would orphan an instance no Node object tracks. The only safe create
-retry is the wire transport's tokened fleet POST, which replays the
-recorded answer instead of launching twice; the metered layer contributes
-the breaker. The read-path methods (describe/poll) and the idempotent
-delete retry freely.
+re-finds the SAME instance rather than orphaning one no Node tracks.
+Instances a crashed process still leaves behind are re-described by token
+and adopted or reaped by the launch journal + GC controller
+(docs/launch-journal.md). The read-path methods (describe/poll) and the
+idempotent delete retry freely.
 """
 
 from __future__ import annotations
@@ -39,7 +46,7 @@ from karpenter_tpu.api.objects import Node
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
 from karpenter_tpu.resilience import BreakerBoard, BreakerOpen, RetryPolicy
-from karpenter_tpu.resilience.markers import idempotent
+from karpenter_tpu.resilience.markers import idempotent, is_idempotent
 
 # Which controller's reconcile (or worker loop) is currently executing.
 reconciling_controller: contextvars.ContextVar[str] = contextvars.ContextVar(
@@ -70,9 +77,17 @@ class MeteredCloudProvider(CloudProvider):
         )
         name = delegate.name()
         self._policies: Dict[str, RetryPolicy] = {
-            # max_attempts=1: breaker only — see the module docstring
-            "create": RetryPolicy(max_attempts=1, deadline=20.0,
-                                  dependency=f"{name}:create"),
+            # create retries are safe ONLY against a delegate that replays
+            # launch tokens — its own @idempotent marker (karplint-enforced
+            # to imply token awareness) is the opt-in. An out-of-tree
+            # provider that never reads request.launch_token stays at the
+            # old breaker-only contract: a retried create there would land
+            # a second instance no Node tracks (the orphan this whole
+            # module docstring is about).
+            "create": RetryPolicy(
+                max_attempts=3 if is_idempotent(delegate.create) else 1,
+                deadline=20.0, dependency=f"{name}:create",
+            ),
             "delete": RetryPolicy(max_attempts=3, deadline=15.0,
                                   dependency=f"{name}:delete"),
             "get_instance_types": RetryPolicy(max_attempts=3, deadline=15.0,
@@ -139,7 +154,16 @@ class MeteredCloudProvider(CloudProvider):
             finally:
                 self._observe(method, start)
 
+    @idempotent
     def create(self, request: NodeRequest) -> Node:
+        # idempotent BY TOKEN: a request arriving without a launch token
+        # (direct callers; the provisioning worker journals its own first)
+        # is stamped here, so every retry below replays one logical launch
+        if request is not None and not getattr(request, "launch_token", ""):
+            import dataclasses
+            import uuid
+
+            request = dataclasses.replace(request, launch_token=uuid.uuid4().hex)
         return self._guarded("create", self.delegate.create, request)
 
     @idempotent
@@ -165,6 +189,12 @@ class MeteredCloudProvider(CloudProvider):
         # liveness probes carry their own miss-threshold debouncing; a
         # breaker/retry layer here would only delay the reset-on-sighting
         return self.delegate.instance_gone(node)
+
+    def list_instances(self):
+        # the GC sweep's read path: unmetered passthrough (the sweep has
+        # its own cadence; a raised list simply defers one GC round, and a
+        # breaker here could mask a real leak for its whole open window)
+        return self.delegate.list_instances()
 
     def requeue_disruption(self, notice) -> bool:
         # a local re-offer, not a metered control-plane call

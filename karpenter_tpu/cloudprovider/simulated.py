@@ -41,7 +41,13 @@ from karpenter_tpu.api.objects import (
     ObjectMeta,
 )
 from karpenter_tpu.api.provisioner import Constraints
-from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest, Offering
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    LiveInstance,
+    NodeRequest,
+    Offering,
+)
 from karpenter_tpu.interruption.types import DisruptionNotice, NoticeQueue
 from karpenter_tpu.resilience.markers import idempotent
 from karpenter_tpu.utils import resources as res
@@ -142,6 +148,11 @@ class SimInstance:
     capacity_type: str
     launch_template: str
     state: str = "running"
+    # the client launch token stamped at create_fleet (the EC2 tag analog):
+    # what makes a retried fleet call replay instead of double-launching,
+    # and what crash recovery re-describes unresolved journal entries by
+    launch_token: str = ""
+    created_at: float = 0.0
 
 
 def default_sim_catalog() -> List[SimInstanceTypeInfo]:
@@ -189,6 +200,13 @@ class SimCloudAPI:
         self._errors: Dict[str, List[Exception]] = {}
         self._counter = itertools.count(1)
         self._mu = threading.Lock()
+        # client-token ledger: token -> instance id. A retried create_fleet
+        # with a token the control plane has already committed replays the
+        # recorded instance instead of launching a second one — the
+        # CreateFleet ClientToken contract, now honored by the in-process
+        # double itself (not only the HTTP wire's replay cache), so every
+        # caller gets idempotent creates.
+        self._fleet_tokens: Dict[str, str] = {}  # guarded-by: self._mu
 
     # -- error injection ----------------------------------------------------
     def inject_error(self, method: str, error: Exception) -> None:
@@ -229,14 +247,26 @@ class SimCloudAPI:
         self,
         capacity_type: str,
         overrides: Sequence[Tuple[str, str, str]],  # (launch_template, instance_type, zone)
+        client_token: str = "",
     ) -> Tuple[List[SimInstance], List[Tuple[str, str, str]]]:
         """Launch ONE instance from the first override whose capacity pool is
         healthy; returns (instances, ICE-errored overrides) — the
         CreateFleet(type=instant, TotalTargetCapacity=1) analog
-        (reference: aws/instance.go:120-156, fake/ec2api.go:78-137)."""
+        (reference: aws/instance.go:120-156, fake/ec2api.go:78-137).
+        A ``client_token`` the control plane has already committed replays
+        the recorded instance: same token, same instance, never a second
+        launch."""
         self._enter("create_fleet")
         errors: List[Tuple[str, str, str]] = []
         with self._mu:
+            if client_token:
+                committed = self._fleet_tokens.get(client_token)
+                if (
+                    committed is not None
+                    and committed in self.instances
+                    and self.instances[committed].state != "terminated"
+                ):
+                    return [self.instances[committed]], errors
             for lt, itype, zone in overrides:
                 if (capacity_type, itype, zone) in self.insufficient_capacity_pools:
                     errors.append((capacity_type, itype, zone))
@@ -247,8 +277,12 @@ class SimCloudAPI:
                     zone=zone,
                     capacity_type=capacity_type,
                     launch_template=lt,
+                    launch_token=client_token,
+                    created_at=time.time(),
                 )
                 self.instances[inst.id] = inst
+                if client_token:
+                    self._fleet_tokens[client_token] = inst.id
                 return [inst], errors
         if errors:
             # EVERY override hit an exhausted pool: surface it typed (with
@@ -264,6 +298,13 @@ class SimCloudAPI:
         with self._mu:
             return [self.instances[i] for i in ids if i in self.instances]
 
+    def list_instances(self) -> List[SimInstance]:
+        """Full inventory (the DescribeInstances-no-filter analog) — what
+        the launch journal's recovery and the GC controller sweep."""
+        self._enter("list_instances")
+        with self._mu:
+            return list(self.instances.values())
+
     def terminate_instances(self, ids: List[str]) -> None:
         self._enter("terminate_instances")
         with self._mu:
@@ -271,6 +312,11 @@ class SimCloudAPI:
                 inst = self.instances.get(i)
                 if inst:
                     inst.state = "terminated"
+                    # release the token ledger entry (Fake/GKE pop theirs
+                    # on delete): a token replay must never resurrect a
+                    # terminated instance as a live create result
+                    if inst.launch_token:
+                        self._fleet_tokens.pop(inst.launch_token, None)
 
     def send_disruption_notice(self, notice: DisruptionNotice) -> None:
         """Fault injector: put a disruption notice on the event bus. Node
@@ -741,7 +787,8 @@ class InstanceProvider:
         # (reference: aws/instance.go:43-49, 2 QPS / 100 burst)
         self.fleet_limiter = TokenBucket(CREATE_FLEET_QPS, CREATE_FLEET_BURST)
 
-    def create(self, config: SimProviderConfig, request: NodeRequest) -> Node:
+    def create(self, config: SimProviderConfig, request: NodeRequest,
+               token: str = "") -> Node:
         # GPU filter BEFORE the 20-type cap: a GPU-heavy prefix must not
         # starve out the generic types (reference: aws/instance.go:73-75)
         options = self._prefer_generic(list(request.instance_type_options))
@@ -771,7 +818,12 @@ class InstanceProvider:
         if not self.fleet_limiter.take(timeout=60):
             raise CloudAPIError("fleet request rate budget exhausted (2 QPS/100 burst)")
         try:
-            instances, errors = self.api.create_fleet(capacity_type, overrides)
+            # the launch token rides the fleet call: a committed token
+            # replays the same instance (in-process ledger or the wire's
+            # replay cache), so a retried create cannot double-launch
+            instances, errors = self.api.create_fleet(
+                capacity_type, overrides, client_token=token
+            )
         except InsufficientCapacityError as e:
             # the typed all-ICE answer (in-process raise, or the wire's 409
             # with details): cache out exactly the pools the control plane
@@ -850,6 +902,10 @@ class InstanceProvider:
                     lbl.ARCH: it.architecture,
                     lbl.OS: lbl.OS_LINUX,
                 },
+                annotations=(
+                    {lbl.LAUNCH_TOKEN_ANNOTATION: instance.launch_token}
+                    if instance.launch_token else {}
+                ),
             ),
             spec=NodeSpec(provider_id=f"sim:///{instance.zone}/{instance.id}"),
             status=NodeStatus(capacity=dict(it.resources), allocatable=allocatable),
@@ -879,13 +935,35 @@ class SimulatedCloudProvider(CloudProvider):
 
         self._liveness = MissTracker(threshold=LIVENESS_MISS_THRESHOLD)
 
+    @idempotent
     def create(self, request: NodeRequest) -> Node:
+        # idempotent BY TOKEN: the launch token rides down to the fleet
+        # call, where a committed token replays the recorded instance
         config = SimProviderConfig.deserialize(request.template.provider)
-        return self.instance_provider.create(config, request)
+        return self.instance_provider.create(
+            config, request, token=request.launch_token
+        )
 
     @idempotent
     def delete(self, node: Node) -> None:
         self.instance_provider.delete(node)
+
+    def list_instances(self) -> List[LiveInstance]:
+        """Live inventory for the GC/adoption cross-check: every
+        non-terminated instance with the launch token its create stamped."""
+        return [
+            LiveInstance(
+                id=inst.id,
+                launch_token=inst.launch_token,
+                instance_type=inst.instance_type,
+                zone=inst.zone,
+                capacity_type=inst.capacity_type,
+                created_at=inst.created_at,
+                provider_id=f"sim:///{inst.zone}/{inst.id}",
+            )
+            for inst in self.api.list_instances()
+            if inst.state != "terminated"
+        ]
 
     @idempotent
     def get_instance_types(self, provider: Optional[Dict[str, Any]] = None) -> List[InstanceType]:
@@ -917,9 +995,9 @@ class SimulatedCloudProvider(CloudProvider):
 
     def requeue_disruption(self, notice: DisruptionNotice) -> bool:
         """Fleet routing: push a notice drained by the wrong replica back
-        onto the event bus for the shard owner's next poll. The HTTP client
-        has no re-offer endpoint, so the wire path answers False and the
-        draining replica handles the notice locally."""
+        onto the event bus for the shard owner's next poll — in-process via
+        the double's injector, over the wire via POST /v1/events/requeue
+        (both expose ``send_disruption_notice``)."""
         sender = getattr(self.api, "send_disruption_notice", None)
         if sender is None:
             return False

@@ -68,10 +68,39 @@ class InstanceType:
 
 @dataclass
 class NodeRequest:
-    """What the provisioner asks the cloud for (reference: types.go:53-56)."""
+    """What the provisioner asks the cloud for (reference: types.go:53-56).
+
+    ``launch_token`` is the client-side idempotency token (the CreateFleet
+    ClientToken contract, aws/instance.go:120): the provider stamps it on
+    the launched instance as a label/tag, and a second ``create`` carrying
+    the SAME token returns the SAME instance instead of launching twice —
+    which is what lets the retry policy cover ``create`` and lets crash
+    recovery (launch/journal.py) re-find an instance whose launching
+    process died before the Node object was written."""
 
     template: Constraints
     instance_type_options: Sequence[InstanceType] = ()
+    launch_token: str = ""
+
+
+@dataclass
+class LiveInstance:
+    """One live machine as the cloud control plane reports it — the
+    ``list_instances`` record the launch journal's recovery and the
+    garbage-collection controller cross-check against Node objects.
+    ``launch_token`` is the client token the launching ``create`` stamped
+    (empty for instances launched out-of-band or by pre-token builds);
+    ``created_at`` is provider-clock seconds (``time.time`` domain) so the
+    GC grace period can spare instances still mid-registration."""
+
+    id: str
+    launch_token: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    created_at: float = 0.0
+    provider_id: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
 
 
 class CloudProvider(abc.ABC):
@@ -112,6 +141,17 @@ class CloudProvider(abc.ABC):
         vendor cannot requeue — the caller then handles the notice locally
         (availability over strict sharding)."""
         return False
+
+    def list_instances(self):
+        """Inventory for the crash-consistency cross-check: every live
+        instance this vendor is running, as :class:`LiveInstance` records
+        carrying the launch token stamped at create. The launch journal's
+        recovery re-describes unresolved tokens against this list, and the
+        garbage-collection controller compares it against Node objects to
+        adopt journaled orphans and terminate unjournaled leaks. Returns
+        ``NotImplemented`` when this vendor has no list surface (the GC
+        controller then opts the provider out of orphan sweeps)."""
+        return NotImplemented
 
     def instance_gone(self, node: Node):
         """Liveness probe for the instance backing ``node``: True when the

@@ -135,10 +135,12 @@ class InterruptionController:
     def _routed_away(self, notice: DisruptionNotice) -> bool:
         """True when another replica owns this notice's shard AND the
         provider accepted the requeue — the owner's next poll picks it up.
-        A provider that cannot requeue (the HTTP wire) answers False and
-        the notice is handled locally: availability beats strict sharding,
-        and the orchestrator's node-scoped actions stay exactly-once
-        because only THIS replica drained the notice."""
+        Both HTTP wires re-offer via POST …/events/requeue, so foreign
+        notices now requeue across processes too; a provider with no
+        requeue surface at all answers False and the notice is handled
+        locally: availability beats strict sharding, and the orchestrator's
+        node-scoped actions stay exactly-once because only THIS replica
+        drained the notice."""
         if self.ownership is None:
             return False
         if self.ownership.owns(self._shard_for(notice.node_name)):

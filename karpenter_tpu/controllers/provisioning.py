@@ -71,10 +71,15 @@ class ProvisionerWorker:
         batcher: Optional[Batcher] = None,
         solver_service_address: Optional[str] = None,
         owned: Optional[callable] = None,
+        journal=None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud_provider = cloud_provider
+        # write-ahead launch journal (launch/journal.py): intent recorded
+        # BEFORE the cloud create, resolved only after the bind — the
+        # breadcrumb crash recovery replays. None = journaling off.
+        self.journal = journal
         self.scheduler = scheduler or Scheduler(
             cluster, solver_service_address=solver_service_address
         )
@@ -338,10 +343,29 @@ class ProvisionerWorker:
                 if err:
                     logger.info("skipping launch: %s", err)
                     return False
+            from karpenter_tpu import obs
+
+            launch_span = obs.tracer().current()
+            trace = (
+                obs.to_traceparent(launch_span) if launch_span is not None else ""
+            )
+            # the launch token IS the launch's identity: stamped on the
+            # cloud instance (providers replay a committed token, so the
+            # metered retry policy can cover create), journaled BEFORE the
+            # cloud call (crash recovery re-describes by it), annotated on
+            # the Node (the GC cross-check pairs instance and Node by it)
+            import uuid as _uuid
+
+            token = _uuid.uuid4().hex
+            if launch_span is not None:
+                launch_span.set_attribute("launch_token", token[:12])
+            if self.journal is not None:
+                self.journal.record_intent(token, self.provisioner.name, trace)
             node = self.cloud_provider.create(
                 NodeRequest(
                     template=vnode.constraints,
                     instance_type_options=vnode.instance_type_options,
+                    launch_token=token,
                 )
             )
             # merge the constraint template into the returned node: labels,
@@ -353,13 +377,11 @@ class ProvisionerWorker:
             # stamp the launch trace onto the Node: the ready transition
             # happens minutes later in another reconcile, and this
             # annotation is how node.ready joins the launch trace
-            from karpenter_tpu import obs
-
-            launch_span = obs.tracer().current()
-            if launch_span is not None:
-                node.metadata.annotations[obs.TRACE_ANNOTATION] = (
-                    obs.to_traceparent(launch_span)
-                )
+            if trace:
+                node.metadata.annotations[obs.TRACE_ANNOTATION] = trace
+            node.metadata.annotations.setdefault(
+                lbl.LAUNCH_TOKEN_ANNOTATION, token
+            )
             node.metadata.finalizers = list(
                 set(node.metadata.finalizers) | set(template.metadata.finalizers)
             )
@@ -372,7 +394,13 @@ class ProvisionerWorker:
                 # node self-registered first — idempotent create
                 # (reference: provisioner.go:155-164)
                 pass
+            if self.journal is not None:
+                self.journal.mark_created(token, node.metadata.name)
             self._bind(vnode.pods, node.metadata.name)
+            if self.journal is not None:
+                # bind done: the launch is fully committed across all three
+                # stores — the journal entry has nothing left to protect
+                self.journal.resolve(token)
             from karpenter_tpu.kube.events import recorder_for
 
             recorder_for(self.cluster).event(
@@ -468,12 +496,14 @@ class ProvisioningController:
         default_solver: str = SOLVER_FFD,
         solver_service_address: Optional[str] = None,
         ownership=None,
+        journal=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
         self.default_solver = default_solver
         self.solver_service_address = solver_service_address
+        self.journal = journal  # write-ahead launch journal, shared by workers
         # fleet.ShardManager (or None = this replica owns everything):
         # reconcile only runs workers for owned shards, and each worker's
         # launch path re-checks through the same manager
@@ -600,6 +630,7 @@ class ProvisioningController:
                     (lambda: self.ownership.owns(name))
                     if self.ownership is not None else None
                 ),
+                journal=self.journal,
             )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h

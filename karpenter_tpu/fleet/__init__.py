@@ -12,6 +12,7 @@ availability.
 from karpenter_tpu.fleet.ownership import (
     DEFAULT_SHARD,
     ShardManager,
+    WatchedShardKeys,
     build_lease_set,
     rendezvous_owner,
 )
@@ -19,6 +20,7 @@ from karpenter_tpu.fleet.ownership import (
 __all__ = [
     "DEFAULT_SHARD",
     "ShardManager",
+    "WatchedShardKeys",
     "build_lease_set",
     "rendezvous_owner",
 ]

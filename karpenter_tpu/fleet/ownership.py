@@ -84,6 +84,50 @@ def build_lease_set(spec: str, cluster=None, identity: Optional[str] = None,
     return FileLeaseSet(spec, **kwargs)
 
 
+class WatchedShardKeys:
+    """Informer-watch-driven shard-key discovery (ROADMAP item 3 headroom).
+
+    The first fleet cut passed ``keys_fn=lambda: [...cluster.provisioners()]``
+    — one provisioner LIST per replica per renew interval, paid forever at
+    fleet scale. This source seeds once and then maintains the key set from
+    the cluster's provisioner watch events; a membership change (a
+    provisioner added or deleted) additionally fires ``on_change`` so the
+    ShardManager ticks IMMEDIATELY — a new provisioner gets an owner within
+    one watch delivery, not one renew interval."""
+
+    def __init__(self, cluster):
+        self._mu = threading.Lock()
+        self._keys: Set[str] = set()  # guarded-by: self._mu
+        # fired (outside the lock) when the key set actually changed;
+        # wired to ShardManager.request_tick by build_runtime
+        self.on_change: Optional[Callable[[], None]] = None
+        # watch BEFORE the seed list: an event landing between the two is
+        # applied on top of the union'd seed instead of being lost
+        cluster.watch("provisioners", self._on_event)
+        with self._mu:
+            self._keys |= {p.metadata.name for p in cluster.provisioners()}
+
+    def _on_event(self, event: str, obj) -> None:
+        name = obj.metadata.name
+        gone = event == "DELETED" or obj.metadata.deletion_timestamp is not None
+        with self._mu:
+            before = name in self._keys
+            if gone:
+                self._keys.discard(name)
+            else:
+                self._keys.add(name)
+            changed = (name in self._keys) != before
+        if changed and self.on_change is not None:
+            try:
+                self.on_change()
+            except Exception:
+                logger.exception("shard-key change notification failed")
+
+    def keys(self) -> Set[str]:
+        with self._mu:
+            return set(self._keys)
+
+
 class ShardManager:
     """One replica's view of the fleet: which shards it owns right now.
 
@@ -126,6 +170,10 @@ class ShardManager:
         self._pending_claims: Set[str] = set()  # guarded-by: self._mu
         self._stop = threading.Event()
         self._crashed = threading.Event()  # chaos: die without releasing
+        # set by request_tick(): the run loop wakes early instead of
+        # sleeping out the renew interval (a provisioner appearing should
+        # find an owner within one watch delivery, docs/fleet.md)
+        self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # key -> last live holder observed in any snapshot; a claim of a
         # key last seen held by a DIFFERENT replica is a takeover
@@ -304,6 +352,12 @@ class ShardManager:
         )
         self._thread.start()
 
+    def request_tick(self) -> None:
+        """Wake the run loop for an immediate tick (key-universe change
+        from the informer watch, a test nudging convergence). Safe from
+        any thread; a no-op when the background loop isn't running."""
+        self._wake.set()
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -312,13 +366,15 @@ class ShardManager:
                 # a raising lease backend must not kill the manager thread;
                 # un-renewed holds expire on their own — the safe direction
                 logger.exception("shard tick failed")
-            self._stop.wait(self.renew_interval)
+            self._wake.wait(self.renew_interval)
+            self._wake.clear()
 
     def crash(self) -> None:
         """Chaos hook: die WITHOUT releasing — holds and membership expire
         on the lease duration, exactly like a SIGKILL'd replica."""
         self._crashed.set()
         self._stop.set()
+        self._wake.set()  # a loop parked in its inter-tick wait dies now
         if self._thread:
             self._thread.join(timeout=2)
         with self._mu:
@@ -326,6 +382,7 @@ class ShardManager:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
         if self._thread:
             self._thread.join(timeout=2)
         if self._crashed.is_set():

@@ -1,0 +1,223 @@
+"""Replay of unresolved launch-journal entries — the adopt/confirm ladder.
+
+An unresolved entry is a launch whose process may have died mid-flight.
+Replay re-describes the entry's launch token against the provider's live
+inventory and lands on exactly one of four outcomes:
+
+- ``ADOPTED``        — the instance exists and no Node object tracks it:
+  the crash hit between the cloud create and the Node write. Recovery
+  writes the Node the dead process never got to (template from the
+  entry's provisioner, capacity from the live instance's type), rejoining
+  the original launch trace via the entry's stored traceparent, and
+  resolves the entry.
+- ``NODE_EXISTS``    — the instance exists and a Node already tracks it:
+  the crash hit between the Node write and the bind. The capacity is
+  tracked; any unbound pods re-enter selection on their own. Resolve.
+- ``NEVER_LAUNCHED`` — no live instance carries the token: the create
+  never committed (or the instance already died). Nothing leaked. Resolve.
+- ``PENDING``        — the entry is younger than the replay grace: the
+  launching process may still be alive and mid-create, so recovery must
+  not race it. Leave the entry for the next sweep.
+
+The grace window is what separates a *crashed* launch from a *slow* one:
+journal entries carry their write time, and replay only touches entries
+older than ``replay_after`` seconds. The garbage-collection controller
+(controllers/garbage_collection.py) drives this on its sweep cadence.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.cloudprovider.types import LiveInstance
+from karpenter_tpu.launch.journal import LaunchJournal, LaunchRecord
+
+logger = logging.getLogger("karpenter.launch")
+
+# Replay outcomes (returned so the controller can count and log them).
+ADOPTED = "adopted"
+NODE_EXISTS = "node_exists"
+NEVER_LAUNCHED = "never_launched"
+PENDING = "pending"
+
+# How old an unresolved entry must be before replay touches it: younger
+# entries may belong to a live process still between its journal write and
+# its bind. The bound must exceed the WORST-case intent-to-commit window,
+# not the typical one: a create can sit the simulated fleet limiter's full
+# 60s take() timeout AND the metered retry policy's 20s deadline before
+# the instance exists — resolving such an entry NEVER_LAUNCHED while the
+# create is still in flight destroys the breadcrumb, so a post-commit
+# crash would then LEAK (grace-period termination, capacity double-paid)
+# instead of adopting. 60 + 20 + slack:
+DEFAULT_REPLAY_AFTER = 120.0
+
+
+def node_for_instance(
+    cluster,
+    cloud_provider,
+    live: LiveInstance,
+    provisioner_name: str = "",
+    trace: str = "",
+) -> Node:
+    """Fabricate the Node object a crashed launch never wrote.
+
+    Mirrors what ``ProvisionerWorker._launch_one`` builds: the cloud
+    half (name/provider-id/capacity/zone labels) comes from the live
+    instance + its catalog type; the template half (labels, taints incl.
+    not-ready, the termination finalizer) from the provisioner's
+    constraints — the finalizer matters most, it is what routes the
+    adopted node's eventual deletion through the terminator so the
+    INSTANCE dies with the Node."""
+    provisioner = (
+        cluster.try_get("provisioners", provisioner_name, namespace="")
+        if provisioner_name else None
+    )
+    itype = None
+    if live.instance_type:
+        try:
+            provider_cfg = (
+                provisioner.spec.constraints.provider
+                if provisioner is not None else None
+            )
+            for it in cloud_provider.get_instance_types(provider_cfg):
+                if it.name == live.instance_type:
+                    itype = it
+                    break
+        except Exception:
+            logger.debug("catalog lookup failed during adoption", exc_info=True)
+
+    labels: Dict[str, str] = {}
+    taints = []
+    finalizers = [lbl.TERMINATION_FINALIZER]
+    if provisioner is not None:
+        template = provisioner.spec.constraints.to_node()
+        labels.update(template.metadata.labels)
+        taints = list(template.spec.taints)
+        finalizers = list(
+            set(template.metadata.finalizers) | {lbl.TERMINATION_FINALIZER}
+        )
+        labels[lbl.PROVISIONER_NAME_LABEL] = provisioner_name
+    if itype is not None:
+        labels[lbl.ARCH] = itype.architecture
+        labels[lbl.OS] = lbl.OS_LINUX
+    if live.instance_type:
+        labels[lbl.INSTANCE_TYPE] = live.instance_type
+    if live.zone:
+        labels[lbl.TOPOLOGY_ZONE] = live.zone
+    if live.capacity_type:
+        labels[lbl.CAPACITY_TYPE] = live.capacity_type
+    labels.update(live.labels)
+
+    annotations = {"karpenter.sh/adopted": "true"}
+    if live.launch_token:
+        annotations[lbl.LAUNCH_TOKEN_ANNOTATION] = live.launch_token
+    if trace:
+        from karpenter_tpu import obs
+
+        annotations[obs.TRACE_ANNOTATION] = trace
+
+    resources = dict(itype.resources) if itype is not None else {}
+    return Node(
+        metadata=ObjectMeta(
+            name=live.id,
+            namespace="",
+            labels=labels,
+            annotations=annotations,
+            finalizers=finalizers,
+        ),
+        spec=NodeSpec(provider_id=live.provider_id, taints=taints),
+        status=NodeStatus(capacity=dict(resources), allocatable=resources),
+    )
+
+
+class NodeIndex:
+    """One sweep's snapshot of the cluster's Nodes, keyed three ways for
+    the instance↔Node pairing: node name (the providers name Nodes after
+    the instance id), provider-id (the authoritative pairing), and
+    launch-token annotation (covers renamed/self-registered nodes). Built
+    ONCE per GC sweep — the naive per-instance ``cluster.nodes()`` scan
+    made each sweep O(instances × nodes) in full list copies under the
+    cluster lock."""
+
+    def __init__(self, cluster):
+        self.by_name: Dict[str, Node] = {}
+        self.by_provider_id: Dict[str, Node] = {}
+        self.by_token: Dict[str, Node] = {}
+        for node in cluster.nodes():
+            self.by_name[node.metadata.name] = node
+            if node.spec.provider_id:
+                self.by_provider_id[node.spec.provider_id] = node
+            token = node.metadata.annotations.get(lbl.LAUNCH_TOKEN_ANNOTATION)
+            if token:
+                self.by_token[token] = node
+
+    def find(self, live: LiveInstance) -> Optional[Node]:
+        node = self.by_name.get(live.id)
+        if node is not None:
+            return node
+        if live.provider_id:
+            node = self.by_provider_id.get(live.provider_id)
+            if node is not None:
+                return node
+        if live.launch_token:
+            return self.by_token.get(live.launch_token)
+        return None
+
+
+def node_tracking(cluster, live: LiveInstance, index: Optional[NodeIndex] = None) -> Optional[Node]:
+    """The Node object already tracking ``live``, or None — matched through
+    ``index`` when the caller (the GC sweep) already built one, else
+    through a fresh snapshot."""
+    if index is not None:
+        return index.find(live)
+    return NodeIndex(cluster).find(live)
+
+
+def replay_entry(
+    journal: LaunchJournal,
+    cluster,
+    cloud_provider,
+    entry: LaunchRecord,
+    instances_by_token: Dict[str, LiveInstance],
+    now: float,
+    replay_after: float = DEFAULT_REPLAY_AFTER,
+    index: Optional[NodeIndex] = None,
+) -> str:
+    """Run the adopt/confirm ladder for ONE unresolved entry; returns the
+    outcome constant. Safe against the live launch path: a racing resolve
+    (the launching process finished after all) is a benign no-op, and the
+    grace window keeps replay off entries young enough to have one."""
+    if now - entry.created_at < replay_after:
+        return PENDING
+    live = instances_by_token.get(entry.token)
+    if live is None:
+        # the create never committed (or the instance already terminated):
+        # confirmed never launched — nothing to adopt, nothing leaked
+        journal.resolve(entry.token)
+        return NEVER_LAUNCHED
+    tracked = node_tracking(cluster, live, index=index)
+    if tracked is not None:
+        # crash landed between Node write and bind: the Node tracks the
+        # instance, unbound pods re-enter selection on their own
+        journal.resolve(entry.token)
+        return NODE_EXISTS
+    node = node_for_instance(
+        cluster, cloud_provider, live,
+        provisioner_name=entry.provisioner, trace=entry.trace,
+    )
+    from karpenter_tpu.kube.client import Conflict
+
+    try:
+        cluster.create("nodes", node)
+    except Conflict:
+        pass  # a racer (another replica's sweep, or self-registration) won
+    journal.resolve(entry.token)
+    logger.warning(
+        "adopted orphan instance %s (token %s, provisioner %s) — "
+        "its launching process died before the Node write",
+        live.id, entry.token[:12], entry.provisioner,
+    )
+    return ADOPTED

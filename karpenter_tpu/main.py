@@ -20,6 +20,7 @@ from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.cloudprovider.types import CloudProvider
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.garbage_collection import GarbageCollectionController
 from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.manager import Manager
 from karpenter_tpu.controllers.metrics_node import NodeMetricsController
@@ -49,6 +50,8 @@ class Runtime:
     termination: TerminationController
     interruption: InterruptionController
     webhook: Webhook
+    garbage_collection: GarbageCollectionController = None
+    journal: object = None  # LaunchJournal when --launch-journal is set
     servers: list = None  # HTTP servers (metrics, health) when serving
     elector: object = None  # LeaderElector when a lease is configured
     ownership: object = None  # fleet.ShardManager when shard leases are configured
@@ -217,10 +220,14 @@ def build_runtime(
 
     # fleet sharding (docs/fleet.md): this replica runs workers only for the
     # provisioner shards whose lease it holds; the manager's claim/renew
-    # loop starts in run_controller_process (tests drive tick() inline)
+    # loop starts in run_controller_process (tests drive tick() inline).
+    # Shard keys come from the informer watch (WatchedShardKeys), not a
+    # per-tick provisioner LIST: the watch keeps the key universe current
+    # for free, and an added/deleted provisioner wakes the manager for an
+    # immediate tick instead of waiting out the renew interval.
     ownership = None
     if options.shard_lease:
-        from karpenter_tpu.fleet import ShardManager, build_lease_set
+        from karpenter_tpu.fleet import ShardManager, WatchedShardKeys, build_lease_set
 
         lease_set = build_lease_set(
             options.shard_lease,
@@ -228,10 +235,16 @@ def build_runtime(
             identity=shard_identity,
             duration=options.shard_lease_duration,
         )
-        ownership = ShardManager(
-            lease_set,
-            keys_fn=lambda: [p.metadata.name for p in cluster.provisioners()],
-        )
+        shard_keys = WatchedShardKeys(cluster)
+        ownership = ShardManager(lease_set, keys_fn=shard_keys.keys)
+        shard_keys.on_change = ownership.request_tick
+
+    # write-ahead launch journal (docs/launch-journal.md): records intent
+    # before every cloud create; the GC controller replays what crashes
+    # leave behind
+    from karpenter_tpu.launch import build_journal
+
+    journal = build_journal(options.launch_journal, cluster=cluster)
 
     manager = Manager(cluster)
     provisioning = ProvisioningController(
@@ -241,6 +254,7 @@ def build_runtime(
         default_solver=options.default_solver,
         solver_service_address=options.solver_service_address or None,
         ownership=ownership,
+        journal=journal,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,
@@ -265,6 +279,15 @@ def build_runtime(
         wave_size=options.consolidation_wave_size,
         ownership=ownership,
     )
+    garbage_collection = GarbageCollectionController(
+        cluster,
+        cloud_provider,
+        journal=journal,
+        termination=termination,
+        ownership=ownership,
+        gc_interval=options.gc_interval,
+        grace_period=options.gc_grace_period,
+    )
     counter = CounterController(cluster)
     pvc = PVCController(cluster)
     metrics_node = NodeMetricsController(cluster)
@@ -278,6 +301,7 @@ def build_runtime(
     manager.register("interruption", interruption.reconcile, concurrency=2)
     manager.register("node", node.reconcile, concurrency=10)
     manager.register("consolidation", consolidation.reconcile, concurrency=2)
+    manager.register("garbage_collection", garbage_collection.reconcile, concurrency=1)
     manager.register("counter", counter.reconcile, concurrency=2)
     manager.register("pvc", pvc.reconcile, concurrency=2)
     manager.register("metrics_node", metrics_node.reconcile, concurrency=2)
@@ -299,6 +323,7 @@ def build_runtime(
     )
     node.register(manager)
     interruption.register(manager)
+    garbage_collection.register(manager)
     consolidation.register(manager)
     counter.register(manager)
     pvc.register(manager)
@@ -316,6 +341,8 @@ def build_runtime(
         termination=termination,
         interruption=interruption,
         webhook=Webhook(cloud_provider, default_solver=options.default_solver),
+        garbage_collection=garbage_collection,
+        journal=journal,
         ownership=ownership,
     )
 

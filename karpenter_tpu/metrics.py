@@ -215,7 +215,8 @@ RESILIENCE_DEADLINE_EXCEEDED = Counter(
 SOLVER_DEGRADED = Counter(
     "degraded_solves_total",
     "Solves served by the FFD fallback because the accelerated path was "
-    "unavailable, by reason (breaker_open/pack_failure).",
+    "unavailable or untrusted, by reason "
+    "(breaker_open/pack_failure/invalid_pack).",
     ["reason"],
     namespace=NAMESPACE,
     subsystem="solver",
@@ -400,6 +401,39 @@ SOLVER_POOL_MEMBERS = Gauge(
     "probe-ready).",
     namespace=NAMESPACE,
     subsystem="solver",
+    registry=REGISTRY,
+)
+
+# Crash-consistent launch path (karpenter_tpu/launch + the GC controller):
+# the journal/adopt/reap loop's three outcomes must be scrapeable — an
+# adoption is a crash the system healed, a leak termination is capacity
+# nobody accounted for, and the replay rate is the crash rate itself.
+LAUNCH_ORPHANS_ADOPTED = Counter(
+    "orphans_adopted_total",
+    "Orphan instances adopted by the GC controller: a journaled launch "
+    "whose process died before the Node object was written.",
+    namespace=NAMESPACE,
+    subsystem="launch",
+    registry=REGISTRY,
+)
+
+LAUNCH_INSTANCES_LEAKED = Counter(
+    "instances_leaked_total",
+    "Leaked instances terminated by the GC sweep: live past the grace "
+    "period with no Node tracking them and no journal entry explaining "
+    "them (out-of-band or pre-token launches).",
+    namespace=NAMESPACE,
+    subsystem="launch",
+    registry=REGISTRY,
+)
+
+LAUNCH_JOURNAL_REPLAYS = Counter(
+    "journal_replays_total",
+    "Unresolved journal entries replayed by recovery, by outcome "
+    "(adopted/node_exists/never_launched).",
+    ["outcome"],
+    namespace=NAMESPACE,
+    subsystem="launch",
     registry=REGISTRY,
 )
 

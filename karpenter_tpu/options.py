@@ -56,6 +56,19 @@ class Options:
     shard_lease_duration: float = field(
         default_factory=lambda: float(_env("SHARD_LEASE_DURATION", "15"))
     )
+    # write-ahead launch journal (docs/launch-journal.md): a shared file
+    # path, kube:<namespace>/<prefix> for apiserver-durable Lease twins, or
+    # memory: for tests; empty = journaling off (creates still carry
+    # tokens, but a crashed launch leaves no breadcrumb to adopt from)
+    launch_journal: str = field(default_factory=lambda: _env("LAUNCH_JOURNAL", ""))
+    # orphan-instance GC sweep cadence and the age past which an untracked,
+    # unjournaled instance is declared a leak and terminated
+    gc_interval: float = field(
+        default_factory=lambda: float(_env("KARPENTER_GC_INTERVAL", "30"))
+    )
+    gc_grace_period: float = field(
+        default_factory=lambda: float(_env("KARPENTER_GC_GRACE_PERIOD", "120"))
+    )
     # live log-level reload source (the mounted config-logging key); empty =
     # static level from LOG_LEVEL
     log_config_file: str = field(default_factory=lambda: _env("LOG_CONFIG_FILE", ""))
@@ -85,6 +98,10 @@ class Options:
             errs.append("consolidation wave size must be positive")
         if self.shard_lease_duration <= 0:
             errs.append("shard lease duration must be positive seconds")
+        if self.gc_interval <= 0:
+            errs.append("GC interval must be positive seconds")
+        if self.gc_grace_period <= 0:
+            errs.append("GC grace period must be positive seconds")
         if self.shard_lease and self.leader_election_lease:
             errs.append(
                 "shard leases replace leader election — set only one of "
@@ -126,6 +143,21 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         "--shard-lease-duration", type=float, default=opts.shard_lease_duration,
         help="seconds a shard lease lives without renewal (failover "
         "completes within ~2x this)",
+    )
+    ap.add_argument(
+        "--launch-journal", default=opts.launch_journal,
+        help="write-ahead launch journal: shared file path, kube:<ns>/<prefix>, "
+        "or memory: ('' disables; docs/launch-journal.md)",
+    )
+    ap.add_argument(
+        "--gc-interval", type=float, default=opts.gc_interval,
+        help="orphan-instance GC sweep cadence in seconds (adoption "
+        "completes within one period)",
+    )
+    ap.add_argument(
+        "--gc-grace-period", type=float, default=opts.gc_grace_period,
+        help="age past which an untracked, unjournaled instance is "
+        "terminated as a leak",
     )
     ap.add_argument("--log-config-file", default=opts.log_config_file)
     ap.add_argument("--log-level", default=opts.log_level)
@@ -174,6 +206,9 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         leader_election_lease=ns.leader_election_lease,
         shard_lease=ns.shard_lease,
         shard_lease_duration=ns.shard_lease_duration,
+        launch_journal=ns.launch_journal,
+        gc_interval=ns.gc_interval,
+        gc_grace_period=ns.gc_grace_period,
         log_config_file=ns.log_config_file,
         log_level=ns.log_level,
         trace_enabled=ns.trace,

@@ -145,6 +145,19 @@ class CircuitBreaker:
             self._publish()
             return True
 
+    def trip(self) -> None:
+        """Force the breaker OPEN immediately — the quarantine edge for
+        CORRECTNESS violations (e.g. a pack result that failed host-side
+        validation), which must not wait out the windowed failure rate the
+        availability path uses."""
+        with self._mu:
+            if self._state != OPEN:
+                self.trips += 1
+            self._probes_in_flight = 0
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._publish()
+
     # -- convenience -------------------------------------------------------
     def call(self, fn: Callable, *args, **kwargs):
         """``allow → fn → record``; raises :class:`BreakerOpen` without

@@ -805,7 +805,56 @@ class TpuScheduler:
             t0 = time.perf_counter()
             nodes = self._decode(batch, result, typemask, constraints, instance_types)
             prof["decode_s"] = time.perf_counter() - t0
+        # host-side sanity check BEFORE the plan reaches the launch/bind
+        # path: a bad device/remote solve (bit flips on the wire, a kernel
+        # regression, a corrupted session) must never produce an invalid
+        # bind. Violations quarantine the shape class outright — this is a
+        # correctness failure, not an availability blip, so the breaker
+        # trips immediately instead of waiting out its failure-rate window.
+        violation = self._validate_pack(nodes, pods, daemon)
+        if violation:
+            breaker.trip()
+            metrics.SOLVER_DEGRADED.labels(reason="invalid_pack").inc()
+            logger.error(
+                "accelerated pack produced an invalid plan (%s); shape class "
+                "quarantined, FFD fallback serves this batch", violation,
+            )
+            prof["packer_backend"] = "ffd-degraded"
+            with self._solve_lock:
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
         return nodes
+
+    @staticmethod
+    def _validate_pack(nodes, pods, daemon) -> Optional[str]:
+        """Host-verified invariants of a decoded pack result: every pod
+        placed at most once, every placed pod from THIS batch, and every
+        node's recomputed totals (pod requests + daemon overhead) fit at
+        least one of its surviving instance types. Returns a description of
+        the first violation, or None. Pure host numpy/python — safe to run
+        on every solve (µs against a >1ms decode)."""
+        batch_keys = {p.key for p in pods}
+        seen: set = set()
+        for i, node in enumerate(nodes):
+            for pod in node.pods:
+                if pod.key in seen:
+                    return f"pod {pod.key} assigned to more than one node"
+                if pod.key not in batch_keys:
+                    return f"pod {pod.key} not part of this batch"
+                seen.add(pod.key)
+            if not node.instance_type_options:
+                return f"node {i} has no surviving instance type"
+            totals = res.merge(
+                daemon, *[res.requests_for_pods(p) for p in node.pods]
+            )
+            if not any(
+                res.fits(totals, it.resources)
+                for it in node.instance_type_options
+            ):
+                return (
+                    f"node {i} capacity exceeded: {res.to_string(totals)} "
+                    "fits none of its surviving instance types"
+                )
+        return None
 
     def _ffd_degrade(self, constraints, instance_types, pods, daemon, plan) -> List[VirtualNode]:
         """The degradation ladder's floor: materialize the topology plan

@@ -20,7 +20,7 @@ def run_lambda():
 
 class FixtureProvider:
     @idempotent
-    def create(self, request):  # fires: create must NOT be idempotent
+    def create(self, request):  # fires: marked but token-LESS (no replay)
         return request
 
     def delete(self, node):  # fires: retried by the metered decorator, unmarked
@@ -30,4 +30,22 @@ class FixtureProvider:
         return []
 
     def poll_disruptions(self):  # fires: unmarked
+        return []
+
+
+class TokenedButUnmarkedProvider:
+    def create(self, request):  # fires: token-carrying create, unmarked
+        token = request.launch_token
+        return (request, token)
+
+    @idempotent
+    def delete(self, node):
+        return None
+
+    @idempotent
+    def get_instance_types(self, provider=None):
+        return []
+
+    @idempotent
+    def poll_disruptions(self):
         return []

@@ -37,7 +37,27 @@ class AbstractProvider(abc.ABC):
 
 
 class GoodProvider:
-    def create(self, request):  # unmarked create: correct
+    def create(self, request):  # unmarked token-less create: correct
+        return request
+
+    @idempotent
+    def delete(self, node):
+        return None
+
+    @idempotent
+    def get_instance_types(self, provider=None):
+        return []
+
+    @idempotent
+    def poll_disruptions(self):
+        return []
+
+
+class GoodTokenProvider:
+    @idempotent
+    def create(self, request):  # marked token-carrying create: correct
+        if request.launch_token in self.launched:
+            return self.launched[request.launch_token]
         return request
 
     @idempotent

@@ -267,7 +267,9 @@ def test_providers_carry_idempotent_markers():
     assert is_idempotent(p.delete)
     assert is_idempotent(p.get_instance_types)
     assert is_idempotent(p.poll_disruptions)
-    assert not is_idempotent(p.create)
+    # create became token-idempotent with the launch-token work: a retried
+    # create replays the committed token instead of double-launching
+    assert is_idempotent(p.create)
 
 
 def test_upsert_keyed_replaces_and_appends():

@@ -394,6 +394,69 @@ class TestSolverDegradation:
         assert pack_calls[0] == attempted  # breaker open: FFD immediately
         assert sched.last_profile.get("packer_backend") == "ffd-degraded"
 
+    def test_invalid_pack_quarantines_shape_and_serves_ffd(self):
+        """A decoded device/remote plan that fails the host-side sanity
+        check (here: one pod assigned to two nodes) must never reach the
+        bind path: the batch is re-served via FFD, the violation counts as
+        `degraded_solves_total{reason="invalid_pack"}`, and the shape
+        class's pack breaker trips IMMEDIATELY (correctness, not an
+        availability blip — no waiting out the failure-rate window)."""
+        from prometheus_client import generate_latest
+
+        from karpenter_tpu import metrics
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        def degraded_invalid() -> float:
+            out = generate_latest(metrics.REGISTRY).decode()
+            for line in out.splitlines():
+                if line.startswith(
+                    'karpenter_solver_degraded_solves_total{reason="invalid_pack"}'
+                ):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        catalog = instance_types(4)
+        constraints = make_provisioner(solver="tpu").spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        real_decode = sched._decode
+        decode_calls = [0]
+
+        def corrupting_decode(*args, **kwargs):
+            decode_calls[0] += 1
+            nodes = real_decode(*args, **kwargs)
+            # corrupt the plan: double-place an already-assigned pod
+            placed = [n for n in nodes if n.pods]
+            if placed and len(nodes) > 1:
+                target = nodes[1] if nodes[1] is not placed[0] else nodes[0]
+                target.pods.append(placed[0].pods[0])
+            elif placed:
+                placed[0].pods.append(placed[0].pods[0])
+            return nodes
+
+        sched._decode = corrupting_decode
+        pods = [make_pod(requests={"cpu": "0.5"}) for _ in range(4)]
+        before = degraded_invalid()
+        nodes = sched.solve(constraints, catalog, list(pods))
+        # pods still schedule, exactly once each, via the FFD floor
+        assert nodes and sum(len(n.pods) for n in nodes) == 4
+        keys = [p.key for n in nodes for p in n.pods]
+        assert len(keys) == len(set(keys))
+        assert sched.last_profile.get("packer_backend") == "ffd-degraded"
+        assert degraded_invalid() == before + 1
+        # ONE violation quarantined the shape outright: the next solve
+        # routes straight to FFD without re-attempting the pack
+        attempted = decode_calls[0]
+        nodes = sched.solve(constraints, catalog, list(pods))
+        assert nodes and sum(len(n.pods) for n in nodes) == 4
+        assert decode_calls[0] == attempted
+
     def test_remote_breaker_half_open_recovers(self):
         from karpenter_tpu.solver.backend import TpuScheduler
 

@@ -10,6 +10,23 @@ import pytest
 
 from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
 from karpenter_tpu.utils.lease import FileLease, LeaderElector
+
+try:  # the self-managed TLS stack (kube/certs.py) needs cryptography
+    import cryptography  # noqa: F401
+
+    _HAS_CRYPTO = True
+except ImportError:
+    _HAS_CRYPTO = False
+
+# Skip (not fail) the TLS-dependent tests where `cryptography` is absent
+# (the hermetic CPU test image) so tier-1 runs green; CI's envtest/image
+# jobs install it and run these for real. Tracked in ROADMAP.md ("webhook
+# TLS suite needs cryptography").
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed: webhook TLS tests skipped "
+    "(tracked in ROADMAP.md; CI envtest installs it)",
+)
 from karpenter_tpu.webhook import (
     Webhook,
     deserialize_provisioner,
@@ -141,6 +158,7 @@ class TestFleetFlowControl:
         assert limiter.qps == 2.0 and limiter.burst == 100
 
 
+@requires_crypto
 class TestWebhookTLS:
     """Admission over HTTPS with the self-managed serving cert — what a
     real apiserver requires (VERDICT r1 missing #2)."""
@@ -323,6 +341,7 @@ class TestChartAndPackaging:
         assert out.count("WebhookConfiguration") == 2
         assert "caBundle: LS0tCg==" in out
 
+    @requires_crypto
     def test_ca_persists_across_leaf_rotation(self, tmp_path):
         """Leaf rotation re-signs under the stored CA so the registered
         caBundle stays valid (a fresh CA per restart would break apiserver
@@ -340,6 +359,7 @@ class TestChartAndPackaging:
         ctx = ssl.create_default_context(cafile=ca2)
         ctx.load_verify_locations(ca2)  # no exception = CA parses
 
+    @requires_crypto
     def test_readonly_cert_dir_serves_existing_instead_of_crashing(self, tmp_path):
         """A Secret-mounted (read-only) cert dir that hits the rotation
         window must serve the existing cert, not crash-loop the webhook."""

@@ -15,10 +15,13 @@ marks that property explicitly with ``@idempotent``
    (classes under ``cloudprovider/`` defining both ``create`` and
    ``delete``) are wrapped by the metered decorator, whose policy table
    retries ``delete`` / ``get_instance_types`` / ``poll_disruptions`` —
-   those methods must be ``@idempotent``. ``create`` is breaker-only by
-   design (a replayed create orphans instances), so a ``create`` marked
-   ``@idempotent`` is itself a finding: the marker would invite someone
-   to raise ``max_attempts`` on the create policy.
+   those methods must be ``@idempotent``. ``create`` is two-sided since
+   the launch-token work: a TOKEN-CARRYING create (its body consumes
+   ``launch_token`` — the request's idempotency key that providers replay
+   instead of double-launching) is retried by the metered policy table
+   and must be ``@idempotent``; a token-LESS create marked
+   ``@idempotent`` is itself a finding — without the token contract the
+   marker would invite retries that orphan instances.
 """
 
 from __future__ import annotations
@@ -42,6 +45,26 @@ RETRIED_PROVIDER_METHODS = ("delete", "get_instance_types", "poll_disruptions")
 
 def _has_idempotent(fn: ast.AST) -> bool:
     return any(dn.rsplit(".", 1)[-1] == "idempotent" for dn in decorator_names(fn))
+
+
+def _token_aware(fn: ast.AST) -> bool:
+    """Does this create's body consume the launch token? Token-carrying
+    creates replay a committed token instead of double-launching, which is
+    the property that makes the @idempotent marker (and therefore retries)
+    sound. Detected syntactically: any ``launch_token`` name/attribute, or
+    a ``launchToken`` wire-field string, in the body."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "launch_token":
+            return True
+        if isinstance(node, ast.Name) and node.id == "launch_token":
+            return True
+        if isinstance(node, ast.keyword) and node.arg in (
+            "launch_token", "client_token",
+        ):
+            return True
+        if isinstance(node, ast.Constant) and node.value == "launchToken":
+            return True
+    return False
 
 
 def _is_abstract(cls: ast.ClassDef) -> bool:
@@ -78,9 +101,11 @@ class RetryIdempotentRule(Rule):
     severity = P0
     doc = (
         "A callable retried by RetryPolicy lacks the @idempotent marker, "
-        "or a create-path mutator carries it — retrying a non-idempotent "
-        "mutator double-applies it; marking create invites retries that "
-        "orphan instances."
+        "or a token-less create-path mutator carries it — retrying a "
+        "non-idempotent mutator double-applies it; marking a create that "
+        "does not consume a launch token invites retries that orphan "
+        "instances, while a token-carrying create IS retried by the "
+        "metered policy table and must be marked."
     )
 
     def run(self, project: Project) -> List[Finding]:
@@ -179,12 +204,22 @@ class RetryIdempotentRule(Rule):
                         )
                     )
             create = methods["create"]
-            if _has_idempotent(create):
+            if _has_idempotent(create) and not _token_aware(create):
                 findings.append(
                     self.finding(
                         src.path, create.lineno,
-                        f"`{node.name}.create` is marked @idempotent — create "
-                        "is breaker-only by design (a replayed create orphans "
-                        "instances); remove the marker",
+                        f"`{node.name}.create` is marked @idempotent but never "
+                        "consumes a launch token — without token replay a "
+                        "retried create double-launches; thread "
+                        "request.launch_token through (or remove the marker)",
+                    )
+                )
+            elif _token_aware(create) and not _has_idempotent(create):
+                findings.append(
+                    self.finding(
+                        src.path, create.lineno,
+                        f"`{node.name}.create` consumes a launch token (same "
+                        "token → same instance) and is retried by the metered "
+                        "cloud decorator, but is not marked @idempotent",
                     )
                 )
