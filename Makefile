@@ -32,8 +32,12 @@ deflake:  ## run the suite 10x to shake out flakes (reference: Makefile:38-39)
 		$(PY) -m pytest tests/ -x -q || exit 1; \
 	done
 
-benchmark:  ## headline solve benchmark (prints one JSON line)
+benchmark:  ## headline solve benchmark (prints one JSON line) + trajectory report
 	$(PY) bench.py
+	-$(PY) -m tools.bench_compare --report
+
+bench-compare:  ## regression gate over the checked-in BENCH_r0x trajectory (CI runs this)
+	$(PY) -m tools.bench_compare
 
 benchmark-notrace:  ## tracing-overhead comparison run (acceptance bar: native leg within 3%)
 	$(PY) bench.py --no-trace
@@ -99,6 +103,6 @@ run:  ## start the controller process against the in-memory cluster
 solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
-.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark benchmark-notrace benchmark-grid \
+.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace benchmark-grid \
 	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -286,6 +286,10 @@ def bench_once(
         from karpenter_tpu import obs
 
         obs.exporter().clear()
+        # the online SLO engine over the same measured window: the bench's
+        # offline percentile cross-checks the engine's online one (the 5%
+        # acceptance bar is the histogram bucket scheme's error bound)
+        slo_eng = obs.configure_slo() if obs.enabled() else None
 
         probe = RttProbe() if breakdown else None
         if probe:
@@ -348,6 +352,24 @@ def bench_once(
     if sess["hit_rate"] is not None:
         # steady-state Pack payloads exclude catalog bytes iff this ≈ 1.0
         out["session_catalog_hit_rate"] = round(sess["hit_rate"], 4)
+    if slo_eng is not None:
+        # per-objective verdicts from the ONLINE engine — the same code
+        # path production serves at /debug/slo, fed by this run's spans
+        objectives = slo_eng.snapshot()["objectives"]
+        sp = objectives.get("solve_p99")
+        if sp is not None and sp["value"] is not None:
+            out["slo_solve_p99_s"] = round(sp["value"], 4)
+            out["slo_solve_p99_ok"] = bool(sp["ok"])
+            # online (log-linear sketch) vs offline (exact sort) agreement
+            out["slo_online_offline_delta_pct"] = round(
+                abs(sp["value"] - out["p99_s"]) / max(out["p99_s"], 1e-9) * 100,
+                2,
+            )
+        out["slo_burn_rates"] = {
+            name: o["burn_rate"]
+            for name, o in objectives.items()
+            if o["events"]["slow"]
+        }
     if obs.enabled():
         # self-time attribution down the worst iteration's span tree — the
         # trace-backed answer to "where did the tail iteration's time go"
@@ -2285,6 +2307,8 @@ def main():
     line["trace_enabled"] = obs.enabled()
     for k in ("packer_backend", "wire_in_path", "breakdown_ms", "worst_iter",
               "trace_critical_path_ms",
+              "slo_solve_p99_ok", "slo_solve_p99_s",
+              "slo_online_offline_delta_pct", "slo_burn_rates",
               "transport_rtt_floor_ms", "rtt_samples", "rtt_p50_ms",
               "rtt_per_solve_samples", "p99_minus_rtt_each_s",
               "p90_minus_rtt_each_s", "mean_minus_rtt_each_s",
@@ -2317,7 +2341,9 @@ def main():
             for k in ("pods_per_sec", "mean_s", "p99_s",
                       "rtt_per_solve_samples", "mean_minus_rtt_each_s",
                       "p90_minus_rtt_each_s", "p99_minus_rtt_each_s",
-                      "worst_iter", "trace_critical_path_ms"):
+                      "worst_iter", "trace_critical_path_ms",
+                      "slo_solve_p99_ok", "slo_solve_p99_s",
+                      "slo_online_offline_delta_pct", "slo_burn_rates"):
                 if k in dev:
                     line[f"device_{k}"] = (
                         round(dev[k], 4) if isinstance(dev[k], float) else dev[k]

@@ -56,6 +56,7 @@ class Runtime:
     elector: object = None  # LeaderElector when a lease is configured
     ownership: object = None  # fleet.ShardManager when shard leases are configured
     log_watcher: object = None  # LogLevelWatcher when a config file is set
+    slo: object = None  # the SloEngine THIS runtime installed (obs/slo.py)
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
     def stop(self) -> None:
@@ -79,6 +80,13 @@ class Runtime:
             self.log_watcher.stop()
         if hasattr(self.cluster, "stop"):
             self.cluster.stop()
+        # detach the SLO engine this runtime installed (ownership-checked:
+        # if a later-started replica's engine is current, it stays; a
+        # runtime that never installed one detaches nothing)
+        if self.slo is not None:
+            from karpenter_tpu import obs
+
+            obs.shutdown_slo(engine=self.slo)
         # undo the post-warmup GC policy: a test booting a runtime
         # in-process must not leak a frozen heap into the rest of the run
         from karpenter_tpu.utils.gcpolicy import restore
@@ -141,12 +149,29 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 self.end_headers()
                 self.wfile.write(b"ok" if ok else b"unhealthy")
             elif self.path.startswith("/debug/traces"):
-                # the in-memory trace ring: recent span trees, newest first
+                # the in-memory trace ring: recent span trees, newest
+                # first; ?limit= and ?name= narrow to one trace family
+                import json
+                from urllib.parse import urlsplit
+
+                from karpenter_tpu import obs
+
+                body = json.dumps(
+                    obs.debug_traces_payload(urlsplit(self.path).query)
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/slo"):
+                # live objective verdicts + burn rates from the online
+                # SLO engine ({} until one is configured)
                 import json
 
                 from karpenter_tpu import obs
 
-                body = json.dumps({"traces": obs.exporter().snapshot()}).encode()
+                body = json.dumps({"slo": obs.slo_snapshot()}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -364,6 +389,17 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
             runtime.options.flight_dir,
             budget_s=runtime.options.flight_budget_ms / 1e3,
         )
+    # online SLO engine (docs/observability.md): objective verdicts and
+    # burn rates from the span stream, served at /debug/slo and as
+    # karpenter_slo_* metrics; flight records snapshot its burning panel
+    objectives = (
+        obs.load_objectives(runtime.options.slo_config)
+        if runtime.options.slo_config
+        else None
+    )
+    runtime.slo = obs.configure_slo(
+        objectives=objectives, window_s=runtime.options.slo_window
+    )
     if runtime.options.log_config_file:
         runtime.log_watcher = LogLevelWatcher(runtime.options.log_config_file)
         runtime.log_watcher.start()

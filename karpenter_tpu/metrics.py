@@ -318,6 +318,96 @@ FLIGHT_RECORDS = Counter(
     registry=REGISTRY,
 )
 
+# Trace ring residency (obs/export.py): /debug/traces serves whatever the
+# ring holds, and the drop counter alone cannot say whether the ring is
+# near capacity — the gauges make eviction pressure scrapeable per process
+# (controller and sidecar each publish their own ring's numbers).
+TRACE_RING_TREES = Gauge(
+    "ring_trees",
+    "Root span trees currently held in the in-memory trace ring.",
+    namespace=NAMESPACE,
+    subsystem="trace",
+    registry=REGISTRY,
+)
+
+TRACE_RING_SPANS = Gauge(
+    "ring_spans",
+    "Total spans (across all held trees) currently in the trace ring.",
+    namespace=NAMESPACE,
+    subsystem="trace",
+    registry=REGISTRY,
+)
+
+# Online SLO engine (obs/slo.py, docs/observability.md): declarative
+# objectives evaluated from the tracer finish-hook. The gauges are the
+# autopilot's sensor surface AND the alerting surface: `burning` is the
+# multiwindow page condition (fast AND slow windows over budget).
+SLO_OBJECTIVE_OK = Gauge(
+    "objective_ok",
+    "1 while the objective's fast-window value meets its threshold "
+    "(e.g. solve p99 under 100ms); unset until the window has data.",
+    ["objective"],
+    namespace=NAMESPACE,
+    subsystem="slo",
+    registry=REGISTRY,
+)
+
+SLO_BURN_RATE = Gauge(
+    "burn_rate",
+    "Error-budget burn rate per objective and window (fast/slow): "
+    "observed bad-event fraction divided by the objective's budget — "
+    "1.0 means the budget is being consumed exactly as fast as allowed.",
+    ["objective", "window"],
+    namespace=NAMESPACE,
+    subsystem="slo",
+    registry=REGISTRY,
+)
+
+SLO_BURNING = Gauge(
+    "burning",
+    "1 while BOTH burn-rate windows of the objective exceed 1.0 — the "
+    "multiwindow page condition.",
+    ["objective"],
+    namespace=NAMESPACE,
+    subsystem="slo",
+    registry=REGISTRY,
+)
+
+SLO_EVENTS = Counter(
+    "events_total",
+    "SLO-relevant events observed per objective, by verdict (good/bad — "
+    "bad events consume error budget).",
+    ["objective", "verdict"],
+    namespace=NAMESPACE,
+    subsystem="slo",
+    registry=REGISTRY,
+)
+
+# Device-memory telemetry for the session store (solver/service.py): the
+# histograms can see that pack_fetch spiked, but only the resource side
+# can say WHY — a session churn filling HBM shows up here first.
+SOLVER_SESSION_HBM = Gauge(
+    "session_hbm_bytes",
+    "Bytes of catalog tensors pinned on device per live solver session "
+    "(label: the 12-hex-char session key prefix).",
+    ["session"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_HBM_HEADROOM = Gauge(
+    "device_hbm_headroom_bytes",
+    "Device memory limit minus bytes in use, from the backend's "
+    "memory_stats. Labeled by device index so the child only exists once "
+    "a backend actually reported memory — on the CPU test rig the metric "
+    "is ABSENT, never a lying zero.",
+    ["device"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
 # Breaker-open fast-fails on the metered cloud path: these calls never run,
 # so they vanish from the duration histogram — without this counter a
 # launch gap during an outage has no latency attribution at all.

@@ -33,6 +33,13 @@ from karpenter_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     register_state,
     state_snapshot,
+    unregister_state,
+)
+from karpenter_tpu.obs.slo import (  # noqa: F401
+    DEFAULT_OBJECTIVES,
+    SIDECAR_OBJECTIVES,
+    SloEngine,
+    load_objectives,
 )
 from karpenter_tpu.obs.trace import (  # noqa: F401
     TRACE_ANNOTATION,
@@ -93,12 +100,89 @@ def flight_recorder() -> Optional[FlightRecorder]:
         return _flight
 
 
+_slo: Optional[SloEngine] = None  # guarded-by: _lock
+
+
+def configure_slo(
+    objectives=None,
+    window_s: float = 300.0,
+    clock=None,
+    slow_factor: Optional[int] = None,
+) -> SloEngine:
+    """Install (or replace) the online SLO engine on the default tracer:
+    a span finish-hook plus the ``slo`` flight-recorder state panel, so
+    every slow-solve record snapshots which objectives were burning."""
+    global _slo
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    if slow_factor is not None:
+        kwargs["slow_factor"] = slow_factor
+    eng = SloEngine(objectives=objectives, window_s=window_s, **kwargs)
+    with _lock:
+        if _slo is not None:
+            _tracer.remove_hook(_slo)
+        _slo = eng
+    _tracer.add_hook(eng)
+    register_state("slo", eng.burning_panel)
+    return eng
+
+
+def slo_engine() -> Optional[SloEngine]:
+    with _lock:
+        return _slo
+
+
+def shutdown_slo(engine: Optional[SloEngine] = None) -> None:
+    """Detach the engine (hook + flight panel). Pass the engine you
+    installed to make teardown ownership-checked: a stopped replica must
+    not tear down an engine a LATER configure_slo installed for a runtime
+    still running in this process. ``None`` detaches unconditionally
+    (reset_for_tests)."""
+    global _slo
+    with _lock:
+        if engine is not None and _slo is not engine:
+            return  # someone else's engine is current — not ours to kill
+        if _slo is not None:
+            _tracer.remove_hook(_slo)
+        _slo = None
+    unregister_state("slo")
+
+
+def slo_snapshot() -> dict:
+    """The ``/debug/slo`` payload ({} while no engine is configured)."""
+    eng = slo_engine()
+    return eng.snapshot() if eng is not None else {}
+
+
+def debug_traces_payload(query: str = "") -> dict:
+    """The ``GET /debug/traces`` body, shared by both health servers.
+    ``query`` is the raw URL query string; ``?limit=`` bounds the tree
+    count (default 50) and ``?name=`` keeps only trees containing a span
+    of that name — one trace family instead of a 256-tree payload."""
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    limit = 50
+    try:
+        limit = max(int(q["limit"][0]), 0)
+    except (KeyError, ValueError, IndexError):
+        pass
+    name = (q.get("name") or [None])[0] or None
+    exp = exporter()
+    return {
+        "traces": exp.snapshot(limit=limit, name=name),
+        "stats": exp.stats(),
+    }
+
+
 def reset_for_tests() -> None:
-    """Drop collected traces and detach any flight recorder."""
+    """Drop collected traces and detach any flight recorder / SLO engine."""
     global _flight
     with _lock:
         if _flight is not None:
             _tracer.remove_hook(_flight)
         _flight = None
+    shutdown_slo()
     _tracer.exporter.clear()
     _tracer.enabled = True

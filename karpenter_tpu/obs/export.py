@@ -34,6 +34,7 @@ class RingExporter:
         self._lock = threading.Lock()
         self.exported_spans = 0  # guarded-by: self._lock
         self.dropped_spans = 0  # guarded-by: self._lock
+        self.held_spans = 0  # guarded-by: self._lock
 
     def export(self, root: Span) -> None:
         n = _count_spans(root)
@@ -44,26 +45,60 @@ class RingExporter:
                 dropped += _count_spans(self._trees.popleft())
             self.dropped_spans += dropped
             self._trees.append(root)
+            self.held_spans += n - dropped
+            held_trees, held_spans = len(self._trees), self.held_spans
         try:
             from karpenter_tpu import metrics
 
             metrics.TRACE_SPANS.inc(n)
             if dropped:
                 metrics.TRACE_DROPPED.inc(dropped)
+            metrics.TRACE_RING_TREES.set(held_trees)
+            metrics.TRACE_RING_SPANS.set(held_spans)
         except Exception:
             pass  # the sidecar's trimmed images may lack the registry
 
+    def stats(self) -> Dict[str, Any]:
+        """Per-process exporter residency — the /debug/traces sidebar and
+        the source of the `karpenter_trace_ring_*` gauges."""
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "trees": len(self._trees),
+                "spans": self.held_spans,
+                "exported_spans": self.exported_spans,
+                "dropped_spans": self.dropped_spans,
+            }
+
     def snapshot(
-        self, limit: Optional[int] = 50, newest_first: bool = True
+        self,
+        limit: Optional[int] = 50,
+        newest_first: bool = True,
+        name: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
-        """JSON-ready trees; newest first by default (the /debug surface)."""
+        """JSON-ready trees; newest first by default (the /debug surface).
+        ``name`` keeps only trees CONTAINING a span so named (the
+        ``?name=`` query filter — one trace family, not the whole ring);
+        ``limit`` applies after the filter, so it bounds what the operator
+        asked for."""
         with self._lock:
             trees = list(self._trees)
         if newest_first:
             trees.reverse()
-        if limit is not None:
-            trees = trees[:limit]
-        return [t.to_dict() for t in trees]
+        if name is None:
+            # no filter: slice BEFORE serializing — a full 256-tree ring
+            # must not pay 256 deep to_dict()s to answer a limit-50 request
+            if limit is not None:
+                trees = trees[:limit]
+            return [t.to_dict() for t in trees]
+        dicts: List[Dict[str, Any]] = []
+        for t in trees:
+            d = t.to_dict()
+            if spans_named(d, name):
+                dicts.append(d)
+                if limit is not None and len(dicts) >= limit:
+                    break
+        return dicts
 
     def trees(self) -> List[Dict[str, Any]]:
         """All held trees, oldest first — bench correlates tree index to
@@ -73,6 +108,14 @@ class RingExporter:
     def clear(self) -> None:
         with self._lock:
             self._trees.clear()
+            self.held_spans = 0
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.TRACE_RING_TREES.set(0)
+            metrics.TRACE_RING_SPANS.set(0)
+        except Exception:
+            pass
 
     def dump_jsonl(self, path: str) -> int:
         """Write every held tree as one JSON line each; returns the count."""

@@ -49,6 +49,12 @@ def register_state(name: str, provider: Callable[[], Any]) -> None:
         _state_providers[name] = provider
 
 
+def unregister_state(name: str) -> None:
+    """Drop one panel (tests, and engine teardown in reset_for_tests)."""
+    with _state_lock:
+        _state_providers.pop(name, None)
+
+
 def state_snapshot() -> Dict[str, Any]:
     with _state_lock:
         providers = dict(_state_providers)

@@ -83,6 +83,14 @@ class Options:
     flight_budget_ms: float = field(
         default_factory=lambda: float(_env("KARPENTER_FLIGHT_BUDGET_MS", "100"))
     )
+    # online SLO engine (obs/slo.py): fast evaluation window in seconds
+    # (the slow burn-rate window is 12x this), and an optional objectives
+    # file ('' = the built-in defaults; docs/observability.md has the
+    # grammar)
+    slo_window: float = field(
+        default_factory=lambda: float(_env("KARPENTER_SLO_WINDOW", "300"))
+    )
+    slo_config: str = field(default_factory=lambda: _env("KARPENTER_SLO_CONFIG", ""))
 
     def validate(self) -> List[str]:
         errs = []
@@ -109,6 +117,17 @@ class Options:
             )
         if self.flight_budget_ms <= 0:
             errs.append("flight budget must be positive milliseconds")
+        if self.slo_window <= 0:
+            errs.append("SLO window must be positive seconds")
+        if self.slo_config:
+            # a typo'd objective must fail startup, not silently never
+            # evaluate — parse the whole file eagerly
+            try:
+                from karpenter_tpu.obs.slo import load_objectives
+
+                load_objectives(self.slo_config)
+            except Exception as e:
+                errs.append(f"--slo-config {self.slo_config}: {e}")
         if self.default_solver not in ("ffd", "tpu"):
             errs.append(f"solver must be ffd|tpu, got {self.default_solver}")
         from karpenter_tpu.logging_config import validate_log_config
@@ -178,6 +197,16 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         help="solver.solve spans over this budget are flight-recorded",
     )
     ap.add_argument(
+        "--slo-window", type=float, default=opts.slo_window,
+        help="online SLO fast evaluation window in seconds (the slow "
+        "burn-rate window is 12x this; /debug/slo serves the verdicts)",
+    )
+    ap.add_argument(
+        "--slo-config", default=opts.slo_config,
+        help="objectives file, one `source.stat op value` line each "
+        "('' = built-in defaults; docs/observability.md has the grammar)",
+    )
+    ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
         default=opts.consolidation_enabled,
@@ -214,6 +243,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         trace_enabled=ns.trace,
         flight_dir=ns.flight_dir,
         flight_budget_ms=ns.flight_budget_ms,
+        slo_window=ns.slo_window,
+        slo_config=ns.slo_config,
     )
     errs = out.validate()
     if errs:

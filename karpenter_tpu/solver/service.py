@@ -109,6 +109,63 @@ PROTO_FEATURES = PROTO_TRACE_TRAILER
 SESSION_MAX = 8
 SESSION_TTL_S = 900.0
 
+# ---------------------------------------------------------------------------
+# device-memory telemetry (the resource-side half of the SLO story: the
+# latency histograms can see a pack_fetch spike, only these gauges can say
+# whether session churn was filling HBM at the time)
+# ---------------------------------------------------------------------------
+
+
+def _resident_nbytes(resident) -> int:
+    """Bytes pinned on device by one session's catalog tensors."""
+    return int(sum(int(getattr(a, "nbytes", 0) or 0) for a in resident))
+
+
+def _session_label(key: bytes) -> str:
+    return key.hex()[:12]
+
+
+def _publish_session_hbm(key: bytes, nbytes: int) -> None:
+    try:
+        from karpenter_tpu import metrics
+
+        metrics.SOLVER_SESSION_HBM.labels(session=_session_label(key)).set(nbytes)
+    except Exception:
+        pass  # the sidecar's trimmed images may lack the registry
+
+
+def _drop_session_hbm(key: bytes) -> None:
+    try:
+        from karpenter_tpu import metrics
+
+        metrics.SOLVER_SESSION_HBM.remove(_session_label(key))
+    except Exception:
+        pass  # never-published label or trimmed registry
+
+
+def publish_device_headroom() -> Optional[int]:
+    """Set the device-memory headroom gauge from the backend's
+    memory_stats; returns the headroom (None when the backend does not
+    report memory — the CPU test rig — in which case the gauge stays
+    unset rather than lying with a zero)."""
+    try:
+        import jax
+
+        device = jax.devices()[0]
+        stats = device.memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        in_use = stats.get("bytes_in_use")
+        if not limit or in_use is None:
+            return None
+        headroom = max(int(limit) - int(in_use), 0)
+        from karpenter_tpu import metrics
+
+        metrics.SOLVER_HBM_HEADROOM.labels(device=str(device.id)).set(headroom)
+        return headroom
+    except Exception:
+        return None
+
+
 # ``kernel.pack`` takes 7 pod-side arrays then the 3 catalog-side ones
 # (join_table, frontiers, daemon) — the split the session protocol is
 # built around (see EncodedBatch.pack_args).
@@ -291,23 +348,28 @@ class SolverService:
     # -- sessions -----------------------------------------------------------
 
     def _evict_sessions_locked(self) -> None:
-        """LRU + TTL eviction; caller holds ``_sessions_lock``."""
+        """LRU + TTL eviction; caller holds ``_sessions_lock``. Every
+        evicted session also releases its HBM gauge label — a dashboard
+        summing ``karpenter_solver_session_hbm_bytes`` must track what is
+        actually pinned, not what ever was."""
         from karpenter_tpu.solver import session_stats
 
         now = self._clock()
-        evicted = 0
+        evicted = []
         stale = [
             k for k, v in self._sessions.items()
             if now - v[1] > self.session_ttl
         ]
         for k in stale:
             del self._sessions[k]
-            evicted += 1
+            evicted.append(k)
         while len(self._sessions) > self.session_max:
-            self._sessions.popitem(last=False)
-            evicted += 1
+            k, _ = self._sessions.popitem(last=False)
+            evicted.append(k)
         if evicted:
-            session_stats.record_eviction(evicted)
+            session_stats.record_eviction(len(evicted))
+            for k in evicted:
+                _drop_session_hbm(k)
 
     def open_session_bytes(self, request: bytes) -> bytes:
         """Pin one catalog generation's tensors on device under its key.
@@ -363,6 +425,11 @@ class SolverService:
             won = key not in self._sessions
             if won:
                 self._sessions[key] = [resident, self._clock(), True]
+                # gauge write stays under the lock: published after release,
+                # a concurrent open's eviction of this key could interleave
+                # its _drop_session_hbm BEFORE our publish — resurrecting
+                # the label for a session no longer resident, forever
+                _publish_session_hbm(key, _resident_nbytes(resident))
             else:
                 self._sessions[key][1] = self._clock()
             self._sessions.move_to_end(key)
@@ -374,6 +441,9 @@ class SolverService:
                 # for the solve that triggered this open (proactive or
                 # NEEDS_CATALOG retry)
                 session_stats.record(False)
+            # headroom is global (not per-key), so it can stay off-lock —
+            # it queries the backend, which must not run under the store lock
+            publish_device_headroom()
             logger.info("solver session opened (catalog key %s)", key.hex()[:12])
         # capability advertisement rides every OpenSession response: the
         # client gates its Pack trace trailer on PROTO_TRACE_TRAILER
@@ -620,12 +690,19 @@ def _serve_health(service: SolverService, port: int):
 
                 code, body = 200, generate_latest(_m.REGISTRY)
             elif self.path.startswith("/debug/traces"):
+                from urllib.parse import urlsplit
+
                 from karpenter_tpu import obs
 
                 code = 200
                 body = _json.dumps(
-                    {"traces": obs.exporter().snapshot()}
+                    obs.debug_traces_payload(urlsplit(self.path).query)
                 ).encode()
+            elif self.path.startswith("/debug/slo"):
+                from karpenter_tpu import obs
+
+                code = 200
+                body = _json.dumps({"slo": obs.slo_snapshot()}).encode()
             elif self.path.startswith("/debug/flight"):
                 from karpenter_tpu import obs
 
@@ -884,16 +961,32 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "('' disables; served at GET /debug/flight)")
     ap.add_argument("--flight-budget-ms", type=float, default=100.0,
                     help="sidecar.pack spans over this budget are recorded")
+    ap.add_argument("--slo-window", type=float, default=300.0,
+                    help="online SLO fast evaluation window in seconds "
+                         "(slow burn-rate window is 12x; GET /debug/slo)")
+    ap.add_argument("--slo-config", default="",
+                    help="objectives file ('' = the sidecar defaults: "
+                         "sidecar.pack.p99 + session.catalog_hit_rate)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    if args.flight_dir:
-        from karpenter_tpu import obs
+    from karpenter_tpu import obs
 
+    if args.flight_dir:
         # the sidecar's end-to-end unit is its own pack span
         obs.configure_flight(
             args.flight_dir, budget_s=args.flight_budget_ms / 1e3,
             watch=("sidecar.pack",),
         )
+    # the sidecar judges its own half of the objectives: its pack span and
+    # the session store it owns (controller-side spans never reach here)
+    obs.configure_slo(
+        objectives=(
+            obs.load_objectives(args.slo_config)
+            if args.slo_config
+            else obs.SIDECAR_OBJECTIVES
+        ),
+        window_s=args.slo_window,
+    )
     server = serve(
         args.address, args.max_workers, health_port=args.health_port, warmup=True,
         service=SolverService(session_max=args.session_max, session_ttl=args.session_ttl),

@@ -47,6 +47,13 @@ def record(hit: bool) -> None:
         # the gauge is set under the lock so two racing records cannot
         # publish their snapshots out of order and leave a stale value
         metrics.SOLVER_SESSION_HIT_RATE.set(_hits / (_hits + _misses))
+    # the online SLO engine judges `session.catalog_hit_rate` from the
+    # same event stream (outside the lock: the engine has its own)
+    from karpenter_tpu import obs
+
+    eng = obs.slo_engine()
+    if eng is not None:
+        eng.record_ratio("session.catalog_hit_rate", hit)
 
 
 def record_upload() -> None:

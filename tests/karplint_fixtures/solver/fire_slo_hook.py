@@ -1,0 +1,18 @@
+# karplint-fixture: expect=span-closed, tracer-host-sync
+"""An SLO finish-hook leaking into traced solver code: the engine is
+host-side span machinery (obs call = span-closed P0), and feeding it a
+traced value forces a host sync per solve (tracer-host-sync)."""
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu import obs
+
+
+@jax.jit
+def pack_with_inline_slo(pod_req):
+    total = jnp.sum(pod_req)
+    eng = obs.slo_engine()  # span-closed: obs machinery inside jit
+    if eng is not None:
+        # tracer-host-sync: float() on a traced value to fill a histogram
+        eng.record_ratio("session.catalog_hit_rate", float(total) > 0)
+    return pod_req

@@ -1,0 +1,24 @@
+# karplint-fixture: clean=span-closed, tracer-host-sync
+"""Near-miss: the sanctioned SLO hook shape — the engine consumes
+COMPLETED spans on the tracer's host side; nothing obs-flavored is
+reachable from the jit root."""
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu import obs
+
+
+@jax.jit
+def pure_kernel(pod_req):
+    # the kernel stays pure device data flow; judgment happens after
+    return jnp.cumsum(pod_req, axis=0)
+
+
+def finish_hook(span):
+    # runs host-side when the tracer closes a watched span — never from
+    # inside traced code, so the float() below is a host float on a host
+    # value, not a device sync
+    eng = obs.slo_engine()
+    if eng is not None:
+        eng(span)
+    return float(span.duration_s)

@@ -1,0 +1,146 @@
+"""The bench-trajectory regression gate (tools/bench_compare.py): headline
+key comparison semantics, the allowlist (pinned and unpinned), truncated-
+tail salvage, and the acceptance pin — r04 -> r05 on the checked-in files
+reproduces the known deltas and passes."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.bench_compare import (  # noqa: E402
+    compare,
+    extract_record,
+    find_bench_files,
+    main,
+    run,
+)
+
+
+def _bench(tmp_path, name, record):
+    p = tmp_path / name
+    p.write_text(json.dumps({"parsed": record}))
+    return p
+
+
+class TestCompare:
+    def test_direction_aware_verdicts(self):
+        rows = compare(
+            {"pipelined_pods_per_sec": 100.0, "device_p99_s": 0.1},
+            {"pipelined_pods_per_sec": 80.0, "device_p99_s": 0.2},
+        )
+        by_key = {r["key"]: r for r in rows}
+        # throughput down 20% = regressed; p99 UP 100% = regressed too
+        assert by_key["pipelined_pods_per_sec"]["verdict"] == "regressed"
+        assert by_key["device_p99_s"]["verdict"] == "regressed"
+
+    def test_improvement_and_tolerance(self):
+        rows = compare(
+            {"pipelined_pods_per_sec": 100.0, "device_p99_s": 0.2},
+            {"pipelined_pods_per_sec": 109.0, "device_p99_s": 0.1},
+        )
+        by_key = {r["key"]: r for r in rows}
+        assert by_key["pipelined_pods_per_sec"]["verdict"] == "ok"  # +9% < 10%
+        assert by_key["device_p99_s"]["verdict"] == "improved"  # halved
+
+    def test_missing_keys_reported_not_failed(self, tmp_path):
+        old = _bench(tmp_path, "BENCH_r01.json", {"pipelined_pods_per_sec": 10.0})
+        new = _bench(tmp_path, "BENCH_r02.json", {"device_p99_s": 0.1})
+        report = run(old, new)
+        assert report["failed"] == []  # budgeted legs drop keys legitimately
+        verdicts = {r["key"]: r["verdict"] for r in report["rows"]}
+        assert verdicts["pipelined_pods_per_sec"] == "missing_new"
+        assert verdicts["device_p99_s"] == "missing_old"
+
+
+class TestGate:
+    def test_regression_fails_and_allowlist_excuses(self, tmp_path):
+        old = _bench(tmp_path, "BENCH_r01.json", {"pipelined_pods_per_sec": 100.0})
+        new = _bench(tmp_path, "BENCH_r02.json", {"pipelined_pods_per_sec": 50.0})
+        report = run(old, new)
+        assert report["failed"] == ["pipelined_pods_per_sec"]
+
+        allow = tmp_path / "allow.json"
+        allow.write_text(json.dumps([
+            {"key": "pipelined_pods_per_sec", "reason": "traded for p99"},
+        ]))
+        excused = run(old, new, allowlist_path=allow)
+        assert excused["failed"] == []
+        row = next(r for r in excused["rows"]
+                   if r["key"] == "pipelined_pods_per_sec")
+        assert row["verdict"] == "allowlisted" and row["reason"]
+
+    def test_pinned_waiver_dies_with_its_run(self, tmp_path):
+        old = _bench(tmp_path, "BENCH_r01.json", {"pipelined_pods_per_sec": 100.0})
+        new = _bench(tmp_path, "BENCH_r02.json", {"pipelined_pods_per_sec": 50.0})
+        allow = tmp_path / "allow.json"
+        allow.write_text(json.dumps([
+            {"key": "pipelined_pods_per_sec", "reason": "r01 only",
+             "new": "BENCH_r01.json"},  # pinned to a DIFFERENT run
+        ]))
+        assert run(old, new, allowlist_path=allow)["failed"] == [
+            "pipelined_pods_per_sec"
+        ]
+
+    def test_cli_exit_codes(self, tmp_path):
+        old = _bench(tmp_path, "BENCH_r01.json", {"pipelined_pods_per_sec": 100.0})
+        new = _bench(tmp_path, "BENCH_r02.json", {"pipelined_pods_per_sec": 50.0})
+        args = [str(old), str(new), "--allowlist", ""]
+        assert main(args) == 1
+        assert main(args + ["--report"]) == 0  # the make-benchmark mode
+        assert main(["--dir", str(tmp_path / "empty")]) == 2
+
+    def test_newest_two_selected_by_round_number(self, tmp_path):
+        for i in (3, 1, 10, 2):
+            _bench(tmp_path, f"BENCH_r{i:02d}.json", {"value": float(i)})
+        files = find_bench_files(tmp_path)
+        assert [f.name for f in files[-2:]] == [
+            "BENCH_r03.json", "BENCH_r10.json",
+        ]
+
+
+class TestTailSalvage:
+    def test_front_truncated_tail_recovers_suffix(self, tmp_path):
+        # the harness stored only the tail of a long record line: the head
+        # (and the opening brace) are gone, possibly mid-nested-object
+        full = {"noise": {"a": 1}, "pipelined_pods_per_sec": 240612.8,
+                "device_p99_s": 0.1605}
+        line = json.dumps(full)
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps({"tail": line[len('{"noise": {"a"'):]}))
+        record, truncated = extract_record(p)
+        assert truncated is True
+        assert record["pipelined_pods_per_sec"] == 240612.8
+        assert record["device_p99_s"] == 0.1605
+
+
+@pytest.mark.skipif(
+    len(find_bench_files(REPO_ROOT)) < 2,
+    reason="checked-in bench trajectory not present",
+)
+class TestCheckedInTrajectory:
+    def test_r04_to_r05_reproduces_known_deltas_and_passes(self):
+        """The acceptance pin: the r05 round DOUBLED pipelined throughput
+        (the first TPU>CPU round); the gate must see that as improvement,
+        fail nothing, and salvage r05's truncated record line."""
+        report = run(
+            REPO_ROOT / "BENCH_r04.json",
+            REPO_ROOT / "BENCH_r05.json",
+            allowlist_path=REPO_ROOT / "tools" / "bench_allowlist.json",
+        )
+        assert report["failed"] == []
+        row = next(r for r in report["rows"]
+                   if r["key"] == "pipelined_pods_per_sec")
+        assert row["verdict"] == "improved"
+        assert row["delta_pct"] == pytest.approx(104.7, abs=0.5)
+
+    def test_make_bench_compare_equivalent_passes(self):
+        # exactly what CI runs: newest two checked-in rounds, default gate
+        assert main(["--dir", str(REPO_ROOT),
+                     "--allowlist",
+                     str(REPO_ROOT / "tools" / "bench_allowlist.json")]) == 0

@@ -729,3 +729,376 @@ class TestDebugEndpoints:
             assert "state" in flight["records"][0]
         finally:
             httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the online SLO engine (obs/slo.py)
+# ---------------------------------------------------------------------------
+
+
+class TestSloGrammar:
+    def test_defaults_and_sidecar_sets_parse(self):
+        from karpenter_tpu.obs.slo import (
+            DEFAULT_OBJECTIVES, SIDECAR_OBJECTIVES, parse_objectives,
+        )
+
+        assert len(parse_objectives(DEFAULT_OBJECTIVES)) == 4
+        assert len(parse_objectives(SIDECAR_OBJECTIVES)) == 2
+
+    @pytest.mark.parametrize("expr", [
+        "solve.p99 100ms",            # no operator
+        "mystery.p99 < 100ms",        # unknown source
+        "solve.p101 < 100ms",         # not a percentile
+        "solve.median < 100ms",       # unknown stat
+        "solve.p99 < 100parsecs",     # unknown unit
+    ])
+    def test_bad_expression_raises(self, expr):
+        from karpenter_tpu.obs.slo import parse_objectives
+
+        with pytest.raises(ValueError):
+            parse_objectives([expr])
+
+    def test_units_and_threshold(self):
+        from karpenter_tpu.obs.slo import Objective
+
+        assert Objective("solve.p99 < 100ms").threshold == pytest.approx(0.1)
+        assert Objective("solve.p50 < 250us").threshold == pytest.approx(250e-6)
+        assert Objective("time_to_bind.p99 < 5s").threshold == pytest.approx(5.0)
+        assert Objective("provision.success_rate >= 0.999").budget == (
+            pytest.approx(0.001)
+        )
+
+    def test_name_collision_rejected(self):
+        from karpenter_tpu.obs.slo import parse_objectives
+
+        with pytest.raises(ValueError, match="collides"):
+            parse_objectives(["solve.p99 < 100ms", "solve.p99 < 50ms"])
+
+    def test_config_file_round_trip_and_eager_validation(self, tmp_path):
+        from karpenter_tpu.obs.slo import load_objectives
+
+        good = tmp_path / "slo.conf"
+        good.write_text(
+            "# the controller's view\n"
+            "solve.p99 < 100ms   # BASELINE\n"
+            "\n"
+            "session.catalog_hit_rate >= 0.9\n"
+        )
+        assert load_objectives(str(good)) == [
+            "solve.p99 < 100ms", "session.catalog_hit_rate >= 0.9",
+        ]
+        bad = tmp_path / "bad.conf"
+        bad.write_text("solve.p99 <\n")
+        with pytest.raises(ValueError):
+            load_objectives(str(bad))
+
+    def test_typoed_config_fails_options_validation(self, tmp_path):
+        from karpenter_tpu.options import Options
+
+        bad = tmp_path / "bad.conf"
+        bad.write_text("warp.factor > 9\n")
+        errs = Options(slo_config=str(bad)).validate()
+        assert any("slo-config" in e for e in errs)
+
+
+class TestSloEngine:
+    def _engine(self, clock, objectives=None, window_s=10.0):
+        return obs.configure_slo(
+            objectives=objectives, window_s=window_s, clock=clock,
+        )
+
+    def test_online_quantile_tracks_offline_within_5pct(self):
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        durations = [0.001 * (i + 1) for i in range(200)]  # 1ms..200ms
+        for d in durations:
+            eng(_FakeSpan("solver.solve", d))
+        snap = eng.snapshot()["objectives"]["solve_p99"]
+        offline = sorted(durations)[int(0.99 * len(durations)) - 1]
+        assert abs(snap["value"] - offline) / offline < 0.05
+
+    def test_window_rotation_burn_rate_transitions(self):
+        """The deterministic burn-rate life cycle under a fake clock:
+        a burst of budget-breaching solves trips BOTH windows (burning),
+        the fast window forgives after window_s of silence (not burning,
+        slow still hot), and the slow window forgives after 12x that."""
+        t = [0.0]
+        eng = self._engine(lambda: t[0], window_s=10.0)  # slow = 120s
+        st = eng.snapshot()["objectives"]["solve_p99"]
+        assert st["ok"] is None and st["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+
+        for _ in range(50):  # every one breaches the 100ms threshold
+            eng(_FakeSpan("solver.solve", 0.5))
+        hot = eng.snapshot()["objectives"]["solve_p99"]
+        assert hot["ok"] is False
+        # 100% bad over a 1% budget: burn rate 100x in both windows
+        assert hot["burn_rate"]["fast"] == pytest.approx(100.0)
+        assert hot["burn_rate"]["slow"] == pytest.approx(100.0)
+        assert hot["burning"] is True
+
+        t[0] += 15.0  # one fast window of silence: slices expire by INDEX
+        cooled = eng.snapshot()["objectives"]["solve_p99"]
+        assert cooled["events"]["fast"] == 0
+        assert cooled["burn_rate"]["fast"] == 0.0
+        assert cooled["events"]["slow"] == 50  # still inside the slow window
+        assert cooled["burn_rate"]["slow"] == pytest.approx(100.0)
+        assert cooled["burning"] is False  # multiwindow: a cooled fast unpages
+
+        t[0] += 130.0  # beyond the slow window too
+        cold = eng.snapshot()["objectives"]["solve_p99"]
+        assert cold["events"] == {"fast": 0, "slow": 0}
+        assert cold["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+
+    def test_good_events_do_not_burn(self):
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        for _ in range(100):
+            eng(_FakeSpan("solver.solve", 0.001))
+        snap = eng.snapshot()["objectives"]["solve_p99"]
+        assert snap["ok"] is True
+        assert snap["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert snap["burning"] is False
+
+    def test_span_ratio_counts_errors(self):
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        for i in range(1000):
+            eng(_FakeSpan("provision.round", 0.01, error="boom" if i < 5 else None))
+        snap = eng.snapshot()["objectives"]["provision_success_rate"]
+        assert snap["value"] == pytest.approx(0.995)
+        assert snap["ok"] is False  # 0.995 < 0.999
+        # 0.5% bad over a 0.1% budget
+        assert snap["burn_rate"]["fast"] == pytest.approx(5.0)
+
+    def test_low_volume_windows_never_burn(self):
+        """Burn divides by OBSERVED volume: after an idle period a tiny
+        all-bad burst is 100% of both windows — the volume guard keeps it
+        from paging until the window holds MIN_WINDOW_EVENTS."""
+        from karpenter_tpu.obs.slo import MIN_WINDOW_EVENTS
+
+        t = [3600.0 * 10]  # a long-idle process
+        eng = self._engine(lambda: t[0])
+        for _ in range(MIN_WINDOW_EVENTS - 1):
+            eng(_FakeSpan("solver.solve", 0.5))  # every one breaches
+        blip = eng.snapshot()["objectives"]["solve_p99"]
+        assert blip["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert blip["burning"] is False
+        assert blip["ok"] is False  # the VERDICT still tells the truth
+        eng(_FakeSpan("solver.solve", 0.5))  # ...the guard threshold
+        page = eng.snapshot()["objectives"]["solve_p99"]
+        assert page["burn_rate"]["fast"] == pytest.approx(100.0)
+        assert page["burning"] is True
+
+    def test_ratio_source_via_record_ratio(self):
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        for _ in range(8):
+            eng.record_ratio("session.catalog_hit_rate", True)
+        eng.record_ratio("session.catalog_hit_rate", False)
+        snap = eng.snapshot()["objectives"]["session_catalog_hit_rate"]
+        assert snap["value"] == pytest.approx(8 / 9)
+        assert snap["ok"] is False  # 0.889 < 0.9
+
+    def test_time_to_bind_adds_admission_window(self):
+        t = [0.0]
+        eng = self._engine(lambda: t[0], objectives=["time_to_bind.p99 < 5s"])
+        eng(_FakeSpan(
+            "provision.round", 3.0, attrs={"admission_window_s": 4.0},
+        ))
+        snap = eng.snapshot()["objectives"]["time_to_bind_p99"]
+        assert snap["value"] == pytest.approx(7.0, rel=0.05)
+        assert snap["ok"] is False
+
+    def test_slo_gauges_published(self):
+        from prometheus_client import generate_latest
+
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        bad_before = metrics.SLO_EVENTS.labels(
+            objective="solve_p99", verdict="bad"
+        )._value.get()
+        for _ in range(10):
+            eng(_FakeSpan("solver.solve", 0.5))
+        eng.snapshot()  # snapshot republishes every gauge
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert 'karpenter_slo_objective_ok{objective="solve_p99"} 0.0' in out
+        assert ('karpenter_slo_burn_rate{objective="solve_p99",'
+                'window="fast"} 100.0') in out
+        assert 'karpenter_slo_burning{objective="solve_p99"} 1.0' in out
+        bad_after = metrics.SLO_EVENTS.labels(
+            objective="solve_p99", verdict="bad"
+        )._value.get()
+        assert bad_after - bad_before == 10
+
+    def test_objective_ok_unset_until_data(self):
+        """A data-less objective must not publish ok=0.0 ("failing") —
+        the child gauge materializes on the first real verdict."""
+        from prometheus_client import generate_latest
+
+        t = [0.0]
+        eng = self._engine(lambda: t[0], objectives=["provision.p95 < 1s"])
+        eng.snapshot()
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert 'karpenter_slo_objective_ok{objective="provision_p95"}' not in out
+        assert 'karpenter_slo_burning{objective="provision_p95"} 0.0' in out
+        eng(_FakeSpan("provision.round", 0.01))
+        eng.snapshot()
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert 'karpenter_slo_objective_ok{objective="provision_p95"} 1.0' in out
+
+    def test_exemplar_agrees_with_flight_record(self, tmp_path):
+        """The breach exemplar and the flight record must name the SAME
+        trace: /debug/slo's "show me a bad solve" id greps straight into
+        the flight dir."""
+        rec = obs.configure_flight(str(tmp_path), budget_s=0.0)
+        eng = self._engine(
+            time.monotonic, objectives=["solve.p99 < 1us"],  # all breach
+        )
+        with obs.tracer().span("solver.solve"):
+            pass
+        records = rec.recent()
+        assert len(records) == 1
+        snap = eng.snapshot()["objectives"]["solve_p99"]
+        assert snap["exemplars"]["breach"] == records[0]["trace_id"]
+        assert snap["exemplars"]["worst"]["trace_id"] == records[0]["trace_id"]
+
+    def test_flight_record_snapshots_burning_panel(self, tmp_path):
+        rec = obs.configure_flight(str(tmp_path), budget_s=0.0)
+        self._engine(time.monotonic, objectives=["solve.p99 < 1us"])
+        # hooks run in registration order (flight before slo), so each
+        # record sees the engine as of the PREVIOUS span — warm with one
+        with obs.tracer().span("solver.solve"):
+            pass
+        with obs.tracer().span("solver.solve"):
+            pass
+        state = rec.recent()[0]["state"]  # newest record
+        assert state["slo"]["solve_p99"]["ok"] is False
+
+    def test_concurrent_hook_vs_snapshot(self):
+        """Finish-hooks hammer the windows while /debug/slo snapshots —
+        no torn reads, no dict-changed-size, every event accounted for."""
+        t = [0.0]
+        eng = self._engine(lambda: t[0])
+        errors = []
+        n_threads, per_thread = 4, 300
+
+        def emit():
+            try:
+                for _ in range(per_thread):
+                    eng(_FakeSpan("solver.solve", 0.001))
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        def snapshot():
+            try:
+                for _ in range(200):
+                    eng.snapshot()
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [threading.Thread(target=emit) for _ in range(n_threads)]
+        threads.append(threading.Thread(target=snapshot))
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert errors == []
+        snap = eng.snapshot()["objectives"]["solve_p99"]
+        assert snap["events"]["fast"] == n_threads * per_thread
+
+    def test_reset_for_tests_detaches_engine(self):
+        self._engine(time.monotonic)
+        assert obs.slo_engine() is not None
+        obs.reset_for_tests()
+        assert obs.slo_engine() is None
+        assert obs.slo_snapshot() == {}
+        from karpenter_tpu.obs.flight import state_snapshot
+
+        assert "slo" not in state_snapshot()
+
+    def test_shutdown_slo_is_ownership_checked(self):
+        """A stopped replica must not tear down the engine a later-started
+        replica installed in the same process (Runtime.stop passes the
+        engine it owns)."""
+        first = self._engine(time.monotonic)
+        second = self._engine(time.monotonic)  # replaces first
+        obs.shutdown_slo(engine=first)  # stale owner: a no-op
+        assert obs.slo_engine() is second
+        obs.shutdown_slo(engine=second)  # the current owner detaches
+        assert obs.slo_engine() is None
+
+
+class _FakeSpan:
+    """The minimal Span surface the engine's hook reads (a real tracer
+    span's duration comes from perf_counter — not fake-clockable)."""
+
+    def __init__(self, name, duration_s, attrs=None, error=None, trace_id="t" * 32):
+        self.name = name
+        self.duration_s = duration_s
+        self.attrs = attrs or {}
+        self.error = error
+        self.trace_id = trace_id
+
+
+class TestSloDebugEndpoints:
+    def test_sidecar_serves_slo_and_filtered_traces(self):
+        from karpenter_tpu.solver.service import SolverService, _serve_health
+
+        eng = obs.configure_slo(objectives=obs.SIDECAR_OBJECTIVES)
+        eng(_FakeSpan("sidecar.pack", 0.5))
+        with obs.tracer().span("sidecar.pack"):
+            pass
+        with obs.tracer().span("solver.solve"):
+            pass
+        service = SolverService()
+        service.ready.set()
+        port = free_port()
+        httpd = _serve_health(service, port)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/slo", timeout=5
+            ) as resp:
+                slo = json.loads(resp.read())["slo"]
+            assert slo["objectives"]["sidecar_pack_p99"]["ok"] is False
+            # ?name= narrows to one trace family; ?limit= bounds it
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces?name=sidecar.pack&limit=5",
+                timeout=5,
+            ) as resp:
+                body = json.loads(resp.read())
+            assert [t["name"] for t in body["traces"]] == ["sidecar.pack"]
+            # exporter residency stats ride the same payload (the drop
+            # counter is process-lifetime cumulative, so only its presence
+            # is asserted — earlier tests may legitimately have evicted)
+            assert body["stats"]["trees"] == 2
+            assert body["stats"]["spans"] == 2
+            assert body["stats"]["dropped_spans"] >= 0
+            assert body["stats"]["capacity"] > 0
+        finally:
+            httpd.shutdown()
+
+    def test_trace_limit_filter_unit(self):
+        for i in range(6):
+            with obs.tracer().span("a" if i % 2 else "b"):
+                pass
+        payload = obs.debug_traces_payload("limit=2")
+        assert len(payload["traces"]) == 2
+        named = obs.debug_traces_payload("name=a")
+        assert {t["name"] for t in named["traces"]} == {"a"}
+        assert len(named["traces"]) == 3
+        # garbage query degrades to the defaults, never a 500
+        assert len(obs.debug_traces_payload("limit=banana")["traces"]) == 6
+
+    def test_ring_gauges_track_residency(self):
+        from prometheus_client import generate_latest
+
+        with obs.tracer().span("root"):
+            with obs.tracer().span("child"):
+                pass
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert "karpenter_trace_ring_trees 1.0" in out
+        assert "karpenter_trace_ring_spans 2.0" in out
+        obs.exporter().clear()
+        out = generate_latest(metrics.REGISTRY).decode()
+        assert "karpenter_trace_ring_trees 0.0" in out
+        assert "karpenter_trace_ring_spans 0.0" in out

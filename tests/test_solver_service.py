@@ -468,3 +468,72 @@ class TestHealth:
         out = generate_latest(metrics.REGISTRY).decode()
         assert f'karpenter_solver_breaker_open{{address="{address}"}} 1.0' in out
         assert f'karpenter_solver_breaker_trips_total{{address="{address}"}} 1.0' in out
+
+
+class TestHbmTelemetry:
+    """Device-memory telemetry (docs/metrics.md): the per-session HBM
+    gauge must track the session store exactly — labels appear on open,
+    carry the pinned byte count, and vanish on LRU/TTL eviction."""
+
+    @staticmethod
+    def _hbm_labels():
+        from karpenter_tpu import metrics
+
+        return {
+            s.labels["session"]: s.value
+            for m in metrics.SOLVER_SESSION_HBM.collect()
+            for s in m.samples
+        }
+
+    @staticmethod
+    def _open(svc, seed):
+        from karpenter_tpu.solver.service import _key_array
+
+        rng = np.random.default_rng(seed)
+        join = rng.integers(-1, 5, (3, 2)).astype(np.int32)
+        front = rng.random((3, 1, 2)).astype(np.float32)
+        daemon = np.zeros(2, np.float32)
+        key = catalog_session_key(join, front, daemon)
+        svc.open_session_bytes(pack_arrays([_key_array(key), join, front, daemon]))
+        nbytes = join.nbytes + front.nbytes + daemon.nbytes
+        return key.hex()[:12], nbytes
+
+    def test_gauge_set_on_open_and_removed_on_lru_eviction(self):
+        svc = SolverService(session_max=2)
+        first, nbytes = self._open(svc, seed=10)
+        labels = self._hbm_labels()
+        assert labels.get(first) == nbytes  # catalog tensors, byte-exact
+        second, _ = self._open(svc, seed=11)
+        third, _ = self._open(svc, seed=12)  # LRU evicts `first`
+        labels = self._hbm_labels()
+        assert first not in labels
+        assert second in labels and third in labels
+        # the SUM over labels is what the store pins right now
+        assert svc.session_count() == 2 == len(
+            {k for k in labels if k in (second, third)}
+        )
+
+    def test_gauge_removed_on_ttl_eviction(self):
+        now = [0.0]
+        svc = SolverService(session_ttl=10.0, clock=lambda: now[0])
+        first, _ = self._open(svc, seed=20)
+        assert first in self._hbm_labels()
+        now[0] = 11.0
+        second, _ = self._open(svc, seed=21)  # open sweeps the stale entry
+        labels = self._hbm_labels()
+        assert first not in labels and second in labels
+
+    def test_headroom_gauge_never_lies_on_cpu(self):
+        """The CPU test rig reports no memory_stats: the headroom child
+        must stay ABSENT (None return), never publish a fake zero."""
+        from karpenter_tpu import metrics
+        from karpenter_tpu.solver.service import publish_device_headroom
+
+        got = publish_device_headroom()
+        samples = [
+            s for m in metrics.SOLVER_HBM_HEADROOM.collect() for s in m.samples
+        ]
+        if got is None:
+            assert samples == []  # no child = no lie
+        else:  # a real accelerator backend: the child carries the headroom
+            assert got >= 0 and samples[0].value == got

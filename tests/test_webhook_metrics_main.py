@@ -257,6 +257,24 @@ class TestServedEndpoints:
                 f"http://127.0.0.1:{opts.health_probe_port}/healthz", timeout=5
             )
             assert health.status == 200
+            # the controller health server also judges: run_controller_process
+            # installs the online SLO engine, so /debug/slo serves the
+            # default objectives (no data yet — ok stays null, not failing)
+            import json
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{opts.health_probe_port}/debug/slo", timeout=5
+            ) as resp:
+                slo = json.loads(resp.read())["slo"]
+            assert "solve_p99" in slo["objectives"]
+            assert slo["objectives"]["solve_p99"]["ok"] is None
+            # and /debug/traces carries the exporter stats + query filters
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{opts.health_probe_port}/debug/traces?limit=1",
+                timeout=5,
+            ) as resp:
+                traces = json.loads(resp.read())
+            assert "stats" in traces and len(traces["traces"]) <= 1
         finally:
             runtime.stop()
 

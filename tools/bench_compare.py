@@ -1,0 +1,272 @@
+"""Bench-trajectory regression gate: newest ``BENCH_r0x.json`` vs its
+predecessor.
+
+The repo's north star is a number (BASELINE: 10k pods x 400 types under
+100ms p99) and the ``BENCH_r0x.json`` files are its trajectory — but until
+now they were unchecked artifacts: a PR that halved ``pipelined_pods_per_sec``
+would land green. This tool is the CI-side twin of the online SLO engine
+(``karpenter_tpu/obs/slo.py``): offline, across runs, same philosophy —
+a declared objective judged mechanically, with an allowlist (not silence)
+for the regressions a human has explicitly accepted.
+
+Usage (from the repo root)::
+
+    python -m tools.bench_compare                 # newest two BENCH_r0x.json
+    python -m tools.bench_compare OLD.json NEW.json
+    python -m tools.bench_compare --report        # non-fatal (make benchmark)
+
+Exit codes: 0 clean (or ``--report``), 1 regression beyond the threshold,
+2 usage error (fewer than two bench files, unreadable JSON, bad allowlist).
+
+Comparison semantics:
+
+- Headline keys only (``HEADLINE_KEYS``): each carries a direction —
+  ``pipelined_pods_per_sec`` up is good, ``device_p99_s`` down is good.
+- A key missing on either side is reported but never fails the gate: bench
+  legs are budgeted (``BENCH_BUDGET_S``) and a capped run drops legs; the
+  record line itself may even be tail-truncated (see ``extract_record``).
+- Regression = worse by more than ``--threshold`` (default 10%) and not
+  covered by the allowlist (``tools/bench_allowlist.json``: a list of
+  ``{"key": ..., "reason": ...}`` entries; an entry may pin ``"new"`` to
+  the run basename so the waiver dies with the run it excused).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+# key -> direction: +1 higher is better, -1 lower is better
+HEADLINE_KEYS: Dict[str, int] = {
+    "value": +1,  # the headline pods-scheduled/sec record line
+    "pipelined_pods_per_sec": +1,
+    "device_p99_s": -1,
+    "session_catalog_hit_rate": +1,
+    "chaos_provision_success_rate": +1,
+}
+
+DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def find_bench_files(root: Path) -> List[Path]:
+    """All ``BENCH_r0x.json`` under ``root``, oldest round first."""
+    out: List[Tuple[int, Path]] = []
+    for p in root.iterdir():
+        m = _BENCH_RE.match(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
+
+
+def _salvage_tail(tail: str) -> Optional[Dict[str, Any]]:
+    """Recover a record from a front-truncated JSON line.
+
+    The bench harness stores only the last N chars of output (``tail``);
+    a long record line loses its opening brace and some leading keys —
+    possibly cutting inside a nested object. Reopen the object at each
+    successive top-level-looking key boundary until one suffix parses:
+    the first success is the maximal recoverable record.
+    """
+    line = tail.strip().splitlines()[-1] if tail.strip() else ""
+    if not line:
+        return None
+    for m in re.finditer(r', "', line):
+        try:
+            got = json.loads("{" + line[m.start() + 2:])
+        except json.JSONDecodeError:
+            continue
+        if isinstance(got, dict):
+            return got
+    return None
+
+
+def extract_record(path: Path) -> Tuple[Dict[str, Any], bool]:
+    """The bench record from one BENCH file: ``(record, truncated)``.
+
+    Prefers the harness's ``parsed`` field; falls back to parsing the last
+    line of ``tail``, then to suffix salvage (``truncated=True``) when the
+    stored tail cut the record line's head off. A bare record line written
+    by ``bench.py > out.json`` also works.
+    """
+    data = json.loads(path.read_text())
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"], False
+    if isinstance(data, dict) and "tail" in data:
+        line = str(data["tail"]).strip().splitlines()[-1] if str(data["tail"]).strip() else ""
+        try:
+            got = json.loads(line)
+            if isinstance(got, dict):
+                return got, False
+        except json.JSONDecodeError:
+            pass
+        got = _salvage_tail(str(data["tail"]))
+        if got is not None:
+            return got, True
+        raise ValueError(f"{path}: no recoverable record line in tail")
+    if isinstance(data, dict):
+        return data, False
+    raise ValueError(f"{path}: not a bench record")
+
+
+def load_allowlist(path: Optional[Path]) -> List[Dict[str, str]]:
+    if path is None or not path.exists():
+        return []
+    entries = json.loads(path.read_text())
+    if not isinstance(entries, list) or not all(
+        isinstance(e, dict) and "key" in e and "reason" in e for e in entries
+    ):
+        raise ValueError(
+            f"{path}: allowlist must be a list of "
+            '{"key": ..., "reason": ...[, "new": <run basename>]} entries'
+        )
+    return entries
+
+
+def _allowed(
+    entries: List[Dict[str, str]], key: str, new_name: str
+) -> Optional[str]:
+    for e in entries:
+        if e["key"] != key:
+            continue
+        if "new" in e and e["new"] != new_name:
+            continue  # the waiver was pinned to a different run
+        return e["reason"]
+    return None
+
+
+def compare(
+    old: Dict[str, Any],
+    new: Dict[str, Any],
+    threshold: float = 0.10,
+    keys: Optional[Dict[str, int]] = None,
+) -> List[Dict[str, Any]]:
+    """Per-key comparison rows; ``verdict`` is ``ok`` / ``improved`` /
+    ``regressed`` / ``missing_old`` / ``missing_new``. Regressions beyond
+    the threshold are the gate's concern; the rest is the report."""
+    rows: List[Dict[str, Any]] = []
+    for key, direction in (keys or HEADLINE_KEYS).items():
+        a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or isinstance(a, bool):
+            a = None
+        if not isinstance(b, (int, float)) or isinstance(b, bool):
+            b = None
+        if a is None or b is None:
+            rows.append({
+                "key": key, "old": a, "new": b,
+                "verdict": "missing_new" if b is None else "missing_old",
+            })
+            continue
+        # signed change toward "better": positive = improvement
+        change = (b - a) / abs(a) if a else 0.0
+        better = change * direction
+        verdict = "ok"
+        if better < -threshold:
+            verdict = "regressed"
+        elif better > threshold:
+            verdict = "improved"
+        rows.append({
+            "key": key, "old": a, "new": b,
+            "delta_pct": round(change * 100, 1),
+            "direction": "up" if direction > 0 else "down",
+            "verdict": verdict,
+        })
+    return rows
+
+
+def run(
+    old_path: Path,
+    new_path: Path,
+    threshold: float = 0.10,
+    allowlist_path: Optional[Path] = None,
+) -> Dict[str, Any]:
+    """The full gate: returns the report dict; ``report["failed"]`` lists
+    unallowlisted regressions (nonzero = the gate should redden)."""
+    old, old_trunc = extract_record(old_path)
+    new, new_trunc = extract_record(new_path)
+    entries = load_allowlist(allowlist_path)
+    rows = compare(old, new, threshold=threshold)
+    failed = []
+    for row in rows:
+        if row["verdict"] != "regressed":
+            continue
+        reason = _allowed(entries, row["key"], new_path.name)
+        if reason is not None:
+            row["verdict"] = "allowlisted"
+            row["reason"] = reason
+        else:
+            failed.append(row["key"])
+    return {
+        "old": old_path.name,
+        "new": new_path.name,
+        "threshold_pct": round(threshold * 100, 1),
+        "truncated": {
+            **({"old": True} if old_trunc else {}),
+            **({"new": True} if new_trunc else {}),
+        },
+        "rows": rows,
+        "failed": failed,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("files", nargs="*", metavar="OLD NEW",
+                    help="two bench JSON files (default: the newest two "
+                         "BENCH_r0x.json in --dir)")
+    ap.add_argument("--dir", default=".", help="where BENCH_r0x.json live")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression tolerance as a fraction (default 0.10)")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                    help="accepted-regression entries (JSON list)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the comparison but always exit 0 "
+                         "(the `make benchmark` non-fatal mode)")
+    args = ap.parse_args(argv)
+
+    if len(args.files) == 2:
+        old_path, new_path = Path(args.files[0]), Path(args.files[1])
+    elif not args.files:
+        try:
+            files = find_bench_files(Path(args.dir))
+        except OSError:
+            files = []
+        if len(files) < 2:
+            print(f"bench_compare: need two BENCH_r0x.json under {args.dir}, "
+                  f"found {len(files)}", file=sys.stderr)
+            return 2
+        old_path, new_path = files[-2], files[-1]
+    else:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    try:
+        report = run(
+            old_path, new_path,
+            threshold=args.threshold,
+            allowlist_path=Path(args.allowlist) if args.allowlist else None,
+        )
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+
+    print(json.dumps(report, indent=2))
+    if report["failed"] and not args.report:
+        print(
+            f"bench_compare: REGRESSION {report['old']} -> {report['new']}: "
+            + ", ".join(report["failed"])
+            + f" (>{report['threshold_pct']}% worse; allowlist: {args.allowlist})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
