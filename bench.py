@@ -1477,6 +1477,299 @@ def _sample(m, name: str) -> float:
     return total
 
 
+def bench_overload_storm(
+    n_pods: int = 300,
+    overload_factor: float = 5.0,
+    n_provisioners: int = 4,
+    batcher_depth: int = 10,
+    max_inflight: int = 1,
+    queue_depth: int = 2,
+    sidecar_floor_s: float = 0.2,
+    calibration_pods: int = 60,
+):
+    """Overload-control proof (docs/overload.md): drive ≥``overload_factor``×
+    the measured single-rate capacity at a chaos-slowed sidecar with tiny
+    admission caps and a bounded batcher, with a high/default/low pod
+    priority mix. The system must DECIDE what to drop: queue depths stay at
+    their caps, sheds land on the lowest priority class first, every
+    highest-priority pod still binds, goodput holds ≥80% of single-rate
+    capacity, zero deadline-expired solves reach device dispatch, and no
+    real circuit breaker trips on pure overload."""
+    import threading
+
+    import numpy as np
+
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.solver.service import (
+        N_POD_ARRAYS,
+        STATUS_DEADLINE_EXCEEDED,
+        SolverService,
+        pack_arrays,
+        serve,
+        unpack_arrays,
+    )
+    from karpenter_tpu.testing.chaos import ChaosPolicy, SidecarChaos, chaos_wrap
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+    t_start = time.perf_counter()
+    # pin the device path: the cost router would (correctly) route these
+    # small batches to native and the admission gate would never see load
+    packer_before = os.environ.get("KARPENTER_PACKER")
+    os.environ["KARPENTER_PACKER"] = "device"
+
+    service = SolverService(
+        max_inflight=max_inflight, queue_depth=queue_depth,
+        overload_retry_after=0.2,
+    )
+    wrapped = chaos_wrap(
+        service, ChaosPolicy(error_rate=0.0, latency_floor=sidecar_floor_s)
+    )
+    address = f"127.0.0.1:{SidecarChaos._free_port()}"
+    server = serve(address, max_workers=8, service=wrapped)
+
+    cluster = Cluster()
+    bound_at = {}
+    t0_box = [0.0]
+    watch_mu = threading.Lock()
+
+    def on_pod(event, pod):
+        if event == "DELETED" or not pod.spec.node_name:
+            return
+        with watch_mu:
+            bound_at.setdefault(
+                pod.metadata.name, time.perf_counter() - t0_box[0]
+            )
+
+    cluster.watch("pods", on_pod)
+    rt = build_runtime(
+        Options(solver_service_address=address),
+        cluster=cluster,
+        cloud_provider=SimulatedCloudProvider(api=SimCloudAPI()),
+    )
+    shed_by_priority: dict = {}
+    shed_by_reason: dict = {}
+    shed_mu = threading.Lock()
+    trips_before = _sample(m, "karpenter_solver_breaker_trips_total")
+    try:
+        rt.manager.start()
+        for i in range(n_provisioners):
+            cluster.create("provisioners", make_provisioner(
+                name=f"ols-{i}", solver="tpu",
+                requirements=[NodeSelectorRequirement(
+                    key="ols", operator="In", values=[f"ols-{i}"],
+                )],
+            ))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(rt.provisioning.workers) == n_provisioners:
+                break
+            time.sleep(0.05)
+        # let the solver warmups land so the calibration measures capacity,
+        # not compile time (an artificially low capacity would soften the
+        # goodput bar)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if all(w.warmed.is_set() for w in rt.provisioning.list_workers()):
+                break
+            time.sleep(0.1)
+        for w in rt.provisioning.list_workers():
+            w.batcher.idle_duration = 0.1
+            w.batcher.max_depth = batcher_depth
+            # shed audit: record every dropped pod's priority class + reason
+            # on top of the worker's own hook (which clears pending state
+            # and emits the Warning event)
+            orig = w.batcher._on_shed
+
+            def on_shed(item, reason, _orig=orig):
+                from karpenter_tpu.utils.pod import priority_of
+
+                with shed_mu:
+                    shed_by_priority[priority_of(item)] = (
+                        shed_by_priority.get(priority_of(item), 0) + 1
+                    )
+                    shed_by_reason[reason] = shed_by_reason.get(reason, 0) + 1
+                if _orig is not None:
+                    _orig(item, reason)
+
+            w.batcher._on_shed = on_shed
+
+        def make_storm_pod(i: int, prefix: str):
+            # 10% high / 70% default / 20% low: the mix the shed ordering
+            # is judged against
+            r = i % 10
+            pclass = (
+                "high-priority" if r == 0
+                else "low-priority" if r >= 8
+                else ""
+            )
+            return make_pod(
+                name=f"{prefix}-{i}", requests={"cpu": "0.25"},
+                node_selector={"ols": f"ols-{i % n_provisioners}"},
+                priority_class_name=pclass,
+            )
+
+        # -- phase 1: single-rate capacity ----------------------------------
+        # Two steps, because a pure burst mostly binds in ONE batcher round
+        # and measures burst-absorption, not sustained rate — on a fast
+        # machine that inflates "capacity" past what any multi-round drain
+        # can match and the goodput bar becomes unmeetable. Step 1 bursts
+        # to get a rate estimate; step 2 re-measures PACED at that 1x rate
+        # (the same offered-load shape as the storm), and THAT drain is the
+        # capacity the >=0.8 goodput bar is judged against.
+        t0_box[0] = time.perf_counter()
+        for i in range(calibration_pods):
+            cluster.create("pods", make_storm_pod(i, "cal"))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            cal = [p for p in cluster.pods() if p.metadata.name.startswith("cal-")]
+            if cal and all(p.spec.node_name for p in cal):
+                break
+            time.sleep(0.05)
+        cal_latencies = [
+            v for k, v in bound_at.items() if k.startswith("cal-")
+        ]
+        burst_capacity = (
+            len(cal_latencies) / max(max(cal_latencies, default=1.0), 1e-6)
+        )
+        t0_box[0] = time.perf_counter()
+        for i in range(calibration_pods):
+            cluster.create("pods", make_storm_pod(i, "calp"))
+            target = (i + 1) / max(burst_capacity, 1e-6)
+            lag = target - (time.perf_counter() - t0_box[0])
+            if lag > 0:
+                time.sleep(lag)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            cal = [
+                p for p in cluster.pods()
+                if p.metadata.name.startswith("calp-")
+            ]
+            if cal and all(p.spec.node_name for p in cal):
+                break
+            time.sleep(0.05)
+        paced_latencies = [
+            v for k, v in bound_at.items() if k.startswith("calp-")
+        ]
+        capacity = (
+            len(paced_latencies)
+            / max(max(paced_latencies, default=1.0), 1e-6)
+        )
+
+        # -- phase 2: the storm at overload_factor x capacity ----------------
+        rate = max(capacity * overload_factor, 20.0)
+        shed_batcher_before = _sample(m, "karpenter_batcher_shed_total")
+        t0_box[0] = time.perf_counter()
+        for i in range(n_pods):
+            cluster.create("pods", make_storm_pod(i, "storm"))
+            target = (i + 1) / rate
+            lag = target - (time.perf_counter() - t0_box[0])
+            if lag > 0:
+                time.sleep(lag)
+        offered_window = time.perf_counter() - t0_box[0]
+        # settle: shed pods re-enter via selection's requeue, and every
+        # HIGH-priority pod must bind (shed ordering protects them). Wait
+        # for the whole storm to drain (bounded) so goodput and p99 cover
+        # sustained overload, not just the first burst.
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            storm = [
+                p for p in cluster.pods()
+                if p.metadata.name.startswith("storm-")
+            ]
+            if storm and all(p.spec.node_name for p in storm):
+                break
+            time.sleep(0.1)
+        high = [
+            p for p in cluster.pods()
+            if p.metadata.name.startswith("storm-")
+            and p.spec.priority_class_name == "high-priority"
+        ]
+        high_bound = sum(1 for p in high if p.spec.node_name)
+
+        # -- phase 3: deadline-shed probe ------------------------------------
+        # an already-expired propagated budget must shed BEFORE device
+        # dispatch: junk pod arrays prove the gate runs first (they would
+        # crash the solve if it ever got that far). Wait for the sidecar to
+        # quiesce so late storm solves can't blur the dispatch delta.
+        deadline = time.time() + 60
+        while time.time() < deadline and service.admission.depth():
+            time.sleep(0.05)
+        time.sleep(2 * sidecar_floor_s)
+        dispatches_before = service.dispatches
+        deadline_probes = 8
+        for _ in range(deadline_probes):
+            arrays = (
+                [np.zeros(4, np.int32), np.asarray([64, 1], np.int32)]
+                + [np.zeros(4, np.float32)] * N_POD_ARRAYS
+                + [np.asarray([0.0], np.float32)]  # 0s of budget left
+            )
+            resp = service.solve_bytes(pack_arrays(arrays))
+            status = int(unpack_arrays(resp)[0].reshape(-1)[0])
+            assert status == STATUS_DEADLINE_EXCEEDED, status
+        deadline_expired_dispatches = (
+            service.dispatches - dispatches_before
+        )
+
+        storm = [p for p in cluster.pods() if p.metadata.name.startswith("storm-")]
+        bound_total = sum(1 for p in storm if p.spec.node_name)
+        accepted = sorted(
+            v for k, v in bound_at.items() if k.startswith("storm-")
+        )
+        # goodput under SUSTAINED overload: everything the system bound
+        # over the storm-to-drain span — the bar is >=80% of the single-
+        # rate capacity, i.e. overload costs at most a fifth of throughput
+        goodput = bound_total / max(accepted[-1] if accepted else offered_window, 1e-6)
+        shed_batcher = _sample(m, "karpenter_batcher_shed_total") - shed_batcher_before
+        trips = _sample(m, "karpenter_solver_breaker_trips_total") - trips_before
+        batcher_peaks = [
+            w.batcher.max_depth_seen for w in rt.provisioning.list_workers()
+        ]
+        return {
+            "pods": n_pods,
+            "overload_factor": overload_factor,
+            "provisioners": n_provisioners,
+            "capacity_pods_per_sec": round(capacity, 1),
+            "burst_capacity_pods_per_sec": round(burst_capacity, 1),
+            "offered_rate_pods_per_sec": round(rate, 1),
+            "offered_window_s": round(offered_window, 2),
+            "goodput_pods_per_sec": round(goodput, 1),
+            "goodput_fraction_of_capacity": round(goodput / max(capacity, 1e-6), 3),
+            "accepted_p99_bind_s": round(_p99(accepted), 3) if accepted else None,
+            "bound_total": bound_total,
+            "high_priority_success_rate": round(
+                high_bound / max(len(high), 1), 4
+            ),
+            "batcher_shed_total": int(shed_batcher),
+            "shed_by_priority": {str(k): v for k, v in sorted(shed_by_priority.items())},
+            "shed_by_reason": dict(sorted(shed_by_reason.items())),
+            "sidecar_shed": dict(service.shed),
+            "sidecar_dispatches": service.dispatches,
+            "deadline_sheds": deadline_probes,
+            "deadline_expired_dispatches": int(deadline_expired_dispatches),
+            "batcher_depth_cap": batcher_depth,
+            "batcher_max_depth_seen": max(batcher_peaks, default=0),
+            "batcher_depth_bounded": max(batcher_peaks, default=0) <= batcher_depth,
+            "admission_depth_cap": max_inflight + queue_depth,
+            "admission_max_depth_seen": service.admission.max_depth_seen,
+            "admission_depth_bounded": (
+                service.admission.max_depth_seen <= max_inflight + queue_depth
+            ),
+            "breaker_trips_on_overload": int(trips),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        if packer_before is None:
+            os.environ.pop("KARPENTER_PACKER", None)
+        else:
+            os.environ["KARPENTER_PACKER"] = packer_before
+        rt.stop()
+        server.stop(grace=0)
+
+
 def bench_multi_provisioner(n_provisioners: int, n_pods: int, iters: int):
     """BASELINE config 4: many provisioners' batches solved concurrently —
     stacked on the batch axis and sharded over the device mesh
@@ -2035,6 +2328,19 @@ def main():
                          "duplicate_launches (bar: 0), adoption latency vs "
                          "the one-GC-period bar, and "
                          "chaos_provision_success_rate (bar: 1.0)")
+    ap.add_argument("--overload-storm", type=int, metavar="N_PODS", default=0,
+                    help="overload-control storm: >=5x the measured "
+                         "single-rate capacity at a chaos-slowed sidecar "
+                         "with tiny admission caps and a bounded batcher "
+                         "(high/default/low priority mix); reports goodput "
+                         "vs capacity (bar: >=0.8), shed counts by "
+                         "priority, accepted-work p99, max queue depths vs "
+                         "caps, deadline_expired_dispatches (bar: 0), "
+                         "high_priority_success_rate (bar: 1.0), and "
+                         "breaker_trips_on_overload (bar: 0)")
+    ap.add_argument("--overload-factor", type=float, default=5.0,
+                    help="offered-load multiple of measured capacity for "
+                         "--overload-storm")
     ap.add_argument("--config", type=int, default=0, metavar="1..5",
                     help="run one of BASELINE.json's five configs")
     ap.add_argument("--all-configs", action="store_true",
@@ -2133,6 +2439,33 @@ def main():
             "unit": "aggregate pods/sec",
             "fleet_ok": ok,
             **{k: v for k, v in r.items() if k != "aggregate_pods_per_sec"},
+        }))
+        return
+
+    if args.overload_storm:
+        r = bench_overload_storm(
+            args.overload_storm, overload_factor=args.overload_factor,
+        )
+        ok = (
+            r["goodput_fraction_of_capacity"] >= 0.8
+            and r["deadline_expired_dispatches"] == 0
+            and r["batcher_depth_bounded"]
+            and r["admission_depth_bounded"]
+            and r["high_priority_success_rate"] == 1.0
+            and r["breaker_trips_on_overload"] == 0
+        )
+        print(json.dumps({
+            "metric": (
+                f"overload-storm ({r['pods']} pods at "
+                f"{r['overload_factor']}x capacity, bounded batcher + "
+                "sidecar admission + deadline sheds)"
+            ),
+            "value": r["goodput_fraction_of_capacity"],
+            "unit": "goodput fraction of single-rate capacity",
+            "overload_ok": ok,
+            **{k: v for k, v in r.items()
+               if k != "goodput_fraction_of_capacity"},
+            "goodput_fraction_of_capacity": r["goodput_fraction_of_capacity"],
         }))
         return
 

@@ -3,8 +3,8 @@
 
 Supports exactly the constructs charts/karpenter-tpu/templates use:
 ``{{ .Values.dotted.path }}`` substitution (scalars inline; mappings as
-flow-style YAML) and whole-line ``{{- if .Values.flag }} / {{- end }}``
-boolean gates. Real deployments can use helm directly — the templates stay
+flow-style YAML) and whole-line ``{{- if .Values.flag }}`` /
+``{{- if not .Values.flag }}`` / ``{{- end }}`` boolean gates. Real deployments can use helm directly — the templates stay
 inside helm's syntax — this exists so `make chart` verifies rendering
 without a helm binary.
 """
@@ -50,10 +50,13 @@ def render(template: str, values: dict) -> str:
     out_lines = []
     skip_depth = 0
     for line in template.splitlines():
-        m_if = re.match(r"\s*\{\{-? if \.Values\.([\w.]+) \}\}\s*$", line)
+        m_if = re.match(r"\s*\{\{-? if (not )?\.Values\.([\w.]+) \}\}\s*$", line)
         m_end = re.match(r"\s*\{\{-? end \}\}\s*$", line)
         if m_if:
-            if skip_depth or not lookup(values, m_if.group(1)):
+            truthy = bool(lookup(values, m_if.group(2)))
+            if m_if.group(1):
+                truthy = not truthy
+            if skip_depth or not truthy:
                 skip_depth += 1
             continue
         if m_end:

@@ -415,12 +415,23 @@ class _WireTransport:
 
         def pause(seconds: float) -> bool:
             """Sleep toward the next attempt — unless the deadline would
-            pass first, in which case the current error is final."""
+            pass first (the current error is final) or the wire's retry
+            budget is dry (an overloaded far side must not receive
+            amplified load — resilience/overload.py)."""
+            from karpenter_tpu.resilience import default_retry_budget
+
             if time.monotonic() - start + seconds > allowance:
                 metrics.RESILIENCE_DEADLINE_EXCEEDED.labels(dependency="wire").inc()
                 return False
+            if not default_retry_budget().try_spend("wire"):
+                metrics.RESILIENCE_RETRIES.labels(
+                    dependency="wire", outcome="budget_exhausted"
+                ).inc()
+                return False
             self.retries += 1
-            metrics.RESILIENCE_RETRIES.labels(dependency="wire").inc()
+            metrics.RESILIENCE_RETRIES.labels(
+                dependency="wire", outcome="retried"
+            ).inc()
             time.sleep(seconds)
             return True
 
@@ -437,7 +448,11 @@ class _WireTransport:
                 req.add_header("traceparent", obs.to_traceparent(span))
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    out = json.loads(resp.read() or b"{}")
+                from karpenter_tpu.resilience import default_retry_budget
+
+                default_retry_budget().record_success("wire")
+                return out
             except urllib.error.HTTPError as e:
                 payload = {}
                 try:

@@ -123,6 +123,12 @@ class ConsolidationController:
         # already pending when the wave launched, settle deadline)
         self._wave_lock = threading.Lock()
         self._pending_waves: Dict[str, tuple] = {}
+        # brownout ladder rung 1 (resilience/brownout.py): consolidation is
+        # VOLUNTARY disruption — evicting pods creates the exact pending
+        # work an overloaded provisioner is already drowning in, so it is
+        # the first wave the ladder pauses. In-flight waves still settle;
+        # only NEW plans are deferred.
+        self._paused = False  # guarded-by: self._wave_lock
         if migration == "bind" and isinstance(cluster, ApiCluster):
             # would fail mid-execute on the first rebind (409), leaking the
             # already-launched replacements next to the old capacity
@@ -340,6 +346,15 @@ class ConsolidationController:
             self._pending_waves.pop(provisioner_name, None)
         return True
 
+    # -- brownout ----------------------------------------------------------
+    def set_paused(self, paused: bool) -> None:
+        with self._wave_lock:
+            self._paused = bool(paused)
+
+    def paused(self) -> bool:
+        with self._wave_lock:
+            return self._paused
+
     # -- reconcile ---------------------------------------------------------
     def reconcile(self, name: str) -> Optional[float]:
         if not self.enabled:
@@ -355,6 +370,11 @@ class ConsolidationController:
             )
 
             return OWNERSHIP_RECHECK_INTERVAL
+        if self.paused():
+            # brownout: no new voluntary disruption while the ladder is
+            # engaged — re-check on the wave cadence so recovery picks the
+            # work back up quickly
+            return WAVE_CHECK_INTERVAL
         if not self.wave_settled(name):
             # the previous wave's pods have not all re-seated: no new
             # disruption yet, check back shortly

@@ -83,7 +83,13 @@ class ProvisionerWorker:
         self.scheduler = scheduler or Scheduler(
             cluster, solver_service_address=solver_service_address
         )
-        self.batcher = batcher or Batcher()
+        # bounded, priority-aware admission (docs/overload.md): a full
+        # queue sheds the oldest lowest-priority pod instead of growing
+        # without limit, and the brownout ladder scales the window/sheds
+        # queued low-priority work through the same hooks
+        self.batcher = batcher or Batcher(
+            priority_fn=podutil.priority_of, on_shed=self._on_shed
+        )
         # fleet split-brain guard: does this replica still hold the shard
         # lease for this provisioner? Re-checked at solve time and again
         # immediately before every cloud create — a replica that lost its
@@ -191,6 +197,24 @@ class ProvisionerWorker:
         """Is this pod enqueued or in the batch currently being solved?"""
         with self._pending_lock:
             return key in self._pending_keys
+
+    def _on_shed(self, pod: Pod, reason: str) -> None:
+        """Batcher shed hook: clear the pod's pending state — selection's
+        periodic requeue re-submits it once capacity recovers — and
+        surface the drop as a Warning event so every shed is auditable."""
+        key = getattr(pod, "key", None)
+        if key is None:
+            return
+        with self._pending_lock:
+            self._pending_keys.discard(key)
+            self._requeued_keys.discard(key)
+        from karpenter_tpu.kube.events import recorder_for
+
+        recorder_for(self.cluster).event(
+            "Provisioner", self.provisioner.name, "PodShed",
+            f"pod {key} shed from the admission queue ({reason}); it "
+            "re-enters selection when capacity recovers", type="Warning",
+        )
 
     # -- the provision loop ------------------------------------------------
     def provision_once(self) -> List[VirtualNode]:

@@ -57,6 +57,7 @@ class Runtime:
     ownership: object = None  # fleet.ShardManager when shard leases are configured
     log_watcher: object = None  # LogLevelWatcher when a config file is set
     slo: object = None  # the SloEngine THIS runtime installed (obs/slo.py)
+    brownout: object = None  # BrownoutController when --brownout is on
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
     def stop(self) -> None:
@@ -69,6 +70,10 @@ class Runtime:
             # survivors rebalance immediately instead of waiting out the
             # lease duration; a crash()-ed manager skips the release
             self.ownership.stop()
+        if self.brownout is not None:
+            # stops the ladder AND fully reverses it: a stopped replica
+            # leaves no degradation behind (resilience/brownout.py)
+            self.brownout.stop()
         self.manager.stop()
         self.provisioning.stop()
         self.termination.stop()
@@ -313,6 +318,24 @@ def build_runtime(
         gc_interval=options.gc_interval,
         grace_period=options.gc_grace_period,
     )
+    # the SLO-driven brownout ladder (docs/overload.md): consumes burn
+    # state from whatever SLO engine is installed (run_controller_process
+    # installs it; the sensor reads lazily, so construction order is free)
+    # and actuates the batchers, the router, and consolidation. Built here,
+    # STARTED in run_controller_process alongside the engine.
+    brownout = None
+    if options.brownout_enabled:
+        from karpenter_tpu.resilience.brownout import BrownoutController
+        from karpenter_tpu.solver.router import default_router
+
+        brownout = BrownoutController(
+            provisioning=provisioning,
+            consolidation=consolidation,
+            router=default_router(),
+            cluster=cluster,
+            interval=options.brownout_interval,
+        )
+
     counter = CounterController(cluster)
     pvc = PVCController(cluster)
     metrics_node = NodeMetricsController(cluster)
@@ -369,6 +392,7 @@ def build_runtime(
         garbage_collection=garbage_collection,
         journal=journal,
         ownership=ownership,
+        brownout=brownout,
     )
 
 
@@ -400,6 +424,11 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     runtime.slo = obs.configure_slo(
         objectives=objectives, window_s=runtime.options.slo_window
     )
+    if runtime.brownout is not None:
+        # the ladder's audit panel rides every flight record: a slow-solve
+        # incident file answers "was the system already degrading?"
+        obs.register_state("brownout", runtime.brownout.report)
+        runtime.brownout.start()
     if runtime.options.log_config_file:
         runtime.log_watcher = LogLevelWatcher(runtime.options.log_config_file)
         runtime.log_watcher.start()

@@ -193,8 +193,11 @@ RESILIENCE_BREAKER_STATE = Gauge(
 
 RESILIENCE_RETRIES = Counter(
     "retries_total",
-    "Retried operations, by dependency.",
-    ["dependency"],
+    "Retry decisions, by dependency and outcome: `retried` spent a retry "
+    "token and ran again; `budget_exhausted` means the per-dependency retry "
+    "budget was dry — the failure propagated instead of amplifying the "
+    "storm (docs/overload.md).",
+    ["dependency", "outcome"],
     namespace=NAMESPACE,
     subsystem="resilience",
     registry=REGISTRY,
@@ -524,6 +527,76 @@ LAUNCH_JOURNAL_REPLAYS = Counter(
     ["outcome"],
     namespace=NAMESPACE,
     subsystem="launch",
+    registry=REGISTRY,
+)
+
+# Overload control (docs/overload.md): past saturation the system decides
+# what to drop instead of letting the queues decide. Every shed — batcher
+# or sidecar admission — must be attributable on the scrape, and the
+# brownout ladder's current rung is the one number an operator checks
+# first when latency climbs.
+BATCHER_SHED = Counter(
+    "shed_total",
+    "Pods shed from a full admission batcher, by reason (queue_full: a "
+    "full-queue add displaced the oldest lowest-priority entry; brownout: "
+    "the ladder's shed rung drained queued low-priority work).",
+    ["reason"],
+    namespace=NAMESPACE,
+    subsystem="batcher",
+    registry=REGISTRY,
+)
+
+SOLVER_ADMISSION_SHED = Counter(
+    "admission_shed_total",
+    "Sidecar solve/open requests refused by admission control, by reason "
+    "(queue_full: depth + inflight caps hit, answered STATUS_OVERLOADED "
+    "with a retry-after hint; deadline: the propagated round budget "
+    "expired before device dispatch, answered STATUS_DEADLINE_EXCEEDED; "
+    "hbm_pressure: device headroom under the floor, new session uploads "
+    "refused while resident-session solves keep flowing).",
+    ["reason"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_ADMISSION_DEPTH = Gauge(
+    "admission_queue_depth",
+    "Solve requests currently queued or executing behind the sidecar "
+    "admission gate (bounded by --solver-max-inflight + "
+    "--solver-queue-depth).",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_POOL_OVERLOAD_SKIPS = Counter(
+    "pool_overload_skips_total",
+    "Solves routed past a pool member sitting out an overload retry-after "
+    "window (the soft breaker: overload is backpressure, not failure — "
+    "the member's real circuit breaker is untouched).",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+BROWNOUT_LEVEL = Gauge(
+    "brownout_level",
+    "Current rung of the SLO-driven brownout ladder (0 = normal service; "
+    "each rung above sheds progressively more deferrable work — "
+    "docs/overload.md has the ladder order and rationale).",
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+BROWNOUT_TRANSITIONS = Counter(
+    "brownout_transitions_total",
+    "Brownout ladder steps taken, by direction (escalate/recover) — every "
+    "step also lands as a span and a Warning/Normal event, so each "
+    "degradation is auditable.",
+    ["direction"],
+    namespace=NAMESPACE,
     registry=REGISTRY,
 )
 

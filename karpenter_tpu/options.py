@@ -91,6 +91,16 @@ class Options:
         default_factory=lambda: float(_env("KARPENTER_SLO_WINDOW", "300"))
     )
     slo_config: str = field(default_factory=lambda: _env("KARPENTER_SLO_CONFIG", ""))
+    # SLO-driven brownout ladder (resilience/brownout.py): when an
+    # objective burns, walk the ordered degradation ladder (pause probes/
+    # consolidation -> shrink admission window -> bias native -> shed
+    # low-priority queue) instead of letting the queues decide what drops
+    brownout_enabled: bool = field(
+        default_factory=lambda: _env("KARPENTER_BROWNOUT", "true").lower() == "true"
+    )
+    brownout_interval: float = field(
+        default_factory=lambda: float(_env("KARPENTER_BROWNOUT_INTERVAL", "5"))
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -119,6 +129,8 @@ class Options:
             errs.append("flight budget must be positive milliseconds")
         if self.slo_window <= 0:
             errs.append("SLO window must be positive seconds")
+        if self.brownout_interval <= 0:
+            errs.append("brownout tick interval must be positive seconds")
         if self.slo_config:
             # a typo'd objective must fail startup, not silently never
             # evaluate — parse the whole file eagerly
@@ -207,6 +219,17 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         "('' = built-in defaults; docs/observability.md has the grammar)",
     )
     ap.add_argument(
+        "--brownout",
+        action=argparse.BooleanOptionalAction,
+        default=opts.brownout_enabled,
+        help="SLO-driven brownout ladder: degrade deferrable work in order "
+        "while an objective burns (--no-brownout disables; docs/overload.md)",
+    )
+    ap.add_argument(
+        "--brownout-interval", type=float, default=opts.brownout_interval,
+        help="seconds between brownout ladder evaluations",
+    )
+    ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
         default=opts.consolidation_enabled,
@@ -245,6 +268,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         flight_budget_ms=ns.flight_budget_ms,
         slo_window=ns.slo_window,
         slo_config=ns.slo_config,
+        brownout_enabled=ns.brownout,
+        brownout_interval=ns.brownout_interval,
     )
     errs = out.validate()
     if errs:

@@ -26,8 +26,19 @@ from karpenter_tpu.resilience.breaker import (  # noqa: F401
     BreakerOpen,
     CircuitBreaker,
 )
+from karpenter_tpu.resilience.brownout import (  # noqa: F401
+    BrownoutController,
+    LEVEL_NAMES as BROWNOUT_LEVEL_NAMES,
+)
 from karpenter_tpu.resilience.liveness import MissTracker  # noqa: F401
 from karpenter_tpu.resilience.markers import idempotent, is_idempotent  # noqa: F401
+from karpenter_tpu.resilience.overload import (  # noqa: F401
+    DeadlineExceededError,
+    OverloadedError,
+    RetryBudget,
+    default_retry_budget,
+    reset_default_retry_budget,
+)
 from karpenter_tpu.resilience.policy import (  # noqa: F401
     Budget,
     RetryPolicy,

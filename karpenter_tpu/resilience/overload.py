@@ -1,0 +1,119 @@
+"""Overload-control vocabulary shared across the wire, pool, and retry
+layers (docs/overload.md).
+
+Two failure classes that are NOT failures in the breaker sense:
+
+- :class:`OverloadedError` — the dependency is alive but refusing work
+  (bounded admission queue full, HBM pressure). It carries the server's
+  retry-after hint. Tripping a circuit breaker on it would amplify the
+  brownout into an outage: the breaker's half-open probes and the
+  rerouted traffic both land on whatever capacity remains. Callers back
+  off for the hint window instead (the pool's soft breaker).
+- :class:`DeadlineExceededError` — the work's own deadline (the
+  propagated per-round :class:`Budget`) expired. Retrying is by
+  definition useless; the only correct move is the degradation floor.
+
+Both are classified non-retryable by ``default_retryable`` so no
+RetryPolicy anywhere turns a shed into a retry storm.
+
+:class:`RetryBudget` is the third leg: even for retryable failures, a
+dependency that keeps failing earns fewer retries. Tokens are spent per
+retry and refilled by successes, so a healthy dependency retries freely
+while a drowning one degrades to fail-fast — the client-side half of
+admission control.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+# Retry-budget defaults: ~10 retries of burst headroom per dependency,
+# earned back at one token per 10 successes. A dependency failing more
+# than ~10% of the time exhausts the budget and fails fast — the classic
+# retry-budget ratio (each success funds a tenth of a retry).
+RETRY_BUDGET_CAPACITY = 10.0
+RETRY_BUDGET_REFILL_PER_SUCCESS = 0.1
+
+
+class OverloadedError(RuntimeError):
+    """A dependency shed this request under load (not a failure: the
+    dependency is alive and will recover — retry AFTER the hint, or
+    route elsewhere)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = max(float(retry_after), 0.0)
+
+
+class DeadlineExceededError(RuntimeError):
+    """The operation's propagated deadline expired before (or while) the
+    work ran — non-retryable by construction; take the degradation floor."""
+
+
+class RetryBudget:
+    """Per-dependency retry token bucket, refilled by successes.
+
+    ``try_spend`` consumes one token per retry attempt; ``record_success``
+    refills ``refill_per_success`` tokens (capped). Fresh dependencies
+    start with a full bucket so transient blips retry normally; a
+    sustained failure rate drains it and retries self-limit instead of
+    multiplying offered load onto an overloaded dependency.
+    """
+
+    def __init__(
+        self,
+        capacity: float = RETRY_BUDGET_CAPACITY,
+        refill_per_success: float = RETRY_BUDGET_REFILL_PER_SUCCESS,
+    ):
+        self.capacity = float(capacity)
+        self.refill_per_success = float(refill_per_success)
+        self._tokens: Dict[str, float] = {}  # guarded-by: self._lock
+        self._lock = threading.Lock()
+
+    def try_spend(self, dependency: str) -> bool:
+        """Spend one retry token; False means the budget is exhausted and
+        the caller must propagate the failure instead of retrying."""
+        with self._lock:
+            tokens = self._tokens.get(dependency, self.capacity)
+            if tokens < 1.0:
+                return False
+            self._tokens[dependency] = tokens - 1.0
+            return True
+
+    def record_success(self, dependency: str) -> None:
+        with self._lock:
+            tokens = self._tokens.get(dependency, self.capacity)
+            self._tokens[dependency] = min(
+                self.capacity, tokens + self.refill_per_success
+            )
+
+    def remaining(self, dependency: str) -> float:
+        with self._lock:
+            return self._tokens.get(dependency, self.capacity)
+
+    def snapshot(self) -> Dict[str, float]:
+        """{dependency: tokens} for dependencies that have drawn down —
+        a flight-recorder-friendly view of who is earning retries."""
+        with self._lock:
+            return {k: round(v, 3) for k, v in sorted(self._tokens.items())}
+
+
+# Process-shared default: every RetryPolicy with a dependency label draws
+# from one bucket per dependency, so concurrent callers (launch pool
+# threads, pollers) share the same self-limit instead of each bringing a
+# fresh budget to the same drowning dependency.
+_default_lock = threading.Lock()
+_default: RetryBudget = RetryBudget()
+
+
+def default_retry_budget() -> RetryBudget:
+    with _default_lock:
+        return _default
+
+
+def reset_default_retry_budget() -> None:
+    """Tests isolate budget drawdown with this."""
+    global _default
+    with _default_lock:
+        _default = RetryBudget()

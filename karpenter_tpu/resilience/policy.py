@@ -94,6 +94,11 @@ _NON_RETRYABLE_NAMES = frozenset(
         "GkeStockoutError",
         "GkeApiError",
         "InstanceNotFoundError",
+        # overload-control verdicts (resilience/overload.py): a shed must
+        # never become a retry storm, and an expired deadline cannot be
+        # retried into existence
+        "OverloadedError",
+        "DeadlineExceededError",
     }
 )
 
@@ -129,6 +134,7 @@ class RetryPolicy:
         rng: Optional[random.Random] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        retry_budget=None,
     ):
         self.max_attempts = max(int(max_attempts), 1)
         self.base = base
@@ -139,6 +145,11 @@ class RetryPolicy:
         self._rng = rng
         self._clock = clock
         self._sleep = sleep
+        # per-dependency retry token bucket (resilience/overload.py):
+        # None + a dependency label = the process-shared default budget;
+        # budget accounting is skipped entirely for unlabeled policies
+        # (no dependency to draw down)
+        self._retry_budget = retry_budget
 
     def effective_deadline(self) -> float:
         """Seconds this operation may spend: the policy deadline, capped by
@@ -149,14 +160,24 @@ class RetryPolicy:
             return self.deadline
         return min(self.deadline, max(budget.remaining(), 0.0))
 
+    def _budget(self):
+        if self._retry_budget is not None:
+            return self._retry_budget
+        if not self.dependency:
+            return None
+        from karpenter_tpu.resilience.overload import default_retry_budget
+
+        return default_retry_budget()
+
     def call(self, fn: Callable, *args, **kwargs):
         start = self._clock()
         allowance = self.effective_deadline()
         backoffs = decorrelated_jitter(self.base, self.cap, self._rng)
+        budget = self._budget()
         last: Optional[BaseException] = None
         for attempt in range(self.max_attempts):
             try:
-                return fn(*args, **kwargs)
+                out = fn(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — classification decides
                 last = e
                 if attempt + 1 >= self.max_attempts or not self.retryable(e):
@@ -167,8 +188,23 @@ class RetryPolicy:
                         dependency=self.dependency or "unknown"
                     ).inc()
                     raise
+                # the retry-budget gate: an overloaded dependency earns
+                # fewer retries — once the bucket is dry the failure
+                # propagates instead of multiplying offered load
+                if budget is not None and not budget.try_spend(self.dependency):
+                    metrics.RESILIENCE_RETRIES.labels(
+                        dependency=self.dependency or "unknown",
+                        outcome="budget_exhausted",
+                    ).inc()
+                    raise
                 metrics.RESILIENCE_RETRIES.labels(
-                    dependency=self.dependency or "unknown"
+                    dependency=self.dependency or "unknown", outcome="retried"
                 ).inc()
                 self._sleep(pause)
+            else:
+                # successes refill the bucket: a recovered dependency
+                # re-earns its retry headroom
+                if budget is not None:
+                    budget.record_success(self.dependency)
+                return out
         raise last if last is not None else AssertionError("unreachable")

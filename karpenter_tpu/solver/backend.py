@@ -23,6 +23,10 @@ from karpenter_tpu.api.objects import NodeSelectorRequirement, Pod
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.resilience.overload import (
+    DeadlineExceededError,
+    OverloadedError,
+)
 from karpenter_tpu.scheduling.ffd import (
     FFDScheduler,
     VirtualNode,
@@ -251,6 +255,11 @@ class TpuScheduler:
                     return finish_native
                 try:
                     device_finish = self._pack_device(batch, prof=prof)
+                except (OverloadedError, DeadlineExceededError):
+                    # a shed is backpressure, not a path failure: poisoning
+                    # the device EMA with the 60s penalty would route every
+                    # future solve off a path that is merely full right now
+                    raise
                 except Exception:
                     self.router.record_failure(key, backend)
                     raise  # the device ladder already ends in lax.scan
@@ -258,6 +267,8 @@ class TpuScheduler:
                 def finish_device():
                     try:
                         out = device_finish()
+                    except (OverloadedError, DeadlineExceededError):
+                        raise  # shed, not failure: no EMA penalty
                     except Exception:
                         self.router.record_failure(key, backend)
                         raise
@@ -623,12 +634,37 @@ class TpuScheduler:
                 pending = self._remote_or_init().pack_begin(
                     *args, n_max=n_max, prof=prof, record=record
                 )
+            except DeadlineExceededError:
+                # the round budget already expired (client-side pre-shed,
+                # or the sidecar's queue check): non-retryable by
+                # construction — no breaker, no local re-solve, the round
+                # takes its FFD floor in _solve
+                raise
+            except OverloadedError as e:
+                # the sidecar (or whole pool) is FULL, not broken: its real
+                # breaker must stay closed — overload tripping it would add
+                # half-open probe traffic and reroutes onto whatever
+                # capacity remains. Local capacity is unaffected; solve here.
+                logger.info(
+                    "solver service %s overloaded (retry after %.2fs); "
+                    "in-process kernel serves this batch",
+                    self.service_address, e.retry_after,
+                )
             except Exception as e:
                 self._remote_failure(e)
             else:
                 def fetch_remote():
                     try:
                         result = pending()
+                    except DeadlineExceededError:
+                        raise  # shed, not failure: straight to the floor
+                    except OverloadedError as e:
+                        logger.info(
+                            "solver service %s shed the solve (overloaded, "
+                            "retry after %.2fs); in-process kernel fallback",
+                            self.service_address, e.retry_after,
+                        )
+                        return self._pack_local_begin(args, p, n_max, prof)()
                     except Exception as e:
                         self._remote_failure(e)
                         return self._pack_local_begin(args, p, n_max, prof)()
@@ -764,6 +800,22 @@ class TpuScheduler:
                     t0 = time.perf_counter()
                     pending = self._pack(batch)
                     begin_s = time.perf_counter() - t0
+            except (OverloadedError, DeadlineExceededError) as e:
+                # a shed is NOT a shape failure: the pack breaker stays
+                # closed (overload tripping it would pin the shape class to
+                # FFD for the full open window after load recedes) and the
+                # batch takes the floor once, non-retryably
+                reason = (
+                    "deadline" if isinstance(e, DeadlineExceededError)
+                    else "overload"
+                )
+                metrics.SOLVER_DEGRADED.labels(reason=reason).inc()
+                logger.warning(
+                    "accelerated pack shed (%s); FFD floor serves this batch",
+                    e,
+                )
+                prof["packer_backend"] = "ffd-degraded"
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             except Exception:
                 breaker.record_failure()
                 metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
@@ -779,6 +831,22 @@ class TpuScheduler:
                 result, typemask = pending()
                 fetch_wait_s = time.perf_counter() - t0
                 fetch_sp.set_attribute("backend", prof.get("packer_backend"))
+        except (OverloadedError, DeadlineExceededError) as e:
+            # shed mid-flight (sidecar admission or the propagated round
+            # deadline): no breaker state moves — overload is backpressure,
+            # and retrying an expired deadline is useless by definition.
+            # One non-retryable drop to the FFD floor, never a retry storm.
+            reason = (
+                "deadline" if isinstance(e, DeadlineExceededError)
+                else "overload"
+            )
+            metrics.SOLVER_DEGRADED.labels(reason=reason).inc()
+            logger.warning(
+                "accelerated pack shed (%s); FFD floor serves this batch", e,
+            )
+            prof["packer_backend"] = "ffd-degraded"
+            with self._solve_lock:
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
         except Exception:
             breaker.record_failure()
             metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()

@@ -27,11 +27,16 @@ from __future__ import annotations
 import hashlib
 import logging
 import threading
+import time
 from bisect import bisect_right
 from collections import OrderedDict
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu import metrics
+from karpenter_tpu.resilience.overload import (
+    DeadlineExceededError,
+    OverloadedError,
+)
 from karpenter_tpu.solver.service import (
     N_POD_ARRAYS,
     CatalogKeyMemo,
@@ -109,8 +114,10 @@ class SolverPool:
         cold_timeout: float = 180.0,
         breaker_open_seconds: float = MEMBER_BREAKER_SECONDS,
         client_factory: Optional[Callable[[str], RemoteSolver]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         addresses = [a.strip() for a in addresses if a.strip()]
+        self._clock = clock
         self.ring = HashRing(addresses)
         self.addresses = self.ring.members
         self._timeout = timeout
@@ -130,6 +137,13 @@ class SolverPool:
         self._clients: dict = {}  # guarded-by: self._mu
         self._key_memo = CatalogKeyMemo(self.KEY_MEMO_MAX)
         self.failovers = 0  # guarded-by: self._mu
+        # soft breaker (docs/overload.md): a member answering
+        # STATUS_OVERLOADED sits out its retry-after window and is simply
+        # routed around — overload is backpressure, not failure, so its
+        # REAL circuit breaker (and its half-open probe traffic) is never
+        # touched by a shed
+        self._backoff_until: Dict[str, float] = {}  # guarded-by: self._mu
+        self.overload_skips = 0  # guarded-by: self._mu
         self._mu = threading.Lock()
 
     # -- members ------------------------------------------------------------
@@ -157,6 +171,38 @@ class SolverPool:
         self._breaker(address).record_success()
         metrics.SOLVER_BREAKER_OPEN.labels(address=address).set(0)
         self._publish_available()
+
+    def _member_overloaded(self, address: str, retry_after: float) -> None:
+        """Soft breaker: sit the member out for its own retry-after hint.
+        No breaker state is touched — the member is healthy, just full."""
+        with self._mu:
+            self._backoff_until[address] = self._clock() + max(retry_after, 0.0)
+        logger.info(
+            "solver pool member %s overloaded; sitting it out %.2fs",
+            address, max(retry_after, 0.0),
+        )
+
+    def _soft_backing_off(self, address: str) -> bool:
+        with self._mu:
+            until = self._backoff_until.get(address)
+            if until is None:
+                return False
+            if self._clock() >= until:
+                del self._backoff_until[address]
+                return False
+            return True
+
+    def _backoff_remaining(self, address: str) -> float:
+        with self._mu:
+            until = self._backoff_until.get(address)
+            if until is None:
+                return 0.0
+            return max(until - self._clock(), 0.0)
+
+    def _count_overload_skip(self, address: str) -> None:
+        metrics.SOLVER_POOL_OVERLOAD_SKIPS.labels(address=address).inc()
+        with self._mu:
+            self.overload_skips += 1
 
     def _publish_available(self) -> None:
         metrics.SOLVER_POOL_MEMBERS.set(len(self.available_members()))
@@ -191,7 +237,16 @@ class SolverPool:
         key = self._catalog_key(catalog_side)
         order = self.ring.ordered(key)
         last_exc: Optional[Exception] = None
+        hints: List[float] = []
         for i, address in enumerate(order):
+            if self._soft_backing_off(address):
+                # soft breaker (docs/overload.md): the member said
+                # STATUS_OVERLOADED within its retry-after window — route
+                # around it without an RPC and WITHOUT touching its real
+                # breaker (overload is backpressure, not failure)
+                self._count_overload_skip(address)
+                hints.append(self._backoff_remaining(address))
+                continue
             breaker = self._breaker(address)
             if not breaker.allow():
                 # rerouted off a breaker-open member: the solve lands on a
@@ -204,6 +259,15 @@ class SolverPool:
                 pending = client.pack_begin(
                     *inputs, n_max=n_max, prof=prof, record=record
                 )
+            except DeadlineExceededError:
+                # OUR deadline, not the member's health: no breaker, no
+                # failover — the round already degraded to its FFD floor
+                raise
+            except OverloadedError as e:
+                self._member_overloaded(address, e.retry_after)
+                self._count_overload_skip(address)
+                hints.append(e.retry_after)
+                continue
             except Exception as e:
                 last_exc = e
                 self._member_failure(address, e)
@@ -211,6 +275,14 @@ class SolverPool:
                 continue
             return self._wrap_wait(
                 pending, address, order[i + 1:], inputs, n_max, prof, record
+            )
+        if hints:
+            # the pool is FULL, not broken: a typed verdict so the
+            # scheduler's outer remote breaker never trips on pure overload;
+            # the soonest member to free sets the caller's hint
+            raise OverloadedError(
+                f"every solver pool member overloaded (tried {order})",
+                retry_after=min(hints),
             )
         raise PoolExhausted(
             f"no solver pool member available (tried {order}): {last_exc}"
@@ -228,6 +300,21 @@ class SolverPool:
         def wait():
             try:
                 out = pending()
+            except DeadlineExceededError:
+                # the propagated round budget expired: not this member's
+                # fault, and no surviving member could make the deadline
+                # either — straight up to the caller's FFD floor
+                raise
+            except OverloadedError as e:
+                # shed mid-flight: sit the member out for its hint window
+                # (no breaker state touched) and fail over to the rest of
+                # the ring — another member may have headroom
+                self._member_overloaded(address, e.retry_after)
+                self._count_overload_skip(address)
+                return self._failover(
+                    address, remaining, inputs, n_max, prof, record, e,
+                    failed_is_overloaded=True,
+                )
             except Exception as e:
                 self._member_failure(address, e)
                 return self._failover(
@@ -242,15 +329,26 @@ class SolverPool:
         self, failed: str, remaining: List[str],
         inputs, n_max: int, prof: Optional[dict], record: bool,
         cause: Exception,
+        failed_is_overloaded: bool = False,
     ):
         from karpenter_tpu import obs
 
         last_exc: Exception = cause
+        hints: List[float] = (
+            [cause.retry_after] if isinstance(cause, OverloadedError) else []
+        )
         for address in remaining:
+            if self._soft_backing_off(address):
+                self._count_overload_skip(address)
+                hints.append(self._backoff_remaining(address))
+                continue
             breaker = self._breaker(address)
             if not breaker.allow():
                 continue
-            self._count_failover(failed)
+            # a reroute off a FAILED member is a failover; a reroute off a
+            # merely-full one is a soft skip (already counted by the caller)
+            if not failed_is_overloaded:
+                self._count_failover(failed)
             # synchronous on the surviving member: its RemoteSolver's
             # NEEDS_CATALOG path re-uploads the session transparently
             with obs.tracer().span(
@@ -262,13 +360,29 @@ class SolverPool:
                     out = client.pack_begin(
                         *inputs, n_max=n_max, prof=prof, record=record
                     )()
+                except DeadlineExceededError:
+                    raise  # the WORK's deadline: no member can outrun it
+                except OverloadedError as e:
+                    self._member_overloaded(address, e.retry_after)
+                    self._count_overload_skip(address)
+                    hints.append(e.retry_after)
+                    failed, failed_is_overloaded = address, True
+                    continue
                 except Exception as e:
                     last_exc = e
                     self._member_failure(address, e)
-                    failed = address
+                    failed, failed_is_overloaded = address, False
                     continue
             self._member_success(address)
             return out
+        if isinstance(cause, OverloadedError) and last_exc is cause:
+            # nothing actually FAILED — the original verdict AND every
+            # member visited since was backpressure (a real failure
+            # anywhere would have replaced last_exc)
+            raise OverloadedError(
+                "every solver pool member overloaded during failover",
+                retry_after=min(hints),
+            )
         raise PoolExhausted(
             f"solver pool exhausted after failover (last member error: {last_exc})"
         )

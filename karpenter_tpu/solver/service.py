@@ -60,6 +60,20 @@ the sidecar also opens real child spans (``sidecar.pack`` →
 ``sidecar.solve``/``sidecar.fetch``/``sidecar.serialize``,
 ``sidecar.device_put`` on session open) into its OWN trace ring, served at
 ``GET /debug/traces`` on its health port.
+
+**Overload control** (docs/overload.md): a bounded :class:`AdmissionGate`
+fronts the solve executor (``--solver-max-inflight`` concurrent solves +
+``--solver-queue-depth`` queued; past that ``STATUS_OVERLOADED`` with an
+f32 retry-after hint, which ``SolverPool`` honors as a soft breaker — a
+shed is backpressure, never a breaker-tripping failure). The round
+``Budget``'s remaining seconds ride the Pack frame as a second optional
+trailer (f32[1], gated on the ``PROTO_DEADLINE`` capability bit exactly
+like the trace trailer), and the sidecar re-checks it after queueing so
+already-doomed work sheds with ``STATUS_DEADLINE_EXCEEDED`` *before*
+device dispatch — which the client treats as non-retryable, straight to
+its FFD floor. New-session uploads are additionally refused under an HBM
+headroom floor (``--hbm-floor-bytes``) while resident-session solves keep
+flowing.
 """
 
 from __future__ import annotations
@@ -75,6 +89,14 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+# stdlib-only submodule import: the typed overload verdicts must exist in
+# the sidecar's trimmed images too (resilience/__init__ would pull the
+# metrics registry)
+from karpenter_tpu.resilience.overload import (
+    DeadlineExceededError,
+    OverloadedError,
+)
+
 logger = logging.getLogger("karpenter.solver.service")
 
 MAGIC = b"KTPU"
@@ -89,18 +111,36 @@ HEALTH_METHOD = "/karpenter.solver.v1.Solver/Health"
 SERVING = b"SERVING"
 NOT_SERVING = b"NOT_SERVING"
 
-# in-band response status (first i32 array of every v3 response)
+# in-band response status (first i32 array of every v3 response).
+# DEADLINE_EXCEEDED: the propagated round budget expired before device
+# dispatch — non-retryable by construction (the client goes straight to
+# its FFD floor, never a retry storm). OVERLOADED: the bounded admission
+# queue (or HBM pressure) refused the work; the response payload carries
+# an f32 retry-after hint the pool honors as a soft breaker. A status
+# word neither side knows fails LOUD client-side, like version skew.
 STATUS_OK = 0
 STATUS_NEEDS_CATALOG = 1
+STATUS_DEADLINE_EXCEEDED = 2
+STATUS_OVERLOADED = 3
 
 # capability bits a sidecar advertises in its OpenSession RESPONSE payload
 # (old clients never read that payload; old servers never send it — the one
 # frame both sides already tolerate growing). A client may only append the
 # Pack trace-context trailer after seeing this bit: an old sidecar's
 # `*pod_arrays` unpack would swallow the trailer as an extra pod array and
-# crash the solve mid-rolling-upgrade.
+# crash the solve mid-rolling-upgrade. PROTO_DEADLINE gates the f32
+# remaining-budget trailer the same way (docs/overload.md).
 PROTO_TRACE_TRAILER = 1
-PROTO_FEATURES = PROTO_TRACE_TRAILER
+PROTO_DEADLINE = 2
+PROTO_FEATURES = PROTO_TRACE_TRAILER | PROTO_DEADLINE
+
+# admission-control defaults (docs/overload.md): the executor admits
+# max_inflight concurrent solves, queues queue_depth more, and refuses the
+# rest with STATUS_OVERLOADED + the retry-after hint — queues bounded by
+# decision, not by memory.
+MAX_INFLIGHT = 4
+QUEUE_DEPTH = 16
+OVERLOAD_RETRY_AFTER_S = 1.0
 
 # sidecar session store bounds: one entry per live catalog generation —
 # a handful of provisioners each see one catalog at a time, so a small LRU
@@ -307,6 +347,111 @@ def _ctx_from_array(arr: np.ndarray):
     return SpanContext(raw[:16].hex(), raw[16:24].hex())
 
 
+def _parse_trailers(trailer: Sequence[np.ndarray]):
+    """Optional Pack trailers → ``(SpanContext|None, deadline_s|None)``.
+
+    Trailers are distinguished by shape+dtype, not position — the trace
+    context is i32[6], the deadline an f32[1] of REMAINING budget seconds
+    (relative, because client and sidecar clocks never agree). Anything
+    unrecognized is ignored, so a future trailer degrades old servers to
+    "feature absent", never to a mis-parse."""
+    ctx = None
+    deadline_s = None
+    for arr in trailer:
+        a = np.asarray(arr).reshape(-1)
+        if a.dtype == np.int32 and a.size == TRACE_CTX_WORDS:
+            ctx = _ctx_from_array(arr)
+        elif a.dtype == np.float32 and a.size == 1:
+            deadline_s = float(a[0])
+    return ctx, deadline_s
+
+
+# ---------------------------------------------------------------------------
+# admission control (the sidecar's half of overload control)
+# ---------------------------------------------------------------------------
+
+
+class AdmissionGate:
+    """Bounded admission in front of the solve executor: at most
+    ``max_inflight`` concurrent solves, at most ``queue_depth`` callers
+    parked behind them, everyone else refused immediately — the queue is
+    bounded by decision (STATUS_OVERLOADED + a retry hint), not by gRPC's
+    thread pool backing up until deadlines expire."""
+
+    # a queued caller never parks longer than this even without a
+    # propagated deadline: past it the work is stale enough to refuse.
+    # Must stay well BELOW RemoteSolver's warm RPC timeout (30s) — if the
+    # queue wait outlived the client's gRPC deadline, the client would see
+    # a generic transport error instead of STATUS_OVERLOADED and record a
+    # real breaker failure on pure backpressure
+    MAX_WAIT_S = 5.0
+
+    def __init__(
+        self,
+        max_inflight: int = MAX_INFLIGHT,
+        queue_depth: int = QUEUE_DEPTH,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_inflight = max(int(max_inflight), 1)
+        self.queue_depth = max(int(queue_depth), 0)
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._inflight = 0  # guarded-by: self._cv
+        self._waiting = 0  # guarded-by: self._cv
+        self.max_depth_seen = 0  # guarded-by: self._cv
+
+    def _publish_locked(self) -> None:
+        depth = self._inflight + self._waiting
+        self.max_depth_seen = max(self.max_depth_seen, depth)
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_ADMISSION_DEPTH.set(depth)
+        except Exception:
+            pass  # trimmed registries
+
+    def enter(self, deadline: Optional[float] = None) -> str:
+        """Claim a solve slot. Returns ``"admitted"`` (caller MUST pair
+        with :meth:`leave`), ``"overloaded"`` (queue full, or the bounded
+        wait ran out), or ``"deadline"`` (the caller's own deadline
+        expired while queued — already-doomed work, shed it)."""
+        with self._cv:
+            if self._inflight < self.max_inflight and self._waiting == 0:
+                self._inflight += 1
+                self._publish_locked()
+                return "admitted"
+            if self._waiting >= self.queue_depth:
+                return "overloaded"
+            self._waiting += 1
+            self._publish_locked()
+            try:
+                end = self._clock() + self.MAX_WAIT_S
+                if deadline is not None:
+                    end = min(end, deadline)
+                while self._inflight >= self.max_inflight:
+                    remaining = end - self._clock()
+                    if remaining <= 0:
+                        if deadline is not None and self._clock() >= deadline:
+                            return "deadline"
+                        return "overloaded"
+                    self._cv.wait(remaining)
+                self._inflight += 1
+                return "admitted"
+            finally:
+                self._waiting -= 1
+                self._publish_locked()
+
+    def leave(self) -> None:
+        with self._cv:
+            self._inflight = max(self._inflight - 1, 0)
+            self._cv.notify()
+            self._publish_locked()
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._inflight + self._waiting
+
+
 # ---------------------------------------------------------------------------
 # server (the JAX/TPU sidecar)
 # ---------------------------------------------------------------------------
@@ -331,11 +476,29 @@ class SolverService:
         session_max: int = SESSION_MAX,
         session_ttl: float = SESSION_TTL_S,
         clock: Callable[[], float] = time.monotonic,
+        max_inflight: int = MAX_INFLIGHT,
+        queue_depth: int = QUEUE_DEPTH,
+        overload_retry_after: float = OVERLOAD_RETRY_AFTER_S,
+        hbm_floor_bytes: int = 0,
     ):
         self.ready = threading.Event()
         self.session_max = session_max
         self.session_ttl = session_ttl
         self._clock = clock
+        # overload control (docs/overload.md): bounded admission in front
+        # of the solve executor, plus an HBM-headroom floor below which
+        # NEW session uploads are refused while resident-session solves
+        # keep flowing (the PR-8 headroom gauge is the sensor)
+        self.admission = AdmissionGate(max_inflight, queue_depth, clock=clock)
+        self.overload_retry_after = float(overload_retry_after)
+        self.hbm_floor_bytes = int(hbm_floor_bytes)
+        # observable overload accounting (the bench's acceptance numbers:
+        # zero deadline-expired solves may reach device dispatch)
+        self.dispatches = 0  # guarded-by: self._stats_lock
+        self.shed: dict = {
+            "queue_full": 0, "deadline": 0, "hbm_pressure": 0,
+        }  # guarded-by: self._stats_lock
+        self._stats_lock = threading.Lock()
         # key -> [device-resident (join, frontiers, daemon), last_used, fresh];
         # Pack handler threads race OpenSession handler threads on it.
         # ``fresh`` marks a just-uploaded session: the upload itself is the
@@ -344,6 +507,24 @@ class SolverService:
         # retry) would report ~0.5 hit rate instead of ~0.
         self._sessions: "OrderedDict[bytes, list]" = OrderedDict()  # guarded-by: self._sessions_lock
         self._sessions_lock = threading.Lock()
+
+    # -- overload accounting ------------------------------------------------
+
+    def _count_shed(self, reason: str) -> None:
+        with self._stats_lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_ADMISSION_SHED.labels(reason=reason).inc()
+        except Exception:
+            pass  # trimmed registries
+
+    def _overloaded_response(self) -> bytes:
+        return _status_response(
+            STATUS_OVERLOADED,
+            [np.asarray([self.overload_retry_after], np.float32)],
+        )
 
     # -- sessions -----------------------------------------------------------
 
@@ -399,6 +580,19 @@ class SolverService:
             return _status_response(
                 STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
             )
+        # HBM-pressure gate (docs/overload.md): a NEW catalog upload is the
+        # one request that grows device residency — below the headroom
+        # floor it is refused with a retry hint while solves against
+        # already-resident sessions (the touch path above) keep flowing
+        if self.hbm_floor_bytes:
+            headroom = publish_device_headroom()
+            if headroom is not None and headroom < self.hbm_floor_bytes:
+                self._count_shed("hbm_pressure")
+                logger.warning(
+                    "refusing session open %s: device headroom %d under "
+                    "floor %d", key.hex()[:12], headroom, self.hbm_floor_bytes,
+                )
+                return self._overloaded_response()
         if ctx is not None:
             # the catalog upload is the session protocol's one heavy moment —
             # traced as the sidecar's own child span (linked to the client's
@@ -520,8 +714,40 @@ class SolverService:
 
     def solve_bytes(self, request: bytes) -> bytes:
         """One delta solve: session key + n_max + the 7 pod-side arrays
-        (+ an optional trace-context trailer). Unknown key →
-        ``NEEDS_CATALOG`` (the client re-opens and retries)."""
+        (+ optional trailers: trace context, propagated deadline). Unknown
+        key → ``NEEDS_CATALOG`` (the client re-opens and retries).
+
+        Overload control wraps the whole solve: the bounded admission gate
+        refuses work past its caps (``STATUS_OVERLOADED`` + retry hint),
+        and a propagated deadline is re-checked AFTER queueing so
+        already-doomed work sheds before it ever touches the device
+        (``STATUS_DEADLINE_EXCEEDED`` — non-retryable client-side)."""
+        arrays = unpack_arrays(request)
+        trailer = arrays[2 + N_POD_ARRAYS:]
+        ctx, deadline_s = _parse_trailers(trailer)
+        deadline = (
+            None if deadline_s is None
+            else self._clock() + max(deadline_s, 0.0)
+        )
+        outcome = self.admission.enter(deadline)
+        if outcome == "deadline":
+            self._count_shed("deadline")
+            return _status_response(STATUS_DEADLINE_EXCEEDED)
+        if outcome == "overloaded":
+            self._count_shed("queue_full")
+            return self._overloaded_response()
+        try:
+            if deadline is not None and self._clock() >= deadline:
+                # the budget died while this request sat in the admission
+                # queue: shed BEFORE device dispatch — the round it
+                # belonged to has already degraded to its FFD floor
+                self._count_shed("deadline")
+                return _status_response(STATUS_DEADLINE_EXCEEDED)
+            return self._solve_admitted(arrays, ctx)
+        finally:
+            self.admission.leave()
+
+    def _solve_admitted(self, arrays: List[np.ndarray], ctx) -> bytes:
         import jax
 
         from karpenter_tpu import obs
@@ -529,11 +755,8 @@ class SolverService:
 
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
-        arrays = unpack_arrays(request)
         key_arr, n_max_arr = arrays[0], arrays[1]
         pod_arrays = arrays[2:2 + N_POD_ARRAYS]
-        trailer = arrays[2 + N_POD_ARRAYS:]
-        ctx = _ctx_from_array(trailer[0]) if trailer else None
         key = key_arr.tobytes()
         vals = n_max_arr.reshape(-1)
         n_max = int(vals[0])
@@ -562,6 +785,10 @@ class SolverService:
             return _status_response(STATUS_NEEDS_CATALOG)
         if record_hit:
             session_stats.record(True)
+        with self._stats_lock:
+            # from here the solve reaches the device: the overload-storm
+            # acceptance bar counts dispatches vs deadline sheds
+            self.dispatches += 1
         if ctx is None:
             result = pack_best(*pod_arrays, *resident, n_max=n_max)
             # one fused device→host transfer on the sidecar too — per-array
@@ -823,7 +1050,19 @@ class RemoteSolver:
         request = pack_arrays(arrays)
         with obs.tracer().span("solver.wire_open", attrs={"address": self.address}):
             response = self._open_call(request, timeout=timeout)
-        _status, payload = self._split_status(response)
+        status, payload = self._split_status(response)
+        if status == STATUS_OVERLOADED:
+            # HBM pressure or admission refusal: typed so the pool's soft
+            # breaker (and the scheduler's local fallback) can tell
+            # backpressure from failure — no real breaker may trip on it
+            raise OverloadedError(
+                f"solver {self.address} refused session open (overloaded)",
+                retry_after=self._retry_after(payload),
+            )
+        if status != STATUS_OK:
+            raise RuntimeError(
+                f"unknown OpenSession status word {status} from {self.address}"
+            )
         features = int(payload[0].reshape(-1)[0]) if payload else 0
         with self._lock:
             self._server_features = features
@@ -839,6 +1078,34 @@ class RemoteSolver:
         status_arr, *payload = unpack_arrays(response)
         return int(status_arr.reshape(-1)[0]), payload
 
+    @staticmethod
+    def _retry_after(payload: List[np.ndarray]) -> float:
+        """The f32 retry-after hint an OVERLOADED response leads with."""
+        try:
+            return float(np.asarray(payload[0]).reshape(-1)[0])
+        except Exception:
+            return 1.0
+
+    def _check_status(self, status: int, payload: List[np.ndarray]) -> None:
+        """Raise the typed verdict for any terminal non-OK status. An
+        unknown word fails LOUD — a silent mis-parse on status would be
+        the exact bug the version-skew check exists to prevent."""
+        if status == STATUS_OK:
+            return
+        if status == STATUS_DEADLINE_EXCEEDED:
+            raise DeadlineExceededError(
+                f"solver {self.address} shed the solve: propagated round "
+                "budget expired before device dispatch"
+            )
+        if status == STATUS_OVERLOADED:
+            raise OverloadedError(
+                f"solver {self.address} refused the solve (overloaded)",
+                retry_after=self._retry_after(payload),
+            )
+        raise RuntimeError(
+            f"unknown solver status word {status} from {self.address}"
+        )
+
     # -- solves -------------------------------------------------------------
 
     def pack_begin(
@@ -851,8 +1118,16 @@ class RemoteSolver:
         attribute serialization separately from the in-flight wait.
         ``record=False`` keeps this Pack out of the sidecar's hit-rate
         stats (shadow probes, saturation re-dispatches)."""
+        from karpenter_tpu.resilience import current_budget
         from karpenter_tpu.solver.kernel import split_result
 
+        # client-side pre-shed: a round whose budget already expired must
+        # not even pay serialization — straight to the caller's FFD floor
+        budget = current_budget.get()
+        if budget is not None and budget.expired:
+            raise DeadlineExceededError(
+                "round budget expired before solver dispatch"
+            )
         pod_side, catalog_side = inputs[:N_POD_ARRAYS], inputs[N_POD_ARRAYS:]
         key = self._catalog_key(catalog_side)
         p = len(inputs[0])
@@ -870,16 +1145,22 @@ class RemoteSolver:
         arrays = [
             _key_array(key), np.asarray([n_max, 1 if record else 0], np.int32)
         ] + [np.asarray(a) for a in pod_side]
-        # trace-context trailer: the span active at DISPATCH time parents
-        # the sidecar's child spans. Sent ONLY to a sidecar that advertised
-        # PROTO_TRACE_TRAILER in its OpenSession response — an untraced (or
+        # optional trailers, each capability-gated on the bits the sidecar
+        # advertised in its OpenSession response — an untraced (or
         # old-peer) frame is byte-identical to before, so rolling upgrades
-        # in either order keep solving
+        # in either order keep solving:
+        # - trace context: the span active at DISPATCH time parents the
+        #   sidecar's child spans (PROTO_TRACE_TRAILER);
+        # - deadline: the round Budget's REMAINING seconds (relative —
+        #   clocks never agree across the wire), so the sidecar can shed
+        #   already-doomed work before device dispatch (PROTO_DEADLINE)
         span = obs.tracer().current()
         with self._lock:
-            trailer_ok = bool(self._server_features & PROTO_TRACE_TRAILER)
-        if span is not None and trailer_ok:
+            features = self._server_features
+        if span is not None and (features & PROTO_TRACE_TRAILER):
             arrays.append(_trace_ctx_array(span.context))
+        if budget is not None and (features & PROTO_DEADLINE):
+            arrays.append(np.asarray([budget.remaining()], np.float32))
         request = pack_arrays(arrays)
         if prof is not None:
             prof["wire_ser_s"] = (
@@ -891,7 +1172,10 @@ class RemoteSolver:
             with obs.tracer().span(
                 "solver.wire", attrs={"address": self.address}
             ) as wsp:
-                response = future.result()
+                # belt over the RPC's own deadline: the future resolves by
+                # `timeout` in every healthy case, the slack only bounds a
+                # misbehaving transport (karplint bounded-wait)
+                response = future.result(timeout=timeout + 5.0)
                 status, payload = self._split_status(response)
                 if status == STATUS_NEEDS_CATALOG:
                     # sidecar restarted or evicted this catalog: re-open and
@@ -914,6 +1198,10 @@ class RemoteSolver:
                             "solver session re-open did not take "
                             f"(catalog key {key.hex()[:12]})"
                         )
+                if status != STATUS_OK:
+                    # typed verdicts (deadline/overload) + loud unknowns
+                    wsp.set_attribute("status", status)
+                    self._check_status(status, payload)
                 with self._lock:
                     self._warm_shapes.add(shape)
                 t1 = time.perf_counter()
@@ -956,6 +1244,23 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--health-port", type=int, default=8081)
     ap.add_argument("--session-max", type=int, default=SESSION_MAX)
     ap.add_argument("--session-ttl", type=float, default=SESSION_TTL_S)
+    ap.add_argument("--solver-max-inflight", type=int, default=MAX_INFLIGHT,
+                    help="concurrent solves admitted to the device executor; "
+                         "everything past this queues (docs/overload.md)")
+    ap.add_argument("--solver-queue-depth", type=int, default=QUEUE_DEPTH,
+                    help="solve requests allowed to queue behind the "
+                         "inflight cap; beyond it requests are refused "
+                         "STATUS_OVERLOADED with a retry-after hint")
+    ap.add_argument("--overload-retry-after", type=float,
+                    default=OVERLOAD_RETRY_AFTER_S,
+                    help="retry-after hint (seconds) carried by "
+                         "STATUS_OVERLOADED responses; pool clients sit "
+                         "out the member for this window")
+    ap.add_argument("--hbm-floor-bytes", type=int, default=0,
+                    help="device-memory headroom floor: below it NEW "
+                         "session uploads are refused STATUS_OVERLOADED "
+                         "while resident-session solves keep flowing "
+                         "(0 disables)")
     ap.add_argument("--flight-dir", default="",
                     help="capped on-disk ring for slow-solve flight records "
                          "('' disables; served at GET /debug/flight)")
@@ -989,7 +1294,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     )
     server = serve(
         args.address, args.max_workers, health_port=args.health_port, warmup=True,
-        service=SolverService(session_max=args.session_max, session_ttl=args.session_ttl),
+        service=SolverService(
+            session_max=args.session_max, session_ttl=args.session_ttl,
+            max_inflight=args.solver_max_inflight,
+            queue_depth=args.solver_queue_depth,
+            overload_retry_after=args.overload_retry_after,
+            hbm_floor_bytes=args.hbm_floor_bytes,
+        ),
     )
     try:
         while True:

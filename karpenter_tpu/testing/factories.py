@@ -51,6 +51,7 @@ def make_pod(
     node_name: str = "",
     unschedulable: bool = True,
     owner: Optional[OwnerReference] = None,
+    priority_class_name: str = "",
 ) -> Pod:
     affinity = None
     if node_requirements or node_preferences or pod_requirements or pod_anti_requirements:
@@ -89,6 +90,7 @@ def make_pod(
                 )
             ],
             topology_spread_constraints=list(topology or []),
+            priority_class_name=priority_class_name,
         ),
         status=status,
     )

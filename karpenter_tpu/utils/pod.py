@@ -101,3 +101,26 @@ def has_required_pod_anti_affinity(pod: Pod) -> bool:
     return (
         aff is not None and aff.pod_anti_affinity is not None and bool(aff.pod_anti_affinity.required)
     )
+
+
+# Priority classing for overload decisions (docs/overload.md): without a
+# PriorityClass store to resolve real values, the class NAME maps to a
+# coarse ordinal — enough to decide what the batcher sheds first. System
+# classes outrank everything; an unnamed class is the default tier; names
+# starting "low"/"best-effort" opt workloads into shed-first.
+_PRIORITY_BY_CLASS = {
+    "system-node-critical": 100,
+    "system-cluster-critical": 90,
+}
+
+
+def priority_of(pod: Pod) -> int:
+    """Coarse priority ordinal for shed ordering (higher = keep longer)."""
+    name = pod.spec.priority_class_name or ""
+    if name in _PRIORITY_BY_CLASS:
+        return _PRIORITY_BY_CLASS[name]
+    if name.startswith("high"):
+        return 10
+    if name.startswith(("low", "best-effort")):
+        return -10
+    return 0

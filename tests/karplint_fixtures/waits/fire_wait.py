@@ -1,0 +1,21 @@
+# karplint-fixture: expect=bounded-wait
+"""Unbounded parks: a queue get, an event wait, a condition wait, and a
+future result, all timeout-less — each one parks its thread forever when
+the far side sheds, crashes, or simply never produces."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._queue = queue.Queue()
+        self._done = threading.Event()
+        self._cv = threading.Condition()
+
+    def run(self, future):
+        item = self._queue.get()  # blocks forever on an idle producer
+        self._done.wait()  # blocks forever if the setter shed the work
+        with self._cv:
+            self._cv.wait()  # missed-notify = parked forever
+        return item, future.result()  # wedged transport = parked forever

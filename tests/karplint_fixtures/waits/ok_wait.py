@@ -1,0 +1,30 @@
+# karplint-fixture: clean=bounded-wait
+"""Bounded parks (the near-miss): every wait carries a timeout and the
+loop re-checks its stop condition, so a dead producer costs one slice,
+not a thread. A dict's ``.get(key)`` must not trip the queue heuristic."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._queue = queue.Queue()
+        self._done = threading.Event()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._config = {}
+
+    def run(self, future):
+        try:
+            item = self._queue.get(timeout=1.0)
+        except queue.Empty:
+            item = None
+        while not self._done.wait(0.5):
+            if self._stopped:
+                break
+        with self._cv:
+            self._cv.wait(0.5)
+        # a plain dict .get with a key argument is not a queue park
+        mode = self._config.get("mode")
+        return item, mode, future.result(timeout=5.0)

@@ -234,6 +234,204 @@ class TestSolverPoolFailover:
         pool.close()
 
 
+class TestPoolSoftBreaker:
+    """STATUS_OVERLOADED is backpressure, not failure (docs/overload.md):
+    the member sits out its retry-after window, traffic routes around it,
+    and its REAL breaker — and the half-open probe traffic a trip would
+    bring — is never touched."""
+
+    def _fake_inputs(self):
+        from karpenter_tpu.solver.service import N_POD_ARRAYS
+
+        return tuple(
+            np.full(4, i, np.float32) for i in range(N_POD_ARRAYS + 3)
+        )
+
+    def _pool(self, behaviors, clock):
+        """behaviors: {address: callable(address) -> result-or-raise}; the
+        callable runs at WAIT time (dispatch always succeeds)."""
+        from karpenter_tpu.resilience.overload import OverloadedError  # noqa: F401
+
+        calls = {a: 0 for a in behaviors}
+
+        class FakeClient:
+            def __init__(self, address):
+                self.address = address
+
+            def pack_begin(self, *inputs, n_max, prof=None, record=True):
+                calls[self.address] += 1
+
+                def wait():
+                    return behaviors[self.address](self.address)
+
+                return wait
+
+            def close(self):
+                pass
+
+        pool = SolverPool(
+            list(behaviors),
+            client_factory=FakeClient,
+            clock=lambda: clock[0],
+        )
+        return pool, calls
+
+    def test_overloaded_member_sat_out_for_hint_window(self):
+        from karpenter_tpu.resilience.overload import OverloadedError
+
+        clock = [0.0]
+
+        def overloaded(addr):
+            raise OverloadedError(f"{addr} full", retry_after=5.0)
+
+        inputs = self._fake_inputs()
+        key = None
+        behaviors = {"a:1": overloaded, "b:1": lambda addr: ("ok", addr)}
+        pool, calls = self._pool(behaviors, clock)
+        key = pool._catalog_key(inputs[7:])
+        order = pool.ring.ordered(key)
+        first = order[0]
+        if first == "b:1":  # make the OVERLOADED member the primary
+            behaviors["b:1"], behaviors["a:1"] = (
+                behaviors["a:1"], behaviors["b:1"],
+            )
+        survivor = order[1]
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        # the overloaded member's REAL breaker never moved
+        assert pool._breaker(first).available()
+        assert set(pool.available_members()) == {"a:1", "b:1"}
+        assert pool.overload_skips == 1
+        # within the hint window: routed around WITHOUT an RPC
+        calls_before = calls[first]
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        assert calls[first] == calls_before
+        assert pool.overload_skips == 2
+        # past the window the member earns traffic again
+        clock[0] = 6.0
+        behaviors[first] = lambda addr: ("recovered", addr)
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("recovered", first)
+        pool.close()
+
+    def test_all_members_overloaded_raises_typed_verdict(self):
+        from karpenter_tpu.resilience.overload import OverloadedError
+
+        clock = [0.0]
+
+        def overloaded_2(addr):
+            raise OverloadedError(f"{addr} full", retry_after=2.0)
+
+        def overloaded_7(addr):
+            raise OverloadedError(f"{addr} full", retry_after=7.0)
+
+        inputs = self._fake_inputs()
+        pool, _ = self._pool(
+            {"a:1": overloaded_2, "b:1": overloaded_7}, clock
+        )
+        with pytest.raises(OverloadedError) as ei:
+            pool.pack_begin(*inputs, n_max=4)()
+        # NOT PoolExhausted: the pool is full, not broken — and the hint
+        # is the soonest member to free
+        assert ei.value.retry_after == 2.0
+        # neither breaker moved: a retry after the hint routes normally
+        assert set(pool.available_members()) == {"a:1", "b:1"}
+        pool.close()
+
+    def test_real_failure_then_overloaded_survivor_is_exhaustion_not_backpressure(self):
+        """A hard member failure followed by an overloaded survivor must
+        surface as PoolExhausted carrying the REAL error — reporting it as
+        OverloadedError would log a broken member as backpressure and skip
+        the outer remote-breaker accounting for the failed round."""
+        from karpenter_tpu.resilience.overload import OverloadedError
+
+        clock = [0.0]
+
+        def hard_fail(addr):
+            raise RuntimeError(f"{addr} segfaulted mid-solve")
+
+        def overloaded(addr):
+            raise OverloadedError(f"{addr} full", retry_after=3.0)
+
+        inputs = self._fake_inputs()
+        behaviors = {"a:1": hard_fail, "b:1": overloaded}
+        pool, _ = self._pool(behaviors, clock)
+        key = pool._catalog_key(inputs[7:])
+        primary = pool.ring.route(key)
+        if primary != "a:1":  # the REAL failure must be the primary's
+            behaviors["a:1"], behaviors["b:1"] = (
+                behaviors["b:1"], behaviors["a:1"],
+            )
+        with pytest.raises(PoolExhausted, match="segfaulted"):
+            pool.pack_begin(*inputs, n_max=4)()
+        pool.close()
+
+    def test_deadline_exceeded_propagates_without_failover(self):
+        from karpenter_tpu.resilience.overload import DeadlineExceededError
+
+        clock = [0.0]
+
+        def doomed(addr):
+            raise DeadlineExceededError("round budget expired")
+
+        served = []
+
+        def serve_ok(addr):
+            served.append(addr)
+            return ("ok", addr)
+
+        inputs = self._fake_inputs()
+        pool, calls = self._pool({"a:1": doomed, "b:1": doomed}, clock)
+        key = pool._catalog_key(inputs[7:])
+        primary = pool.ring.route(key)
+        with pytest.raises(DeadlineExceededError):
+            pool.pack_begin(*inputs, n_max=4)()
+        # no failover: the deadline is the WORK's, not the member's — the
+        # other member was never asked to solve doomed work
+        other = [a for a in ("a:1", "b:1") if a != primary][0]
+        assert calls[other] == 0
+        assert set(pool.available_members()) == {"a:1", "b:1"}
+        pool.close()
+
+    def test_dispatch_time_overload_skips_to_next_member(self):
+        from karpenter_tpu.resilience.overload import OverloadedError
+
+        clock = [0.0]
+        inputs = self._fake_inputs()
+
+        calls = {"a:1": 0, "b:1": 0}
+
+        class DispatchOverloaded:
+            def __init__(self, address):
+                self.address = address
+
+            def pack_begin(self, *a, **kw):
+                calls[self.address] += 1
+                if self.address == primary_box[0]:
+                    raise OverloadedError("full at dispatch", retry_after=3.0)
+                return lambda: ("ok", self.address)
+
+            def close(self):
+                pass
+
+        primary_box = [None]
+        pool = SolverPool(
+            ["a:1", "b:1"],
+            client_factory=DispatchOverloaded,
+            clock=lambda: clock[0],
+        )
+        key = pool._catalog_key(inputs[7:])
+        primary_box[0] = pool.ring.route(key)
+        survivor = [a for a in ("a:1", "b:1") if a != primary_box[0]][0]
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        assert pool._breaker(primary_box[0]).available()
+        assert pool.overload_skips == 1
+        assert pool.failovers == 0  # a soft skip is not a failover
+        pool.close()
+
+
 class TestSchedulerWithPool:
     def test_scheduler_solves_through_pool_and_degrades_to_ffd(self):
         """TpuScheduler with a comma-separated pool address solves through

@@ -47,10 +47,11 @@ def test_full_repo_analyze_under_10s():
     assert time.perf_counter() - t0 < 10.0
 
 
-def test_all_nine_rules_registered():
+def test_all_ten_rules_registered():
     from tools.karplint import rule_names
 
     assert rule_names() == [
+        "bounded-wait",
         "lock-guard",
         "metric-name",
         "patch-literal-list",

@@ -1,0 +1,638 @@
+"""Overload-control tests (docs/overload.md): the bounded priority-aware
+batcher, retry budgets, the sidecar admission gate, the typed shed
+verdicts' never-a-failure contract, and the SLO-driven brownout ladder's
+engage-and-fully-reverse audit trail."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.resilience import (
+    Budget,
+    CircuitBreaker,
+    DeadlineExceededError,
+    OverloadedError,
+    RetryBudget,
+    RetryPolicy,
+)
+from karpenter_tpu.resilience.brownout import (
+    LEVEL_NAMES,
+    MAX_LEVEL,
+    PRESSURE_BY_LEVEL,
+    ROUTER_BIAS,
+    BrownoutController,
+)
+from karpenter_tpu.utils.batcher import Batcher
+from karpenter_tpu.utils.pod import priority_of
+
+
+class TestPriorityOf:
+    def test_classes_order_correctly(self):
+        from karpenter_tpu.testing.factories import make_pod
+
+        system = make_pod(priority_class_name="system-cluster-critical")
+        high = make_pod(priority_class_name="high-batch")
+        default = make_pod()
+        low = make_pod(priority_class_name="low-priority")
+        best_effort = make_pod(priority_class_name="best-effort-batch")
+        assert (
+            priority_of(system)
+            > priority_of(high)
+            > priority_of(default)
+            > priority_of(low)
+        )
+        assert priority_of(best_effort) < priority_of(default)
+
+
+class TestBoundedBatcher:
+    def test_full_queue_sheds_oldest_lowest_priority(self):
+        shed = []
+        b = Batcher(
+            max_depth=3,
+            priority_fn=lambda item: item[0],
+            on_shed=lambda item, reason: shed.append((item, reason)),
+        )
+        b.add((0, "old-low"))
+        b.add((5, "mid"))
+        b.add((10, "high"))
+        b.add((5, "newer-mid"))  # full: the oldest lowest-priority entry goes
+        assert shed == [((0, "old-low"), "queue_full")]
+        assert b.depth() == 3
+        # nothing queued is below the new default tier now: an incoming
+        # low-priority item is itself the least important thing in sight
+        b.add((0, "new-low"))
+        assert shed[-1] == ((0, "new-low"), "queue_full")
+        b.stop()
+
+    def test_incoming_item_refused_when_strictly_least_important(self):
+        shed = []
+        b = Batcher(
+            max_depth=2,
+            priority_fn=lambda item: item,
+            on_shed=lambda item, reason: shed.append(item),
+        )
+        b.add(5)
+        b.add(5)
+        b.add(1)  # lower than everything queued: refused outright
+        assert shed == [1]
+        items, _ = b.wait()
+        assert items == [5, 5]
+        b.stop()
+
+    def test_queue_depth_never_exceeds_cap(self):
+        b = Batcher(max_depth=4)
+        for i in range(50):
+            b.add(i)
+        assert b.depth() == 4
+        assert b.max_depth_seen == 4
+        assert b.shed_total == 46
+        b.stop()
+
+    def test_shed_metric_and_hook_containment(self):
+        from karpenter_tpu import metrics as m
+
+        def sample():
+            return m.REGISTRY.get_sample_value(
+                "karpenter_batcher_shed_total", {"reason": "queue_full"}
+            ) or 0.0
+
+        before = sample()
+
+        def raising_hook(item, reason):
+            raise RuntimeError("hook bug")
+
+        b = Batcher(max_depth=1, on_shed=raising_hook)
+        b.add(1)
+        b.add(2)  # shed fires the raising hook — the add must survive
+        assert sample() == before + 1
+        assert b.depth() == 1
+        b.stop()
+
+    def test_pressure_scales_window_and_reverses(self):
+        b = Batcher(idle_duration=5.0, max_duration=50.0, max_items=100, max_depth=10)
+        b.set_pressure(0.01)
+        for i in range(4):
+            b.add(i)
+        t0 = time.monotonic()
+        items, _ = b.wait()  # idle window scaled to ~50ms: returns fast
+        assert time.monotonic() - t0 < 2.0
+        # cap scaled: max(100*0.01, 1) = 1 item per batch
+        assert len(items) == 1
+        b.set_pressure(1.0)
+        assert b.pressure() == 1.0
+        b.stop()
+
+    def test_shed_low_priority_drains_below_floor_only(self):
+        shed = []
+        b = Batcher(
+            max_depth=10,
+            priority_fn=lambda item: item,
+            on_shed=lambda item, reason: shed.append((item, reason)),
+        )
+        for pri in (-10, 0, 10, -10, 0):
+            b.add(pri)
+        dropped = b.shed_low_priority(0)
+        assert dropped == 2
+        assert [s for s, _ in shed] == [-10, -10]
+        assert all(reason == "brownout" for _, reason in shed)
+        items, _ = b.wait()
+        assert items == [0, 10, 0]
+        b.stop()
+
+    def test_add_after_stop_still_returns_preset_gate(self):
+        b = Batcher(max_depth=2)
+        b.stop()
+        gate = b.add(1)
+        assert gate.is_set()
+
+    def test_wait_parks_bounded_and_stop_wakes(self):
+        b = Batcher(max_depth=2)
+        out = []
+        t = threading.Thread(target=lambda: out.append(b.wait()))
+        t.start()
+        time.sleep(0.1)
+        b.stop()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert out == [([], 0.0)]
+
+
+class TestRetryBudget:
+    def test_spend_drains_and_success_refills(self):
+        rb = RetryBudget(capacity=2, refill_per_success=0.5)
+        assert rb.try_spend("dep")
+        assert rb.try_spend("dep")
+        assert not rb.try_spend("dep")  # dry
+        rb.record_success("dep")
+        rb.record_success("dep")  # +1.0 token
+        assert rb.try_spend("dep")
+        assert not rb.try_spend("dep")
+
+    def test_refill_caps_at_capacity(self):
+        rb = RetryBudget(capacity=3, refill_per_success=10.0)
+        rb.record_success("dep")
+        assert rb.remaining("dep") == 3.0
+
+    def test_budgets_are_per_dependency(self):
+        rb = RetryBudget(capacity=1)
+        assert rb.try_spend("a")
+        assert not rb.try_spend("a")
+        assert rb.try_spend("b")
+        assert rb.snapshot() == {"a": 0.0, "b": 0.0}
+
+    def test_policy_stops_retrying_when_budget_dry(self):
+        from karpenter_tpu import metrics as m
+
+        def exhausted():
+            return m.REGISTRY.get_sample_value(
+                "karpenter_resilience_retries_total",
+                {"dependency": "flaky", "outcome": "budget_exhausted"},
+            ) or 0.0
+
+        rb = RetryBudget(capacity=1, refill_per_success=0.0)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        policy = RetryPolicy(
+            max_attempts=5, base=0.0001, cap=0.0001, dependency="flaky",
+            retry_budget=rb, sleep=lambda s: None,
+        )
+        before = exhausted()
+        with pytest.raises(ConnectionError):
+            policy.call(fn)
+        # one original attempt + one budgeted retry, then the bucket is dry
+        assert len(calls) == 2
+        assert exhausted() == before + 1
+
+    def test_policy_success_refills_budget(self):
+        rb = RetryBudget(capacity=1, refill_per_success=1.0)
+        rb.try_spend("dep")  # drain
+        policy = RetryPolicy(
+            max_attempts=2, dependency="dep", retry_budget=rb,
+            sleep=lambda s: None,
+        )
+        assert policy.call(lambda: "ok") == "ok"
+        assert rb.remaining("dep") == 1.0
+
+    def test_unlabeled_policy_skips_budget_accounting(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("down")
+            return "ok"
+
+        policy = RetryPolicy(
+            max_attempts=3, base=0.0001, cap=0.0001, sleep=lambda s: None,
+        )
+        assert policy.call(fn) == "ok"
+        assert len(calls) == 3
+
+    def test_shed_verdicts_are_never_retried(self):
+        for exc in (
+            OverloadedError("full", retry_after=2.0),
+            DeadlineExceededError("expired"),
+        ):
+            calls = []
+
+            def fn(e=exc):
+                calls.append(1)
+                raise e
+
+            policy = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+            with pytest.raises(type(exc)):
+                policy.call(fn)
+            assert len(calls) == 1  # non-retryable by classification
+
+    def test_overloaded_error_carries_hint(self):
+        e = OverloadedError("full", retry_after=3.5)
+        assert e.retry_after == 3.5
+        assert OverloadedError("full", retry_after=-1).retry_after == 0.0
+
+
+class TestAdmissionGate:
+    def _gate(self, **kw):
+        from karpenter_tpu.solver.service import AdmissionGate
+
+        return AdmissionGate(**kw)
+
+    def test_admits_up_to_inflight_then_refuses_past_queue(self):
+        gate = self._gate(max_inflight=2, queue_depth=0)
+        assert gate.enter() == "admitted"
+        assert gate.enter() == "admitted"
+        assert gate.enter() == "overloaded"  # queue_depth 0: refuse at once
+        gate.leave()
+        assert gate.enter() == "admitted"
+        assert gate.depth() == 2
+
+    def test_queued_caller_admitted_when_slot_frees(self):
+        gate = self._gate(max_inflight=1, queue_depth=1)
+        assert gate.enter() == "admitted"
+        results = []
+        t = threading.Thread(target=lambda: results.append(gate.enter()))
+        t.start()
+        time.sleep(0.1)
+        assert gate.depth() == 2  # 1 inflight + 1 queued
+        gate.leave()
+        t.join(timeout=5)
+        assert results == ["admitted"]
+        assert gate.max_depth_seen == 2
+
+    def test_expired_deadline_while_queued_returns_deadline(self):
+        clock = [0.0]
+        gate = self._gate(max_inflight=1, queue_depth=2, clock=lambda: clock[0])
+        assert gate.enter() == "admitted"
+        results = []
+
+        def queued():
+            results.append(gate.enter(deadline=0.05))
+
+        t = threading.Thread(target=queued)
+        t.start()
+        time.sleep(0.1)
+        clock[0] = 1.0  # the caller's deadline passed while it sat queued
+        with gate._cv:
+            gate._cv.notify_all()
+        t.join(timeout=5)
+        assert results == ["deadline"]
+
+    def test_overflow_past_queue_depth_refused_immediately(self):
+        gate = self._gate(max_inflight=1, queue_depth=1)
+        assert gate.enter() == "admitted"
+        t = threading.Thread(target=gate.enter)  # occupies the queue slot
+        t.daemon = True
+        t.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        assert gate.enter() == "overloaded"
+        assert time.monotonic() - t0 < 1.0  # no park, an immediate refusal
+        gate.leave()
+        t.join(timeout=5)
+
+    def test_bounded_wait_stays_below_client_rpc_timeout(self):
+        """The gate's queue wait must answer STATUS_OVERLOADED BEFORE the
+        client's warm gRPC deadline fires — if the RPC deadline won the
+        race, the client would see a generic transport error and record a
+        real breaker failure on pure backpressure."""
+        import inspect
+
+        from karpenter_tpu.solver.service import AdmissionGate, RemoteSolver
+
+        warm_timeout = inspect.signature(
+            RemoteSolver.__init__
+        ).parameters["timeout"].default
+        assert AdmissionGate.MAX_WAIT_S < warm_timeout / 2
+
+
+class TestSchedulerShedHandling:
+    """The never-a-failure contract at the scheduler: typed shed verdicts
+    take the FFD floor WITHOUT moving breaker state."""
+
+    def _scheduler_with_failing_remote(self, exc):
+        import random as _random
+
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        sched = TpuScheduler(
+            Cluster(), rng=_random.Random(0), service_address="127.0.0.1:1",
+        )
+
+        class FakeRemote:
+            def pack_begin(self, *a, **kw):
+                raise exc
+
+        sched._remote = FakeRemote()
+        return sched
+
+    def _solve_inputs(self):
+        import random as _random
+
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.testing import make_pod, make_provisioner
+
+        catalog = sorted(
+            instance_types(6), key=lambda it: it.effective_price()
+        )
+        constraints = make_provisioner(solver="tpu").spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        pods = [make_pod(requests={"cpu": "0.5"}) for _ in range(5)]
+        return constraints, catalog, pods
+
+    def test_overloaded_remote_serves_batch_without_breaker_trip(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PACKER", "device")
+        sched = self._scheduler_with_failing_remote(
+            OverloadedError("full", retry_after=0.5)
+        )
+        constraints, catalog, pods = self._solve_inputs()
+        vnodes = sched.solve(constraints, catalog, pods)
+        assert sum(len(v.pods) for v in vnodes) == len(pods)
+        # overload is backpressure: the remote breaker NEVER trips on it
+        assert sched._remote_breaker.state == "closed"
+        assert not sched._pack_breakers.open_dependencies()
+
+    def test_deadline_exceeded_takes_ffd_floor_without_breaker_trip(self, monkeypatch):
+        from karpenter_tpu import metrics as m
+
+        def degraded():
+            return m.REGISTRY.get_sample_value(
+                "karpenter_solver_degraded_solves_total", {"reason": "deadline"}
+            ) or 0.0
+
+        monkeypatch.setenv("KARPENTER_PACKER", "device")
+        sched = self._scheduler_with_failing_remote(
+            DeadlineExceededError("budget expired")
+        )
+        constraints, catalog, pods = self._solve_inputs()
+        before = degraded()
+        vnodes = sched.solve(constraints, catalog, pods)
+        # non-retryable: the batch is still served — by the FFD floor
+        assert sum(len(v.pods) for v in vnodes) == len(pods)
+        assert degraded() == before + 1
+        assert sched._remote_breaker.state == "closed"
+        assert not sched._pack_breakers.open_dependencies()
+        assert sched.last_profile.get("packer_backend") == "ffd-degraded"
+
+    def test_client_pre_shed_on_expired_budget(self):
+        """pack_begin under an already-expired round budget refuses before
+        paying serialization."""
+        from karpenter_tpu.solver.service import RemoteSolver
+
+        rs = RemoteSolver.__new__(RemoteSolver)  # no channel needed
+        budget = Budget(0.0)
+        with budget.activate():
+            with pytest.raises(DeadlineExceededError):
+                rs.pack_begin(*([None] * 10), n_max=4)
+
+
+class TestRouterBrownoutKnobs:
+    def test_probes_pause_and_resume(self):
+        from karpenter_tpu.solver.router import CostRouter
+
+        r = CostRouter(probe_every=1)
+        key = (1, 2, 3, 0)
+        r.record(key, "device", 0.1)
+        r.record(key, "native", 0.2)
+        r.choose(key, ["device", "native"])
+        assert r.should_probe(key)
+        r.set_probes_paused(True)
+        assert not r.should_probe(key)
+        r.set_probes_paused(False)
+        assert r.should_probe(key)
+
+    def test_bias_routes_marginal_races_to_native_and_reverses(self):
+        from karpenter_tpu.solver.router import CostRouter
+
+        r = CostRouter()
+        key = (1, 2, 3, 0)
+        r.record(key, "device", 0.010)
+        r.record(key, "native", 0.012)  # device wins the honest race
+        assert r.choose(key, ["device", "native"]) == "device"
+        r.set_brownout_bias(8.0)
+        assert r.choose(key, ["device", "native"]) == "native"
+        # stored EMAs untouched: recovery is instant
+        r.set_brownout_bias(1.0)
+        assert r.choose(key, ["device", "native"]) == "device"
+        assert r.ema(key, "device") == pytest.approx(0.010)
+
+
+class TestBrownoutLadder:
+    def _harness(self, burning):
+        """A controller wired to real actuation surfaces: a provisioning
+        double with one batcher, a consolidation double, a fresh router."""
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.router import CostRouter
+
+        batcher = Batcher(max_depth=10, priority_fn=lambda item: item)
+
+        class Worker:
+            def __init__(self):
+                self.batcher = batcher
+
+        class Provisioning:
+            def list_workers(self):
+                return [Worker()]
+
+        class Consolidation:
+            def __init__(self):
+                self._paused = False
+
+            def set_paused(self, paused):
+                self._paused = paused
+
+            def paused(self):
+                return self._paused
+
+        router = CostRouter()
+        consolidation = Consolidation()
+        cluster = Cluster()
+        ctl = BrownoutController(
+            burning_fn=lambda: burning[0],
+            provisioning=Provisioning(),
+            consolidation=consolidation,
+            router=router,
+            cluster=cluster,
+            escalate_after=1,
+            recover_after=1,
+        )
+        return ctl, batcher, router, consolidation, cluster
+
+    def test_ladder_engages_in_order_and_fully_reverses(self):
+        from karpenter_tpu import obs
+        from karpenter_tpu import metrics as m
+
+        obs.reset_for_tests()
+        burning = [True]
+        ctl, batcher, router, consolidation, cluster = self._harness(burning)
+        batcher.add(-10)  # queued low-priority work for the shed rung
+        batcher.add(0)
+
+        # escalate one rung per burning tick, asserting each rung's actions
+        assert ctl.tick() == 1
+        assert router.probes_paused()
+        assert consolidation.paused()
+        assert batcher.pressure() == PRESSURE_BY_LEVEL[1]
+        assert ctl.tick() == 2
+        assert batcher.pressure() == PRESSURE_BY_LEVEL[2]
+        assert ctl.tick() == 3
+        assert router.brownout_bias() == ROUTER_BIAS
+        assert ctl.tick() == 4
+        assert batcher.depth() == 1  # the low-priority entry was shed
+        assert ctl.tick() == MAX_LEVEL  # clamped
+
+        gauge = m.REGISTRY.get_sample_value("karpenter_brownout_level")
+        assert gauge == MAX_LEVEL
+
+        # recover one rung per clean tick, all the way to normal service
+        burning[0] = False
+        levels = [ctl.tick() for _ in range(MAX_LEVEL)]
+        assert levels == [3, 2, 1, 0]
+        assert not router.probes_paused()
+        assert router.brownout_bias() == 1.0
+        assert not consolidation.paused()
+        assert batcher.pressure() == 1.0
+        assert m.REGISTRY.get_sample_value("karpenter_brownout_level") == 0
+
+        # audit trail: every step and its reversal is a span...
+        spans = [
+            s
+            for tree in obs.exporter().snapshot(limit=None)
+            for s in obs.spans_named(tree, "brownout.transition")
+        ]
+        directions = [s["attrs"]["direction"] for s in spans]
+        assert directions.count("escalate") == MAX_LEVEL
+        assert directions.count("recover") == MAX_LEVEL
+        steps = {s["attrs"]["step"] for s in spans}
+        assert steps == set(LEVEL_NAMES[level] for level in range(1, MAX_LEVEL + 1))
+        # ...and a cluster event
+        reasons = [e.reason for e in cluster.list("events", None)]
+        assert reasons.count("BrownoutEscalated") == MAX_LEVEL
+        assert reasons.count("BrownoutRecovered") == MAX_LEVEL
+        # the controller's own audit list agrees
+        assert len(ctl.transitions) == 2 * MAX_LEVEL
+        batcher.stop()
+        obs.reset_for_tests()
+
+    def test_escalate_needs_sustained_burn(self):
+        burning = [True]
+        ctl, batcher, *_ = self._harness(burning)
+        ctl.escalate_after = 3
+        assert ctl.tick() == 0
+        assert ctl.tick() == 0
+        assert ctl.tick() == 1  # third consecutive burning tick engages
+        burning[0] = False
+        ctl.recover_after = 2
+        assert ctl.tick() == 1
+        assert ctl.tick() == 0
+        batcher.stop()
+
+    def test_broken_sensor_counts_as_clean(self):
+        ctl = BrownoutController(
+            burning_fn=lambda: 1 / 0, escalate_after=1, recover_after=1,
+        )
+        ctl._level = 2
+        assert ctl.tick() == 1  # recovers instead of wedging at rung 2
+
+    def test_stop_reverses_whatever_rung_was_engaged(self):
+        burning = [True]
+        ctl, batcher, router, consolidation, cluster = self._harness(burning)
+        ctl.tick()
+        ctl.tick()
+        assert ctl.level() == 2
+        ctl.stop()
+        assert ctl.level() == 0
+        assert not router.probes_paused()
+        assert batcher.pressure() == 1.0
+        assert not consolidation.paused()
+        batcher.stop()
+
+    def test_default_sensor_reads_slo_engine(self):
+        from karpenter_tpu import obs
+
+        obs.reset_for_tests()
+        try:
+            ctl = BrownoutController(escalate_after=1)
+            assert ctl.tick() == 0  # no engine configured: never burns
+            obs.configure_slo(window_s=60)
+            assert ctl.tick() == 0  # engine quiet: still clean
+        finally:
+            obs.reset_for_tests()
+
+    def test_consolidation_reconcile_pauses_under_brownout(self):
+        from karpenter_tpu.controllers.consolidation import (
+            WAVE_CHECK_INTERVAL,
+            ConsolidationController,
+        )
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.testing import make_provisioner
+
+        cluster = Cluster()
+        cluster.create("provisioners", make_provisioner(name="p1"))
+
+        class NoPlanProvider:
+            def get_instance_types(self, provider=None):
+                raise AssertionError("a paused consolidation must not plan")
+
+        ctl = ConsolidationController(
+            cluster, NoPlanProvider(), enabled=True, migration="bind"
+        )
+        ctl.set_paused(True)
+        assert ctl.reconcile("p1") == WAVE_CHECK_INTERVAL
+        ctl.set_paused(False)
+
+
+class TestRuntimeWiring:
+    def test_build_runtime_wires_brownout_and_stop_reverses(self):
+        from karpenter_tpu.main import build_runtime
+        from karpenter_tpu.options import Options
+
+        rt = build_runtime(Options(), start_workers=False)
+        try:
+            assert rt.brownout is not None
+            assert rt.brownout.provisioning is rt.provisioning
+            # actuate a rung, then prove Runtime.stop fully reverses it
+            rt.brownout._level = 2
+            rt.brownout._apply(2)
+        finally:
+            rt.stop()
+        assert rt.brownout.level() == 0
+
+    def test_no_brownout_option_disables(self):
+        from karpenter_tpu.main import build_runtime
+        from karpenter_tpu.options import Options, parse_args
+
+        opts = parse_args(["--no-brownout"])
+        assert not opts.brownout_enabled
+        rt = build_runtime(opts, start_workers=False)
+        try:
+            assert rt.brownout is None
+        finally:
+            rt.stop()

@@ -49,7 +49,7 @@ def drive(cluster, provisioning, selection, pod):
     result = selection.reconcile(pod.metadata.name, pod.metadata.namespace)
     for worker in provisioning.list_workers():
         worker.batcher.idle_duration = 0.01
-        if not worker.batcher._queue.empty():
+        if worker.batcher.depth():
             worker.provision_once()
     return result
 

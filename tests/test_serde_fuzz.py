@@ -288,6 +288,120 @@ def test_v3_catalog_key_content_addressed():
     assert service.catalog_session_key(join.reshape(3, 2), front, daemon) != k1
 
 
+# -- v3 status words (overload control) ---------------------------------------
+#
+# PR-9 grew the response status vocabulary: OVERLOADED (bounded admission /
+# HBM pressure refused the work, payload leads with an f32 retry-after hint)
+# and DEADLINE_EXCEEDED (the propagated round budget died before device
+# dispatch — non-retryable). The words must survive the codec exactly, a
+# status word NEITHER side knows must fail loud (the version-skew contract
+# extended to in-band status), and frames without the new trailers must
+# parse identically on a new server — old client × new server interop.
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_v3_status_word_round_trip(seed):
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    status = rng.choice([
+        service.STATUS_OK,
+        service.STATUS_NEEDS_CATALOG,
+        service.STATUS_DEADLINE_EXCEEDED,
+        service.STATUS_OVERLOADED,
+    ])
+    hint = rng.uniform(0.05, 30.0)
+    payload = (
+        [np.asarray([hint], np.float32)]
+        if status == service.STATUS_OVERLOADED else []
+    )
+    frame = service._status_response(status, payload)
+    status_arr, *rest = service.unpack_arrays(frame)
+    assert int(status_arr.reshape(-1)[0]) == status
+    if status == service.STATUS_OVERLOADED:
+        # the retry-after hint the pool's soft breaker honors
+        assert rest and float(rest[0][0]) == pytest.approx(hint)
+
+
+@pytest.mark.parametrize(
+    "status,exc_name",
+    [(2, "DeadlineExceededError"), (3, "OverloadedError")],
+)
+def test_v3_shed_statuses_raise_typed_verdicts(status, exc_name):
+    """The client maps each shed word to its typed error — typed so the
+    pool soft-breaker and the scheduler's FFD floor can tell backpressure
+    (retryable elsewhere) from a doomed solve (never retryable)."""
+    import numpy as np
+
+    from karpenter_tpu.resilience.overload import (
+        DeadlineExceededError,
+        OverloadedError,
+    )
+    from karpenter_tpu.solver import service
+
+    expected = {"DeadlineExceededError": DeadlineExceededError,
+                "OverloadedError": OverloadedError}[exc_name]
+    solver = service.RemoteSolver.__new__(service.RemoteSolver)
+    solver.address = "fuzz:0"
+    frame = service._status_response(
+        status, [np.asarray([0.25], np.float32)] if status == 3 else []
+    )
+    word, payload = service.RemoteSolver._split_status(frame)
+    with pytest.raises(expected):
+        solver._check_status(word, payload)
+    if exc_name == "OverloadedError":
+        try:
+            solver._check_status(word, payload)
+        except OverloadedError as e:
+            assert e.retry_after == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize("status", [4, 5, 17, -1, 2**20])
+def test_v3_unknown_status_word_fails_loudly(status):
+    """A status word neither side knows is a protocol error, not a retry
+    signal — silent tolerance here would be the status-plane version of a
+    silent version-skew mis-parse."""
+    from karpenter_tpu.solver import service
+
+    solver = service.RemoteSolver.__new__(service.RemoteSolver)
+    solver.address = "fuzz:0"
+    frame = service._status_response(status)
+    word, payload = service.RemoteSolver._split_status(frame)
+    with pytest.raises(RuntimeError, match=f"unknown solver status word {status}"):
+        solver._check_status(word, payload)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_v3_old_client_frames_parse_without_deadline(seed):
+    """Old client × new server: a Pack frame with NO trailing deadline/trace
+    arrays (what a pre-PR-9 client sends) must parse to (no trace, no
+    deadline) — the server treats it as an unbounded solve, never an error.
+    And the deadline trailer itself round-trips by shape+dtype, whatever
+    order the trailers arrive in."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    ctx_arr = np.frombuffer(
+        bytes(rng.randrange(256) for _ in range(24)), np.int32
+    )
+    remaining = rng.uniform(0.001, 60.0)
+    deadline_arr = np.asarray([remaining], np.float32)
+
+    assert service._parse_trailers([]) == (None, None)
+    ctx, dl = service._parse_trailers([deadline_arr, ctx_arr])
+    assert dl == pytest.approx(remaining, rel=1e-6)
+    assert ctx is not None and ctx.trace_id == ctx_arr.tobytes()[:16].hex()
+    # new-server tolerance: an unrecognized future trailer shape is ignored
+    ctx2, dl2 = service._parse_trailers(
+        [np.zeros(3, np.float64), deadline_arr]
+    )
+    assert ctx2 is None and dl2 == pytest.approx(remaining, rel=1e-6)
+
+
 def test_known_bad_documents_rejected():
     base = serde.to_wire("provisioners", random_provisioner(random.Random(1)))
     bad_op = json.loads(json.dumps(base))

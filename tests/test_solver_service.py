@@ -390,6 +390,214 @@ class TestSessions:
         assert STATUS_OK != STATUS_NEEDS_CATALOG
 
 
+class TestOverloadControl:
+    """Wire status words STATUS_OVERLOADED / STATUS_DEADLINE_EXCEEDED
+    (docs/overload.md): the bounded admission gate, the propagated-deadline
+    pre-dispatch shed, HBM-pressure gating of new uploads, typed client
+    verdicts, and loud unknown-status failure."""
+
+    def _opened(self, svc):
+        """Open a real session on ``svc``; returns (key, pod-side arrays,
+        n_max)."""
+        from karpenter_tpu.solver.service import _key_array
+
+        args, n_max = encoded_args()
+        args = [np.asarray(a) for a in args]
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        svc.open_session_bytes(
+            pack_arrays([_key_array(key)] + args[N_POD_ARRAYS:])
+        )
+        return key, args[:N_POD_ARRAYS], n_max
+
+    def _solve_frame(self, key, pod_arrays, n_max, deadline_s=None):
+        from karpenter_tpu.solver.service import _key_array
+
+        arrays = [_key_array(key), np.asarray([n_max, 1], np.int32)] + pod_arrays
+        if deadline_s is not None:
+            arrays.append(np.asarray([deadline_s], np.float32))
+        return pack_arrays(arrays)
+
+    def test_full_admission_queue_answers_overloaded_with_hint(self):
+        from karpenter_tpu.solver.service import STATUS_OVERLOADED
+
+        svc = SolverService(
+            max_inflight=1, queue_depth=0, overload_retry_after=0.7,
+        )
+        key, pods, n_max = self._opened(svc)
+        assert svc.admission.enter() == "admitted"  # occupy the one slot
+        try:
+            response = svc.solve_bytes(self._solve_frame(key, pods, n_max))
+            status_arr, *payload = unpack_arrays(response)
+            assert int(status_arr.reshape(-1)[0]) == STATUS_OVERLOADED
+            # the retry-after hint rides the payload
+            assert float(payload[0].reshape(-1)[0]) == pytest.approx(0.7)
+            assert svc.shed["queue_full"] == 1
+            assert svc.dispatches == 0
+        finally:
+            svc.admission.leave()
+        # slot freed: the same frame now solves
+        response = svc.solve_bytes(self._solve_frame(key, pods, n_max))
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_OK
+        assert svc.dispatches == 1
+
+    def test_expired_deadline_sheds_before_device_dispatch(self):
+        from karpenter_tpu.solver.service import STATUS_DEADLINE_EXCEEDED
+
+        svc = SolverService()
+        key, pods, n_max = self._opened(svc)
+        # junk pod arrays prove the shed happens pre-dispatch: they would
+        # crash the solve if it ever reached the kernel
+        junk = [np.zeros(3, np.float32)] * N_POD_ARRAYS
+        response = svc.solve_bytes(
+            self._solve_frame(key, junk, n_max, deadline_s=0.0)
+        )
+        assert (
+            int(unpack_arrays(response)[0].reshape(-1)[0])
+            == STATUS_DEADLINE_EXCEEDED
+        )
+        assert svc.shed["deadline"] == 1
+        assert svc.dispatches == 0
+
+    def test_live_deadline_solves_normally(self):
+        svc = SolverService()
+        key, pods, n_max = self._opened(svc)
+        response = svc.solve_bytes(
+            self._solve_frame(key, pods, n_max, deadline_s=30.0)
+        )
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_OK
+        assert svc.dispatches == 1
+        assert svc.shed["deadline"] == 0
+
+    def test_hbm_floor_refuses_new_uploads_resident_solves_flow(self, monkeypatch):
+        from karpenter_tpu.solver import service as svcmod
+        from karpenter_tpu.solver.service import STATUS_OVERLOADED, _key_array
+
+        svc = SolverService(hbm_floor_bytes=1024)
+        key, pods, n_max = self._opened(svc)  # resident BEFORE the pressure
+        monkeypatch.setattr(svcmod, "publish_device_headroom", lambda: 0)
+        # a NEW catalog generation is refused...
+        args2, _ = encoded_args(n_types=5, n_pods=4, seed=9)
+        args2 = [np.asarray(a) for a in args2]
+        key2 = catalog_session_key(*args2[N_POD_ARRAYS:])
+        assert key2 != key
+        response = svc.open_session_bytes(
+            pack_arrays([_key_array(key2)] + args2[N_POD_ARRAYS:])
+        )
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_OVERLOADED
+        assert svc.shed["hbm_pressure"] == 1
+        assert svc.session_count() == 1
+        # ...while the RESIDENT session's solves keep flowing
+        response = svc.solve_bytes(self._solve_frame(key, pods, n_max))
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_OK
+        # and re-opening the resident key is still a cheap touch, not a shed
+        response = svc.open_session_bytes(
+            pack_arrays(
+                [_key_array(key)]
+                + [np.asarray(a) for a in encoded_args()[0][N_POD_ARRAYS:]]
+            )
+        )
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_OK
+
+    def test_client_raises_typed_verdicts_and_unknown_fails_loud(self):
+        from karpenter_tpu.resilience.overload import (
+            DeadlineExceededError,
+            OverloadedError,
+        )
+        from karpenter_tpu.solver.service import (
+            STATUS_DEADLINE_EXCEEDED,
+            STATUS_OVERLOADED,
+        )
+
+        rs = RemoteSolver.__new__(RemoteSolver)  # no channel needed
+        rs.address = "test:1"
+        with pytest.raises(OverloadedError) as ei:
+            rs._check_status(
+                STATUS_OVERLOADED, [np.asarray([2.5], np.float32)]
+            )
+        assert ei.value.retry_after == 2.5
+        with pytest.raises(DeadlineExceededError):
+            rs._check_status(STATUS_DEADLINE_EXCEEDED, [])
+        with pytest.raises(RuntimeError, match="unknown solver status word 99"):
+            rs._check_status(99, [])
+        rs._check_status(STATUS_OK, [])  # no-op
+        # a hint-less OVERLOADED payload still carries a sane default
+        with pytest.raises(OverloadedError) as ei:
+            rs._check_status(STATUS_OVERLOADED, [])
+        assert ei.value.retry_after == 1.0
+
+    def test_overloaded_over_live_grpc_and_old_frames_interop(self):
+        """End to end over the wire: a full sidecar admission queue raises
+        the typed OverloadedError client-side; an old-style frame (no
+        trailers at all) still solves on the new server."""
+        from karpenter_tpu.resilience.overload import OverloadedError
+
+        address = f"127.0.0.1:{free_port()}"
+        svc = SolverService(
+            max_inflight=1, queue_depth=0, overload_retry_after=0.3,
+        )
+        server = serve(address, service=svc)
+        try:
+            args, n_max = encoded_args()
+            client = RemoteSolver(address, timeout=10)
+            client.pack(*args, n_max=n_max)  # old-client-shaped happy path
+            assert svc.admission.enter() == "admitted"
+            try:
+                with pytest.raises(OverloadedError) as ei:
+                    client.pack(*args, n_max=n_max)
+                assert ei.value.retry_after == pytest.approx(0.3)
+            finally:
+                svc.admission.leave()
+            client.pack(*args, n_max=n_max)  # recovered
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_deadline_propagates_over_live_grpc(self):
+        """The round Budget rides the wire: a request that outlives its
+        budget in the sidecar's admission queue sheds pre-dispatch and the
+        client surfaces the non-retryable verdict. With the capability bit
+        stripped (an old server), the same frame carries no deadline and
+        the solve goes through once the queue frees — rolling-upgrade
+        interop."""
+        import threading
+
+        from karpenter_tpu.resilience import Budget
+        from karpenter_tpu.resilience.overload import DeadlineExceededError
+        from karpenter_tpu.solver.service import PROTO_TRACE_TRAILER
+
+        address = f"127.0.0.1:{free_port()}"
+        svc = SolverService(max_inflight=1, queue_depth=2)
+        server = serve(address, service=svc)
+        try:
+            args, n_max = encoded_args()
+            client = RemoteSolver(address, timeout=10)
+            client.pack(*args, n_max=n_max)  # open the session, learn features
+            assert svc.admission.enter() == "admitted"  # wedge the executor
+            try:
+                with Budget(0.3).activate():  # expires while queued
+                    with pytest.raises(DeadlineExceededError):
+                        client.pack(*args, n_max=n_max)
+                assert svc.shed["deadline"] == 1
+                dispatches = svc.dispatches
+                # an "old server" never advertised PROTO_DEADLINE: the
+                # client must not append the trailer, so the same doomed
+                # budget just queues until the executor frees, then solves
+                with client._lock:
+                    client._server_features = PROTO_TRACE_TRAILER
+                release = threading.Timer(0.5, svc.admission.leave)
+                release.start()
+                with Budget(0.3).activate():
+                    client.pack(*args, n_max=n_max)
+                release.join()
+                assert svc.dispatches == dispatches + 1
+                assert svc.shed["deadline"] == 1  # no further shed
+            finally:
+                pass  # the timer already released the wedge slot
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+
 class TestHealth:
     def test_grpc_and_http_health_flip_on_readiness(self):
         """Readiness is gated on the warmup solve; a not-yet-warm sidecar

@@ -176,6 +176,7 @@ def _load_rules() -> None:
         retry,
         spans,
         tracer,
+        waits,
     )
 
     _rules_loaded = True

@@ -1,0 +1,153 @@
+"""Bounded waits: no timeout-less park on a queue, event, or future.
+
+Overload-control invariant (docs/overload.md): past saturation every
+queue is bounded by DECISION, and every wait must be bounded too — a
+``Queue.get()`` / ``Event.wait()`` / ``Condition.wait()`` /
+``Future.result()`` with no timeout parks its thread until someone else
+behaves, which under overload (a dead sidecar, a wedged flush, a shed
+batch whose gate nobody will ever set) is forever. Production code waits
+with a timeout and re-checks its stop/deadline condition; only tests may
+park unboundedly (the fixture corpus and ``tests/`` are out of scope —
+the analyzer gates ``karpenter_tpu`` only).
+
+Detection is constructor-tracked to stay precise: the rule follows
+assignments of ``threading.Event()`` / ``threading.Condition()`` /
+``queue.Queue()``-family constructors to names and attributes WITHIN a
+file, and flags timeout-less ``.wait()`` / ``.get()`` on those. A
+``.result()`` with no timeout is flagged on any receiver — the only
+stdlib ``result()`` worth calling is ``concurrent.futures.Future``'s,
+and an unbounded one rode the PR-9 incident where a misbehaving gRPC
+transport never resolved its future.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+# constructor dotted-names whose instances park on .wait()
+EVENT_CTORS = {"threading.Event", "threading.Condition", "Event", "Condition"}
+# ...and whose instances park on .get()
+QUEUE_CTORS = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """True when the call bounds itself: any positional arg (both
+    ``Event.wait`` and ``Queue.get`` take timeout positionally — and a
+    positional block=False on get() is equally bounded) or an explicit
+    ``timeout=`` keyword."""
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _target_name(node: ast.AST) -> str:
+    """`self._cv` -> `_cv`, `done` -> `done`, else ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+@register
+class BoundedWaitRule(Rule):
+    name = "bounded-wait"
+    severity = P1
+    doc = (
+        "timeout-less Queue.get() / Event.wait() / Condition.wait() / "
+        "Future.result() outside tests — under overload an unbounded park "
+        "is forever; wait with a timeout and re-check the stop condition."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in self.files(project):
+            waiters, getters = self._tracked(src)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute
+                ):
+                    continue
+                method = node.func.attr
+                recv = _target_name(node.func.value)
+                if method == "result" and not _has_timeout(node):
+                    findings.append(
+                        self.finding(
+                            src.path, node.lineno,
+                            "`.result()` with no timeout parks forever on a "
+                            "misbehaving transport — bound it "
+                            "(`future.result(timeout=...)`)",
+                        )
+                    )
+                elif (
+                    method == "wait"
+                    and recv in waiters
+                    and not _has_timeout(node)
+                ):
+                    findings.append(
+                        self.finding(
+                            src.path, node.lineno,
+                            f"`{recv}.wait()` with no timeout — a shed or "
+                            "crashed setter leaves this thread parked "
+                            "forever; wait a bounded slice and re-check",
+                        )
+                    )
+                elif (
+                    method == "get"
+                    and recv in getters
+                    and not _has_timeout(node)
+                ):
+                    findings.append(
+                        self.finding(
+                            src.path, node.lineno,
+                            f"`{recv}.get()` with no timeout — an idle "
+                            "producer (or a stopped one) blocks this "
+                            "consumer forever; use `get(timeout=...)`",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _tracked(src: SourceFile) -> tuple:
+        """Names/attrs assigned an Event/Condition (waiters) or a Queue
+        (getters) anywhere in this file."""
+        waiters: Set[str] = set()
+        getters: Set[str] = set()
+        for node in ast.walk(src.tree):
+            value = None
+            targets = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = dotted_name(value.func)
+            if ctor is None:
+                continue
+            bucket = (
+                waiters if ctor in EVENT_CTORS
+                else getters if ctor in QUEUE_CTORS
+                else None
+            )
+            if bucket is None:
+                continue
+            for target in targets:
+                name = _target_name(target)
+                if name:
+                    bucket.add(name)
+        return waiters, getters
