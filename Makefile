@@ -76,6 +76,10 @@ overload-chaos:  ## overload-control proof: shed/brownout suites + the >=5x offe
 	$(PY) -m pytest tests/test_overload.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --overload-storm 300
 
+corruption-chaos:  ## pack-integrity proof: checksum/canary/quarantine suites + the 4-mode corruption storm leg
+	$(PY) -m pytest tests/test_integrity.py tests/test_serde_fuzz.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --corruption-storm 200
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -108,5 +112,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos corruption-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -1229,6 +1229,232 @@ def bench_fleet_storm(
             pass
 
 
+def bench_corruption_storm(
+    n_pods: int = 200,
+    pool_size: int = 2,
+    corrupt_rate: float = 0.05,
+    canary_rate: float = 0.25,
+    seed: int = 20260803,
+    breaker_cooloff_s: float = 1.5,
+):
+    """Silent-data-corruption storm (docs/integrity.md): the full runtime
+    provisions against a solver sidecar pool whose SERVING member emits
+    seeded corrupt frames — one phase per mode (payload bit-flip, frame
+    truncation, stale-session replay, NaN injection into the result
+    tensors) at 100% corruption to prove per-mode detection + quarantine
+    latency, then a mixed phase at the configured rate. Wire checksums and
+    the canary cross-check are ON. Acceptance: corrupt_packs_bound=0 /
+    detection_rate=1.0 (no corruption ever reaches a bind — a post-storm
+    cluster scan is the judge), quarantine_within_solves <= 5, and
+    chaos_provision_success_rate=1.0 via ring failover + the native/FFD
+    floor."""
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.solver import integrity
+    from karpenter_tpu.testing.chaos import (
+        CORRUPTION_MODES,
+        ChaosPolicy,
+        SidecarChaos,
+    )
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.utils import resources as res
+
+    t_start = time.perf_counter()
+    # pin the device path: the cost router would (correctly) route these
+    # small batches to native, and a storm that never crosses the wire
+    # proves nothing about wire/device corruption defense
+    packer_before = os.environ.get("KARPENTER_PACKER")
+    os.environ["KARPENTER_PACKER"] = "device"
+    integrity.reset()
+    sidecars = SidecarChaos(n=pool_size)
+    cluster = Cluster()
+    rt = build_runtime(
+        Options(
+            solver_service_address=sidecars.address_spec,
+            pack_checksum=True,
+            canary_rate=canary_rate,
+        ),
+        cluster=cluster,
+        cloud_provider=SimulatedCloudProvider(api=SimCloudAPI()),
+    )
+    rt.manager.start()
+    created = 0
+
+    def create_pods(prefix: str, n: int) -> list:
+        nonlocal created
+        names = []
+        for i in range(n):
+            name = f"{prefix}-{i}"
+            names.append(name)
+            cluster.create(
+                "pods", make_pod(name=name, requests={"cpu": "0.25"})
+            )
+        created += n
+        return names
+
+    def wait_bound(names: list, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        want = set(names)
+        while time.time() < deadline:
+            live = {
+                p.metadata.name: p for p in cluster.pods()
+                if p.metadata.name in want
+            }
+            if len(live) == len(want) and all(
+                p.spec.node_name for p in live.values()
+            ):
+                return
+            time.sleep(0.05)
+
+    try:
+        cluster.create("provisioners", make_provisioner(solver="tpu"))
+        deadline = time.time() + 10
+        while time.time() < deadline and not rt.provisioning.workers:
+            time.sleep(0.02)
+        assert rt.provisioning.workers, "provisioner worker never started"
+        worker = next(iter(rt.provisioning.workers.values()))
+        worker.batcher.idle_duration = 0.1
+
+        # ---- warm: sessions open, compiles done, the ring's serving
+        # member identified (consistent-hash: ONE member owns this catalog)
+        wait_bound(create_pods("warm", 10))
+        victim = sidecars.busiest()
+        # shorten the member-breaker cool-off so four quarantine/recovery
+        # cycles fit a CI-sized run (test-harness reach-in, not a knob)
+        sched = worker.scheduler._tpu
+        assert sched is not None, "TPU scheduler never engaged"
+        pool = sched._remote_or_init()
+        pool._breakers._kwargs["open_seconds"] = breaker_cooloff_s
+        for b in pool._breakers._breakers.values():
+            b.open_seconds = breaker_cooloff_s
+        # restart the serving member behind a chaos proxy (same address —
+        # the ring still routes to it); its sessions drop, the client
+        # re-opens transparently through NEEDS_CATALOG
+        sidecars.restart(victim, policy=ChaosPolicy(seed=seed))
+        proxy = sidecars.proxies[victim]
+        wait_bound(create_pods("rewarm", 6))
+
+        # ---- one phase per corruption mode at 100% injection
+        n_phase = max(n_pods // 8, 10)
+        per_mode = {}
+        for i, mode in enumerate(CORRUPTION_MODES):
+            q0 = integrity.totals().get("quarantines", 0)
+            calls0 = proxy.calls_total("solve_bytes")
+            injected0 = proxy.corrupted_total()
+            proxy.policy = ChaosPolicy(
+                corrupt_rate=1.0, corruption_modes=(mode,),
+                methods=frozenset({"solve_bytes"}), seed=seed + i,
+            )
+            names = create_pods(f"storm-{mode}", n_phase)
+            quarantine_deadline = time.time() + 60
+            calls_at_quarantine = None
+            while time.time() < quarantine_deadline:
+                if integrity.totals().get("quarantines", 0) > q0:
+                    calls_at_quarantine = proxy.calls_total("solve_bytes")
+                    break
+                time.sleep(0.01)
+            # stop corrupting so the phase settles and the member recovers
+            # through its half-open probe before the next phase
+            proxy.policy = ChaosPolicy(seed=seed)
+            wait_bound(names)
+            per_mode[mode] = {
+                "injected": proxy.corrupted_total() - injected0,
+                "quarantined": calls_at_quarantine is not None,
+                "quarantine_within_solves": (
+                    max(calls_at_quarantine - calls0, 1)
+                    if calls_at_quarantine is not None else None
+                ),
+            }
+            time.sleep(breaker_cooloff_s + 0.3)  # half-open re-admission
+
+        # ---- mixed phase at the configured rate, all four modes
+        proxy.policy = ChaosPolicy(
+            corrupt_rate=max(corrupt_rate, 0.01),
+            corruption_modes=CORRUPTION_MODES,
+            methods=frozenset({"solve_bytes"}), seed=seed + 99,
+        )
+        wait_bound(create_pods("mixed", max(n_pods - created, 20)), timeout=180)
+        proxy.policy = ChaosPolicy(seed=seed)
+
+        # ---- settle, then judge: did ANY corrupt pack reach a bind?
+        all_names = [p.metadata.name for p in cluster.pods()]
+        wait_bound(all_names, timeout=60)
+        pods = list(cluster.pods())
+        bound = [p for p in pods if p.spec.node_name]
+        node_names = {n.metadata.name for n in cluster.nodes()}
+        anomalies = []
+        by_node: dict = {}
+        for p in bound:
+            reqs = res.requests_for_pods(p)
+            if any(not math.isfinite(v) for v in reqs.values()):
+                anomalies.append(f"pod {p.metadata.name}: non-finite requests")
+            if p.spec.node_name not in node_names:
+                anomalies.append(
+                    f"pod {p.metadata.name}: bound to missing node "
+                    f"{p.spec.node_name}"
+                )
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        for node in cluster.nodes():
+            members = by_node.get(node.metadata.name, [])
+            if not members or not node.status.allocatable:
+                continue
+            totals = res.merge(*[res.requests_for_pods(p) for p in members])
+            if not res.fits(totals, node.status.allocatable):
+                anomalies.append(
+                    f"node {node.metadata.name}: oversubscribed "
+                    f"({res.to_string(totals)})"
+                )
+        totals = integrity.totals()
+        injected = proxy.corrupted_total()
+        corrupt_packs_bound = len(anomalies)
+        quarantine_within = [
+            m["quarantine_within_solves"] for m in per_mode.values()
+            if m["quarantine_within_solves"] is not None
+        ]
+        return {
+            "pods": created,
+            "pool_size": pool_size,
+            "corrupt_member": victim,
+            "corrupt_rate_mixed_phase": max(corrupt_rate, 0.01),
+            "canary_rate": canary_rate,
+            "pack_checksum": True,
+            "seed": seed,
+            "injected_corruptions": injected,
+            "injected_by_mode": dict(sorted(proxy.corrupted.items())),
+            "per_mode": per_mode,
+            "corrupt_packs_bound": corrupt_packs_bound,
+            "bind_anomalies": anomalies[:5],
+            "detection_rate": (
+                round((injected - corrupt_packs_bound) / injected, 4)
+                if injected else None
+            ),
+            "quarantine_within_solves": (
+                max(quarantine_within) if quarantine_within else None
+            ),
+            "all_modes_quarantined": all(
+                m["quarantined"] for m in per_mode.values()
+            ),
+            "chaos_provision_success_rate": round(
+                len(bound) / max(created, 1), 4
+            ),
+            "integrity_counters": totals,
+            "canary_solves": totals.get("canary_solves", 0),
+            "pool_failovers_total": _sample(
+                m, "karpenter_solver_pool_failovers_total"
+            ),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        if packer_before is None:
+            os.environ.pop("KARPENTER_PACKER", None)
+        else:
+            os.environ["KARPENTER_PACKER"] = packer_before
+        rt.stop()
+        sidecars.stop_all()
+
+
 def bench_crash_storm(
     n_pods: int = 200,
     n_provisioners: int = 4,
@@ -2328,6 +2554,22 @@ def main():
                          "duplicate_launches (bar: 0), adoption latency vs "
                          "the one-GC-period bar, and "
                          "chaos_provision_success_rate (bar: 1.0)")
+    ap.add_argument("--corruption-storm", type=int, metavar="N_PODS", default=0,
+                    help="silent-data-corruption storm: the serving sidecar "
+                         "pool member emits seeded corrupt frames (payload "
+                         "bit-flip, frame truncation, stale-session replay, "
+                         "NaN injection), one 100%%-injection phase per mode "
+                         "+ a mixed phase, with wire checksums and the "
+                         "native canary cross-check ON; reports "
+                         "corrupt_packs_bound (bar: 0), detection_rate "
+                         "(bar: 1.0), quarantine_within_solves (bar: <=5) "
+                         "and chaos_provision_success_rate (bar: 1.0)")
+    ap.add_argument("--corrupt-rate", type=float, default=0.05,
+                    help="mixed-phase corruption probability for "
+                         "--corruption-storm (per-mode phases always run "
+                         "at 1.0)")
+    ap.add_argument("--canary-rate", type=float, default=0.25,
+                    help="canary cross-check fraction for --corruption-storm")
     ap.add_argument("--overload-storm", type=int, metavar="N_PODS", default=0,
                     help="overload-control storm: >=5x the measured "
                          "single-rate capacity at a chaos-slowed sidecar "
@@ -2439,6 +2681,35 @@ def main():
             "unit": "aggregate pods/sec",
             "fleet_ok": ok,
             **{k: v for k, v in r.items() if k != "aggregate_pods_per_sec"},
+        }))
+        return
+
+    if args.corruption_storm:
+        r = bench_corruption_storm(
+            args.corruption_storm,
+            pool_size=args.fleet_pool,
+            corrupt_rate=args.corrupt_rate,
+            canary_rate=args.canary_rate,
+            seed=args.chaos_seed,
+        )
+        ok = (
+            r["corrupt_packs_bound"] == 0
+            and r["detection_rate"] == 1.0
+            and r["all_modes_quarantined"]
+            and (r["quarantine_within_solves"] or 99) <= 5
+            and r["chaos_provision_success_rate"] == 1.0
+        )
+        print(json.dumps({
+            "metric": (
+                f"corruption-storm ({r['pods']} pods, "
+                f"{r['pool_size']}-member pool, 4 corruption modes, "
+                "checksums + canary on)"
+            ),
+            "value": r["detection_rate"],
+            "unit": "corruption detection rate (corrupt packs never bind)",
+            "integrity_ok": ok,
+            **{k: v for k, v in r.items() if k != "detection_rate"},
+            "detection_rate": r["detection_rate"],
         }))
         return
 

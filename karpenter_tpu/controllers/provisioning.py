@@ -72,6 +72,8 @@ class ProvisionerWorker:
         solver_service_address: Optional[str] = None,
         owned: Optional[callable] = None,
         journal=None,
+        pack_checksum: Optional[bool] = None,
+        canary_rate: Optional[float] = None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
@@ -81,7 +83,8 @@ class ProvisionerWorker:
         # breadcrumb crash recovery replays. None = journaling off.
         self.journal = journal
         self.scheduler = scheduler or Scheduler(
-            cluster, solver_service_address=solver_service_address
+            cluster, solver_service_address=solver_service_address,
+            pack_checksum=pack_checksum, canary_rate=canary_rate,
         )
         # bounded, priority-aware admission (docs/overload.md): a full
         # queue sheds the oldest lowest-priority pod instead of growing
@@ -521,12 +524,19 @@ class ProvisioningController:
         solver_service_address: Optional[str] = None,
         ownership=None,
         journal=None,
+        pack_checksum: Optional[bool] = None,
+        canary_rate: Optional[float] = None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
         self.default_solver = default_solver
         self.solver_service_address = solver_service_address
+        # pack-integrity knobs (docs/integrity.md), threaded to every
+        # worker's scheduler; None = the KARPENTER_PACK_CHECKSUM /
+        # KARPENTER_CANARY_RATE env twins
+        self.pack_checksum = pack_checksum
+        self.canary_rate = canary_rate
         self.journal = journal  # write-ahead launch journal, shared by workers
         # fleet.ShardManager (or None = this replica owns everything):
         # reconcile only runs workers for owned shards, and each worker's
@@ -655,6 +665,8 @@ class ProvisioningController:
                     if self.ownership is not None else None
                 ),
                 journal=self.journal,
+                pack_checksum=self.pack_checksum,
+                canary_rate=self.canary_rate,
             )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h

@@ -285,6 +285,10 @@ def build_runtime(
         solver_service_address=options.solver_service_address or None,
         ownership=ownership,
         journal=journal,
+        # pack-integrity knobs (docs/integrity.md): wire checksums on the
+        # sidecar path + the native canary cross-check rate
+        pack_checksum=options.pack_checksum,
+        canary_rate=options.canary_rate,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,

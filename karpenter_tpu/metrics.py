@@ -215,12 +215,17 @@ RESILIENCE_DEADLINE_EXCEEDED = Counter(
 
 # Solver degradation: batches that fell back to the host FFD scheduler
 # because the accelerated path was broken (breaker open) or failed mid-solve.
+# `address` is the pack's PROVENANCE — the pool member (or single sidecar)
+# that served the rejected result, "" for the in-process path — so one bad
+# member's invalid packs attribute to IT instead of smearing across the
+# whole remote path.
 SOLVER_DEGRADED = Counter(
     "degraded_solves_total",
     "Solves served by the FFD fallback because the accelerated path was "
     "unavailable or untrusted, by reason "
-    "(breaker_open/pack_failure/invalid_pack).",
-    ["reason"],
+    "(breaker_open/pack_failure/invalid_pack/integrity_screen/deadline/"
+    "overload) and the serving member's address ('' = in-process).",
+    ["reason", "address"],
     namespace=NAMESPACE,
     subsystem="solver",
     registry=REGISTRY,
@@ -597,6 +602,78 @@ BROWNOUT_TRANSITIONS = Counter(
     "degradation is auditable.",
     ["direction"],
     namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+# Pack integrity (docs/integrity.md): the corruption-defense subsystem's
+# scrape surface. Every counter is labeled by the address the corrupt data
+# is ATTRIBUTED to ("" for the in-process device path) — silent data
+# corruption is only actionable when it names a specific sidecar/device.
+SOLVER_INTEGRITY_CHECKSUM_FAILURES = Counter(
+    "integrity_checksum_failures_total",
+    "Wire frames rejected by the end-to-end checksum (request rejected "
+    "server-side as STATUS_INTEGRITY, response rejected client-side, or "
+    "a frame too mangled to parse under negotiated integrity), by the "
+    "member address the corruption is attributed to.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_INTEGRITY_SESSION_MISMATCHES = Counter(
+    "integrity_session_mismatches_total",
+    "Pack responses that echoed a DIFFERENT catalog session key than the "
+    "solve was dispatched against (stale-session replay, store rollback, "
+    "evict/re-open race) — rejected before decode, recovered via a forced "
+    "re-open.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_INTEGRITY_CANARY_SOLVES = Counter(
+    "integrity_canary_solves_total",
+    "Device/pool packs re-solved on the in-process native packer off the "
+    "hot path and compared (the --canary-rate cross-check).",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_INTEGRITY_CANARY_MISMATCHES = Counter(
+    "integrity_canary_mismatches_total",
+    "Canary cross-checks where the native re-solve DISAGREED with the "
+    "served pack — a plausible-shaped but wrong result (silent data "
+    "corruption); the serving member is quarantined.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_INTEGRITY_SCREEN_FAILURES = Counter(
+    "integrity_screen_failures_total",
+    "Accelerated pack results that failed the host-side NaN/bounds screen "
+    "(non-finite node requests, assignment outside the node table, "
+    "impossible node counts) before decode.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_INTEGRITY_QUARANTINES = Counter(
+    "integrity_quarantines_total",
+    "Integrity quarantines fired: a member (or the in-process shape class) "
+    "breaker forced OPEN by a corruption verdict — checksum failure, "
+    "canary mismatch, screen failure, or session mismatch that survived "
+    "the re-open.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
     registry=REGISTRY,
 )
 

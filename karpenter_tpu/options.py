@@ -17,6 +17,18 @@ def _env(key: str, default: str) -> str:
     return os.environ.get(key, default)
 
 
+def env_bool(key: str, default: bool = False) -> bool:
+    """One spelling of the boolean env contract — shared with consumers
+    that read the twin directly (e.g. TpuScheduler's integrity knobs), so
+    the accepted literals can't drift between parsers."""
+    return _env(key, "true" if default else "false").strip().lower() == "true"
+
+
+def env_float(key: str, default: float = 0.0) -> float:
+    raw = _env(key, "").strip()
+    return float(raw) if raw else default
+
+
 @dataclass
 class Options:
     cluster_name: str = field(default_factory=lambda: _env("CLUSTER_NAME", ""))
@@ -37,7 +49,7 @@ class Options:
         default_factory=lambda: _env("SOLVER_SERVICE_ADDRESS", "")
     )  # empty = in-process
     consolidation_enabled: bool = field(
-        default_factory=lambda: _env("KARPENTER_CONSOLIDATION", "false").lower() == "true"
+        default_factory=lambda: env_bool("KARPENTER_CONSOLIDATION")
     )
     # evict-mode retirement pacing: nodes retired per reconcile wave
     consolidation_wave_size: int = field(
@@ -75,7 +87,7 @@ class Options:
     log_level: str = field(default_factory=lambda: _env("LOG_LEVEL", "info"))
     # end-to-end tracing (karpenter_tpu/obs): span pipeline + /debug/traces
     trace_enabled: bool = field(
-        default_factory=lambda: _env("KARPENTER_TRACE", "true").lower() == "true"
+        default_factory=lambda: env_bool("KARPENTER_TRACE", default=True)
     )
     # slow-solve flight recorder: capped on-disk ring of over-budget solve
     # traces + router/breaker/session state; empty = disabled
@@ -91,12 +103,22 @@ class Options:
         default_factory=lambda: float(_env("KARPENTER_SLO_WINDOW", "300"))
     )
     slo_config: str = field(default_factory=lambda: _env("KARPENTER_SLO_CONFIG", ""))
+    # pack integrity (docs/integrity.md): per-frame checksums on the v3
+    # solver wire (capability-gated — off keeps the wire byte-identical),
+    # and the fraction of device/pool solves re-solved on the in-process
+    # native packer and compared (0 disables the canary)
+    pack_checksum: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_PACK_CHECKSUM")
+    )
+    canary_rate: float = field(
+        default_factory=lambda: env_float("KARPENTER_CANARY_RATE")
+    )
     # SLO-driven brownout ladder (resilience/brownout.py): when an
     # objective burns, walk the ordered degradation ladder (pause probes/
     # consolidation -> shrink admission window -> bias native -> shed
     # low-priority queue) instead of letting the queues decide what drops
     brownout_enabled: bool = field(
-        default_factory=lambda: _env("KARPENTER_BROWNOUT", "true").lower() == "true"
+        default_factory=lambda: env_bool("KARPENTER_BROWNOUT", default=True)
     )
     brownout_interval: float = field(
         default_factory=lambda: float(_env("KARPENTER_BROWNOUT_INTERVAL", "5"))
@@ -131,6 +153,8 @@ class Options:
             errs.append("SLO window must be positive seconds")
         if self.brownout_interval <= 0:
             errs.append("brownout tick interval must be positive seconds")
+        if not 0.0 <= self.canary_rate <= 1.0:
+            errs.append("canary rate must be a fraction in [0, 1]")
         if self.slo_config:
             # a typo'd objective must fail startup, not silently never
             # evaluate — parse the whole file eagerly
@@ -219,6 +243,21 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         "('' = built-in defaults; docs/observability.md has the grammar)",
     )
     ap.add_argument(
+        "--pack-checksum",
+        action=argparse.BooleanOptionalAction,
+        default=opts.pack_checksum,
+        help="end-to-end frame checksums on the v3 solver wire "
+        "(capability-gated on PROTO_CHECKSUM, so mixed-version fleets "
+        "interop; a mismatch quarantines the member — docs/integrity.md)",
+    )
+    ap.add_argument(
+        "--canary-rate", type=float, default=opts.canary_rate,
+        help="fraction of device/pool solves re-solved on the in-process "
+        "native packer off the hot path and compared; a mismatch "
+        "quarantines the serving member (0 disables; pauses while the "
+        "brownout ladder has probes paused)",
+    )
+    ap.add_argument(
         "--brownout",
         action=argparse.BooleanOptionalAction,
         default=opts.brownout_enabled,
@@ -268,6 +307,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         flight_budget_ms=ns.flight_budget_ms,
         slo_window=ns.slo_window,
         slo_config=ns.slo_config,
+        pack_checksum=ns.pack_checksum,
+        canary_rate=ns.canary_rate,
         brownout_enabled=ns.brownout,
         brownout_interval=ns.brownout_interval,
     )

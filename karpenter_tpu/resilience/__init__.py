@@ -30,6 +30,7 @@ from karpenter_tpu.resilience.brownout import (  # noqa: F401
     BrownoutController,
     LEVEL_NAMES as BROWNOUT_LEVEL_NAMES,
 )
+from karpenter_tpu.resilience.integrity import IntegrityError  # noqa: F401
 from karpenter_tpu.resilience.liveness import MissTracker  # noqa: F401
 from karpenter_tpu.resilience.markers import idempotent, is_idempotent  # noqa: F401
 from karpenter_tpu.resilience.overload import (  # noqa: F401

@@ -1,0 +1,42 @@
+"""Typed integrity verdicts (docs/integrity.md).
+
+Stdlib-only on purpose, exactly like :mod:`resilience.overload`: the solver
+sidecar's trimmed images import these through ``solver/service.py``, so the
+module must not pull the metrics registry or any third-party dependency.
+
+An :class:`IntegrityError` is the corruption-defense subsystem's one typed
+verdict: a frame that failed its end-to-end checksum, a response the codec
+could not parse while integrity checking was negotiated, a Pack echoing the
+WRONG catalog session key even after a forced re-open, or a pack result
+that failed the host-side NaN/bounds screen. It is deliberately NOT a
+subclass of the overload verdicts — overload is backpressure (retry
+elsewhere, or later); corruption is a correctness failure whose source must
+be quarantined:
+
+- **never retryable on the same member** — the pool fails the solve over
+  to the next ring member and fires ``CircuitBreaker.trip()`` (the
+  immediate-OPEN correctness edge, not the windowed availability path) on
+  the member that produced the corrupt frame;
+- **always loud** — a checksum mismatch raises, it never degrades into a
+  silently wrong array the way a tolerated mis-parse would.
+"""
+
+from __future__ import annotations
+
+
+class IntegrityError(RuntimeError):
+    """A wire frame or pack result failed an end-to-end integrity check.
+
+    ``address`` names the peer the corrupt data is attributed to (empty
+    for the in-process path); ``kind`` says which defense layer fired:
+    ``checksum`` (frame digest mismatch, either side), ``frame`` (the
+    codec could not parse a frame while integrity was negotiated —
+    truncation), ``session`` (a Pack echoed the wrong catalog session key
+    even after a forced re-open), ``screen`` (host-side NaN/bounds screen)
+    or ``canary`` (the native cross-check disagreed with the served pack).
+    """
+
+    def __init__(self, message: str, address: str = "", kind: str = "checksum"):
+        super().__init__(message)
+        self.address = address
+        self.kind = kind

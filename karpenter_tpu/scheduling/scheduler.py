@@ -24,19 +24,27 @@ class Scheduler:
         cluster: Cluster,
         rng: Optional[random.Random] = None,
         solver_service_address: Optional[str] = None,
+        pack_checksum: Optional[bool] = None,
+        canary_rate: Optional[float] = None,
     ):
         self.cluster = cluster
         self.ffd = FFDScheduler(cluster, rng=rng)
         self._tpu = None  # built lazily: importing jax is not free
         self._rng = rng
         self._service_address = solver_service_address
+        # corruption defense (docs/integrity.md): wire checksums + canary
+        # cross-check rate, threaded to the TPU backend (None = env twins)
+        self._pack_checksum = pack_checksum
+        self._canary_rate = canary_rate
 
     def _tpu_scheduler(self):
         if self._tpu is None:
             from karpenter_tpu.solver.backend import TpuScheduler
 
             self._tpu = TpuScheduler(
-                self.cluster, rng=self._rng, service_address=self._service_address
+                self.cluster, rng=self._rng, service_address=self._service_address,
+                pack_checksum=self._pack_checksum,
+                canary_rate=self._canary_rate,
             )
         return self._tpu
 

@@ -23,6 +23,7 @@ from karpenter_tpu.api.objects import NodeSelectorRequirement, Pod
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.resilience.integrity import IntegrityError
 from karpenter_tpu.resilience.overload import (
     DeadlineExceededError,
     OverloadedError,
@@ -110,8 +111,32 @@ class TpuScheduler:
         cluster: Cluster,
         rng: Optional[random.Random] = None,
         service_address: Optional[str] = None,
+        pack_checksum: Optional[bool] = None,
+        canary_rate: Optional[float] = None,
     ):
+        from karpenter_tpu.options import env_bool, env_float
+
         self.cluster = cluster
+        # corruption defense (docs/integrity.md): per-frame wire checksums
+        # on the sidecar path (capability-gated; off keeps the wire
+        # byte-identical), and the canary cross-check rate — the fraction
+        # of device/pool solves re-solved on the in-process native packer
+        # off the hot path and compared. None = the env twins (one parser,
+        # options.py's), so bench legs and tests can flip them without
+        # re-plumbing constructors.
+        self.pack_checksum = (
+            bool(pack_checksum) if pack_checksum is not None
+            else env_bool("KARPENTER_PACK_CHECKSUM")
+        )
+        self.canary_rate = (
+            float(canary_rate) if canary_rate is not None
+            else env_float("KARPENTER_CANARY_RATE")
+        )
+        # seeded so a bench/test run's canary sampling is reproducible;
+        # the rate, not the sequence, is the contract
+        self._canary_rng = random.Random(0xCA7A17)  # guarded-by: self._canary_lock
+        self._canary_thread: Optional[threading.Thread] = None  # guarded-by: self._canary_lock
+        self._canary_lock = threading.Lock()
         self.topology = Topology(cluster, rng=rng)
         self._ffd_fallback = FFDScheduler(cluster, rng=rng)
         # remote sidecar transport (SURVEY §5.8); None = in-process kernel
@@ -182,6 +207,11 @@ class TpuScheduler:
         obs.register_state("pack_breakers_open", self._pack_breakers.open_dependencies)
         obs.register_state("remote_breaker", lambda: self._remote_breaker.state)
         obs.register_state("session_cache", session_stats.snapshot)
+        # the integrity panel: checksum/canary/screen/quarantine counters
+        # at incident time — the first question after a quarantine fires
+        from karpenter_tpu.solver import integrity as _integrity
+
+        obs.register_state("integrity", _integrity.snapshot)
 
     def _pack(self, batch: enc.EncodedBatch):
         """BEGIN the packing solve (called under the solve lock): route by
@@ -593,15 +623,22 @@ class TpuScheduler:
                         # exhausted (solver/pool.py)
                         from karpenter_tpu.solver.pool import SolverPool
 
-                        self._remote = SolverPool(
+                        pool = SolverPool(
                             self.service_address.split(","),
                             timeout=REMOTE_SOLVE_TIMEOUT,
+                            checksum=self.pack_checksum,
                         )
+                        # integrity quarantines fired inside the pool
+                        # surface as cluster Warning events through the
+                        # scheduler (the pool has no cluster handle)
+                        pool.on_quarantine = self._integrity_event
+                        self._remote = pool
                     else:
                         from karpenter_tpu.solver.service import RemoteSolver
 
                         self._remote = RemoteSolver(
-                            self.service_address, timeout=REMOTE_SOLVE_TIMEOUT
+                            self.service_address, timeout=REMOTE_SOLVE_TIMEOUT,
+                            checksum=self.pack_checksum,
                         )
         return self._remote
 
@@ -617,6 +654,123 @@ class TpuScheduler:
             "solver service %s failed (%s); in-process kernel for %.0fs",
             self.service_address, e, REMOTE_BREAKER_SECONDS,
         )
+
+    # -- integrity (docs/integrity.md) ---------------------------------------
+
+    def _integrity_event(self, reason: str, address: str, detail: str) -> None:
+        """Every quarantine is a cluster Warning event: an operator must
+        see 'this member produced corrupt data' next to the pods it almost
+        mis-scheduled, not only on a dashboard."""
+        try:
+            from karpenter_tpu.kube.events import recorder_for
+
+            recorder_for(self.cluster).event(
+                "Solver", address or "in-process", "IntegrityQuarantine",
+                f"pack integrity violation ({reason}): {detail} — "
+                "docs/integrity.md has the runbook",
+                type="Warning",
+            )
+        except Exception:
+            logger.debug("integrity event write failed", exc_info=True)
+
+    def _remote_integrity_failure(self, e: IntegrityError) -> None:
+        """Corruption attributed to the single configured sidecar (a pool
+        quarantines its own member internally and never re-raises
+        IntegrityError): quarantine it — ``trip()``, the immediate-OPEN
+        correctness edge — and let the caller serve in-process."""
+        logger.error(
+            "solver service %s quarantined for corruption (%s); in-process "
+            "kernel for %.0fs", self.service_address, e, REMOTE_BREAKER_SECONDS,
+        )
+        self._quarantine_source(
+            e.address or self.service_address or "", e.kind, str(e)
+        )
+
+    def _quarantine_source(
+        self, address: str, reason: str, detail: str, batch=None
+    ) -> None:
+        """Quarantine whatever produced a corrupt pack RESULT (screen,
+        canary, invalid decoded plan), attributed by the pack's provenance:
+        a pool member's own breaker when the solve named one (one bad
+        member must not poison the whole remote path), the single-sidecar
+        remote breaker otherwise, and the shape class's pack breaker for
+        the in-process device path (local SDC has no address to blame)."""
+        from karpenter_tpu.solver import integrity as integ
+
+        remote = self._remote
+        if address and remote is not None and hasattr(remote, "quarantine"):
+            # pool member: trips, records, and fires the event hook
+            remote.quarantine(address, reason, detail)
+            return
+        if address and self.service_address:
+            self._remote_breaker.trip()
+            metrics.SOLVER_BREAKER_OPEN.labels(
+                address=self.service_address
+            ).set(1)
+            metrics.SOLVER_BREAKER_TRIPS.labels(
+                address=self.service_address
+            ).inc()
+        elif batch is not None:
+            self._pack_breakers.get(
+                "pack:" + "x".join(map(str, self._route_key(batch)))
+            ).trip()
+        integ.record_quarantine(address, reason, detail)
+        self._integrity_event(reason, address, detail)
+
+    def _maybe_canary(self, batch: enc.EncodedBatch, result, prof) -> None:
+        """Start the canary cross-check for a fraction of device/pool
+        solves: re-solve the SAME encoded batch on the in-process native
+        packer OFF the hot path (daemon thread, at most one in flight —
+        the shadow-probe discipline) and compare. Brownout-aware: while
+        the router's probes are paused (ladder rung >= 1), the canary —
+        pure verification spend — pauses with them."""
+        if self.canary_rate <= 0 or prof.get("packer_backend") != "device":
+            return
+        if self.router.probes_paused():
+            return
+        from karpenter_tpu.solver import native
+
+        if not native.native_available():
+            return
+        address = str(prof.get("solver_address") or "")
+        with self._canary_lock:
+            if self._canary_rng.random() >= self.canary_rate:
+                return
+            if self._canary_thread is not None and self._canary_thread.is_alive():
+                return  # previous canary still comparing; sample the next draw
+            t = threading.Thread(
+                target=self._canary_check, args=(batch, result, address),
+                name="karpenter-integrity-canary", daemon=True,
+            )
+            self._canary_thread = t
+            # started under the lock, like the shadow probe: is_alive() is
+            # False for an assigned-but-unstarted thread
+            t.start()
+
+    def _canary_check(self, batch: enc.EncodedBatch, result, address: str) -> None:
+        """The canary body (synchronous — tests call it directly): native
+        re-solve at the SAME node-table size, exact compare, quarantine the
+        serving member on disagreement."""
+        from karpenter_tpu.solver import integrity as integ
+        from karpenter_tpu.solver import native
+
+        try:
+            n_max = int(np.asarray(result[1]).shape[0])  # node_sig is [n_max]
+            reference = native.pack_native(*batch.pack_args(), n_max=n_max)
+            diff = integ.compare_results(result, reference, n_pods=batch.n_pods)
+        except Exception:
+            # a canary that cannot run proves nothing either way — it must
+            # never fail a healthy solve
+            logger.debug("integrity canary re-solve failed", exc_info=True)
+            return
+        integ.record_canary(address, mismatch=diff is not None)
+        if diff is None:
+            return
+        logger.error(
+            "integrity canary mismatch (%s) for pack served by %s; "
+            "quarantining", diff, address or "in-process",
+        )
+        self._quarantine_source(address, "canary", diff, batch=batch)
 
     def _pack_once_begin(
         self, args, p: int, n_max: int, prof: dict, record: bool = True
@@ -650,6 +804,11 @@ class TpuScheduler:
                     "in-process kernel serves this batch",
                     self.service_address, e.retry_after,
                 )
+            except IntegrityError as e:
+                # corruption at dispatch/open time: quarantine (trip, not
+                # the windowed path) and solve in-process — never a retry
+                # against transport that just lied about its bytes
+                self._remote_integrity_failure(e)
             except Exception as e:
                 self._remote_failure(e)
             else:
@@ -664,6 +823,13 @@ class TpuScheduler:
                             "retry after %.2fs); in-process kernel fallback",
                             self.service_address, e.retry_after,
                         )
+                        return self._pack_local_begin(args, p, n_max, prof)()
+                    except IntegrityError as e:
+                        # corrupt response frame or a wrong-session echo
+                        # that survived the forced re-open: quarantine and
+                        # re-solve in-process — the corrupt bytes never
+                        # reach decode
+                        self._remote_integrity_failure(e)
                         return self._pack_local_begin(args, p, n_max, prof)()
                     except Exception as e:
                         self._remote_failure(e)
@@ -792,7 +958,7 @@ class TpuScheduler:
                 "pack:" + "x".join(map(str, self._route_key(batch)))
             )
             if not breaker.allow():
-                metrics.SOLVER_DEGRADED.labels(reason="breaker_open").inc()
+                metrics.SOLVER_DEGRADED.labels(reason="breaker_open", address="").inc()
                 prof["packer_backend"] = "ffd-degraded"
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             try:
@@ -809,7 +975,7 @@ class TpuScheduler:
                     "deadline" if isinstance(e, DeadlineExceededError)
                     else "overload"
                 )
-                metrics.SOLVER_DEGRADED.labels(reason=reason).inc()
+                metrics.SOLVER_DEGRADED.labels(reason=reason, address="").inc()
                 logger.warning(
                     "accelerated pack shed (%s); FFD floor serves this batch",
                     e,
@@ -818,7 +984,7 @@ class TpuScheduler:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
             except Exception:
                 breaker.record_failure()
-                metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
+                metrics.SOLVER_DEGRADED.labels(reason="pack_failure", address="").inc()
                 logger.exception(
                     "accelerated pack failed; FFD fallback serves this batch"
                 )
@@ -840,7 +1006,7 @@ class TpuScheduler:
                 "deadline" if isinstance(e, DeadlineExceededError)
                 else "overload"
             )
-            metrics.SOLVER_DEGRADED.labels(reason=reason).inc()
+            metrics.SOLVER_DEGRADED.labels(reason=reason, address="").inc()
             logger.warning(
                 "accelerated pack shed (%s); FFD floor serves this batch", e,
             )
@@ -849,13 +1015,39 @@ class TpuScheduler:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
         except Exception:
             breaker.record_failure()
-            metrics.SOLVER_DEGRADED.labels(reason="pack_failure").inc()
+            metrics.SOLVER_DEGRADED.labels(reason="pack_failure", address="").inc()
             logger.exception(
                 "accelerated pack failed; FFD fallback serves this batch"
             )
             prof["packer_backend"] = "ffd-degraded"
             # the FFD floor shares per-scheduler state (the fallback
             # scheduler, pod selector snapshots): take the lock back
+            with self._solve_lock:
+                return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
+        # host-side NaN/bounds screen over the RAW result, before decode
+        # can launder non-finite totals into a plausible-looking plan: a
+        # checksummed frame proves the bytes crossed intact, not that an
+        # SDC-afflicted device computed them correctly (docs/integrity.md).
+        # Runs on EVERY accelerated solve — µs of numpy against a >1ms
+        # decode — so detection never depends on the sampled canary.
+        from karpenter_tpu.solver import integrity as integ
+
+        screen = integ.screen_result(result, n_pods=batch.n_pods)
+        if screen:
+            address = str(prof.get("solver_address") or "")
+            integ.record_screen_failure(address)
+            self._quarantine_source(address, "screen", screen, batch=batch)
+            # provenance label: one vocabulary with the integrity counters
+            # ("local" for the in-process path), so a per-address join
+            # across the two families matches
+            metrics.SOLVER_DEGRADED.labels(
+                reason="integrity_screen", address=address or "local"
+            ).inc()
+            logger.error(
+                "accelerated pack failed the integrity screen (%s); source "
+                "quarantined, FFD fallback serves this batch", screen,
+            )
+            prof["packer_backend"] = "ffd-degraded"
             with self._solve_lock:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
         breaker.record_success()
@@ -876,20 +1068,31 @@ class TpuScheduler:
         # host-side sanity check BEFORE the plan reaches the launch/bind
         # path: a bad device/remote solve (bit flips on the wire, a kernel
         # regression, a corrupted session) must never produce an invalid
-        # bind. Violations quarantine the shape class outright — this is a
-        # correctness failure, not an availability blip, so the breaker
-        # trips immediately instead of waiting out its failure-rate window.
+        # bind. Violations quarantine BY PROVENANCE — the serving pool
+        # member's breaker when the pack names one (one bad member must not
+        # poison the whole remote path), the shape class outright for the
+        # in-process path — and this is a correctness failure, not an
+        # availability blip, so the trip is immediate, never the windowed
+        # failure rate.
         violation = self._validate_pack(nodes, pods, daemon)
         if violation:
-            breaker.trip()
-            metrics.SOLVER_DEGRADED.labels(reason="invalid_pack").inc()
+            address = str(prof.get("solver_address") or "")
+            self._quarantine_source(address, "invalid_pack", violation, batch=batch)
+            metrics.SOLVER_DEGRADED.labels(
+                reason="invalid_pack", address=address or "local"
+            ).inc()
             logger.error(
-                "accelerated pack produced an invalid plan (%s); shape class "
+                "accelerated pack produced an invalid plan (%s); source "
                 "quarantined, FFD fallback serves this batch", violation,
             )
             prof["packer_backend"] = "ffd-degraded"
             with self._solve_lock:
                 return self._ffd_degrade(constraints, instance_types, pods, daemon, plan)
+        # canary cross-check (docs/integrity.md): a sampled fraction of
+        # device/pool solves is re-solved on the native packer off the hot
+        # path and compared — the layer that catches a plausible-shaped,
+        # screen-clean pack computed from corrupt inputs
+        self._maybe_canary(batch, result, prof)
         return nodes
 
     @staticmethod

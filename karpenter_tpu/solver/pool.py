@@ -33,10 +33,12 @@ from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu import metrics
+from karpenter_tpu.resilience.integrity import IntegrityError
 from karpenter_tpu.resilience.overload import (
     DeadlineExceededError,
     OverloadedError,
 )
+from karpenter_tpu.solver import integrity
 from karpenter_tpu.solver.service import (
     N_POD_ARRAYS,
     CatalogKeyMemo,
@@ -115,6 +117,7 @@ class SolverPool:
         breaker_open_seconds: float = MEMBER_BREAKER_SECONDS,
         client_factory: Optional[Callable[[str], RemoteSolver]] = None,
         clock: Callable[[], float] = time.monotonic,
+        checksum: bool = False,
     ):
         addresses = [a.strip() for a in addresses if a.strip()]
         self._clock = clock
@@ -124,13 +127,17 @@ class SolverPool:
         self._cold_timeout = cold_timeout
         self._client_factory = client_factory or (
             lambda addr: RemoteSolver(
-                addr, timeout=timeout, cold_timeout=cold_timeout
+                addr, timeout=timeout, cold_timeout=cold_timeout,
+                checksum=checksum,
             )
         )
         from karpenter_tpu.resilience import BreakerBoard
 
-        # one breaker per member address; the board handles lazy creation
+        # one breaker per member address; the board handles lazy creation.
+        # The pool's clock is the breakers' clock — an injected test clock
+        # must drive the cool-off too, or half-open recovery is untestable.
         self._breakers = BreakerBoard(
+            clock=clock,
             window=1, min_volume=1, failure_rate=0.5,
             open_seconds=breaker_open_seconds,
         )
@@ -145,6 +152,10 @@ class SolverPool:
         self._backoff_until: Dict[str, float] = {}  # guarded-by: self._mu
         self.overload_skips = 0  # guarded-by: self._mu
         self._mu = threading.Lock()
+        # integrity-quarantine hook (reason, address, detail): the owning
+        # scheduler points this at its cluster-event emitter so every
+        # quarantine lands as a Warning event, not only a log line
+        self.on_quarantine: Optional[Callable[[str, str, str], None]] = None
 
     # -- members ------------------------------------------------------------
     def _client(self, address: str) -> RemoteSolver:
@@ -171,6 +182,35 @@ class SolverPool:
         self._breaker(address).record_success()
         metrics.SOLVER_BREAKER_OPEN.labels(address=address).set(0)
         self._publish_available()
+
+    def quarantine(self, address: str, reason: str, detail: str = "") -> None:
+        """Integrity quarantine (docs/integrity.md): force the member's
+        breaker OPEN immediately — ``trip()``, the correctness edge, not
+        the windowed availability path — because the member produced
+        CORRUPT data (checksum failure, canary mismatch, screen failure,
+        stale-session replay). Half-open probes re-admit it after the
+        cool-off exactly like an availability trip; a member that is still
+        corrupting re-quarantines on its first probe-served solve."""
+        self._breaker(address).trip()
+        metrics.SOLVER_BREAKER_OPEN.labels(address=address).set(1)
+        metrics.SOLVER_BREAKER_TRIPS.labels(address=address).inc()
+        integrity.record_quarantine(address, reason, detail)
+        logger.error(
+            "solver pool member %s QUARANTINED (%s): %s",
+            address, reason, detail,
+        )
+        hook = self.on_quarantine
+        if hook is not None:
+            try:
+                hook(reason, address, detail)
+            except Exception:
+                logger.debug("quarantine hook failed", exc_info=True)
+        self._publish_available()
+
+    def _member_corrupt(self, address: str, exc: IntegrityError) -> None:
+        """An integrity verdict attributed to this member: quarantine and
+        (the caller) reroutes — never a retry on the same member."""
+        self.quarantine(address, exc.kind, str(exc))
 
     def _member_overloaded(self, address: str, retry_after: float) -> None:
         """Soft breaker: sit the member out for its own retry-after hint.
@@ -268,6 +308,14 @@ class SolverPool:
                 self._count_overload_skip(address)
                 hints.append(e.retry_after)
                 continue
+            except IntegrityError as e:
+                # corrupt frame at dispatch/open time: quarantine THIS
+                # member (trip, not windowed failure) and try the next —
+                # non-retryable on the same member by construction
+                last_exc = e
+                self._member_corrupt(address, e)
+                self._count_failover(address)
+                continue
             except Exception as e:
                 last_exc = e
                 self._member_failure(address, e)
@@ -314,6 +362,14 @@ class SolverPool:
                 return self._failover(
                     address, remaining, inputs, n_max, prof, record, e,
                     failed_is_overloaded=True,
+                )
+            except IntegrityError as e:
+                # corruption discovered at FETCH time (checksum/session
+                # guard fired inside the member's wait): quarantine the
+                # member and re-solve synchronously on the rest of the ring
+                self._member_corrupt(address, e)
+                return self._failover(
+                    address, remaining, inputs, n_max, prof, record, e
                 )
             except Exception as e:
                 self._member_failure(address, e)
@@ -367,6 +423,11 @@ class SolverPool:
                     self._count_overload_skip(address)
                     hints.append(e.retry_after)
                     failed, failed_is_overloaded = address, True
+                    continue
+                except IntegrityError as e:
+                    last_exc = e
+                    self._member_corrupt(address, e)
+                    failed, failed_is_overloaded = address, False
                     continue
                 except Exception as e:
                     last_exc = e

@@ -74,6 +74,19 @@ device dispatch — which the client treats as non-retryable, straight to
 its FFD floor. New-session uploads are additionally refused under an HBM
 headroom floor (``--hbm-floor-bytes``) while resident-session solves keep
 flowing.
+
+**End-to-end integrity** (docs/integrity.md): with ``--pack-checksum`` on
+and the sidecar advertising ``PROTO_CHECKSUM``, every Pack exchange carries
+a blake2b-64 frame checksum both ways (one more array in the ordinary
+framing, digest over everything between the header and the trailer) and
+the response echoes the catalog session key it was solved against. A
+digest mismatch — either side — is a typed
+:class:`~karpenter_tpu.resilience.integrity.IntegrityError`, never a
+silently wrong array; a wrong-session echo is audited and recovered
+through the NEEDS_CATALOG machinery (one forced re-open, then
+IntegrityError). Both are NON-retryable on the same member: the pool
+quarantines the member (``CircuitBreaker.trip`` — the correctness edge)
+and fails the solve over through the ring.
 """
 
 from __future__ import annotations
@@ -89,9 +102,10 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-# stdlib-only submodule import: the typed overload verdicts must exist in
-# the sidecar's trimmed images too (resilience/__init__ would pull the
-# metrics registry)
+# stdlib-only submodule imports: the typed overload/integrity verdicts must
+# exist in the sidecar's trimmed images too (resilience/__init__ would pull
+# the metrics registry)
+from karpenter_tpu.resilience.integrity import IntegrityError
 from karpenter_tpu.resilience.overload import (
     DeadlineExceededError,
     OverloadedError,
@@ -122,6 +136,11 @@ STATUS_OK = 0
 STATUS_NEEDS_CATALOG = 1
 STATUS_DEADLINE_EXCEEDED = 2
 STATUS_OVERLOADED = 3
+# INTEGRITY: the request frame failed its end-to-end checksum server-side —
+# the bytes that arrived are not the bytes that were sent. Typed and
+# non-retryable-on-the-SAME-member client-side (the pool quarantines the
+# path and fails over; retrying corrupt transport would be a coin flip).
+STATUS_INTEGRITY = 4
 
 # capability bits a sidecar advertises in its OpenSession RESPONSE payload
 # (old clients never read that payload; old servers never send it — the one
@@ -132,7 +151,21 @@ STATUS_OVERLOADED = 3
 # remaining-budget trailer the same way (docs/overload.md).
 PROTO_TRACE_TRAILER = 1
 PROTO_DEADLINE = 2
-PROTO_FEATURES = PROTO_TRACE_TRAILER | PROTO_DEADLINE
+# PROTO_CHECKSUM gates the integrity feature pair (docs/integrity.md): a
+# per-frame blake2b-64 checksum trailer on Pack requests/responses, and the
+# Pack response echoing the catalog session key it was solved against. Both
+# would crash or silently confuse an old peer's positional parse, so the
+# client engages them only after seeing this bit — the same rolling-upgrade
+# contract as the trace/deadline trailers.
+PROTO_CHECKSUM = 4
+PROTO_FEATURES = PROTO_TRACE_TRAILER | PROTO_DEADLINE | PROTO_CHECKSUM
+
+# Pack-request flags (optional third word of the n_max array; old servers
+# read words 0-1 and ignore the rest, and the client only sends it after
+# the server advertised PROTO_CHECKSUM anyway): bit 0 asks the server to
+# echo the session key the solve ran against — the client's stale-session /
+# wrong-catalog-generation guard.
+PACK_FLAG_ECHO_SESSION = 1
 
 # admission-control defaults (docs/overload.md): the executor admits
 # max_inflight concurrent solves, queues queue_depth more, and refuses the
@@ -261,6 +294,103 @@ def unpack_arrays(data: bytes) -> List[np.ndarray]:
         offset += n_bytes
         out.append(arr)
     return out
+
+
+# ---------------------------------------------------------------------------
+# frame checksums (docs/integrity.md)
+# ---------------------------------------------------------------------------
+#
+# The integrity trailer is one more array in the ordinary v3 framing — an
+# i32[3] whose first word is a magic marker and whose remaining 8 bytes are
+# a blake2b-64 digest of everything BETWEEN the fixed header and the
+# trailer's own header (frame[8:trailer]). Appending it only rewrites the
+# count word at offset 6, which the digest deliberately excludes:
+#
+# - a flip in magic/version fails loudly at the codec already;
+# - a flip anywhere in [8, trailer) changes digested bytes → mismatch;
+# - a flip in the count word either breaks the parse (count grew past the
+#   buffer) or drops the trailer from the parse (count shrank) — and a
+#   frame that NEGOTIATED checksums but arrives without one is rejected as
+#   "missing", so shrinking the count cannot smuggle a silent change;
+# - a flip inside the trailer itself un-marks it (missing) or breaks the
+#   digest (mismatch).
+#
+# Verification walks only the array HEADERS (no array materialization), so
+# it is O(frame bytes) in the one blake2b pass.
+
+CHECKSUM_MAGIC = 0x4B53554D  # "MUSK" little-endian; spells KSUM on the wire
+CHECKSUM_WORDS = 3  # [magic, digest_lo, digest_hi]
+_I32_CODE = _DTYPE_CODES[np.dtype(np.int32)]
+
+
+def append_checksum(frame: bytes) -> bytes:
+    """Return ``frame`` with the integrity trailer appended (count word
+    bumped; every other byte of the original frame unchanged)."""
+    digest = hashlib.blake2b(frame[8:], digest_size=8).digest()
+    count = struct.unpack_from("<H", frame, 6)[0]
+    trailer = (
+        struct.pack("<BBI", _I32_CODE, 1, CHECKSUM_WORDS)
+        + struct.pack("<i", CHECKSUM_MAGIC)
+        + digest
+    )
+    return frame[:6] + struct.pack("<H", count + 1) + frame[8:] + trailer
+
+
+def _checksum_span(frame: bytes) -> Tuple[Optional[int], Optional[bytes]]:
+    """Walk the framing headers; ``(trailer_header_offset, digest)`` when
+    the LAST declared array is an integrity trailer, else ``(None, None)``.
+    Raises like :func:`unpack_arrays` on malformed framing — a frame too
+    broken to walk is a loud codec error, never a quiet "missing"."""
+    if frame[:4] != MAGIC:
+        raise ValueError("bad magic")
+    version, count = struct.unpack_from("<HH", frame, 4)
+    if version != VERSION:
+        raise ValueError(f"unsupported version {version}")
+    offset = 8
+    last = None
+    for _ in range(count):
+        header = offset
+        code, ndim = struct.unpack_from("<BB", frame, offset)
+        offset += 2
+        shape = struct.unpack_from(f"<{ndim}I", frame, offset)
+        offset += 4 * ndim
+        dtype = _DTYPES[code]
+        n_bytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        payload = offset
+        offset += n_bytes
+        if offset > len(frame):
+            raise ValueError("truncated frame")
+        last = (header, code, shape, payload)
+    if last is None:
+        return None, None
+    header, code, shape, payload = last
+    if code == _I32_CODE and shape == (CHECKSUM_WORDS,):
+        if struct.unpack_from("<i", frame, payload)[0] == CHECKSUM_MAGIC:
+            return header, frame[payload + 4:payload + 12]
+    return None, None
+
+
+def verify_checksum(frame: bytes) -> str:
+    """``"ok"`` / ``"missing"`` / ``"mismatch"``. Malformed framing raises
+    (codec-level loudness); whether ``"missing"`` is acceptable is the
+    caller's negotiation state — a peer that agreed to checksums and sends
+    none is as rejected as one whose digest disagrees."""
+    header, digest = _checksum_span(frame)
+    if header is None:
+        return "missing"
+    computed = hashlib.blake2b(frame[8:header], digest_size=8).digest()
+    return "ok" if computed == digest else "mismatch"
+
+
+def is_checksum_array(a: np.ndarray) -> bool:
+    """True for the integrity trailer once it has been through the codec —
+    how parsers strip it before positional payload interpretation."""
+    a = np.asarray(a)
+    return (
+        a.dtype == np.int32
+        and a.shape == (CHECKSUM_WORDS,)
+        and int(a[0]) == CHECKSUM_MAGIC
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +628,10 @@ class SolverService:
         self.shed: dict = {
             "queue_full": 0, "deadline": 0, "hbm_pressure": 0,
         }  # guarded-by: self._stats_lock
+        # request frames rejected for a checksum mismatch, by method — the
+        # sidecar's own view of wire corruption (the client attributes the
+        # same failure to this member's address on its scrape)
+        self.checksum_failures: dict = {}  # guarded-by: self._stats_lock
         self._stats_lock = threading.Lock()
         # key -> [device-resident (join, frontiers, daemon), last_used, fresh];
         # Pack handler threads race OpenSession handler threads on it.
@@ -525,6 +659,30 @@ class SolverService:
             STATUS_OVERLOADED,
             [np.asarray([self.overload_retry_after], np.float32)],
         )
+
+    # -- integrity ----------------------------------------------------------
+
+    def _reject_corrupt(self, method: str) -> bytes:
+        """The request's bytes are not the bytes the client sent: refuse
+        with the typed status instead of solving against garbage. The
+        response IS checksummed — the client negotiated integrity (it sent
+        a digest), so it will require one on the way back too."""
+        with self._stats_lock:
+            self.checksum_failures[method] = (
+                self.checksum_failures.get(method, 0) + 1
+            )
+        logger.error(
+            "%s request failed frame checksum; rejecting (STATUS_INTEGRITY)",
+            method,
+        )
+        return append_checksum(_status_response(STATUS_INTEGRITY))
+
+    @staticmethod
+    def _seal(response: bytes, checksummed: bool) -> bytes:
+        """Checksum the response iff the request carried a (valid)
+        checksum — symmetric negotiation with zero extra round trips, and
+        an unchecksummed (old-client) exchange stays byte-identical."""
+        return append_checksum(response) if checksummed else response
 
     # -- sessions -----------------------------------------------------------
 
@@ -566,8 +724,43 @@ class SolverService:
         from karpenter_tpu import obs
         from karpenter_tpu.solver import session_stats
 
+        # wire integrity (docs/integrity.md): a corrupted upload must never
+        # pin garbage catalog tensors a whole fleet of delta solves would
+        # then trust — reject before touching the store or the device
+        try:
+            verdict = verify_checksum(request)
+        except ValueError as e:
+            if "version" in str(e) or "magic" in str(e):
+                raise  # version skew stays a LOUD protocol error (v1→v2 contract)
+            return self._reject_corrupt("open_session")
+        except Exception:
+            # otherwise unparseable framing IS corruption: answer the typed
+            # status (the client quarantines the path) instead of crashing
+            # the handler into a generic transport error
+            return self._reject_corrupt("open_session")
+        if verdict == "mismatch":
+            return self._reject_corrupt("open_session")
+        checksummed = verdict == "ok"
         key_arr, join_table, frontiers, daemon, *rest = unpack_arrays(request)
+        rest = [a for a in rest if not is_checksum_array(a)]
         key = key_arr.tobytes()
+        # content-address verification: the claimed key must BE the hash of
+        # the uploaded tensors, or every delta solve under this key would
+        # run against tensors the key does not describe (a corrupt client
+        # memo — wire corruption is the checksum's job). Once per catalog
+        # generation, same blake2b the client already paid.
+        computed = catalog_session_key(join_table, frontiers, daemon)
+        if computed != key:
+            with self._stats_lock:
+                self.checksum_failures["open_session_key"] = (
+                    self.checksum_failures.get("open_session_key", 0) + 1
+                )
+            logger.error(
+                "session open claims key %s but tensors hash to %s; "
+                "rejecting (STATUS_INTEGRITY)",
+                key.hex()[:12], computed.hex()[:12],
+            )
+            return self._seal(_status_response(STATUS_INTEGRITY), checksummed)
         record = bool(rest[0].reshape(-1)[0]) if rest else True
         ctx = _ctx_from_array(rest[1]) if len(rest) > 1 else None
         with self._sessions_lock:
@@ -577,8 +770,11 @@ class SolverService:
                 self._sessions.move_to_end(key)
                 self._evict_sessions_locked()
         if hit is not None:
-            return _status_response(
-                STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+            return self._seal(
+                _status_response(
+                    STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+                ),
+                checksummed,
             )
         # HBM-pressure gate (docs/overload.md): a NEW catalog upload is the
         # one request that grows device residency — below the headroom
@@ -592,7 +788,7 @@ class SolverService:
                     "refusing session open %s: device headroom %d under "
                     "floor %d", key.hex()[:12], headroom, self.hbm_floor_bytes,
                 )
-                return self._overloaded_response()
+                return self._seal(self._overloaded_response(), checksummed)
         if ctx is not None:
             # the catalog upload is the session protocol's one heavy moment —
             # traced as the sidecar's own child span (linked to the client's
@@ -640,9 +836,13 @@ class SolverService:
             publish_device_headroom()
             logger.info("solver session opened (catalog key %s)", key.hex()[:12])
         # capability advertisement rides every OpenSession response: the
-        # client gates its Pack trace trailer on PROTO_TRACE_TRAILER
-        return _status_response(
-            STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+        # client gates its Pack trace trailer on PROTO_TRACE_TRAILER (and
+        # the integrity pair on PROTO_CHECKSUM)
+        return self._seal(
+            _status_response(
+                STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+            ),
+            checksummed,
         )
 
     def session_count(self) -> int:
@@ -721,8 +921,27 @@ class SolverService:
         refuses work past its caps (``STATUS_OVERLOADED`` + retry hint),
         and a propagated deadline is re-checked AFTER queueing so
         already-doomed work sheds before it ever touches the device
-        (``STATUS_DEADLINE_EXCEEDED`` — non-retryable client-side)."""
-        arrays = unpack_arrays(request)
+        (``STATUS_DEADLINE_EXCEEDED`` — non-retryable client-side).
+
+        Wire integrity (docs/integrity.md) brackets everything: a request
+        whose checksum disagrees is refused with ``STATUS_INTEGRITY``
+        before any byte of it is trusted, and when the request carried a
+        checksum the response carries one back."""
+        try:
+            verdict = verify_checksum(request)
+        except ValueError as e:
+            if "version" in str(e) or "magic" in str(e):
+                raise  # version skew stays a LOUD protocol error (v1→v2 contract)
+            return self._reject_corrupt("pack")
+        except Exception:
+            # otherwise unparseable framing IS corruption (truncation,
+            # header flips): the typed refusal, never a handler crash the
+            # client would book as a windowed availability failure
+            return self._reject_corrupt("pack")
+        if verdict == "mismatch":
+            return self._reject_corrupt("pack")
+        checksummed = verdict == "ok"
+        arrays = [a for a in unpack_arrays(request) if not is_checksum_array(a)]
         trailer = arrays[2 + N_POD_ARRAYS:]
         ctx, deadline_s = _parse_trailers(trailer)
         deadline = (
@@ -732,18 +951,20 @@ class SolverService:
         outcome = self.admission.enter(deadline)
         if outcome == "deadline":
             self._count_shed("deadline")
-            return _status_response(STATUS_DEADLINE_EXCEEDED)
+            return self._seal(_status_response(STATUS_DEADLINE_EXCEEDED), checksummed)
         if outcome == "overloaded":
             self._count_shed("queue_full")
-            return self._overloaded_response()
+            return self._seal(self._overloaded_response(), checksummed)
         try:
             if deadline is not None and self._clock() >= deadline:
                 # the budget died while this request sat in the admission
                 # queue: shed BEFORE device dispatch — the round it
                 # belonged to has already degraded to its FFD floor
                 self._count_shed("deadline")
-                return _status_response(STATUS_DEADLINE_EXCEEDED)
-            return self._solve_admitted(arrays, ctx)
+                return self._seal(
+                    _status_response(STATUS_DEADLINE_EXCEEDED), checksummed
+                )
+            return self._seal(self._solve_admitted(arrays, ctx), checksummed)
         finally:
             self.admission.leave()
 
@@ -764,6 +985,13 @@ class SolverService:
         # stats (shadow probes, saturation re-dispatches — one logical
         # solve must count once, matching the in-process path)
         record = bool(vals[1]) if vals.size > 1 else True
+        # optional third word (PROTO_CHECKSUM peers only): feature flags —
+        # bit 0 asks for the session-key echo so the client can reject a
+        # wrong-catalog-generation pack instead of decoding it
+        flags = int(vals[2]) if vals.size > 2 else 0
+        echo = (
+            [_key_array(key)] if flags & PACK_FLAG_ECHO_SESSION else []
+        )
         record_hit = False
         with self._sessions_lock:
             hit = self._sessions.get(key)
@@ -794,7 +1022,7 @@ class SolverService:
             # one fused device→host transfer on the sidecar too — per-array
             # fetches each pay the full device round trip
             buf = jax.device_get(kernel.fuse_result(result))
-            return _status_response(STATUS_OK, [np.asarray(buf)])
+            return _status_response(STATUS_OK, [np.asarray(buf), *echo])
         # traced solve: child spans around solve/fetch/serialize make the
         # sidecar's half of the RTT attributable. The spans land in THIS
         # process's trace ring (GET /debug/traces on the sidecar health
@@ -814,15 +1042,20 @@ class SolverService:
             fetch_s = time.perf_counter() - t0
             t0 = time.perf_counter()
             response = _status_response(
-                STATUS_OK, [np.asarray(buf), np.zeros(3, np.float32)]
+                STATUS_OK, [np.asarray(buf), np.zeros(3, np.float32), *echo]
             )
             serialize_s = time.perf_counter() - t0
             sp.add_child_record("sidecar.serialize", serialize_s)
-            # the trailer is the LAST array: its 12 payload bytes end the
-            # message, so the measured durations (serialize included —
-            # which by then has happened) patch in place
-            response = response[:-12] + struct.pack(
-                "<3f", solve_s, fetch_s, serialize_s
+            # the stage trailer's 12 payload bytes sit right before the
+            # (fixed-width: 22-byte) session echo when one was asked for,
+            # else they end the message — so the measured durations
+            # (serialize included, which by then has happened) patch in
+            # place at a computable offset
+            tail = len(response) - (22 if echo else 0)
+            response = (
+                response[:tail - 12]
+                + struct.pack("<3f", solve_s, fetch_s, serialize_s)
+                + response[tail:]
             )
         return response
 
@@ -979,11 +1212,23 @@ class RemoteSolver:
     # re-open on its next use
     OPENED_MAX = 64
 
-    def __init__(self, address: str, timeout: float = 30.0, cold_timeout: float = 180.0):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        cold_timeout: float = 180.0,
+        checksum: bool = False,
+    ):
         import grpc
 
         self.address = address
         self.timeout = timeout
+        # end-to-end frame integrity (docs/integrity.md): when enabled AND
+        # the sidecar advertised PROTO_CHECKSUM, Pack exchanges carry a
+        # blake2b trailer both ways and the response must echo the session
+        # key it solved against. OpenSession requests carry the trailer
+        # unconditionally (old servers' variadic tail ignores it).
+        self.checksum = bool(checksum)
         # first call per (P, n_max) shape must cover the sidecar's XLA
         # compile; later calls get the short deadline
         self.cold_timeout = cold_timeout
@@ -1048,9 +1293,17 @@ class RemoteSolver:
             # a variadic tail and ignore extra arrays
             arrays.append(_trace_ctx_array(span.context))
         request = pack_arrays(arrays)
+        if self.checksum:
+            # also safe on any server (variadic tail); a PROTO_CHECKSUM
+            # server verifies it and checksums its response in kind
+            request = append_checksum(request)
+        with self._lock:
+            require = bool(
+                self.checksum and (self._server_features & PROTO_CHECKSUM)
+            )
         with obs.tracer().span("solver.wire_open", attrs={"address": self.address}):
             response = self._open_call(request, timeout=timeout)
-        status, payload = self._split_status(response)
+        status, payload = self._receive_open(response, require)
         if status == STATUS_OVERLOADED:
             # HBM pressure or admission refusal: typed so the pool's soft
             # breaker (and the scheduler's local fallback) can tell
@@ -1060,9 +1313,10 @@ class RemoteSolver:
                 retry_after=self._retry_after(payload),
             )
         if status != STATUS_OK:
-            raise RuntimeError(
-                f"unknown OpenSession status word {status} from {self.address}"
-            )
+            # typed verdicts (a corrupt OPEN request answers
+            # STATUS_INTEGRITY → IntegrityError, which the pool turns into
+            # a quarantine, not a windowed failure) + loud unknowns
+            self._check_status(status, payload)
         features = int(payload[0].reshape(-1)[0]) if payload else 0
         with self._lock:
             self._server_features = features
@@ -1076,7 +1330,106 @@ class RemoteSolver:
     @staticmethod
     def _split_status(response: bytes) -> Tuple[int, List[np.ndarray]]:
         status_arr, *payload = unpack_arrays(response)
+        # the integrity trailer is transport framing, not payload
+        payload = [a for a in payload if not is_checksum_array(a)]
         return int(status_arr.reshape(-1)[0]), payload
+
+    def _receive(self, response: bytes, require_checksum: bool) -> Tuple[int, List[np.ndarray]]:
+        """Verify-then-parse one Pack response frame. With integrity
+        negotiated (``require_checksum``) a missing or disagreeing digest —
+        or a frame too mangled to parse at all — is a typed
+        :class:`IntegrityError` attributed to this member; without it, a
+        present-but-wrong digest still fails (free defense), and parse
+        errors propagate raw.
+
+        One deliberate tolerance: a cleanly-parsing UNsealed
+        ``NEEDS_CATALOG`` is the rollback signature — a member restarted
+        on a pre-checksum build has an empty session store AND no seal —
+        and its only effect is the forced re-open, which IS the
+        capability-renegotiation channel (:meth:`_receive_open` decides
+        there whether the downgrade is legitimate). Coherently rewriting a
+        sealed frame into this shape would require re-framing, which
+        random corruption does not do, and the worst it buys is one
+        redundant re-open — never a decoded array."""
+        try:
+            verdict = verify_checksum(response)
+            status, payload = self._split_status(response)
+        except Exception as e:
+            if require_checksum:
+                self._record_checksum_failure()
+                raise IntegrityError(
+                    f"solver {self.address} sent an unparseable frame ({e})",
+                    address=self.address, kind="frame",
+                ) from e
+            raise
+        if verdict == "mismatch" or (
+            verdict == "missing"
+            and require_checksum
+            and status != STATUS_NEEDS_CATALOG
+        ):
+            self._record_checksum_failure()
+            raise IntegrityError(
+                f"solver {self.address} response failed frame checksum "
+                f"({verdict})",
+                address=self.address, kind="checksum",
+            )
+        return status, payload
+
+    def _receive_open(self, response: bytes, require_checksum: bool) -> Tuple[int, List[np.ndarray]]:
+        """:meth:`_receive` with one extra tolerance: a cleanly-parsing
+        UNchecksummed OpenSession response whose features word no longer
+        advertises ``PROTO_CHECKSUM`` is a legitimate rollback to a
+        pre-checksum build, NOT corruption — the open response IS the
+        capability-negotiation channel (exactly as trusted as the original
+        negotiation was), and refusing it would quarantine a healthy,
+        merely older member until this process restarts. A response that
+        still claims ``PROTO_CHECKSUM`` while omitting its negotiated
+        trailer — or any digest mismatch — stays fatal: stripping a
+        trailer coherently requires rewriting the framing, which random
+        corruption does not do."""
+        try:
+            verdict = verify_checksum(response)
+            status, payload = self._split_status(response)
+        except Exception as e:
+            if require_checksum:
+                self._record_checksum_failure()
+                raise IntegrityError(
+                    f"solver {self.address} sent an unparseable open "
+                    f"response ({e})",
+                    address=self.address, kind="frame",
+                ) from e
+            raise
+        if verdict == "mismatch":
+            self._record_checksum_failure()
+            raise IntegrityError(
+                f"solver {self.address} open response failed frame checksum",
+                address=self.address, kind="checksum",
+            )
+        if verdict == "missing" and require_checksum:
+            features = (
+                int(payload[0].reshape(-1)[0])
+                if status == STATUS_OK and payload else 0
+            )
+            if features & PROTO_CHECKSUM:
+                self._record_checksum_failure()
+                raise IntegrityError(
+                    f"solver {self.address} advertises PROTO_CHECKSUM but "
+                    "sent no frame checksum",
+                    address=self.address, kind="checksum",
+                )
+            logger.warning(
+                "solver %s no longer advertises PROTO_CHECKSUM; disabling "
+                "frame checksums toward this member", self.address,
+            )
+        return status, payload
+
+    def _record_checksum_failure(self) -> None:
+        try:
+            from karpenter_tpu.solver import integrity
+
+            integrity.record_checksum_failure(self.address)
+        except Exception:
+            pass  # trimmed registries
 
     @staticmethod
     def _retry_after(payload: List[np.ndarray]) -> float:
@@ -1101,6 +1454,16 @@ class RemoteSolver:
             raise OverloadedError(
                 f"solver {self.address} refused the solve (overloaded)",
                 retry_after=self._retry_after(payload),
+            )
+        if status == STATUS_INTEGRITY:
+            # the REQUEST arrived corrupt server-side: same quarantine
+            # semantics as a corrupt response — the path, not the payload,
+            # is what's broken, so never retry it on this member
+            self._record_checksum_failure()
+            raise IntegrityError(
+                f"solver {self.address} rejected a corrupt request frame "
+                "(checksum mismatch server-side)",
+                address=self.address, kind="checksum",
             )
         raise RuntimeError(
             f"unknown solver status word {status} from {self.address}"
@@ -1142,8 +1505,17 @@ class RemoteSolver:
         from karpenter_tpu import obs
 
         t0 = time.perf_counter()
+        with self._lock:
+            features = self._server_features
+        # integrity pair (docs/integrity.md), gated like every other
+        # capability: frame checksums both ways + the session-key echo that
+        # rejects a wrong-catalog-generation pack before decode
+        integrity_on = bool(self.checksum and (features & PROTO_CHECKSUM))
+        vals = [n_max, 1 if record else 0]
+        if integrity_on:
+            vals.append(PACK_FLAG_ECHO_SESSION)
         arrays = [
-            _key_array(key), np.asarray([n_max, 1 if record else 0], np.int32)
+            _key_array(key), np.asarray(vals, np.int32)
         ] + [np.asarray(a) for a in pod_side]
         # optional trailers, each capability-gated on the bits the sidecar
         # advertised in its OpenSession response — an untraced (or
@@ -1155,13 +1527,14 @@ class RemoteSolver:
         #   clocks never agree across the wire), so the sidecar can shed
         #   already-doomed work before device dispatch (PROTO_DEADLINE)
         span = obs.tracer().current()
-        with self._lock:
-            features = self._server_features
         if span is not None and (features & PROTO_TRACE_TRAILER):
             arrays.append(_trace_ctx_array(span.context))
         if budget is not None and (features & PROTO_DEADLINE):
             arrays.append(np.asarray([budget.remaining()], np.float32))
         request = pack_arrays(arrays)
+        if integrity_on:
+            # LAST, over the final bytes: the digest covers every trailer
+            request = append_checksum(request)
         if prof is not None:
             prof["wire_ser_s"] = (
                 prof.get("wire_ser_s", 0.0) + time.perf_counter() - t0
@@ -1176,44 +1549,91 @@ class RemoteSolver:
                 # `timeout` in every healthy case, the slack only bounds a
                 # misbehaving transport (karplint bounded-wait)
                 response = future.result(timeout=timeout + 5.0)
-                status, payload = self._split_status(response)
-                if status == STATUS_NEEDS_CATALOG:
-                    # sidecar restarted or evicted this catalog: re-open and
-                    # retry ONCE, synchronously (the overlap is already lost)
+                buf = stage = None
+                # integrity expectation for THIS exchange; the forced
+                # re-open below refreshes it, so a member rolled back to a
+                # pre-checksum build recovers on the in-flight retry
+                # instead of waiting out another breaker cool-off
+                require = integrity_on
+                for attempt in (0, 1):
+                    status, payload = self._receive(response, require)
+                    if status == STATUS_NEEDS_CATALOG:
+                        reason = "not resident"
+                    else:
+                        if status != STATUS_OK:
+                            # typed verdicts (deadline/overload/integrity)
+                            # + loud unknowns
+                            wsp.set_attribute("status", status)
+                            self._check_status(status, payload)
+                        buf, stage, echoed = self._parse_pack_payload(payload)
+                        if not require or echoed in (None, key):
+                            break
+                        # session-generation guard (docs/integrity.md): the
+                        # sidecar solved against a DIFFERENT catalog
+                        # generation (concurrent evict/re-open race, store
+                        # rollback, replayed response) — never decode a
+                        # wrong-catalog pack; audit, then recover through
+                        # the NEEDS_CATALOG machinery
+                        reason = "wrong-session echo"
+                        try:
+                            from karpenter_tpu.solver import integrity
+
+                            integrity.record_session_mismatch(self.address)
+                        except Exception:
+                            pass  # trimmed registries
+                        logger.warning(
+                            "solver %s echoed session %s for a solve against "
+                            "%s; re-opening", self.address,
+                            echoed.hex()[:12], key.hex()[:12],
+                        )
+                    if attempt == 1:
+                        if reason == "wrong-session echo":
+                            raise IntegrityError(
+                                f"solver {self.address} kept answering with "
+                                f"the wrong catalog session (want "
+                                f"{key.hex()[:12]})",
+                                address=self.address, kind="session",
+                            )
+                        # fail loud: something is evicting faster than we
+                        # open (session_max=0, or a thrashing key) — the
+                        # caller's breaker turns this into the in-process
+                        # fallback
+                        raise RuntimeError(
+                            "solver session re-open did not take "
+                            f"(catalog key {key.hex()[:12]})"
+                        )
+                    # sidecar restarted, evicted this catalog, or served the
+                    # wrong generation: re-open and retry ONCE, synchronously
+                    # (the overlap is already lost)
                     logger.info(
-                        "solver session %s not resident; re-opening", key.hex()[:12]
+                        "solver session %s %s; re-opening",
+                        key.hex()[:12], reason,
                     )
                     wsp.set_attribute("needs_catalog_retry", True)
                     self._open_session(
                         key, catalog_side, timeout, force=True, record=record
                     )
-                    status, payload = self._split_status(
-                        self._call(request, timeout=timeout)
-                    )
-                    if status == STATUS_NEEDS_CATALOG:
-                        # fail loud: something is evicting faster than we open
-                        # (session_max=0, or a thrashing key) — the caller's
-                        # breaker turns this into the in-process fallback
-                        raise RuntimeError(
-                            "solver session re-open did not take "
-                            f"(catalog key {key.hex()[:12]})"
+                    with self._lock:
+                        # DOWNWARD-only refresh: the server seals iff the
+                        # REQUEST carried a checksum, and the retried
+                        # request is the original bytes — so a re-open
+                        # that just learned PROTO_CHECKSUM (pre-checksum
+                        # member upgraded mid-flight) must not raise the
+                        # expectation above what this request asked for
+                        require = require and bool(
+                            self._server_features & PROTO_CHECKSUM
                         )
-                if status != STATUS_OK:
-                    # typed verdicts (deadline/overload) + loud unknowns
-                    wsp.set_attribute("status", status)
-                    self._check_status(status, payload)
+                    response = self._call(request, timeout=timeout)
                 with self._lock:
                     self._warm_shapes.add(shape)
                 t1 = time.perf_counter()
-                buf = payload[0]
-                if len(payload) > 1:
+                if stage is not None:
                     # the sidecar's stage trailer: graft its half of the RTT
                     # into this tree as completed child records — the
                     # remainder of the wire span is pure transport
-                    vals = np.asarray(payload[1]).reshape(-1)
                     for name, seconds in zip(
                         ("sidecar.solve", "sidecar.fetch", "sidecar.serialize"),
-                        vals[:3],
+                        stage[:3],
                     ):
                         wsp.add_child_record(name, float(seconds))
                 out = split_result(buf, p, n_max, r)
@@ -1221,9 +1641,26 @@ class RemoteSolver:
                     prof["wire_deser_s"] = (
                         prof.get("wire_deser_s", 0.0) + time.perf_counter() - t1
                     )
+                    prof["solver_address"] = self.address  # pack provenance
                 return out
 
         return wait
+
+    @staticmethod
+    def _parse_pack_payload(payload: List[np.ndarray]):
+        """An OK Pack payload → ``(fused buf, stage trailer | None,
+        echoed session key | None)``. Trailers are shape/dtype-addressed
+        (f32[3] = sidecar stages, i32[4] = the 16-byte session echo), so
+        any subset in any order parses — the rolling-upgrade contract."""
+        buf = payload[0]
+        stage = echoed = None
+        for extra in payload[1:]:
+            a = np.asarray(extra).reshape(-1)
+            if a.dtype == np.float32 and a.size == 3:
+                stage = a
+            elif a.dtype == np.int32 and a.size == 4:
+                echoed = a.tobytes()
+        return buf, stage, echoed
 
     def pack(self, *inputs, n_max: int):
         """Synchronous convenience wrapper over ``pack_begin``."""

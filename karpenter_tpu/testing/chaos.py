@@ -47,6 +47,24 @@ CHAOS_METHODS = frozenset({
     "solve_bytes", "open_session_bytes",
 })
 
+# The byte-level corruption surface (docs/integrity.md): silent-data-
+# corruption chaos applies to the solver wire only — the cloud doubles
+# speak python objects, where "corruption" has no byte representation.
+CORRUPT_METHODS = frozenset({"solve_bytes", "open_session_bytes"})
+
+# The four seeded corruption modes the corruption-storm leg must prove are
+# all detected (bench.py --corruption-storm):
+# - bit_flip: one random bit of the request or response frame — what the
+#   checksum layer exists for;
+# - truncate: the frame cut short mid-array — loud at the codec/checksum;
+# - stale_session: the response's echoed session key swapped and the
+#   checksum RECOMPUTED — a checksum-valid wrong-catalog response only the
+#   session-generation guard can reject;
+# - nan_inject: the f32 NaN bit pattern written over the first result word
+#   and the checksum RECOMPUTED — device SDC's shape: a perfectly framed,
+#   checksum-valid pack computed wrong, caught by the host-side screen.
+CORRUPTION_MODES = ("bit_flip", "truncate", "stale_session", "nan_inject")
+
 # exponential p95 = mean * ln(20); invert to calibrate the mean from a p95
 _LN20 = 2.9957322735539909
 
@@ -80,9 +98,18 @@ class ChaosPolicy:
     methods: Optional[frozenset] = None
     # cap one latency sample so a tail draw can't stall a test (× p95)
     latency_cap_factor: float = 4.0
+    # silent-data-corruption injection (CORRUPT_METHODS only): per-call
+    # probability that the frame is corrupted, and the mode pool drawn from
+    corrupt_rate: float = 0.0
+    corruption_modes: Sequence[str] = CORRUPTION_MODES
 
     def applies_to(self, method: str) -> bool:
         if method not in CHAOS_METHODS:
+            return False
+        return self.methods is None or method in self.methods
+
+    def corrupt_applies_to(self, method: str) -> bool:
+        if method not in CORRUPT_METHODS:
             return False
         return self.methods is None or method in self.methods
 
@@ -110,6 +137,8 @@ class ChaosProxy:
         self._rng_mu = threading.Lock()
         self.injected: Dict[str, int] = {}   # method -> injected failures
         self.delayed: Dict[str, int] = {}    # method -> latency injections
+        self.corrupted: Dict[str, int] = {}  # corruption mode -> injections
+        self.calls: Dict[str, int] = {}      # method -> chaos-surface calls
         self._count_mu = threading.Lock()
 
     # -- bookkeeping --------------------------------------------------------
@@ -121,18 +150,57 @@ class ChaosProxy:
         with self._count_mu:
             return sum(self.injected.values())
 
+    def corrupted_total(self) -> int:
+        with self._count_mu:
+            return sum(self.corrupted.values())
+
+    def calls_total(self, method: str = "solve_bytes") -> int:
+        with self._count_mu:
+            return self.calls.get(method, 0)
+
     def elapsed(self) -> float:
         return self._clock() - self._t0
 
     # -- the wrap -----------------------------------------------------------
     def __getattr__(self, name: str):
         attr = getattr(self._delegate, name)
-        if not callable(attr) or not self.policy.applies_to(name):
+        corruptible = callable(attr) and name in CORRUPT_METHODS
+        if not callable(attr) or (
+            not self.policy.applies_to(name) and not corruptible
+        ):
             return attr
 
         def chaotic(*args, **kwargs):
-            self._maybe_disturb(name, args)
-            return attr(*args, **kwargs)
+            if name in CORRUPT_METHODS:
+                self._note(self.calls, name)
+            if self.policy.applies_to(name):
+                self._maybe_disturb(name, args)
+            mode = seed = None
+            request_side = False
+            if (
+                self.policy.corrupt_rate > 0
+                and self.policy.corrupt_applies_to(name)
+            ):
+                with self._rng_mu:
+                    if self._rng.random() < self.policy.corrupt_rate:
+                        mode = self._rng.choice(
+                            list(self.policy.corruption_modes)
+                        )
+                        # bit flips hit either direction; the structured
+                        # modes model a corrupt RESPONSE (stale replay and
+                        # SDC both happen server/device-side)
+                        request_side = (
+                            mode == "bit_flip" and self._rng.random() < 0.5
+                        )
+                        seed = self._rng.randrange(2**31)
+            if mode is not None and request_side:
+                self._note(self.corrupted, mode)
+                return attr(_corrupt_frame(args[0], mode, seed), *args[1:], **kwargs)
+            out = attr(*args, **kwargs)
+            if mode is not None:
+                self._note(self.corrupted, mode)
+                out = _corrupt_frame(out, mode, seed)
+            return out
 
         return chaotic
 
@@ -184,6 +252,110 @@ def chaos_wrap(api, policy: ChaosPolicy, clock=time.monotonic) -> ChaosProxy:
     the bare double went — ``SimulatedCloudProvider(api=...)``,
     ``GkeCloudProvider(api=...)``, ``CloudAPIServer(api=...)``."""
     return ChaosProxy(api, policy, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# silent-data-corruption injectors (docs/integrity.md): each mode is a pure
+# seeded function over one wire frame, so a storm replays bit-for-bit
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_frame(frame: bytes, mode: str, seed: int) -> bytes:
+    if not isinstance(frame, (bytes, bytearray)):
+        return frame  # not a wire frame (already-raised paths)
+    if mode == "truncate":
+        return _truncate(bytes(frame), seed)
+    if mode == "stale_session":
+        return _stale_session(bytes(frame), seed)
+    if mode == "nan_inject":
+        return _nan_inject(bytes(frame), seed)
+    return _bit_flip(bytes(frame), seed)
+
+
+def _bit_flip(frame: bytes, seed: int) -> bytes:
+    """Flip one random bit past the magic/version words (those fail loudly
+    on their own and prove nothing about the checksum layer)."""
+    import random
+
+    rng = random.Random(seed)
+    if len(frame) <= 8:
+        return frame
+    out = bytearray(frame)
+    out[rng.randrange(8, len(out))] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+def _truncate(frame: bytes, seed: int) -> bytes:
+    import random
+
+    rng = random.Random(seed)
+    if len(frame) <= 5:
+        return frame[:1]
+    return frame[:rng.randrange(4, len(frame))]
+
+
+def _stale_session(frame: bytes, seed: int) -> bytes:
+    """Swap the echoed session key for a random one and RECOMPUTE the
+    checksum: a wrong-catalog-generation response that sails through every
+    byte-level check — only the client's session-generation guard can
+    reject it. Frames without an echo degrade to a bit flip."""
+    import random
+
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    try:
+        arrays = service.unpack_arrays(frame)
+    except Exception:
+        return _bit_flip(frame, seed)
+    had_checksum = bool(arrays) and service.is_checksum_array(arrays[-1])
+    arrays = [a for a in arrays if not service.is_checksum_array(a)]
+    swapped = False
+    for i, a in enumerate(arrays):
+        a = np.asarray(a)
+        if i > 0 and a.dtype == np.int32 and a.ndim == 1 and a.size == 4:
+            arrays[i] = np.frombuffer(
+                bytes(rng.randrange(256) for _ in range(16)), np.int32
+            )
+            swapped = True
+            break
+    if not swapped:
+        return _bit_flip(frame, seed)
+    out = service.pack_arrays(arrays)
+    return service.append_checksum(out) if had_checksum else out
+
+
+def _nan_inject(frame: bytes, seed: int) -> bytes:
+    """Write the f32 NaN bit pattern over the first word of the fused
+    result buffer and RECOMPUTE the checksum — the shape real device SDC
+    takes: a perfectly framed, checksum-valid pack whose CONTENT is wrong.
+    Only the host-side screen / canary cross-check can catch it. Frames
+    without a result buffer degrade to a bit flip."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    try:
+        arrays = service.unpack_arrays(frame)
+    except Exception:
+        return _bit_flip(frame, seed)
+    had_checksum = bool(arrays) and service.is_checksum_array(arrays[-1])
+    arrays = [np.array(a) for a in arrays if not service.is_checksum_array(a)]
+    hit = False
+    for i, a in enumerate(arrays):
+        # the fused result buffer: the one big i32 array (f32 totals are
+        # bitcast into it); the status word (size 1), session echo (4) and
+        # trace words (6) are all far smaller
+        if i > 0 and a.dtype == np.int32 and a.ndim == 1 and a.size > 16:
+            a.reshape(-1)[0] = np.float32(np.nan).view(np.int32)
+            hit = True
+            break
+    if not hit:
+        return _bit_flip(frame, seed)
+    out = service.pack_arrays(arrays)
+    return service.append_checksum(out) if had_checksum else out
 
 
 # ---------------------------------------------------------------------------
@@ -279,19 +451,47 @@ class SidecarChaos:
     fail exactly like a SIGKILL'd pod's would. ``restart`` serves the SAME
     address again with a FRESH ``SolverService`` (empty session store), so
     clients that remembered the address's sessions hit NEEDS_CATALOG, the
-    restart-recovery path the pool's failover ladder must absorb."""
+    restart-recovery path the pool's failover ladder must absorb.
 
-    def __init__(self, n: int = 2, max_workers: int = 4):
+    ``policies`` (member index -> :class:`ChaosPolicy`) — or the ``policy``
+    argument to :meth:`restart` — wraps that member's service in a chaos
+    proxy, which is how the corruption-storm leg makes exactly the
+    SERVING member emit corrupt frames; the proxies are kept in
+    ``self.proxies`` so the leg can read injection counters and retarget
+    ``proxy.policy`` between phases."""
+
+    def __init__(
+        self,
+        n: int = 2,
+        max_workers: int = 4,
+        policies: Optional[Dict[int, ChaosPolicy]] = None,
+    ):
         from karpenter_tpu.solver.service import serve
 
         self._serve = serve
         self._max_workers = max_workers
         self.servers: Dict[str, object] = {}
+        self.proxies: Dict[str, ChaosProxy] = {}
         self.addresses: list = []
-        for _ in range(n):
+        for i in range(n):
             address = f"127.0.0.1:{self._free_port()}"
             self.addresses.append(address)
-            self.servers[address] = serve(address, max_workers=max_workers)
+            self.servers[address] = self._serve_member(
+                address, (policies or {}).get(i)
+            )
+
+    def _serve_member(self, address: str, policy: Optional[ChaosPolicy]):
+        from karpenter_tpu.solver.service import SolverService
+
+        service = SolverService()
+        if policy is not None:
+            service = chaos_wrap(service, policy)
+            self.proxies[address] = service
+        else:
+            self.proxies.pop(address, None)
+        return self._serve(
+            address, max_workers=self._max_workers, service=service
+        )
 
     @staticmethod
     def _free_port() -> int:
@@ -319,13 +519,15 @@ class SidecarChaos:
         if server is not None:
             server.stop(grace=0)
 
-    def restart(self, address: str) -> None:
+    def restart(
+        self, address: str, policy: Optional[ChaosPolicy] = None
+    ) -> None:
         """Fresh process-equivalent on the same address: empty session
-        store, immediate readiness."""
+        store, immediate readiness. ``policy`` restarts the member behind
+        a chaos proxy (the corruption-storm leg's way of corrupting the
+        member the ring actually routes to, without moving the ring)."""
         self.kill(address)
-        self.servers[address] = self._serve(
-            address, max_workers=self._max_workers
-        )
+        self.servers[address] = self._serve_member(address, policy)
 
     def stop_all(self) -> None:
         for address in list(self.servers):

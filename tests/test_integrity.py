@@ -1,0 +1,707 @@
+"""End-to-end pack integrity (docs/integrity.md): wire checksums over a
+live gRPC sidecar, the session-generation guard, per-member quarantine and
+ring failover in the pool, the host-side NaN/bounds screen, and the native
+canary cross-check — including the no-false-positive bar on a clean path.
+"""
+
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.resilience.integrity import IntegrityError
+from karpenter_tpu.solver import integrity
+from karpenter_tpu.solver.service import (
+    N_POD_ARRAYS,
+    PROTO_CHECKSUM,
+    STATUS_INTEGRITY,
+    STATUS_OK,
+    RemoteSolver,
+    SolverService,
+    append_checksum,
+    catalog_session_key,
+    is_checksum_array,
+    pack_arrays,
+    unpack_arrays,
+    verify_checksum,
+    _key_array,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_integrity_counters():
+    integrity.reset()
+    yield
+    integrity.reset()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def encoded_batch(n_types: int = 8, n_pods: int = 6, seed: int = 3):
+    """(constraints, catalog, pods, daemon, batch) for a real encode."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(n_types), key=lambda it: it.effective_price())
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cluster = Cluster()
+    Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+    daemon = daemon_overhead(cluster, constraints)
+    batch = enc.encode(constraints, catalog, pods, daemon)
+    return constraints, catalog, pods, daemon, batch
+
+
+# ---------------------------------------------------------------------------
+# wire checksums over a live sidecar
+# ---------------------------------------------------------------------------
+
+
+class TestWireChecksums:
+    def test_checksummed_grpc_round_trip(self):
+        """A checksum-enabled client against a live sidecar: the server
+        advertises PROTO_CHECKSUM, the exchange verifies both ways, the
+        session echo agrees, and the result matches an unchecksummed solve
+        bit-for-bit (integrity must never change the answer)."""
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        try:
+            plain = RemoteSolver(address, checksum=False)
+            sealed = RemoteSolver(address, checksum=True)
+            out_plain = plain.pack(*args, n_max=n_max)
+            out_sealed = sealed.pack(*args, n_max=n_max)
+            assert sealed._server_features & PROTO_CHECKSUM
+            for a, b in zip(out_plain, out_sealed):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert integrity.totals().get("checksum_failures", 0) == 0
+            plain.close()
+            sealed.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_server_rejects_corrupt_checksummed_request(self):
+        """A checksummed Pack frame with one flipped payload bit answers
+        STATUS_INTEGRITY — the server never solves against garbage — and
+        the sidecar's own failure counter moves."""
+        service = SolverService()
+        _, _, _, _, batch = encoded_batch()
+        args = [np.asarray(a) for a in batch.pack_args()]
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        open_resp = service.open_session_bytes(
+            append_checksum(
+                pack_arrays([_key_array(key)] + args[N_POD_ARRAYS:])
+            )
+        )
+        assert verify_checksum(open_resp) == "ok"  # sealed in kind
+        request = append_checksum(
+            pack_arrays(
+                [_key_array(key), np.asarray([len(batch.pod_valid), 1, 1], np.int32)]
+                + args[:N_POD_ARRAYS]
+            )
+        )
+        corrupt = bytearray(request)
+        corrupt[60] ^= 0x10  # payload region
+        response = service.solve_bytes(bytes(corrupt))
+        status = int(unpack_arrays(response)[0].reshape(-1)[0])
+        assert status == STATUS_INTEGRITY
+        assert service.checksum_failures.get("pack") == 1
+        # the clean frame still solves — the path is not poisoned
+        ok = service.solve_bytes(request)
+        arrays = unpack_arrays(ok)
+        assert int(arrays[0].reshape(-1)[0]) == STATUS_OK
+        # and the response carries: checksum (request was sealed) + echo
+        assert is_checksum_array(arrays[-1])
+        echoed = next(
+            np.asarray(a) for a in arrays[1:]
+            if np.asarray(a).dtype == np.int32 and np.asarray(a).size == 4
+        )
+        assert echoed.tobytes() == key
+
+    def test_unchecksummed_exchange_stays_byte_compatible(self):
+        """Old-client interop: a plain v3 exchange against the new server
+        carries no checksum, no echo — byte-identical framing."""
+        service = SolverService()
+        _, _, _, _, batch = encoded_batch()
+        args = [np.asarray(a) for a in batch.pack_args()]
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        service.open_session_bytes(
+            pack_arrays([_key_array(key)] + args[N_POD_ARRAYS:])
+        )
+        response = service.solve_bytes(
+            pack_arrays(
+                [_key_array(key), np.asarray([len(batch.pod_valid)], np.int32)]
+                + args[:N_POD_ARRAYS]
+            )
+        )
+        arrays = unpack_arrays(response)
+        assert int(arrays[0].reshape(-1)[0]) == STATUS_OK
+        assert len(arrays) == 2  # status + buf: no echo, no checksum
+        assert verify_checksum(response) == "missing"
+
+    def test_corrupt_responses_raise_typed_integrity_error(self):
+        """Chaos bit-flips on the wire (either direction): the client's
+        verdict is IntegrityError, never a silently wrong array — and a
+        healed wire recovers without rebuilding the client."""
+        from karpenter_tpu.testing.chaos import ChaosPolicy, chaos_wrap
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        proxy = chaos_wrap(SolverService(), ChaosPolicy())
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address, service=proxy)
+        try:
+            client = RemoteSolver(address, checksum=True)
+            client.pack(*args, n_max=n_max)  # clean warm-up (features learned)
+            proxy.policy = ChaosPolicy(
+                corrupt_rate=1.0, corruption_modes=("bit_flip",), seed=11,
+            )
+            with pytest.raises(IntegrityError):
+                client.pack(*args, n_max=n_max)
+            assert proxy.corrupted_total() >= 1
+            assert integrity.totals().get("checksum_failures", 0) >= 1
+            proxy.policy = ChaosPolicy()
+            out = client.pack(*args, n_max=n_max)  # healed wire serves again
+            assert len(out) == 5
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+
+class OldBuildShim:
+    """The response surface of a pre-checksum sidecar build over the
+    current kernel: no PROTO_CHECKSUM advertisement, never seals, never
+    echoes — what a rolled-back member actually answers with."""
+
+    def __init__(self, service):
+        self._s = service
+
+    def open_session_bytes(self, request):
+        from karpenter_tpu.solver.service import PROTO_CHECKSUM
+
+        arrays = [
+            np.asarray(a)
+            for a in unpack_arrays(self._s.open_session_bytes(request))
+            if not is_checksum_array(a)
+        ]
+        if len(arrays) > 1:
+            arrays[1] = np.array(
+                [int(arrays[1].reshape(-1)[0]) & ~PROTO_CHECKSUM], np.int32
+            )
+        return pack_arrays(arrays)
+
+    def solve_bytes(self, request):
+        arrays = [
+            np.asarray(a)
+            for a in unpack_arrays(self._s.solve_bytes(request))
+            if not is_checksum_array(a)
+        ]
+        arrays = [
+            a for i, a in enumerate(arrays)
+            if i == 0 or not (a.dtype == np.int32 and a.ndim == 1 and a.size == 4)
+        ]
+        return pack_arrays(arrays)
+
+    def __getattr__(self, name):
+        return getattr(self._s, name)
+
+
+class TestVersionSkewRecovery:
+    def test_rollback_to_old_build_recovers_in_flight(self):
+        """Checksum negotiated, then the member restarts on a pre-checksum
+        build: the unsealed NEEDS_CATALOG must fall through to the forced
+        re-open (the renegotiation channel), which accepts the downgrade —
+        the solve completes on the SAME member with zero quarantines."""
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address)
+        client = RemoteSolver(address, checksum=True)
+        try:
+            client.pack(*args, n_max=n_max)  # checksum negotiated
+            assert client._server_features & PROTO_CHECKSUM
+            server.stop(grace=0)
+            server = serve(address, service=OldBuildShim(SolverService()))
+            out = client.pack(*args, n_max=n_max)  # rollback restart
+            assert len(out) == 5
+            totals = integrity.totals()
+            assert totals.get("checksum_failures", 0) == 0
+            assert totals.get("quarantines", 0) == 0
+            assert not (client._server_features & PROTO_CHECKSUM)
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_upgrade_to_new_build_recovers_in_flight(self):
+        """The mirror: negotiated WITHOUT checksums against an old build,
+        member restarts upgraded. The re-open learns PROTO_CHECKSUM but
+        the retried request carried no checksum, so the expectation must
+        not be raised above it — the solve completes, and the NEXT solve
+        negotiates checksums."""
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address, service=OldBuildShim(SolverService()))
+        client = RemoteSolver(address, checksum=True)
+        try:
+            client.pack(*args, n_max=n_max)
+            assert not (client._server_features & PROTO_CHECKSUM)
+            server.stop(grace=0)
+            server = serve(address)  # upgraded restart
+            out = client.pack(*args, n_max=n_max)
+            assert len(out) == 5
+            assert client._server_features & PROTO_CHECKSUM
+            out = client.pack(*args, n_max=n_max)  # now fully sealed
+            assert len(out) == 5
+            totals = integrity.totals()
+            assert totals.get("checksum_failures", 0) == 0
+            assert totals.get("quarantines", 0) == 0
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+
+class TestOpenSessionIntegrity:
+    def test_corrupt_open_request_raises_typed_integrity_error(self):
+        """A corrupt OPEN request must surface as IntegrityError (so the
+        pool quarantines) — not the generic unknown-status RuntimeError
+        that would only record a windowed member failure."""
+        from karpenter_tpu.testing.chaos import ChaosPolicy, chaos_wrap
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        proxy = chaos_wrap(SolverService(), ChaosPolicy())
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address, service=proxy)
+        try:
+            client = RemoteSolver(address, checksum=True)
+            client.pack(*args, n_max=n_max)  # learn features
+            proxy.policy = ChaosPolicy(
+                corrupt_rate=1.0, corruption_modes=("bit_flip",),
+                methods=frozenset({"open_session_bytes"}), seed=2,
+            )
+            with pytest.raises(IntegrityError):
+                # force the open path (fresh client state, features warm
+                # via a clean open first would short-circuit — use force)
+                client._open_session(
+                    catalog_session_key(
+                        *[np.asarray(a) for a in args[N_POD_ARRAYS:]]
+                    ),
+                    args[N_POD_ARRAYS:], timeout=10.0, force=True,
+                )
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_unparseable_request_answers_integrity_not_crash(self):
+        """A corrupt request too mangled to parse (header flip, truncation)
+        must answer STATUS_INTEGRITY like any other corruption — a handler
+        crash would reach the client as a generic transport error and be
+        booked as a windowed availability failure, not a quarantine."""
+        service = SolverService()
+        _, _, _, _, batch = encoded_batch()
+        args = [np.asarray(a) for a in batch.pack_args()]
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        request = append_checksum(
+            pack_arrays(
+                [_key_array(key), np.asarray([len(batch.pod_valid), 1, 1], np.int32)]
+                + args[:N_POD_ARRAYS]
+            )
+        )
+        for corrupt in (
+            request[:8] + b"\xff" + request[9:],  # dtype-code byte mangled
+            request[: len(request) // 2],          # truncated mid-array
+        ):
+            response = service.solve_bytes(bytes(corrupt))
+            assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_INTEGRITY
+        open_req = append_checksum(
+            pack_arrays([_key_array(key)] + args[N_POD_ARRAYS:])
+        )
+        response = service.open_session_bytes(open_req[: len(open_req) - 9])
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_INTEGRITY
+        assert service.session_count() == 0
+
+    def test_wrong_keyed_upload_refused(self):
+        """Content-address verification: an upload whose claimed key does
+        not hash to the tensors answers STATUS_INTEGRITY — a corrupt
+        client memo can never pin tensors the key does not describe."""
+        service = SolverService()
+        _, _, _, _, batch = encoded_batch()
+        args = [np.asarray(a) for a in batch.pack_args()]
+        wrong_key = bytes(16)  # all zeros: hashes to nothing real
+        response = service.open_session_bytes(
+            pack_arrays([_key_array(wrong_key)] + args[N_POD_ARRAYS:])
+        )
+        assert int(unpack_arrays(response)[0].reshape(-1)[0]) == STATUS_INTEGRITY
+        assert service.checksum_failures.get("open_session_key") == 1
+        assert service.session_count() == 0
+
+    def test_rollback_to_unchecksummed_member_is_not_quarantined(self):
+        """A member rolled back to a pre-checksum build answers opens
+        WITHOUT a checksum and without PROTO_CHECKSUM in its features:
+        the client must treat that as a legitimate downgrade (disable
+        checksums toward it), never as corruption — or a healthy older
+        member would re-quarantine on every half-open probe forever."""
+        from karpenter_tpu.solver.service import (
+            PROTO_DEADLINE,
+            PROTO_TRACE_TRAILER,
+            _status_response,
+        )
+
+        client = RemoteSolver.__new__(RemoteSolver)
+        client.address = "fuzz:0"
+        client.checksum = True
+        # old-build open response: unchecksummed, features without the bit
+        old = _status_response(
+            STATUS_OK,
+            [np.array([PROTO_TRACE_TRAILER | PROTO_DEADLINE], np.int32)],
+        )
+        status, payload = client._receive_open(old, require_checksum=True)
+        assert status == STATUS_OK
+        # a server CLAIMING the bit while omitting the trailer stays fatal
+        lying = _status_response(
+            STATUS_OK, [np.array([PROTO_CHECKSUM], np.int32)]
+        )
+        with pytest.raises(IntegrityError):
+            client._receive_open(lying, require_checksum=True)
+
+
+# ---------------------------------------------------------------------------
+# session-generation guard
+# ---------------------------------------------------------------------------
+
+
+class TestSessionEchoGuard:
+    def test_stale_session_replay_rejected_then_recovers(self):
+        from karpenter_tpu.testing.chaos import ChaosPolicy, chaos_wrap
+        from karpenter_tpu.solver.service import serve
+
+        _, _, _, _, batch = encoded_batch()
+        args, n_max = batch.pack_args(), len(batch.pod_valid)
+        proxy = chaos_wrap(SolverService(), ChaosPolicy())
+        address = f"127.0.0.1:{free_port()}"
+        server = serve(address, service=proxy)
+        try:
+            client = RemoteSolver(address, checksum=True)
+            client.pack(*args, n_max=n_max)  # clean warm-up
+            # corrupt only the solve responses: every Pack echoes a WRONG
+            # session key (checksum recomputed, so only the session guard
+            # can catch it); the forced re-open retry hits it again, so the
+            # typed verdict escalates
+            proxy.policy = ChaosPolicy(
+                corrupt_rate=1.0, corruption_modes=("stale_session",),
+                methods=frozenset({"solve_bytes"}), seed=5,
+            )
+            with pytest.raises(IntegrityError) as ei:
+                client.pack(*args, n_max=n_max)
+            assert ei.value.kind == "session"
+            assert integrity.totals().get("session_mismatches", 0) >= 2
+            proxy.policy = ChaosPolicy()
+            out = client.pack(*args, n_max=n_max)
+            assert len(out) == 5
+            assert integrity.totals().get("canary_mismatches", 0) == 0
+            client.close()
+        finally:
+            server.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# pool quarantine → ring failover → half-open recovery
+# ---------------------------------------------------------------------------
+
+
+class TestPoolQuarantine:
+    def _fake_inputs(self):
+        return tuple(
+            np.full(4, i, np.float32) for i in range(N_POD_ARRAYS + 3)
+        )
+
+    def _pool(self, behaviors, clock, open_seconds=5.0):
+        from karpenter_tpu.solver.pool import SolverPool
+
+        calls = {a: 0 for a in behaviors}
+
+        class FakeClient:
+            def __init__(self, address):
+                self.address = address
+
+            def pack_begin(self, *inputs, n_max, prof=None, record=True):
+                calls[self.address] += 1
+
+                def wait():
+                    return behaviors[self.address](self.address)
+
+                return wait
+
+            def close(self):
+                pass
+
+        pool = SolverPool(
+            list(behaviors),
+            client_factory=FakeClient,
+            clock=lambda: clock[0],
+            breaker_open_seconds=open_seconds,
+        )
+        return pool, calls
+
+    def test_corrupt_member_quarantined_failover_and_recovery(self):
+        clock = [0.0]
+
+        def corrupt(addr):
+            raise IntegrityError(
+                f"{addr} frame checksum mismatch", address=addr, kind="checksum"
+            )
+
+        behaviors = {"a:1": corrupt, "b:1": lambda addr: ("ok", addr)}
+        inputs = self._fake_inputs()
+        pool, calls = self._pool(behaviors, clock)
+        key = pool._catalog_key(inputs[N_POD_ARRAYS:])
+        order = pool.ring.ordered(key)
+        primary, survivor = order[0], order[1]
+        if primary == "b:1":  # make the corrupt member the primary
+            behaviors["b:1"], behaviors["a:1"] = (
+                behaviors["a:1"], behaviors["b:1"],
+            )
+        quarantines = []
+        pool.on_quarantine = lambda reason, addr, detail: quarantines.append(
+            (reason, addr)
+        )
+        # the corrupt pack fails over through the ring: the caller still
+        # gets a GOOD result, from the survivor
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        # the corrupt member is QUARANTINED: breaker forced open, counted,
+        # evented — and never retried within the cool-off
+        assert not pool._breaker(primary).available()
+        assert pool._breaker(survivor).available()
+        assert quarantines == [("checksum", primary)]
+        assert integrity.totals().get("quarantines") == 1
+        calls_at_quarantine = calls[primary]
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        assert calls[primary] == calls_at_quarantine  # no same-member retry
+        # half-open after the cool-off: a healed member earns its way back
+        clock[0] = 6.0
+        behaviors[primary] = lambda addr: ("healed", addr)
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("healed", primary)
+        assert pool._breaker(primary).state == "closed"
+        # a member still corrupting on its probe re-quarantines immediately
+        behaviors[primary] = corrupt
+        out = pool.pack_begin(*inputs, n_max=4)()
+        assert out == ("ok", survivor)
+        assert not pool._breaker(primary).available()
+        assert integrity.totals().get("quarantines") == 2
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# host-side NaN/bounds screen
+# ---------------------------------------------------------------------------
+
+
+def _clean_result(p=6, n_max=8, r=3):
+    assignment = np.zeros(p, np.int32)
+    node_sig = np.zeros(n_max, np.int32)
+    node_host = np.full(n_max, -1, np.int32)
+    node_req = np.zeros((n_max, r), np.float32)
+    node_req[0] = 1.0
+    return [assignment, node_sig, node_host, node_req, np.asarray([1], np.int32)]
+
+
+class TestScreen:
+    def test_clean_result_passes(self):
+        assert integrity.screen_result(_clean_result(), n_pods=6) is None
+
+    def test_nan_in_node_req_caught(self):
+        result = _clean_result()
+        result[3][0, 1] = np.nan
+        assert "non-finite" in integrity.screen_result(result, n_pods=6)
+
+    def test_assignment_out_of_bounds_caught(self):
+        result = _clean_result()
+        result[0][2] = 7  # n_nodes is 1
+        assert "assignment outside" in integrity.screen_result(result, n_pods=6)
+        result = _clean_result()
+        result[0][0] = np.float32(np.nan).view(np.int32)  # the SDC bit pattern
+        assert "assignment outside" in integrity.screen_result(result, n_pods=6)
+
+    def test_n_nodes_out_of_range_caught(self):
+        result = _clean_result()
+        result[4] = np.asarray([9], np.int32)  # n_max is 8
+        assert "n_nodes" in integrity.screen_result(result, n_pods=6)
+
+    def test_negative_totals_caught(self):
+        result = _clean_result()
+        result[3][0, 0] = -4.0
+        assert "negative" in integrity.screen_result(result, n_pods=6)
+
+    def test_screen_failure_quarantines_and_serves_ffd(self):
+        """A corrupt result from the (mocked) accelerated path: the batch
+        still schedules (FFD floor), the screen counter moves, the shape
+        class is quarantined, and degraded_solves_total attributes it."""
+        from karpenter_tpu import metrics as m
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        constraints, catalog, pods, daemon, _ = encoded_batch()
+        sched = TpuScheduler(Cluster(), rng=random.Random(0))
+
+        def corrupt_pack(batch):
+            def finish():
+                result = _clean_result(
+                    p=len(batch.pod_valid), n_max=8, r=batch.usable.shape[1]
+                )
+                result[3][0, 0] = np.nan
+                return tuple(result), None
+
+            return finish
+
+        sched._pack = corrupt_pack
+        before = m.REGISTRY.get_sample_value(
+            "karpenter_solver_degraded_solves_total",
+            {"reason": "integrity_screen", "address": "local"},
+        ) or 0.0
+        nodes = sched.solve(constraints, catalog, list(pods))
+        assert nodes and sum(len(n.pods) for n in nodes) == len(pods)
+        assert integrity.totals().get("screen_failures") == 1
+        assert integrity.totals().get("quarantines") == 1
+        after = m.REGISTRY.get_sample_value(
+            "karpenter_solver_degraded_solves_total",
+            {"reason": "integrity_screen", "address": "local"},
+        )
+        assert after == before + 1
+        # the shape class is quarantined: the next solve goes straight to
+        # FFD without touching the (corrupt) accelerated path
+        assert sched._pack_breakers.open_dependencies()
+
+
+# ---------------------------------------------------------------------------
+# canary cross-check
+# ---------------------------------------------------------------------------
+
+
+from karpenter_tpu.solver.native import native_available  # noqa: E402
+
+requires_native = pytest.mark.skipif(
+    not native_available(wait=120), reason="g++/native packer unavailable"
+)
+
+
+class TestCanary:
+    def _served(self, batch, n_max=None):
+        """A device-kernel solve of the batch, as host arrays — what the
+        canary would be cross-checking in production."""
+        import jax
+
+        from karpenter_tpu.solver import kernel
+
+        n_max = n_max or max(256, len(batch.pod_valid) // 4)
+        result = kernel.pack(*batch.pack_args(), n_max=n_max)
+        return tuple(np.asarray(a) for a in jax.device_get(tuple(result)))
+
+    @requires_native
+    def test_mismatch_quarantines_by_provenance(self):
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        _, _, _, _, batch = encoded_batch()
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), canary_rate=1.0)
+        served = list(self._served(batch))
+        served[0] = np.array(served[0])
+        served[0][0] = -1  # pod 0 silently dropped: screen-clean, wrong
+        quarantined = []
+
+        class FakePool:
+            def quarantine(self, address, reason, detail=""):
+                quarantined.append((address, reason))
+
+        sched._remote = FakePool()
+        sched._canary_check(batch, tuple(served), "10.0.0.1:50051")
+        totals = integrity.totals()
+        assert totals.get("canary_solves") == 1
+        assert totals.get("canary_mismatches") == 1
+        assert quarantined == [("10.0.0.1:50051", "canary")]
+
+    @requires_native
+    def test_local_mismatch_quarantines_shape_class(self):
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        _, _, _, _, batch = encoded_batch()
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), canary_rate=1.0)
+        served = list(self._served(batch))
+        served[3] = np.array(served[3])
+        served[3][0, 0] += 1.0  # wrong totals, screen-clean
+        sched._canary_check(batch, tuple(served), "")
+        assert integrity.totals().get("canary_mismatches") == 1
+        assert sched._pack_breakers.open_dependencies()
+
+    @requires_native
+    def test_no_false_positives_across_100_seeded_solves(self):
+        """The no-false-positive bar: across 100 seeded device-kernel
+        solves of varied batches, the native canary agrees every time —
+        a canary that cries wolf would quarantine healthy members."""
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), canary_rate=1.0)
+        for seed in range(100):
+            _, _, _, _, batch = encoded_batch(n_pods=6, seed=seed)
+            served = self._served(batch)
+            sched._canary_check(batch, served, "")
+        totals = integrity.totals()
+        assert totals.get("canary_solves") == 100
+        assert totals.get("canary_mismatches", 0) == 0
+        assert totals.get("quarantines", 0) == 0
+
+    def test_canary_pauses_under_brownout(self):
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        _, _, _, _, batch = encoded_batch()
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), canary_rate=1.0)
+        sched.router.set_probes_paused(True)  # brownout rung >= 1
+        sched._maybe_canary(batch, None, {"packer_backend": "device"})
+        assert sched._canary_thread is None
+        assert integrity.totals().get("canary_solves", 0) == 0
+        sched.router.set_probes_paused(False)
+
+    def test_canary_samples_by_rate(self):
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.solver.backend import TpuScheduler
+
+        sched = TpuScheduler(Cluster(), rng=random.Random(0), canary_rate=0.0)
+        sched._maybe_canary(None, None, {"packer_backend": "device"})
+        assert sched._canary_thread is None
+        sched.canary_rate = 1.0
+        # non-device packs are never canaried (native served = nothing to
+        # cross-check against)
+        sched._maybe_canary(None, None, {"packer_backend": "native"})
+        assert sched._canary_thread is None

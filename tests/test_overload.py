@@ -384,7 +384,8 @@ class TestSchedulerShedHandling:
 
         def degraded():
             return m.REGISTRY.get_sample_value(
-                "karpenter_solver_degraded_solves_total", {"reason": "deadline"}
+                "karpenter_solver_degraded_solves_total",
+                {"reason": "deadline", "address": ""},
             ) or 0.0
 
         monkeypatch.setenv("KARPENTER_PACKER", "device")

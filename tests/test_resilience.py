@@ -411,11 +411,12 @@ class TestSolverDegradation:
         from karpenter_tpu.testing import make_pod, make_provisioner
 
         def degraded_invalid() -> float:
+            # address="" — the in-process path's provenance label
             out = generate_latest(metrics.REGISTRY).decode()
             for line in out.splitlines():
                 if line.startswith(
-                    'karpenter_solver_degraded_solves_total{reason="invalid_pack"}'
-                ):
+                    "karpenter_solver_degraded_solves_total"
+                ) and 'reason="invalid_pack"' in line:
                     return float(line.rsplit(" ", 1)[1])
             return 0.0
 

@@ -358,7 +358,23 @@ def test_v3_shed_statuses_raise_typed_verdicts(status, exc_name):
             assert e.retry_after == pytest.approx(0.25)
 
 
-@pytest.mark.parametrize("status", [4, 5, 17, -1, 2**20])
+def test_v3_integrity_status_raises_typed_verdict():
+    """STATUS_INTEGRITY (the server saw a corrupt request frame) maps to
+    the typed IntegrityError — non-retryable on the same member, so the
+    pool quarantines the path instead of replaying corrupt transport."""
+    from karpenter_tpu.resilience.integrity import IntegrityError
+    from karpenter_tpu.solver import service
+
+    solver = service.RemoteSolver.__new__(service.RemoteSolver)
+    solver.address = "fuzz:0"
+    frame = service._status_response(service.STATUS_INTEGRITY)
+    word, payload = service.RemoteSolver._split_status(frame)
+    with pytest.raises(IntegrityError) as ei:
+        solver._check_status(word, payload)
+    assert ei.value.address == "fuzz:0" and ei.value.kind == "checksum"
+
+
+@pytest.mark.parametrize("status", [5, 6, 17, -1, 2**20])
 def test_v3_unknown_status_word_fails_loudly(status):
     """A status word neither side knows is a protocol error, not a retry
     signal — silent tolerance here would be the status-plane version of a
@@ -400,6 +416,114 @@ def test_v3_old_client_frames_parse_without_deadline(seed):
         [np.zeros(3, np.float64), deadline_arr]
     )
     assert ctx2 is None and dl2 == pytest.approx(remaining, rel=1e-6)
+
+
+# -- wire integrity: random byte-flip corpus ---------------------------------
+#
+# The corruption-defense contract (docs/integrity.md): over checksummed v3
+# frames, EVERY single-byte mutation must either fail loudly at the codec
+# (bad magic, version skew, unparseable framing) or be rejected by the
+# checksum layer ("mismatch", or "missing" — a peer that negotiated
+# checksums treats an absent trailer as rejection, which is what closes the
+# count-word hole). No mutation may ever round-trip to a silently different
+# array set.
+
+
+def _frames_equal(a_list, b_list):
+    import numpy as np
+
+    if len(a_list) != len(b_list):
+        return False
+    return all(
+        a.dtype == b.dtype and a.shape == b.shape and np.array_equal(a, b)
+        for a, b in zip(a_list, b_list)
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_byte_flip_corpus_never_silently_differs(seed):
+    """400 random single-byte mutations per seeded frame: loud, or
+    checksum-rejected — never a quiet different parse."""
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    arrays = _random_arrays(rng)
+    frame = service.append_checksum(service.pack_arrays(arrays))
+    original = service.unpack_arrays(frame)
+    silent = []
+    for _ in range(400):
+        out = bytearray(frame)
+        pos = rng.randrange(len(out))
+        bit = 1 << rng.randrange(8)
+        out[pos] ^= bit
+        mutated = bytes(out)
+        try:
+            verdict = service.verify_checksum(mutated)
+        except Exception:
+            continue  # loud at the codec walk — detected
+        if verdict != "ok":
+            continue  # checksum layer rejected (mismatch/missing) — detected
+        try:
+            parsed = service.unpack_arrays(mutated)
+        except Exception:
+            continue  # loud at the full parse — detected
+        if not _frames_equal(parsed, original):
+            silent.append((pos, bit))
+    assert not silent, (
+        f"{len(silent)} mutation(s) passed the checksum yet parsed to "
+        f"different arrays: {silent[:5]}"
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_unchecksummed_frames_admit_silent_flips_motivation(seed):
+    """The control: WITHOUT the trailer, some payload byte flips round-trip
+    to a silently different array — the vulnerability the checksum closes
+    (if this ever stops finding one, the corpus has gone degenerate)."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    arrays = [np.arange(64, dtype=np.int32)]
+    frame = service.pack_arrays(arrays)
+    found_silent = False
+    for _ in range(64):
+        out = bytearray(frame)
+        out[rng.randrange(14, len(out))] ^= 1 << rng.randrange(8)  # payload region
+        try:
+            parsed = service.unpack_arrays(bytes(out))
+        except Exception:
+            continue
+        if not _frames_equal(parsed, arrays):
+            found_silent = True
+            break
+    assert found_silent
+
+
+def test_checksum_covers_trailers_and_survives_append():
+    """append_checksum only rewrites the count word; the digest covers the
+    full pre-trailer body including any trace/deadline trailers."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    base = service.pack_arrays([
+        np.frombuffer(bytes(range(16)), np.int32),  # session key
+        np.asarray([4, 1, 1], np.int32),            # n_max/record/flags
+        np.ones((3, 2), np.float32),                # a pod array
+        np.asarray([0.25], np.float32),             # deadline trailer
+    ])
+    sealed = service.append_checksum(base)
+    assert service.verify_checksum(sealed) == "ok"
+    # body bytes identical: old parsers see the same arrays + one trailer
+    assert sealed[8:8 + len(base) - 8] == base[8:]
+    arrays = service.unpack_arrays(sealed)
+    assert service.is_checksum_array(arrays[-1])
+    # flipping a trailer byte (the deadline f32) is caught
+    broken = bytearray(sealed)
+    broken[len(base) - 2] ^= 0x40
+    assert service.verify_checksum(bytes(broken)) == "mismatch"
 
 
 def test_known_bad_documents_rejected():

@@ -302,6 +302,30 @@ class TestChartAndPackaging:
         # fleet mode by default: shard leases on, whole-process election off
         assert any(a.startswith("--shard-lease=kube:") for a in args)
         assert not any(a.startswith("--leader-election-lease") for a in args)
+        # pack integrity on by default (docs/integrity.md): wire checksums
+        # + the native canary cross-check rate render into the args
+        assert "--pack-checksum" in args
+        assert any(a.startswith("--canary-rate=0.05") for a in args)
+
+    def test_chart_pack_checksum_gate(self):
+        """packChecksum: false must drop the flag (checksum-off wires stay
+        byte-identical for the perf-sensitive legs), while the canary rate
+        keeps rendering independently."""
+        import importlib.util
+        from pathlib import Path
+
+        spec = importlib.util.spec_from_file_location("rc", "hack/render_chart.py")
+        rc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rc)
+        values = rc.load_values(Path("charts/karpenter-tpu/values.yaml"))
+        tpl = Path(
+            "charts/karpenter-tpu/templates/controller-deployment.yaml"
+        ).read_text()
+        assert "--pack-checksum" in rc.render(tpl, values)
+        values["controller"]["packChecksum"] = False
+        out = rc.render(tpl, values)
+        assert "--pack-checksum" not in out
+        assert "--canary-rate=0.05" in out
 
     def test_chart_gates_render_conditionally(self):
         import subprocess
