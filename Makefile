@@ -80,6 +80,10 @@ corruption-chaos:  ## pack-integrity proof: checksum/canary/quarantine suites + 
 	$(PY) -m pytest tests/test_integrity.py tests/test_serde_fuzz.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --corruption-storm 200
 
+partition-chaos:  ## control-plane partition proof: transport/fencing suites + the apiserver blip/brownout/blackout storm leg
+	$(PY) -m pytest tests/test_partition.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --partition-storm 240
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -112,5 +116,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos corruption-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos corruption-chaos partition-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -1229,6 +1229,320 @@ def bench_fleet_storm(
             pass
 
 
+def bench_partition_storm(
+    n_pods: int = 240,
+    n_provisioners: int = 8,
+    n_replicas: int = 3,
+    lease_duration: float = 1.5,
+    renew_interval: float = 0.3,
+    gc_interval: float = 1.0,
+):
+    """Control-plane partition storm (docs/partition.md): N controller
+    replicas, each a real ``ApiCluster`` over HTTP against ONE protocol-
+    double apiserver wrapped in ``ApiServerChaos``, shard leases and all.
+    Four phases: warm -> a SUB-EXPIRY blackout blip (bar: ZERO shard
+    rebalances — the fleet must not read a 10s blip as fleet-wide lease
+    loss) -> a sustained 429 brownout (the transport's Retry-After ladder
+    keeps provisioning) -> a 2x-lease-duration blackout (bar: every
+    replica FENCED, zero cloud mutations while fenced, bounded
+    time-to-recover). Throughout: duplicate_launches=0 (watch-rebind
+    detector), leaked_instances=0 (journal + GC audit), and provision
+    success 1.0 after recovery."""
+    import tempfile
+    import threading
+
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.kube.apiserver import ApiCluster
+    from karpenter_tpu.kube.testserver import TestApiServer
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.testing.chaos import ApiServerChaos
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+    t_start = time.perf_counter()
+    backing = Cluster()
+    env = TestApiServer(cluster=backing)
+    env.start()
+    chaos = ApiServerChaos(seed=20260803)
+    api = SimCloudAPI()
+
+    class _MutationRecorder:
+        """Cloud-mutation timestamps: the fenced-window bar is judged on
+        'zero create_fleet/terminate calls while every replica is fenced'."""
+
+        def __init__(self, delegate):
+            self._delegate = delegate
+            self.mutations = []  # (perf_counter, method)
+            self._mu = threading.Lock()
+
+        def __getattr__(self, name):
+            attr = getattr(self._delegate, name)
+            if name in ("create_fleet", "terminate_instances") and callable(attr):
+                def recorded(*args, **kwargs):
+                    with self._mu:
+                        self.mutations.append((time.perf_counter(), name))
+                    return attr(*args, **kwargs)
+
+                return recorded
+            return attr
+
+        def mutation_count(self) -> int:
+            with self._mu:
+                return len(self.mutations)
+
+    recorder = _MutationRecorder(api)
+    journal_path = tempfile.mktemp(prefix="karpenter-partition-journal-")
+
+    # duplicate-launch detector: a pod whose nodeName flips between two
+    # non-empty values was double-provisioned (no preemption in this leg)
+    rebinds = []
+    last_node = {}
+    watch_mu = threading.Lock()
+
+    def on_pod(event, pod):
+        if event == "DELETED" or not pod.spec.node_name:
+            return
+        with watch_mu:
+            prev = last_node.get(pod.metadata.name)
+            if prev and prev != pod.spec.node_name:
+                rebinds.append((pod.metadata.name, prev, pod.spec.node_name))
+            last_node[pod.metadata.name] = pod.spec.node_name
+
+    backing.watch("pods", on_pod)
+
+    runtimes = []
+    created = 0
+
+    def create_pods(prefix: str, n: int) -> list:
+        nonlocal created
+        names = []
+        for i in range(n):
+            name = f"{prefix}-{i}"
+            names.append(name)
+            backing.create("pods", make_pod(
+                name=name, requests={"cpu": "0.25"},
+                node_selector={"partfleet": f"part-{i % n_provisioners}"},
+            ))
+        created += n
+        return names
+
+    def wait_bound(names: list, timeout: float = 120.0) -> bool:
+        deadline = time.time() + timeout
+        want = set(names)
+        while time.time() < deadline:
+            live = {
+                p.metadata.name: p for p in backing.pods()
+                if p.metadata.name in want
+            }
+            if len(live) == len(want) and all(
+                p.spec.node_name for p in live.values()
+            ):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def sample(name):
+        return _sample(m, name)
+
+    try:
+        for i in range(n_replicas):
+            cluster = ApiCluster(env.url)
+            # CI-speed retry pacing (the ladder SHAPE is what's under test)
+            cluster.transport._backoff_base = 0.01
+            cluster.transport._backoff_cap = 0.1
+            cluster.watch_backoff_base = 0.1
+            cluster.watch_backoff_cap = 2.0
+            rt = build_runtime(
+                Options(
+                    shard_lease="kube:kube-system/karpenter-shard",
+                    shard_lease_duration=lease_duration,
+                    launch_journal=journal_path,
+                    gc_interval=gc_interval,
+                    gc_grace_period=max(gc_interval * 6, 8.0),
+                    default_solver="ffd",
+                ),
+                cluster=cluster,
+                cloud_provider=SimulatedCloudProvider(api=recorder),
+                shard_identity=f"replica-{i}",
+            )
+            cluster.start()
+            assert cluster.wait_for_sync(30), "informer cache never synced"
+            rt.ownership.renew_interval = renew_interval
+            rt.garbage_collection.replay_after = gc_interval
+            rt.ownership.start()
+            rt.manager.start()
+            runtimes.append(rt)
+
+        names = [f"part-{i}" for i in range(n_provisioners)]
+        for name in names:
+            backing.create("provisioners", make_provisioner(
+                name=name, solver="ffd",
+                requirements=[NodeSelectorRequirement(
+                    key="partfleet", operator="In", values=[name],
+                )],
+            ))
+
+        def owner_of(shard):
+            for rt in runtimes:
+                if rt.ownership.owns(shard):
+                    return rt
+            return None
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            owners = {name: owner_of(name) for name in names}
+            if all(
+                rt is not None and name in rt.provisioning.workers
+                for name, rt in owners.items()
+            ):
+                break
+            time.sleep(0.05)
+        assert all(owner_of(n) is not None for n in names), "shards never all owned"
+        for rt in runtimes:
+            for w in rt.provisioning.workers.values():
+                w.batcher.idle_duration = 0.1
+
+        quarter = max(n_pods // 4, 8)
+
+        # ---- phase 1: warm — the fleet provisions over real HTTP
+        assert wait_bound(create_pods("warm", quarter)), "warm phase never bound"
+
+        # ---- phase 2: SUB-EXPIRY blip — the bar is ZERO shard churn
+        rebal_before = sample("karpenter_fleet_shard_rebalances_total")
+        losses_before = sample("karpenter_fleet_shard_losses_total")
+        env.chaos = chaos
+        blip = chaos.blackout(lease_duration * 0.5)
+        while chaos.in_blackout():
+            time.sleep(0.02)
+        time.sleep(renew_interval * 3)  # a couple of post-blip renew ticks
+        blip_rebalances = (
+            sample("karpenter_fleet_shard_rebalances_total") - rebal_before
+        )
+        blip_losses = sample("karpenter_fleet_shard_losses_total") - losses_before
+        assert wait_bound(create_pods("postblip", quarter)), "post-blip pods never bound"
+
+        # ---- phase 3: sustained 429 brownout — Retry-After ladder holds
+        throttled_before = sample("karpenter_kube_throttled_total")
+        chaos.throttle_rate = 0.4
+        chaos.retry_after = 0.05
+        brownout_names = create_pods("brownout", quarter)
+        time.sleep(2.0)
+        chaos.throttle_rate = 0.0
+        brownout_throttles = sample("karpenter_kube_throttled_total") - throttled_before
+        assert wait_bound(brownout_names), "brownout pods never bound"
+
+        # ---- phase 4: 2x-lease blackout — every replica must FENCE
+        def fenced_hits():
+            return m.REGISTRY.get_sample_value(
+                "karpenter_fleet_duplicate_launch_guard_total",
+                {"reason": "fenced"},
+            ) or 0.0
+
+        fenced_guard_before = fenced_hits()
+        blackout_s = lease_duration * 2.2
+        window = chaos.blackout(blackout_s)
+        t_blackout = time.perf_counter()
+        all_fenced_at = None
+        mutations_at_fence = None
+        deadline = time.time() + blackout_s
+        while time.time() < deadline:
+            if all(rt.ownership.fenced() for rt in runtimes):
+                all_fenced_at = time.perf_counter() - t_blackout
+                mutations_at_fence = recorder.mutation_count()
+                break
+            time.sleep(0.02)
+        while chaos.in_blackout():
+            time.sleep(0.02)
+        t_recover_start = time.perf_counter()
+        fenced_mutations = (
+            recorder.mutation_count() - mutations_at_fence
+            if mutations_at_fence is not None else None
+        )
+        # recovery: every shard re-owned, no replica fenced
+        recover_s = None
+        deadline = time.time() + lease_duration * 20
+        while time.time() < deadline:
+            if (
+                all(owner_of(n) is not None for n in names)
+                and not any(rt.ownership.fenced() for rt in runtimes)
+            ):
+                recover_s = time.perf_counter() - t_recover_start
+                break
+            time.sleep(0.05)
+        assert wait_bound(
+            create_pods("recovered", n_pods - created), timeout=180
+        ), "post-recovery pods never bound"
+        fenced_guard_hits = fenced_hits() - fenced_guard_before
+
+        # ---- settle + audits
+        all_names = [p.metadata.name for p in backing.pods()]
+        wait_bound(all_names, timeout=60)
+        pods = list(backing.pods())
+        bound = [p for p in pods if p.spec.node_name]
+        journal = runtimes[0].journal
+        deadline = time.time() + max(gc_interval * 10, 20)
+        while time.time() < deadline and journal.unresolved():
+            time.sleep(0.1)
+        node_names = {n.metadata.name for n in backing.nodes()}
+        provider_ids = {n.spec.provider_id for n in backing.nodes()}
+        live = [i for i in api.list_instances() if i.state != "terminated"]
+        leaked = [
+            i for i in live
+            if i.id not in node_names
+            and f"sim:///{i.zone}/{i.id}" not in provider_ids
+        ]
+        token_counts = {}
+        for inst in live:
+            if inst.launch_token:
+                token_counts[inst.launch_token] = (
+                    token_counts.get(inst.launch_token, 0) + 1
+                )
+        dup_tokens = {t: c for t, c in token_counts.items() if c > 1}
+
+        recover_bar_s = lease_duration * 4
+        return {
+            "pods": created,
+            "provisioners": n_provisioners,
+            "replicas": n_replicas,
+            "lease_duration_s": lease_duration,
+            "chaos_provision_success_rate": round(len(bound) / max(created, 1), 4),
+            "duplicate_launches": len(rebinds) + len(dup_tokens),
+            "duplicate_rebinds": rebinds[:5],
+            "leaked_instances": len(leaked),
+            "blip_s": round(blip.end - blip.start, 3),
+            "blip_rebalances": int(blip_rebalances),
+            "blip_shard_losses": int(blip_losses),
+            "brownout_throttles": int(brownout_throttles),
+            "kube_retries_total": int(sample("karpenter_kube_request_retries_total")),
+            "blackout_s": round(window.end - window.start, 3),
+            "all_replicas_fenced": all_fenced_at is not None,
+            "fenced_within_s": (
+                round(all_fenced_at, 3) if all_fenced_at is not None else None
+            ),
+            "fenced_mutations": fenced_mutations,
+            "fenced_guard_hits": int(fenced_guard_hits),
+            "recover_s": round(recover_s, 3) if recover_s is not None else None,
+            "recover_bar_s": round(recover_bar_s, 3),
+            "recovered_within_bar": (
+                recover_s is not None and recover_s <= recover_bar_s
+            ),
+            "events_dropped": int(sample("karpenter_kube_events_dropped_total")),
+            "journal_unresolved_after": len(journal.unresolved()),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        env.chaos = None
+        for rt in runtimes:
+            rt.stop()
+        env.stop()
+        try:
+            os.remove(journal_path)
+        except OSError:
+            pass
+
+
 def bench_corruption_storm(
     n_pods: int = 200,
     pool_size: int = 2,
@@ -2546,6 +2860,13 @@ def main():
     ap.add_argument("--fleet-provisioners", type=int, default=None)
     ap.add_argument("--fleet-replicas", type=int, default=3)
     ap.add_argument("--fleet-pool", type=int, default=2)
+    ap.add_argument("--partition-storm", type=int, metavar="N_PODS", default=0,
+                    help="control-plane partition storm (docs/partition.md): "
+                    "replicas over a chaos-wrapped apiserver — sub-expiry "
+                    "blip (zero shard churn), 429 brownout, and a 2x-lease "
+                    "blackout (every replica fenced, zero cloud mutations "
+                    "while fenced, bounded recovery)")
+    ap.add_argument("--partition-lease-duration", type=float, default=1.5)
     ap.add_argument("--crash-storm", type=int, metavar="N_PODS", default=0,
                     help="crash-consistency storm: a replica is killed "
                          "between the cloud create and the Node write, a "
@@ -2681,6 +3002,36 @@ def main():
             "unit": "aggregate pods/sec",
             "fleet_ok": ok,
             **{k: v for k, v in r.items() if k != "aggregate_pods_per_sec"},
+        }))
+        return
+
+    if args.partition_storm:
+        r = bench_partition_storm(
+            args.partition_storm,
+            n_provisioners=args.fleet_provisioners or 8,
+            n_replicas=args.fleet_replicas,
+            lease_duration=args.partition_lease_duration,
+        )
+        ok = (
+            r["chaos_provision_success_rate"] == 1.0
+            and r["duplicate_launches"] == 0
+            and r["leaked_instances"] == 0
+            and r["blip_rebalances"] == 0
+            and r["blip_shard_losses"] == 0
+            and r["all_replicas_fenced"]
+            and r["fenced_mutations"] == 0
+            and r["recovered_within_bar"]
+        )
+        print(json.dumps({
+            "metric": (
+                f"partition-storm ({r['provisioners']} provisioners x "
+                f"{r['replicas']} replicas, blip + 429 brownout + "
+                f"{r['blackout_s']}s blackout)"
+            ),
+            "value": r["chaos_provision_success_rate"],
+            "unit": "provision success rate",
+            "partition_ok": ok,
+            **{k: v for k, v in r.items() if k != "chaos_provision_success_rate"},
         }))
         return
 

@@ -141,6 +141,17 @@ class GarbageCollectionController:
         return self.gc_interval
 
     def _sweep(self, span) -> None:
+        if self.ownership is not None and getattr(
+            self.ownership, "fenced", lambda: False
+        )():
+            # apiserver unreachable past lease expiry (docs/partition.md):
+            # this replica can neither trust its Node view nor its shard
+            # claims — adopting or terminating now could reap a peer's
+            # healthy in-flight launch. Skip the whole sweep until the
+            # control plane answers again.
+            metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(reason="fenced").inc()
+            span.set_attribute("skipped", "fenced")
+            return
         instances = self.cloud_provider.list_instances()
         if instances is NotImplemented or instances is None:
             # this vendor has no inventory surface: recovery can still

@@ -71,6 +71,7 @@ class ProvisionerWorker:
         batcher: Optional[Batcher] = None,
         solver_service_address: Optional[str] = None,
         owned: Optional[callable] = None,
+        fenced: Optional[callable] = None,
         journal=None,
         pack_checksum: Optional[bool] = None,
         canary_rate: Optional[float] = None,
@@ -99,6 +100,11 @@ class ProvisionerWorker:
         # lease mid-round must not launch (docs/fleet.md). Single-replica
         # deployments run with the constant-True default.
         self.owned = owned or (lambda: True)
+        # partition-tolerance fence (docs/partition.md): True while the
+        # apiserver has been unreachable past the shard leases' expiry
+        # margin — a peer with a working control plane may own this shard
+        # already, so cloud creates are refused until contact resumes
+        self.fenced = fenced or (lambda: False)
         self._pending_lock = threading.Lock()
         self._pending_keys: set = set()
         # keys a failed launch re-queued THIS round: provision_once's
@@ -269,6 +275,15 @@ class ProvisionerWorker:
             pods = [latest[k] for k in key_order]
             if not pods:
                 return []
+            if self.fenced():
+                # apiserver unreachable past lease expiry: a peer may own
+                # this shard already — launching now is the split-brain the
+                # fence exists to prevent (docs/partition.md)
+                metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                    reason="fenced"
+                ).inc()
+                round_sp.set_attribute("skipped", "fenced")
+                return []
             if not self.owned():
                 # shard lease gone: the new owner's selection loop re-routes
                 # these pods to ITS worker — solving here would race its
@@ -352,6 +367,16 @@ class ProvisionerWorker:
             # wire fleet POST dedupes), but a lost lease means another
             # replica may ALREADY be solving these pods — creating here
             # would double capacity and race its binds.
+            if self.fenced():
+                metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                    reason="fenced"
+                ).inc()
+                logger.warning(
+                    "skipping launch for %s: replica fenced (apiserver "
+                    "unreachable past lease expiry)",
+                    self.provisioner.name,
+                )
+                return False
             if not self.owned():
                 metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
                     reason="lost_ownership"
@@ -663,6 +688,11 @@ class ProvisioningController:
                 owned=(
                     (lambda: self.ownership.owns(name))
                     if self.ownership is not None else None
+                ),
+                fenced=(
+                    self.ownership.fenced
+                    if self.ownership is not None
+                    and hasattr(self.ownership, "fenced") else None
                 ),
                 journal=self.journal,
                 pack_checksum=self.pack_checksum,

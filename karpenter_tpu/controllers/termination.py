@@ -69,26 +69,36 @@ class EvictionQueue:
 
     def process_one(self, key: Tuple[str, str]) -> bool:
         """Evict + queue bookkeeping for one dequeued key; returns whether
-        the eviction succeeded."""
-        if self.evict_once(key):
+        the eviction succeeded. A blocked eviction requeues on the SERVER's
+        ``Retry-After`` hint when the apiserver sent one (the PDB knows when
+        it might admit the eviction better than a blind backoff does), and
+        on the exponential backoff otherwise."""
+        ok, hint = self.evict_once(key)
+        if ok:
             self.queue.forget(key)
             with self._in_flight_mu:
                 self._in_flight.discard(key)
             self.queue.done(key)
             return True
         self.queue.done(key)
-        self.queue.add_rate_limited(key)
+        if hint is not None and hint > 0:
+            self.queue.add_after(key, hint)
+        else:
+            self.queue.add_rate_limited(key)
         return False
 
-    def evict_once(self, key: Tuple[str, str]) -> bool:
+    def evict_once(self, key: Tuple[str, str]) -> Tuple[bool, Optional[float]]:
         namespace, name = key
         pod = self.cluster.try_get("pods", name, namespace)
         if pod is None:  # 404 → nothing to evict
-            return True
-        ok = self.cluster.evict(pod)
+            return True, None
+        ok, hint = self.cluster.evict_with_hint(pod)
         if not ok:
-            logger.debug("eviction of %s/%s blocked by PDB (429)", namespace, name)
-        return ok
+            logger.debug(
+                "eviction of %s/%s blocked by PDB (429%s)", namespace, name,
+                f", Retry-After {hint:.2f}s" if hint is not None else "",
+            )
+        return ok, hint
 
     def stop(self) -> None:
         self.queue.shut_down()
@@ -188,10 +198,23 @@ class TerminationController:
 
     DRAIN_REQUEUE = 1.0
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, start_queue: bool = True):
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        start_queue: bool = True,
+        fenced=None,
+    ):
         self.cluster = cluster
         self.eviction_queue = EvictionQueue(cluster, start=start_queue)
         self.terminator = Terminator(cluster, cloud_provider, self.eviction_queue)
+        # partition-tolerance fence (docs/partition.md): finalizer-driven
+        # teardown acts on the INFORMER view, which is stale while the
+        # apiserver is unreachable past lease expiry — defer the cloud
+        # delete until the control plane answers. (Cloud-NOTIFIED
+        # terminations — interruption's force path — are deliberately not
+        # gated: the cloud itself declared that capacity dying.)
+        self.fenced = fenced or (lambda: False)
 
     def reconcile(self, name: str) -> Optional[float]:
         node = self.cluster.try_get("nodes", name, namespace="")
@@ -203,6 +226,15 @@ class TerminationController:
             return None
         self.terminator.cordon(node)
         if not self.terminator.drain(node):
+            return self.DRAIN_REQUEUE
+        if self.fenced():
+            from karpenter_tpu import metrics
+
+            metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(reason="fenced").inc()
+            logger.warning(
+                "deferring cloud delete of %s: replica fenced (apiserver "
+                "unreachable past lease expiry)", name,
+            )
             return self.DRAIN_REQUEUE
         self.terminator.terminate(node)
         return None

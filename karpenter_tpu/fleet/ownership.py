@@ -200,6 +200,22 @@ class ShardManager:
         with self._mu:
             return set(self._owned)
 
+    def fenced(self) -> bool:
+        """Is this replica FENCED — the lease backend unreachable past a
+        held lease's expiry margin (docs/partition.md)? While True, the
+        launch guards and the GC sweep refuse cloud creates/terminates:
+        a peer with a working control plane may legitimately own our
+        shards already. Backends without the concept (``FileLeaseSet``)
+        never fence."""
+        fn = getattr(self.leases, "fenced", None)
+        if fn is None:
+            return False
+        try:
+            return bool(fn())
+        except Exception:
+            logger.exception("fence status read failed")
+            return False
+
     # -- the protocol -------------------------------------------------------
     def tick(self) -> None:
         """One claim/renew/release round. Exceptions from the lease backend
@@ -310,6 +326,7 @@ class ShardManager:
             self.ticks += 1
             self.last_members = members
             metrics.FLEET_SHARDS_OWNED.set(len(self._owned))
+        metrics.FLEET_FENCED.set(1 if self.fenced() else 0)
 
     def _gain(self, key: str, taken_over: bool = False) -> None:
         with self._mu:

@@ -36,7 +36,17 @@ from urllib.parse import urlparse
 from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.kube import serde
 from karpenter_tpu.kube.client import Cluster, Conflict, NotFound
-from karpenter_tpu.utils.workqueue import TokenBucket
+from karpenter_tpu.kube.transport import (
+    VERB_CREATE,
+    VERB_EVENTS,
+    VERB_LEASE,
+    VERB_MUTATE,
+    VERB_READ,
+    VERB_WATCH,
+    ApiUnavailable,
+    KubeThrottled,
+    KubeTransport,
+)
 
 logger = logging.getLogger("karpenter.kube.apiserver")
 
@@ -61,6 +71,10 @@ RESOURCES: Dict[str, Tuple[str, str]] = {
 }
 
 WATCH_RECONNECT_DELAY = 1.0
+# the watch loop's failure backoff doubles per consecutive failure (with
+# jitter) up to this cap, and resets on any successful list — a down
+# apiserver costs each kind one paced probe, not a re-list hot loop
+WATCH_BACKOFF_CAP = 30.0
 # idle watch reads give up and reconnect after this long, so a stop() or a
 # silently-dead connection never wedges a watch thread indefinitely
 WATCH_READ_TIMEOUT = 60.0
@@ -133,7 +147,24 @@ class ApiCluster(Cluster):
             if insecure_skip_verify:
                 self._ssl_ctx.check_hostname = False
                 self._ssl_ctx.verify_mode = ssl.CERT_NONE
-        self._bucket = TokenBucket(qps, burst)
+        # the resilient transport choke point (kube/transport.py): per-verb
+        # retries, 429/Retry-After handling, mutation-priority flow control
+        # (the old bare TokenBucket generalized), circuit breaker, metrics
+        self.transport = KubeTransport(qps=qps, burst=burst)
+        # Event writes must never hold a reconcile hostage: short connect/
+        # read timeout on the events verb class (tests shrink it)
+        self.events_timeout = 2.0
+        # lease ops get their own short timeout: a renew slower than the
+        # renew cadence is useless, and a 30s connect hang into a real
+        # packet-dropping partition would blow past the fencing margin
+        self.lease_timeout = 5.0
+        # watch-loop failure backoff knobs (tests shrink them to count
+        # re-list attempts in CI time)
+        self.watch_backoff_base = WATCH_RECONNECT_DELAY
+        self.watch_backoff_cap = WATCH_BACKOFF_CAP
+        # kind -> full re-LIST attempts (regression surface for the
+        # blackout hot-loop fix; the prometheus twin is KUBE_RELISTS)
+        self.relist_attempts: Dict[str, int] = {}
         self._watch_kinds = tuple(kinds) if kinds is not None else WATCH_KINDS
         self._stop = threading.Event()
         self._threads: list = []
@@ -176,19 +207,53 @@ class ApiCluster(Cluster):
             h["Authorization"] = f"Bearer {self._token}"
         return h
 
+    _VERB_CLASS = {"GET": VERB_READ, "POST": VERB_CREATE}
+
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
         content_type: str = "application/json",
+        kind: str = "", verb_class: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> Tuple[int, dict]:
-        self._bucket.take()
-        conn = self._connect()
+        """One logical apiserver call through the transport choke point.
+        ``verb_class`` defaults from the method (GET→read, POST→create,
+        PUT/PATCH/DELETE→mutate); Event writes pass ``events`` explicitly
+        (zero retries, short deadline, drop-counted)."""
+        if verb_class is None:
+            verb_class = self._VERB_CLASS.get(method, VERB_MUTATE)
+        if kind == "leases" and verb_class != VERB_WATCH:
+            # lease traffic IS the fencing signal (kube/leader.py): single
+            # attempt, short deadline, never fast-failed by a breaker some
+            # OTHER traffic opened (kube/transport.py VERB_LEASE)
+            verb_class = VERB_LEASE
+            if timeout is None:
+                timeout = self.lease_timeout
+        status, doc, _hint = self.transport.request(
+            verb_class, method, kind,
+            lambda: self._attempt(method, path, body, content_type, timeout),
+        )
+        return status, doc
+
+    def _attempt(
+        self, method: str, path: str, body: Optional[dict],
+        content_type: str, timeout: Optional[float],
+    ) -> Tuple[int, dict, Optional[float]]:
+        """One HTTP round trip: (status, body, Retry-After seconds)."""
+        conn = self._connect(timeout=timeout if timeout is not None else 30.0)
         try:
             payload = json.dumps(body).encode() if body is not None else None
             conn.request(method, path, body=payload, headers=self._headers(content_type))
             resp = conn.getresponse()
             raw = resp.read()
             doc = json.loads(raw) if raw else {}
-            return resp.status, doc
+            retry_after: Optional[float] = None
+            header = resp.getheader("Retry-After")
+            if header:
+                try:
+                    retry_after = float(header)
+                except ValueError:
+                    retry_after = None
+            return resp.status, doc, retry_after
         finally:
             conn.close()
 
@@ -244,20 +309,38 @@ class ApiCluster(Cluster):
         server says the RV is too old (410 Gone / ERROR event) or on a
         transport error, never on routine idle stream ends: client-go resyncs
         on the order of hours, and a full re-LIST dispatches MODIFIED for
-        every cached object, requeueing every controller key."""
+        every cached object, requeueing every controller key.
+
+        Consecutive failures back off with jittered exponential delays (base
+        doubled per failure up to ``watch_backoff_cap``, reset by any
+        successful list) — a down apiserver costs one paced probe per kind,
+        not a re-list hot loop multiplied by every replica in the fleet."""
+        import random
+
         rv: Optional[str] = None
+        failures = 0
         while not self._stop.is_set():
             try:
                 if rv is None:
                     rv = self._relist(kind)
                     self._synced[kind].set()
+                    failures = 0  # success resets the backoff ladder
                 rv = self._stream(kind, rv)
             except Exception as e:
                 if self._stop.is_set():
                     return
-                logger.debug("watch %s disconnected (%s); re-listing", kind, e)
+                failures += 1
+                delay = min(
+                    self.watch_backoff_cap,
+                    self.watch_backoff_base * (2 ** min(failures - 1, 16)),
+                )
+                delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
+                logger.debug(
+                    "watch %s disconnected (%s); re-listing in %.2fs "
+                    "(failure %d)", kind, e, delay, failures,
+                )
                 rv = None  # unknown delta state: resync with a full list
-                self._stop.wait(WATCH_RECONNECT_DELAY)
+                self._stop.wait(delay)
 
     def _relist(self, kind: str) -> str:
         """Full list; reconcile the cache to it (resync), dispatching
@@ -267,7 +350,16 @@ class ApiCluster(Cluster):
         the cache (a create raced the reconnect), so the list's
         resourceVersion gates both overwrites and evictions — mirroring
         ``_apply_event``'s per-object guard."""
-        status, doc = self._request("GET", self._path(kind, None))
+        from karpenter_tpu import metrics
+
+        self.relist_attempts[kind] = self.relist_attempts.get(kind, 0) + 1
+        metrics.KUBE_RELISTS.labels(kind=kind).inc()
+        # the `watch` verb class: flow-limited, breaker-recorded, but NOT
+        # transport-retried — this loop owns the pacing, and stacking two
+        # retry layers would multiply load on a struggling apiserver
+        status, doc = self._request(
+            "GET", self._path(kind, None), kind=kind, verb_class=VERB_WATCH
+        )
         if status != 200:
             raise ApiError(status, str(doc))
         rv = str((doc.get("metadata") or {}).get("resourceVersion") or "0")
@@ -386,10 +478,36 @@ class ApiCluster(Cluster):
         with self._lock:
             self._stores[kind].objects[(obj.metadata.namespace, obj.metadata.name)] = obj
 
+    def degraded(self) -> bool:
+        """Is the transport refusing apiserver calls (breaker open)?
+        Controllers treat True as "serve the informer cache"; the lease
+        layer treats it as UNREACHABLE and fences on its own clock."""
+        return self.transport.degraded()
+
     def get_live(self, kind: str, name: str, namespace: str = "default"):
         """Uncached GET straight from the server — leader election must
-        never trust a stale informer view."""
-        status, doc = self._request("GET", self._path(kind, namespace, name))
+        never trust a stale informer view. While the apiserver breaker is
+        OPEN, watched kinds degrade to the informer cache (counted on
+        ``karpenter_kube_degraded_reads_total``); un-watched kinds (leases)
+        have no cache to fall back on, and the failure propagates so the
+        lease layer can fence instead of trusting anything stale."""
+        try:
+            status, doc = self._request(
+                "GET", self._path(kind, namespace, name), kind=kind
+            )
+        except ApiUnavailable:
+            # only WATCHED kinds have an informer cache worth serving; an
+            # un-watched kind's store holds nothing but this process's own
+            # write echoes, and handing the lease layer its own stale
+            # renewal back would corrupt the REJECTED/UNREACHABLE split
+            if kind in self._watch_kinds:
+                cached = self.try_get(kind, name, namespace=namespace)
+                if cached is not None:
+                    from karpenter_tpu import metrics
+
+                    metrics.KUBE_DEGRADED_READS.inc()
+                    return cached
+            raise
         if status != 200:
             _raise_for(status, str(doc))
         return serde.from_wire(kind, doc)
@@ -399,16 +517,28 @@ class ApiCluster(Cluster):
         shard-lease set (kube/leader.py ``KubeLeaseSet``) must see PEER
         replicas' lease objects, and leases are deliberately not
         informer-watched (WATCH_KINDS) — the cached ``list`` only ever
-        shows this process's own writes for those kinds."""
-        status, doc = self._request("GET", self._path(kind, namespace))
+        shows this process's own writes for those kinds, so there is no
+        cache worth degrading to here: failures propagate and the lease
+        layer classifies them (REJECTED vs UNREACHABLE)."""
+        status, doc = self._request("GET", self._path(kind, namespace), kind=kind)
         if status != 200:
             _raise_for(status, str(doc))
         return [serde.from_wire(kind, item) for item in doc.get("items") or []]
 
     # -- mutations (REST) --------------------------------------------------
+    def _write_policy(self, kind: str) -> dict:
+        """Extra ``_request`` kwargs for a write to ``kind``: Event writes
+        ride the zero-retry/short-deadline ``events`` class — recording is
+        fire-and-forget and must never block a reconcile on a slow
+        apiserver (drops are counted, kube/transport.py)."""
+        if kind == "events":
+            return {"verb_class": VERB_EVENTS, "timeout": self.events_timeout}
+        return {}
+
     def create(self, kind: str, obj):
         status, doc = self._request(
-            "POST", self._path(kind, obj.metadata.namespace), serde.to_wire(kind, obj)
+            "POST", self._path(kind, obj.metadata.namespace), serde.to_wire(kind, obj),
+            kind=kind, **self._write_policy(kind),
         )
         if status not in (200, 201):
             _raise_for(status, str(doc))
@@ -426,6 +556,7 @@ class ApiCluster(Cluster):
             "PUT",
             self._path(kind, obj.metadata.namespace, obj.metadata.name),
             serde.to_wire(kind, obj),
+            kind=kind, **self._write_policy(kind),
         )
         if status != 200:
             _raise_for(status, str(doc))
@@ -450,6 +581,7 @@ class ApiCluster(Cluster):
             self._path(kind, namespace, name, subresource),
             patch,
             content_type="application/merge-patch+json",
+            kind=kind,
         )
         if status != 200:
             _raise_for(status, str(doc))
@@ -468,7 +600,9 @@ class ApiCluster(Cluster):
         )
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
-        status, doc = self._request("DELETE", self._path(kind, namespace, name))
+        status, doc = self._request(
+            "DELETE", self._path(kind, namespace, name), kind=kind
+        )
         if status not in (200, 202):
             _raise_for(status, str(doc))
         # finalizer semantics live on the server: a finalized object comes
@@ -514,6 +648,10 @@ class ApiCluster(Cluster):
 
     # -- subresources ------------------------------------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
+        # VERB_CREATE: a Binding POST is not idempotent at the HTTP layer —
+        # the transport never retries it, and the 409 arm below is the
+        # idempotency ladder (a lost response followed by a re-bind to the
+        # SAME node already achieved the goal)
         status, doc = self._request(
             "POST",
             self._path("pods", pod.metadata.namespace, pod.metadata.name, "binding"),
@@ -523,6 +661,7 @@ class ApiCluster(Cluster):
                 "metadata": {"name": pod.metadata.name},
                 "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
             },
+            kind="pods",
         )
         if status == 409:
             # idempotent retry: a lost response followed by a re-bind to the
@@ -547,19 +686,41 @@ class ApiCluster(Cluster):
         self._notify("pods", "MODIFIED", pod)
 
     def evict(self, pod: Pod) -> bool:
-        status, doc = self._request(
-            "POST",
-            self._path("pods", pod.metadata.namespace, pod.metadata.name, "eviction"),
-            {
-                "apiVersion": "policy/v1",
-                "kind": "Eviction",
-                "metadata": {"name": pod.metadata.name, "namespace": pod.metadata.namespace},
-            },
-        )
+        return self.evict_with_hint(pod)[0]
+
+    def evict_with_hint(self, pod: Pod) -> Tuple[bool, Optional[float]]:
+        """Eviction + the server's pacing opinion: a PDB-blocked eviction
+        answers 429 WITH a ``Retry-After`` header, and discarding it made
+        termination requeue on a blind interval — the hint rides back so
+        the eviction queue can honor the server's own schedule."""
+        try:
+            status, doc, retry_after = self.transport.request(
+                VERB_CREATE, "POST", "pods",
+                lambda: self._attempt(
+                    "POST",
+                    self._path(
+                        "pods", pod.metadata.namespace, pod.metadata.name, "eviction"
+                    ),
+                    {
+                        "apiVersion": "policy/v1",
+                        "kind": "Eviction",
+                        "metadata": {
+                            "name": pod.metadata.name,
+                            "namespace": pod.metadata.namespace,
+                        },
+                    },
+                    "application/json",
+                    None,
+                ),
+            )
+        except KubeThrottled as e:
+            # PDB would be violated (or the apiserver itself throttled the
+            # POST): not evicted, retry when the server said to
+            return False, e.retry_after
         if status == 429:
-            return False  # PDB would be violated; caller retries rate-limited
+            return False, retry_after  # unreachable: transport raises — kept for safety
         if status == 404:
-            return True  # already gone
+            return True, None  # already gone
         if status not in (200, 201):
             _raise_for(status, str(doc))
-        return True
+        return True, None

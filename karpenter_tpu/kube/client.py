@@ -284,6 +284,14 @@ class Cluster:
             pod.metadata.resource_version = self._version
         self._notify("pods", "MODIFIED", pod)
 
+    def evict_with_hint(self, pod: Pod):
+        """``(evicted, retry_after_seconds)``: the Retry-After-aware evict
+        surface the termination queue prefers. The in-memory store has no
+        pacing opinion (None); the real-apiserver backend overrides this to
+        surface the server's 429 ``Retry-After`` header so rate-limited
+        requeues honor the server's schedule instead of a blind interval."""
+        return self.evict(pod), None
+
     def evict(self, pod: Pod) -> bool:
         """The Evict subresource. Returns False (HTTP 429 analog) if a PDB
         would be violated; otherwise deletes the pod with the same finalizer

@@ -20,9 +20,60 @@ logger = logging.getLogger("karpenter.kube.leader")
 
 from karpenter_tpu.api.objects import Lease, ObjectMeta
 from karpenter_tpu.kube.client import Cluster, Conflict, NotFound
+from karpenter_tpu.kube.transport import is_unreachable
 
 DEFAULT_LEASE_NAME = "karpenter-leader-election"
 DEFAULT_LEASE_NAMESPACE = "kube-system"
+
+# Fraction of the lease duration BEFORE nominal expiry at which an
+# unreachable-apiserver hold gives up and fences: the margin is the window
+# in which a peer (with a working apiserver — asymmetric partition) could
+# claim the expired lease while this replica still believes it holds it.
+FENCE_MARGIN_FRACTION = 0.2
+
+
+class FenceStatus:
+    """Shared REJECTED-vs-UNREACHABLE verdict for a family of leases.
+
+    A failed renewal used to read as "a peer took the lease" no matter the
+    cause, so a 10-second apiserver blip synchronously tore down every
+    provisioner worker in the fleet. The split (docs/partition.md):
+
+    - **REJECTED** — the apiserver ANSWERED and the answer was "not yours"
+      (a peer holds it, it expired server-side, a racer's write won):
+      lose the lease NOW, exactly as before.
+    - **UNREACHABLE** — the apiserver did not answer: the hold is still
+      plausibly ours, so keep serving until the lease's own expiry minus a
+      safety margin... then **fence**: assume a peer may own the shard and
+      refuse cloud mutations until the control plane answers again.
+
+    One status object is shared by every lease of a ``KubeLeaseSet`` so a
+    single successful round trip — even a rejected one — un-fences the
+    whole replica (reachability is a property of the apiserver, not of one
+    Lease object)."""
+
+    def __init__(self):
+        # plain bool: written by the lease-manager thread, read lock-free
+        # by launch guards and the GC sweep (attribute reads are atomic)
+        self._fenced = False
+
+    def fence(self) -> None:
+        if not self._fenced:
+            logger.warning(
+                "FENCED: apiserver unreachable past lease expiry margin — "
+                "refusing cloud mutations until the control plane answers"
+            )
+        self._fenced = True
+
+    def contact(self) -> None:
+        """Any completed apiserver round trip proves reachability."""
+        if self._fenced:
+            logger.info("apiserver reachable again; fence lifted")
+        self._fenced = False
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
 
 
 class KubeLease:
@@ -33,6 +84,7 @@ class KubeLease:
         namespace: str = DEFAULT_LEASE_NAMESPACE,
         identity: Optional[str] = None,
         duration: float = 15.0,
+        status: Optional[FenceStatus] = None,
     ):
         self.cluster = cluster
         self.name = name
@@ -40,6 +92,13 @@ class KubeLease:
         self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         # leaseDurationSeconds is an integer ≥ 1 on the wire
         self.duration = max(1, int(round(duration)))
+        # REJECTED-vs-UNREACHABLE verdict sink, shared across a lease set
+        self.status = status if status is not None else FenceStatus()
+        # client-clock expiry of OUR hold (set on successful acquire/renew):
+        # the unreachable-apiserver grace window is judged against this,
+        # never against anything a peer could have written
+        self._held_until: Optional[float] = None
+        self._unreachable_since: Optional[float] = None
 
     def _now(self) -> float:
         return self.cluster.clock()
@@ -48,23 +107,49 @@ class KubeLease:
         getter = getattr(self.cluster, "get_live", None)
         if getter is not None:
             try:
-                return getter("leases", self.name, namespace=self.namespace)
+                out = getter("leases", self.name, namespace=self.namespace)
             except NotFound:
-                return None
-        return self.cluster.try_get("leases", self.name, namespace=self.namespace)
+                out = None
+            # a completed round trip — even a 404 — proves reachability
+            self.status.contact()
+            return out
+        out = self.cluster.try_get("leases", self.name, namespace=self.namespace)
+        self.status.contact()  # the in-memory store always answers
+        return out
 
     def _expired(self, lease: Lease) -> bool:
         renew = lease.renew_time or lease.acquire_time or 0.0
         return renew + lease.lease_duration_seconds <= self._now()
 
+    def _mark_held(self, now: Optional[float] = None) -> None:
+        if now is None:
+            now = self._now()
+        self._held_until = now + self.duration
+        self._unreachable_since = None
+        self.status.contact()
+
     def try_acquire(self) -> bool:
+        # timestamp BEFORE the round trip: the server-side expiry runs from
+        # the renew/acquire time stamped at call entry, so marking the hold
+        # with a post-RTT clock would inflate _held_until by the acquire
+        # latency and eat into the fence safety margin
+        now = self._now()
         try:
-            return self._try_acquire()
-        except Exception:
+            ok = self._try_acquire()
+        except Exception as e:
             # transport blips and unexpected apiserver errors must read as
             # "not acquired", never kill the elector thread (split brain)
-            logger.exception("lease acquire failed; retrying on next tick")
+            if is_unreachable(e):
+                logger.debug(
+                    "lease acquire unreachable; retrying on next tick",
+                    exc_info=True,
+                )
+            else:
+                logger.exception("lease acquire failed; retrying on next tick")
             return False
+        if ok:
+            self._mark_held(now)
+        return ok
 
     def _try_acquire(self) -> bool:
         now = self._now()
@@ -101,19 +186,55 @@ class KubeLease:
         return False
 
     def renew(self) -> bool:
+        """Renew the hold. REJECTED (the apiserver answered "not yours" —
+        a peer holds it, it expired server-side, a racer's write won) is a
+        lost lease NOW, exactly as before fencing existed. UNREACHABLE (no
+        answer at all) keeps the hold until OUR OWN copy of the expiry
+        minus a safety margin, then fences — a 10s apiserver blip must not
+        read as fleet-wide lease loss (docs/partition.md)."""
+        now = self._now()
         try:
             current = self._get()
             if current is None or current.holder_identity != self.identity or self._expired(current):
-                return False
-            current.renew_time = self._now()
+                self._held_until = None
+                return False  # REJECTED: positively not ours any more
+            current.renew_time = now
             self.cluster.update("leases", current)
-            return True
-        except Exception:
-            # failed renewal reads as lost leadership — the safe direction
-            logger.exception("lease renew failed; treating as lost")
+        except Exception as e:
+            if is_unreachable(e):
+                return self._renew_unreachable(now)
+            # Conflict (a racer's write landed first), RBAC, programming
+            # errors: the apiserver ANSWERED — lost is the safe direction
+            logger.exception("lease renew rejected; treating as lost")
+            self._held_until = None
             return False
+        self._mark_held(now)
+        return True
+
+    def _renew_unreachable(self, now: float) -> bool:
+        margin = FENCE_MARGIN_FRACTION * self.duration
+        if self._held_until is not None and now < self._held_until - margin:
+            # still inside our own hold: no peer can legitimately own this
+            # lease yet, so keep serving — zero churn across a short blip
+            if self._unreachable_since is None:
+                self._unreachable_since = now
+                logger.warning(
+                    "apiserver unreachable; lease %s held optimistically "
+                    "(%.1fs until fence)",
+                    self.name, self._held_until - margin - now,
+                )
+            return True
+        # past expiry-minus-margin with the apiserver still silent: a peer
+        # whose apiserver works (asymmetric partition) may claim the
+        # expired lease any moment — fence, and report the hold lost
+        self.status.fence()
+        self._held_until = None
+        self._unreachable_since = None
+        return False
 
     def release(self) -> None:
+        self._held_until = None
+        self._unreachable_since = None
         try:
             current = self._get()
             if current is not None and current.holder_identity == self.identity:
@@ -155,11 +276,21 @@ class KubeLeaseSet:
         self.namespace = namespace
         self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self.duration = duration
+        # ONE fence status across every lease of the set: reachability is a
+        # property of the apiserver, so a single successful round trip on
+        # ANY lease (or the member LIST) un-fences the whole replica
+        self.status = FenceStatus()
         self._leases: dict = {}  # key -> KubeLease (lazily built; single-thread ShardManager use)
         self._member_lease: Optional[KubeLease] = None
         # one LIVE namespace LIST serves a whole tick (heartbeat's member
         # scan AND snapshot's holder resolution): (listing, fetched_at)
         self._listing: tuple = ((), float("-inf"))
+
+    def fenced(self) -> bool:
+        """Is this replica FENCED (apiserver unreachable past a held
+        lease's expiry margin)? ``fleet.ShardManager.fenced`` reads this;
+        launch guards and the GC sweep refuse cloud mutations while True."""
+        return self.status.fenced
 
     def _list_leases(self, max_age: Optional[float] = None) -> list:
         """List the namespace's leases UNCACHED — against a real apiserver
@@ -177,6 +308,7 @@ class KubeLeaseSet:
             leases = lister("leases", namespace=self.namespace)
         else:
             leases = self.cluster.list("leases", namespace=self.namespace)
+        self.status.contact()  # a completed LIST proves reachability
         self._listing = (tuple(leases), now)
         return list(leases)
 
@@ -199,6 +331,7 @@ class KubeLeaseSet:
                 namespace=self.namespace,
                 identity=self.identity,
                 duration=self.duration,
+                status=self.status,
             )
         return lease
 
@@ -211,6 +344,7 @@ class KubeLeaseSet:
                 namespace=self.namespace,
                 identity=self.identity,
                 duration=self.duration,
+                status=self.status,
             )
         if not self._member_lease.renew():
             self._member_lease.try_acquire()
@@ -224,8 +358,11 @@ class KubeLeaseSet:
     def members(self) -> set:
         try:
             leases = self._list_leases()
-        except Exception:
-            logger.exception("listing member leases failed")
+        except Exception as e:
+            if is_unreachable(e):
+                logger.debug("member lease list unreachable", exc_info=True)
+            else:
+                logger.exception("listing member leases failed")
             return {self.identity}
         prefix = f"{self.prefix}-member-"
         now = self.cluster.clock()
@@ -300,8 +437,11 @@ class KubeLeaseSet:
             # two full LISTs per tick per replica would double the
             # apiserver load for the same bytes
             leases = self._list_leases(max_age=min(1.0, self.duration / 3.0))
-        except Exception:
-            logger.exception("listing shard leases failed")
+        except Exception as e:
+            if is_unreachable(e):
+                logger.debug("shard lease list unreachable", exc_info=True)
+            else:
+                logger.exception("listing shard leases failed")
             return {}
         by_name = {lease.metadata.name: lease for lease in leases}
         now = self.cluster.clock()

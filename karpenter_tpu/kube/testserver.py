@@ -99,8 +99,22 @@ class _Request:
 class TestApiServer:
     __test__ = False  # not a pytest class, despite the name
 
-    def __init__(self, cluster: Optional[Cluster] = None, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        chaos=None,
+    ):
         self.cluster = cluster or Cluster()
+        # control-plane chaos (testing/chaos.py ApiServerChaos): when set,
+        # every request consults it first — injected 5xx, 429-with-
+        # Retry-After, latency, and blackout connection drops wrap the
+        # whole REST surface, watch connects included. Settable live so a
+        # storm leg can phase chaos on and off mid-run.
+        self.chaos = chaos
+        # the PDB pacing hint a blocked eviction advertises (Retry-After)
+        self.eviction_retry_after = 1.0
         self._watch_queues: Dict[str, list] = {k: [] for k in Cluster.KINDS}
         self._watch_lock = threading.Lock()
         # recent events per kind, stamped with the store version, so a
@@ -122,19 +136,31 @@ class TestApiServer:
             def log_message(self, *a):
                 pass
 
-            def _send_json(self, code: int, doc: dict) -> None:
+            def _send_json(self, code: int, doc: dict, headers=None) -> None:
                 body = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _chaos(self, method: str) -> bool:
+                """True when the chaos layer handled (or dropped) the
+                request; the real handler must return immediately."""
+                chaos = server.chaos
+                if chaos is None:
+                    return False
+                return chaos.intercept(self, method, self.path)
 
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(length) or b"{}")
 
             def do_GET(self):
+                if self._chaos("GET"):
+                    return
                 req = _Request(self.path)
                 if req.kind is None:
                     return self._send_json(404, _status(404, "NotFound", f"no resource {req.plural}"))
@@ -149,6 +175,8 @@ class TestApiServer:
                 self._send_json(200, serde.to_wire(req.kind, obj))
 
             def do_POST(self):
+                if self._chaos("POST"):
+                    return
                 req = _Request(self.path)
                 if req.kind is None:
                     return self._send_json(404, _status(404, "NotFound", f"no resource {req.plural}"))
@@ -167,6 +195,8 @@ class TestApiServer:
                 self._send_json(201, serde.to_wire(req.kind, created))
 
             def do_PUT(self):
+                if self._chaos("PUT"):
+                    return
                 req = _Request(self.path)
                 if req.kind is None or req.name is None:
                     return self._send_json(404, _status(404, "NotFound", "bad path"))
@@ -203,6 +233,8 @@ class TestApiServer:
                 self._send_json(200, serde.to_wire(req.kind, obj))
 
             def do_PATCH(self):
+                if self._chaos("PATCH"):
+                    return
                 req = _Request(self.path)
                 if req.kind is None or req.name is None:
                     return self._send_json(404, _status(404, "NotFound", "bad path"))
@@ -242,6 +274,8 @@ class TestApiServer:
                 self._send_json(200, serde.to_wire(req.kind, obj))
 
             def do_DELETE(self):
+                if self._chaos("DELETE"):
+                    return
                 req = _Request(self.path)
                 if req.kind is None or req.name is None:
                     return self._send_json(404, _status(404, "NotFound", "bad path"))
@@ -257,7 +291,19 @@ class TestApiServer:
                     return self._send_json(200, serde.to_wire(req.kind, still))
                 self._send_json(200, _status(200, "Success", "deleted"))
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                import sys
+
+                exc = sys.exc_info()[1]
+                # chaos blackout drops and impatient clients (short event
+                # deadlines) tear connections mid-request routinely — that
+                # is the scenario, not a server bug worth a traceback
+                if isinstance(exc, OSError):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = _Server((host, port), Handler)
         self._httpd.daemon_threads = True
         self.url = f"http://{host}:{self._httpd.server_address[1]}"
 
@@ -394,8 +440,11 @@ class TestApiServer:
         if pod is None:
             return handler._send_json(404, _status(404, "NotFound", f"pod {req.name}"))
         if not self.cluster.evict(pod):
+            # real apiserver semantics: the PDB 429 carries Retry-After so
+            # the evictor requeues on the server's schedule, not a blind one
             return handler._send_json(
-                429, _status(429, "TooManyRequests", "disruption budget violated")
+                429, _status(429, "TooManyRequests", "disruption budget violated"),
+                headers={"Retry-After": f"{self.eviction_retry_after:g}"},
             )
         handler._send_json(201, _status(201, "Created", "evicted"))
 

@@ -299,7 +299,10 @@ def build_runtime(
         # requeue, in-flight pods are guarded by worker.is_pending
         wait=False,
     )
-    termination = TerminationController(cluster, cloud_provider, start_queue=start_workers)
+    termination = TerminationController(
+        cluster, cloud_provider, start_queue=start_workers,
+        fenced=(ownership.fenced if ownership is not None else None),
+    )
     interruption = InterruptionController(
         cluster, cloud_provider, provisioning=provisioning, termination=termination,
         ownership=ownership,

@@ -691,3 +691,82 @@ SOLVER_STAGE_DURATION = Histogram(
     buckets=DURATION_BUCKETS,
     registry=REGISTRY,
 )
+
+# Kube client transport (docs/partition.md): every apiserver request —
+# reads, writes, watch re-lists, lease renewals, event writes — crosses the
+# kube/transport.py choke point, and these are its scrape surface. The
+# duration histogram is per ATTEMPT (client-go's request-duration shape) so
+# a retried call shows each round trip; `code` is the HTTP status, or
+# "error" for a connection-level failure.
+KUBE_REQUEST_DURATION = Histogram(
+    "request_duration_seconds",
+    "Kubernetes apiserver request latency per attempt, by HTTP verb, "
+    "resource kind, and response code (\"error\" = connection failure).",
+    ["verb", "kind", "code"],
+    namespace=NAMESPACE,
+    subsystem="kube",
+    buckets=DURATION_BUCKETS,
+    registry=REGISTRY,
+)
+
+KUBE_REQUEST_RETRIES = Counter(
+    "request_retries_total",
+    "Kube transport retries, by verb class (read/mutate/watch — creates "
+    "and events are never retried at the transport).",
+    ["verb_class"],
+    namespace=NAMESPACE,
+    subsystem="kube",
+    registry=REGISTRY,
+)
+
+KUBE_THROTTLED = Counter(
+    "throttled_total",
+    "Kube requests delayed or refused by flow control, by source: "
+    "\"server\" = an apiserver 429 (its Retry-After is honored), "
+    "\"client\" = the local QPS/burst limiter made the call wait.",
+    ["source"],
+    namespace=NAMESPACE,
+    subsystem="kube",
+    registry=REGISTRY,
+)
+
+KUBE_EVENTS_DROPPED = Counter(
+    "events_dropped_total",
+    "Kubernetes Event writes dropped by the zero-retry/short-deadline "
+    "events policy — an Event must never hold a reconcile hostage to a "
+    "slow apiserver; drops lose audit detail, not correctness.",
+    namespace=NAMESPACE,
+    subsystem="kube",
+    registry=REGISTRY,
+)
+
+KUBE_DEGRADED_READS = Counter(
+    "degraded_reads_total",
+    "Live reads served from the informer cache because the apiserver "
+    "breaker is open (degraded read-from-cache mode).",
+    namespace=NAMESPACE,
+    subsystem="kube",
+    registry=REGISTRY,
+)
+
+KUBE_RELISTS = Counter(
+    "relists_total",
+    "Informer full re-LISTs, by kind — each one re-dispatches MODIFIED "
+    "for every cached object; a down apiserver paces these with jittered "
+    "exponential backoff instead of a hot loop.",
+    ["kind"],
+    namespace=NAMESPACE,
+    subsystem="kube",
+    registry=REGISTRY,
+)
+
+FLEET_FENCED = Gauge(
+    "fenced",
+    "1 while this replica is FENCED: the apiserver has been unreachable "
+    "past its shard leases' expiry margin, so a peer may legitimately own "
+    "its shards — cloud creates and GC terminates are refused until the "
+    "control plane answers again (docs/partition.md).",
+    namespace=NAMESPACE,
+    subsystem="fleet",
+    registry=REGISTRY,
+)

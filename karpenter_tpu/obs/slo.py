@@ -91,6 +91,10 @@ SPAN_SOURCES: Dict[str, Tuple[str, str]] = {
     "provision": ("provision.round", "duration"),
     "time_to_bind": ("provision.round", "duration+admission"),
     "sidecar.pack": ("sidecar.pack", "duration"),
+    # the kube transport choke point (kube/transport.py): one span per
+    # logical apiserver request, so `kube.p99 < 1s` pages on a browning-out
+    # control plane before the breaker has to open
+    "kube": ("kube.request", "duration"),
 }
 
 # ratio sources fed by explicit events (not spans): full grammar lhs
@@ -114,6 +118,9 @@ DEFAULT_OBJECTIVES = (
     "provision.success_rate >= 0.999",
     "time_to_bind.p99 < 5s",
     "session.catalog_hit_rate >= 0.9",
+    # apiserver health as seen from THIS client (per kube.request span) —
+    # a browning-out control plane burns this first, before binds fail
+    "kube.p99 < 1s",
 )
 # the sidecar's own view: its end-to-end unit is the pack span, and the
 # session store it owns is the hit-rate source of truth

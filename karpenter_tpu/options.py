@@ -181,8 +181,20 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
     ap.add_argument("--cluster-endpoint", default=opts.cluster_endpoint)
     ap.add_argument("--metrics-port", type=int, default=opts.metrics_port)
     ap.add_argument("--health-probe-port", type=int, default=opts.health_probe_port)
-    ap.add_argument("--kube-client-qps", type=float, default=opts.kube_client_qps)
-    ap.add_argument("--kube-client-burst", type=int, default=opts.kube_client_burst)
+    # --kube-qps/--kube-burst: the client-go-style flow-control spellings
+    # (docs/partition.md) — same knobs, feeding the transport's
+    # mutation-priority token bucket (kube/transport.py)
+    ap.add_argument(
+        "--kube-client-qps", "--kube-qps", type=float,
+        default=opts.kube_client_qps,
+        help="client-side apiserver flow control: sustained requests/sec "
+        "(mutations are prioritized over reads inside the bucket)",
+    )
+    ap.add_argument(
+        "--kube-client-burst", "--kube-burst", type=int,
+        default=opts.kube_client_burst,
+        help="client-side apiserver flow control: burst bucket size",
+    )
     ap.add_argument("--cloud-provider", default=opts.cloud_provider)
     ap.add_argument("--kube-api-server", default=opts.kube_api_server,
                     help="apiserver URL ('' = in-memory store, 'in-cluster' = pod env)")

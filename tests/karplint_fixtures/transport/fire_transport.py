@@ -1,0 +1,20 @@
+# karplint-fixture: expect=kube-transport
+"""A controller reaching around the kube transport choke point: raw
+``http.client`` AND a direct ``._request`` on someone else's client —
+both unmetered, unthrottled, breaker-invisible apiserver traffic."""
+import http.client
+
+
+def sneak_patch(cluster, name):
+    # bypasses retries/flow control/metrics: the exact blind single-shot
+    # write the transport exists to eliminate
+    status, doc = cluster._request(
+        "PATCH", f"/api/v1/nodes/{name}", {"spec": {"unschedulable": True}}
+    )
+    return status, doc
+
+
+def sneak_raw(host):
+    conn = http.client.HTTPConnection(host)
+    conn.request("GET", "/api/v1/pods")
+    return conn.getresponse().status

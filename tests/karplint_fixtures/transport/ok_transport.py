@@ -1,0 +1,27 @@
+# karplint-fixture: clean=kube-transport
+"""Near-misses that must stay clean: a module using its OWN private wire
+helper (the cloud HTTP wire's shape — its `_request` is its choke point),
+and ordinary Cluster-surface calls."""
+import urllib.request
+
+
+class OwnWire:
+    """Defines its own ``_request``: calling it is this module's private
+    transport discipline, not a kube-transport bypass."""
+
+    base_url = "http://cloud.example"
+
+    def _request(self, method, path, body=None):
+        req = urllib.request.Request(self.base_url + path, method=method)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status
+
+    def describe(self):
+        return self._request("GET", "/v1/instances")
+
+
+def through_the_surface(cluster, name):
+    # the sanctioned path: every one of these crosses kube/transport.py
+    live = cluster.get_live("nodes", name, namespace="")
+    cluster.merge_patch("nodes", name, {"spec": {"unschedulable": True}}, namespace="")
+    return live

@@ -742,7 +742,9 @@ class TestSloGrammar:
             DEFAULT_OBJECTIVES, SIDECAR_OBJECTIVES, parse_objectives,
         )
 
-        assert len(parse_objectives(DEFAULT_OBJECTIVES)) == 4
+        # 5 since the kube transport landed: solve / provision /
+        # time_to_bind / session hit rate / kube.p99 (docs/partition.md)
+        assert len(parse_objectives(DEFAULT_OBJECTIVES)) == 5
         assert len(parse_objectives(SIDECAR_OBJECTIVES)) == 2
 
     @pytest.mark.parametrize("expr", [

@@ -1,0 +1,839 @@
+"""Control-plane partition tolerance (docs/partition.md): the resilient
+kube transport (per-verb retries, 429/Retry-After, mutation-priority flow
+control, the apiserver breaker + degraded cache reads), the watch-loop
+backoff hot-fix, the eviction Retry-After satellite, the events
+zero-retry policy, bind-409 disposition, and REJECTED-vs-UNREACHABLE
+lease-loss fencing through the shard manager and the launch/GC guards."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu import metrics as m
+from karpenter_tpu.kube.apiserver import ApiCluster, ApiError
+from karpenter_tpu.kube.client import Cluster, Conflict, NotFound
+from karpenter_tpu.kube.leader import (
+    FENCE_MARGIN_FRACTION,
+    FenceStatus,
+    KubeLease,
+    KubeLeaseSet,
+)
+from karpenter_tpu.kube.testserver import TestApiServer
+from karpenter_tpu.kube.transport import (
+    VERB_CREATE,
+    VERB_EVENTS,
+    VERB_MUTATE,
+    VERB_READ,
+    ApiUnavailable,
+    FlowLimiter,
+    KubeThrottled,
+    KubeTransport,
+    is_unreachable,
+)
+from karpenter_tpu.resilience import CircuitBreaker
+from karpenter_tpu.testing.chaos import ApiServerChaos, ChaosWindow
+from tests.factories import make_pdb, make_pod, make_provisioner
+
+
+def _counter(name, labels=None):
+    return m.REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _transport(clock=None, sleeps=None, **kw):
+    """A KubeTransport with an injected clock and a sleep recorder (sleeps
+    advance the fake clock, so deadlines behave)."""
+    clock = clock or _FakeClock()
+    sleeps = sleeps if sleeps is not None else []
+
+    def sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    kw.setdefault("qps", 1000.0)
+    kw.setdefault("burst", 1000)
+    return KubeTransport(clock=clock, sleep=sleep, **kw), clock, sleeps
+
+
+# ---------------------------------------------------------------------------
+# flow control
+# ---------------------------------------------------------------------------
+
+
+class TestFlowLimiter:
+    def test_mutation_priority_reserve(self):
+        """Reads cannot drain the bucket below the mutation reserve; a
+        mutation still gets a token after reads start refusing."""
+        clock = _FakeClock()
+        limiter = FlowLimiter(qps=0.000001, burst=10, clock=clock, sleep=lambda s: None)
+        reads = 0
+        while limiter.try_take(False):
+            reads += 1
+            assert reads < 100
+        assert reads < 10  # the reserve held some tokens back
+        assert limiter.try_take(True)  # a mutation spends the reserve
+        # and once truly empty, mutations refuse too
+        while limiter.try_take(True):
+            pass
+        assert not limiter.try_take(True)
+
+    def test_take_reports_waits(self):
+        clock = _FakeClock()
+
+        def sleep(s):
+            clock.advance(s)
+
+        limiter = FlowLimiter(qps=100.0, burst=1, clock=clock, sleep=sleep)
+        ok, waited = limiter.take(True, timeout=1.0)
+        assert ok and not waited
+        ok, waited = limiter.take(True, timeout=1.0)
+        assert ok and waited  # had to wait for the refill
+        limiter2 = FlowLimiter(qps=0.000001, burst=1, clock=clock, sleep=sleep)
+        assert limiter2.try_take(True)
+        ok, waited = limiter2.take(True, timeout=0.05)
+        assert not ok and waited  # bounded: gives up at the timeout
+
+
+# ---------------------------------------------------------------------------
+# the transport policy ladder (unit, fake attempts)
+# ---------------------------------------------------------------------------
+
+
+class TestKubeTransport:
+    def test_read_retries_5xx_then_succeeds(self):
+        transport, clock, sleeps = _transport()
+        answers = [(503, {}, None), (503, {}, None), (200, {"ok": 1}, None)]
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return answers[len(calls) - 1]
+
+        before = _counter("karpenter_kube_request_retries_total", {"verb_class": "read"})
+        status, doc, _ = transport.request(VERB_READ, "GET", "pods", attempt)
+        assert status == 200 and doc == {"ok": 1}
+        assert len(calls) == 3
+        assert len(sleeps) == 2  # two jittered backoffs
+        assert _counter(
+            "karpenter_kube_request_retries_total", {"verb_class": "read"}
+        ) == before + 2
+
+    def test_connection_errors_retry_then_raise(self):
+        transport, clock, sleeps = _transport()
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            raise ConnectionRefusedError("down")
+
+        with pytest.raises(ConnectionRefusedError):
+            transport.request(VERB_MUTATE, "PATCH", "nodes", attempt)
+        assert len(calls) == 3  # max_attempts for the mutate class
+
+    def test_create_is_never_retried(self):
+        transport, clock, sleeps = _transport()
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return 503, {"kind": "Status"}, None
+
+        status, doc, _ = transport.request(VERB_CREATE, "POST", "nodes", attempt)
+        assert status == 503
+        assert len(calls) == 1
+        assert sleeps == []
+
+    def test_429_retry_after_is_honored(self):
+        """The server's own hint paces the retry — not the jitter ladder."""
+        transport, clock, sleeps = _transport()
+        answers = [(429, {}, 0.07), (200, {}, None)]
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return answers[len(calls) - 1]
+
+        status, _, _ = transport.request(VERB_MUTATE, "PATCH", "pods", attempt)
+        assert status == 200
+        assert sleeps == [0.07]
+
+    def test_429_on_create_surfaces_the_hint(self):
+        transport, clock, sleeps = _transport()
+        with pytest.raises(KubeThrottled) as ei:
+            transport.request(
+                VERB_CREATE, "POST", "pods", lambda: (429, {}, 0.35)
+            )
+        assert ei.value.retry_after == pytest.approx(0.35)
+        assert sleeps == []
+
+    def test_429_counts_as_breaker_success(self):
+        """A throttling apiserver is ALIVE: a 429 storm must never open
+        the breaker (that would turn backpressure into an outage)."""
+        transport, clock, sleeps = _transport()
+        for _ in range(20):
+            with pytest.raises(KubeThrottled):
+                transport.request(
+                    VERB_CREATE, "POST", "pods", lambda: (429, {}, 0.01)
+                )
+        assert not transport.degraded()
+
+    def test_breaker_opens_then_half_open_recovers(self):
+        clock = _FakeClock()
+        transport, clock, sleeps = _transport(clock=clock)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            return 503, {}, None
+
+        # sustained 5xx: the windowed failure rate opens the breaker (each
+        # logical read pays up to 3 attempts; the breaker can open MID-
+        # ladder, failing the remaining attempts fast)
+        for _ in range(4):
+            try:
+                transport.request(VERB_READ, "GET", "pods", failing)
+            except ApiUnavailable:
+                break
+        assert transport.degraded()
+        n = len(calls)
+        with pytest.raises(ApiUnavailable):
+            transport.request(VERB_READ, "GET", "pods", failing)
+        assert len(calls) == n  # fast-fail: no attempt was paid
+        # cool-off elapses: one half-open probe is admitted and closes it
+        clock.advance(transport.breaker.open_seconds + 0.1)
+        status, _, _ = transport.request(
+            VERB_READ, "GET", "pods", lambda: (200, {}, None)
+        )
+        assert status == 200
+        assert not transport.degraded()
+
+    def test_lease_class_bypasses_an_open_breaker(self):
+        """Lease traffic IS the fencing signal: a breaker opened by OTHER
+        traffic must not fast-fail renewals — a 1s blip would otherwise
+        read as a 5s outage to the lease layer (spurious fencing)."""
+        from karpenter_tpu.kube.transport import VERB_LEASE
+
+        transport, clock, sleeps = _transport()
+        transport.breaker.trip()
+        assert transport.degraded()
+        with pytest.raises(ApiUnavailable):
+            transport.request(VERB_READ, "GET", "pods", lambda: (200, {}, None))
+        status, _, _ = transport.request(
+            VERB_LEASE, "PUT", "leases", lambda: (200, {}, None)
+        )
+        assert status == 200  # the real attempt was paid, breaker or not
+
+    def test_round_budget_caps_retries(self):
+        """An exhausted reconcile-round Budget degrades to retry-free."""
+        from karpenter_tpu.resilience import Budget
+
+        transport, clock, sleeps = _transport()
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return 503, {}, None
+
+        budget = Budget(0.01, clock=clock)
+        with budget.activate():
+            status, _, _ = transport.request(VERB_READ, "GET", "pods", attempt)
+        assert status == 503
+        assert len(calls) == 1
+
+    def test_events_drop_counter(self):
+        transport, clock, sleeps = _transport()
+        before = _counter("karpenter_kube_events_dropped_total")
+
+        def attempt():
+            raise ConnectionResetError("slow apiserver")
+
+        with pytest.raises(ConnectionResetError):
+            transport.request(VERB_EVENTS, "POST", "events", attempt)
+        assert _counter("karpenter_kube_events_dropped_total") == before + 1
+        assert sleeps == []  # zero retries for the events class
+
+    def test_events_5xx_also_counts_as_dropped(self):
+        """A 503 brownout answer is RETURNED (the recorder swallows the
+        ApiError): that write is just as lost as a timeout — the triage
+        counter must see it."""
+        transport, clock, sleeps = _transport()
+        before = _counter("karpenter_kube_events_dropped_total")
+        status, _, _ = transport.request(
+            VERB_EVENTS, "POST", "events", lambda: (503, {}, None)
+        )
+        assert status == 503
+        assert _counter("karpenter_kube_events_dropped_total") == before + 1
+
+    def test_unreachable_classification(self):
+        assert is_unreachable(ApiUnavailable("open"))
+        assert is_unreachable(KubeThrottled("429", 1.0))
+        assert is_unreachable(ConnectionRefusedError())
+        assert is_unreachable(TimeoutError())
+        assert is_unreachable(ApiError(503, "storm"))
+        assert is_unreachable(ApiError(429, "brownout"))
+        assert not is_unreachable(ApiError(403, "rbac"))
+        assert not is_unreachable(Conflict("409"))
+        assert not is_unreachable(NotFound("404"))
+        assert not is_unreachable(ValueError("bug"))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against the protocol double (+ ApiServerChaos)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def env():
+    server = TestApiServer()
+    server.start()
+    clients = []
+
+    def connect(**kw):
+        kw.setdefault("kinds", ())
+        c = ApiCluster(server.url, **kw)
+        # CI-speed retry pacing; the ladder shape is what's under test
+        c.transport._backoff_base = 0.01
+        c.transport._backoff_cap = 0.05
+        clients.append(c)
+        return c
+
+    server.connect = connect
+    yield server
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+class TestTransportE2E:
+    def test_patch_rides_through_transient_5xx(self, env):
+        """The satellite's conflict/transient coverage: a PATCH that eats
+        two injected 503s still lands (idempotent verb class retries)."""
+        cluster = env.connect()
+        cluster.create("provisioners", make_provisioner(name="p1"))
+        chaos = ApiServerChaos(seed=7)
+        env.chaos = chaos
+        chaos.fail_next("PATCH", 2)
+        before = _counter(
+            "karpenter_kube_request_retries_total", {"verb_class": "mutate"}
+        )
+        fresh = cluster.patch_status(
+            "provisioners", "p1", {"lastScaleTime": "2026-08-03T00:00:00Z"},
+            namespace="",
+        )
+        assert fresh is not None
+        assert chaos.counts(chaos.injected) == 2
+        assert _counter(
+            "karpenter_kube_request_retries_total", {"verb_class": "mutate"}
+        ) == before + 2
+
+    def test_conflicts_stay_loud_under_chaos(self, env):
+        """A 409 is a POSITIVE answer: even with chaos injecting transient
+        errors around it, create/update conflicts surface as Conflict, and
+        are never retried into silent success."""
+        cluster = env.connect()
+        prov = make_provisioner(name="dup")
+        cluster.create("provisioners", prov)
+        env.chaos = ApiServerChaos(seed=3)
+        env.chaos.fail_next("PUT", 1)
+        live = cluster.get_live("provisioners", "dup", namespace="")
+        live.metadata.resource_version = 999999  # stale: a racer's write won
+        with pytest.raises(Conflict):
+            cluster.update("provisioners", live)
+        with pytest.raises(Conflict):
+            cluster.create("provisioners", make_provisioner(name="dup"))
+
+    def test_server_429_retry_after_paces_the_mutate_ladder(self, env):
+        cluster = env.connect()
+        cluster.create("provisioners", make_provisioner(name="throttled"))
+        chaos = ApiServerChaos(throttle_rate=1.0, retry_after=0.05, seed=1)
+        env.chaos = chaos
+        before = _counter("karpenter_kube_throttled_total", {"source": "server"})
+        t0 = time.perf_counter()
+        with pytest.raises(KubeThrottled) as ei:
+            cluster.patch_status(
+                "provisioners", "throttled", {"lastScaleTime": "x"}, namespace=""
+            )
+        # all three attempts throttled: two Retry-After sleeps were paid
+        assert time.perf_counter() - t0 >= 0.1
+        assert ei.value.retry_after == pytest.approx(0.05)
+        assert _counter(
+            "karpenter_kube_throttled_total", {"source": "server"}
+        ) >= before + 3
+
+    def test_degraded_reads_serve_the_cache(self, env):
+        """Breaker OPEN -> get_live answers from the informer view for
+        watched kinds, raises ApiUnavailable for un-watched ones (leases:
+        nothing cached there but our own write echoes)."""
+        cluster = env.connect(kinds=("pods",))  # pods ARE informer-watched
+        cluster.transport.breaker = CircuitBreaker(
+            dependency="kube-apiserver", min_volume=2, failure_rate=0.5,
+            open_seconds=60.0,
+        )
+        node_pod = make_pod(name="cached-pod")
+        cluster.create("pods", node_pod)  # populates the local cache
+        env.chaos = ApiServerChaos()
+        env.chaos.blackout(120.0)
+        for _ in range(3):  # feed the breaker its failures
+            try:
+                cluster.get_live("provisioners", "nope", namespace="")
+            except Exception:
+                pass
+        assert cluster.degraded()
+        before = _counter("karpenter_kube_degraded_reads_total")
+        got = cluster.get_live("pods", "cached-pod")
+        assert got.metadata.name == "cached-pod"
+        assert _counter("karpenter_kube_degraded_reads_total") == before + 1
+        # lease traffic bypasses the breaker (it IS the fencing signal) —
+        # it pays the real attempt and fails UNREACHABLE, never from cache
+        with pytest.raises(Exception) as ei:
+            cluster.get_live("leases", "some-lease", namespace="kube-system")
+        assert is_unreachable(ei.value)
+        with pytest.raises(Exception) as ei:
+            cluster.list_live("leases", namespace="kube-system")
+        assert is_unreachable(ei.value)
+
+    def test_bind_409_same_node_is_idempotent(self, env):
+        cluster = env.connect()
+        pod = make_pod(name="bound-once")
+        cluster.create("pods", pod)
+        cluster.bind(pod, "node-a")
+        # a lost response replayed: the server answers 409, the live pod
+        # already points at the SAME node — the goal was achieved
+        replay = make_pod(name="bound-once")
+        replay.spec.node_name = ""
+        cluster.bind(replay, "node-a")
+        assert replay.spec.node_name == "node-a"
+
+    def test_bind_409_different_node_raises(self, env):
+        """The non-idempotent arm (satellite coverage): the live pod is
+        bound ELSEWHERE — rebinding would double-place it, so it raises."""
+        cluster = env.connect()
+        pod = make_pod(name="contested")
+        cluster.create("pods", pod)
+        cluster.bind(pod, "node-a")
+        rival = make_pod(name="contested")
+        rival.spec.node_name = ""
+        with pytest.raises(Conflict):
+            cluster.bind(rival, "node-b")
+        assert rival.spec.node_name == ""
+
+    def test_bind_409_pod_gone_raises(self, env):
+        cluster = env.connect()
+        pod = make_pod(name="vanishing")
+        cluster.create("pods", pod)
+        cluster.bind(pod, "node-a")
+        env.cluster.delete("pods", "vanishing")
+        ghost = make_pod(name="vanishing")
+        ghost.spec.node_name = ""
+        with pytest.raises((Conflict, NotFound)):
+            cluster.bind(ghost, "node-b")
+
+    def test_evict_surfaces_retry_after(self, env):
+        """The satellite: a PDB-blocked eviction's 429 Retry-After header
+        rides back to the caller instead of being discarded."""
+        env.eviction_retry_after = 0.35
+        cluster = env.connect()
+        pod = make_pod(name="protected", labels={"app": "guarded"})
+        pod.spec.node_name = "node-a"
+        env.cluster.seed("pods", pod)
+        env.cluster.create("pdbs", make_pdb(
+            name="guard", labels={"app": "guarded"}, min_available=1,
+        ))
+        ok, hint = cluster.evict_with_hint(pod)
+        assert not ok
+        assert hint == pytest.approx(0.35)
+        # the boolean surface still answers plain False
+        assert cluster.evict(pod) is False
+
+    def test_eviction_queue_honors_the_hint(self, env):
+        """Termination's rate-limited requeue uses the server's schedule,
+        not the blind exponential interval."""
+        from karpenter_tpu.controllers.termination import EvictionQueue
+
+        env.eviction_retry_after = 0.3
+        cluster = env.connect()
+        pod = make_pod(name="queued", labels={"app": "guarded"})
+        pod.spec.node_name = "node-a"
+        env.cluster.seed("pods", pod)
+        # the queue's pre-check reads the CLIENT's informer view: seed the
+        # cache too (this client runs no watches)
+        cluster.seed("pods", pod)
+        env.cluster.create("pdbs", make_pdb(
+            name="guard", labels={"app": "guarded"}, min_available=1,
+        ))
+        q = EvictionQueue(cluster, start=False)
+        q.add([pod])
+        key = q.queue.get(timeout=1.0)
+        assert not q.process_one(key)
+        with q.queue._lock:
+            assert len(q.queue._delayed) == 1
+            ready_at, _, requeued = q.queue._delayed[0]
+        assert requeued == key
+        delay = ready_at - time.monotonic()
+        # ~the server's 0.3s hint — NOT the 0.1s blind base interval
+        assert 0.15 < delay <= 0.31
+
+    def test_event_write_never_blocks_a_reconcile(self, env):
+        """Events ride the zero-retry/short-deadline class: with the
+        apiserver injecting 1s latency, the recorder returns fast and the
+        drop is counted."""
+        from karpenter_tpu.api.objects import Event, ObjectMeta
+        from karpenter_tpu.kube.events import recorder_for
+
+        cluster = env.connect()
+        cluster.events_timeout = 0.2
+        env.chaos = ApiServerChaos(latency_floor=1.0)
+        before = _counter("karpenter_kube_events_dropped_total")
+        t0 = time.perf_counter()
+        out = recorder_for(cluster).event(
+            "Node", "slow-node", "Launched", "latency chaos", type="Normal"
+        )
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.9, f"event write blocked {elapsed:.2f}s"
+        assert out is None  # fire-and-forget: dropped, not raised
+        assert _counter("karpenter_kube_events_dropped_total") == before + 1
+
+    def test_watch_relist_backs_off_under_blackout(self, env):
+        """The hot-loop satellite: a down apiserver drives bounded,
+        exponentially-spaced re-list attempts, and recovery re-syncs."""
+        cluster = ApiCluster(env.url, kinds=("pods",))
+        cluster.transport._backoff_base = 0.01
+        cluster.transport._backoff_cap = 0.02
+        cluster.watch_backoff_base = 0.05
+        cluster.watch_backoff_cap = 0.4
+        env.chaos = ApiServerChaos()
+        env.chaos.blackout(1.0)
+        try:
+            cluster.start()
+            time.sleep(1.0)
+            attempts = cluster.relist_attempts.get("pods", 0)
+            # 0.05+0.1+0.2+0.4... exponential: a handful, not dozens (the
+            # old fixed delay would log ~20 at this base; a hot loop 100s)
+            assert 1 <= attempts <= 8, f"{attempts} relists in 1s"
+            # blackout over: the next paced attempt succeeds and syncs
+            assert cluster.wait_for_sync(10.0)
+        finally:
+            cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# lease-loss fencing: REJECTED vs UNREACHABLE
+# ---------------------------------------------------------------------------
+
+
+class _PartitionedCluster:
+    """In-memory Cluster proxy whose lease surface can be partitioned:
+    while ``down``, every read/write raises a connection error — exactly
+    what the transport surfaces when the apiserver is gone."""
+
+    _CUT = frozenset({
+        "try_get", "get", "list", "create", "update", "delete", "bind",
+    })
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self.down = False
+
+    def __getattr__(self, name):
+        attr = getattr(self._cluster, name)
+        if not callable(attr) or name not in self._CUT:
+            return attr
+
+        def guarded(*args, **kwargs):
+            if self.down:
+                raise ConnectionRefusedError("chaos: apiserver partitioned")
+            return attr(*args, **kwargs)
+
+        return guarded
+
+
+class TestLeaseFencing:
+    def _lease(self, duration=10.0):
+        clock = _FakeClock()
+        backing = Cluster(clock=clock)
+        cluster = _PartitionedCluster(backing)
+        lease = KubeLease(cluster, name="shard-a", identity="r1", duration=duration)
+        return lease, cluster, clock
+
+    def test_sub_expiry_blip_keeps_the_hold(self):
+        lease, cluster, clock = self._lease()
+        assert lease.try_acquire()
+        cluster.down = True  # blip begins
+        clock.advance(3.0)
+        assert lease.renew(), "a sub-expiry blip must not read as lease loss"
+        assert not lease.status.fenced
+        cluster.down = False  # blip ends: a real renew re-anchors expiry
+        assert lease.renew()
+        clock.advance(9.0)  # would be past the ORIGINAL expiry
+        cluster.down = True
+        assert not lease.status.fenced
+
+    def test_fences_past_expiry_margin(self):
+        lease, cluster, clock = self._lease(duration=10.0)
+        assert lease.try_acquire()
+        cluster.down = True
+        margin = FENCE_MARGIN_FRACTION * lease.duration
+        clock.advance(10.0 - margin - 0.5)
+        assert lease.renew()  # still inside the grace window
+        clock.advance(1.0)  # now past expiry - margin
+        assert not lease.renew()
+        assert lease.status.fenced
+
+    def test_recovery_lifts_the_fence(self):
+        lease, cluster, clock = self._lease()
+        assert lease.try_acquire()
+        cluster.down = True
+        clock.advance(50.0)
+        assert not lease.renew()
+        assert lease.status.fenced
+        cluster.down = False
+        assert lease.try_acquire()  # expired server-side: re-acquirable
+        assert not lease.status.fenced
+
+    def test_rejected_is_still_instant_loss(self):
+        """A peer's takeover must behave exactly as before fencing existed:
+        renewal answers False NOW, and nothing fences."""
+        lease, cluster, clock = self._lease(duration=10.0)
+        assert lease.try_acquire()
+        clock.advance(11.0)  # expired; a peer claims it
+        rival = KubeLease(cluster, name="shard-a", identity="r2", duration=10.0)
+        assert rival.try_acquire()
+        assert not lease.renew()
+        assert not lease.status.fenced
+
+    def test_shard_manager_fences_end_to_end(self):
+        """KubeLeaseSet + ShardManager: blip -> zero churn; blackout past
+        expiry -> on_lost + fenced() True + gauge; recovery -> re-owned."""
+        from karpenter_tpu.fleet import ShardManager
+
+        clock = _FakeClock()
+        backing = Cluster(clock=clock)
+        cluster = _PartitionedCluster(backing)
+        leases = KubeLeaseSet(cluster, identity="r1", duration=10.0)
+        gained, lost = [], []
+        mgr = ShardManager(
+            leases, keys_fn=lambda: {"p1"},
+            on_acquired=gained.append, on_lost=lost.append,
+            include_default_shard=False,
+        )
+        mgr.tick()
+        assert mgr.owns("p1") and gained == ["p1"]
+        # sub-expiry blip: renewed optimistically, zero churn
+        cluster.down = True
+        clock.advance(3.0)
+        mgr.tick()
+        assert mgr.owns("p1") and lost == [] and not mgr.fenced()
+        # blackout outlives the lease: fence + synchronous loss
+        clock.advance(20.0)
+        mgr.tick()
+        assert lost == ["p1"]
+        assert not mgr.owns("p1")
+        assert mgr.fenced()
+        assert _counter("karpenter_fleet_fenced") == 1.0
+        # partition heals: the next ticks re-own and un-fence
+        cluster.down = False
+        mgr.tick()
+        mgr.tick()
+        assert mgr.owns("p1")
+        assert not mgr.fenced()
+        assert _counter("karpenter_fleet_fenced") == 0.0
+
+    def test_acquire_hold_is_timestamped_before_the_round_trip(self):
+        """A slow-but-answering acquire must not inflate the client-side
+        hold by its own RTT — that would eat the fence safety margin and
+        reopen the split-brain window the margin exists to cover."""
+        lease, cluster, clock = self._lease(duration=10.0)
+        t0 = clock()
+        orig = KubeLease._try_acquire
+
+        def slow_acquire(self):
+            out = orig(self)
+            clock.advance(5.0)  # the round trip took 5s
+            return out
+
+        KubeLease._try_acquire = slow_acquire
+        try:
+            assert lease.try_acquire()
+        finally:
+            KubeLease._try_acquire = orig
+        assert lease._held_until == pytest.approx(t0 + 10.0)
+
+    def test_file_lease_backends_never_fence(self, tmp_path):
+        from karpenter_tpu.fleet import ShardManager, build_lease_set
+
+        leases = build_lease_set(str(tmp_path / "shards"), identity="r1")
+        mgr = ShardManager(leases, keys_fn=lambda: set())
+        assert mgr.fenced() is False
+
+
+class TestFencedGuards:
+    def _worker(self, fenced):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.provisioning import ProvisionerWorker
+
+        cluster = Cluster()
+        worker = ProvisionerWorker(
+            make_provisioner(), cluster, FakeCloudProvider(instance_types(5)),
+            owned=lambda: True, fenced=fenced,
+        )
+        worker.batcher.idle_duration = 0.01
+        return cluster, worker
+
+    def test_fenced_worker_refuses_the_launch(self):
+        fenced = {"v": False}
+        cluster, worker = self._worker(lambda: fenced["v"])
+        pod = make_pod(name="fenced-pod", requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        worker.add(pod)
+        fenced["v"] = True  # the blackout outlived the lease mid-flight
+        before = _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        )
+        worker.provision_once()
+        assert not pod.spec.node_name
+        assert cluster.nodes() == []
+        assert _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        ) == before + 1
+
+    def test_unfenced_worker_launches(self):
+        cluster, worker = self._worker(lambda: False)
+        pod = make_pod(name="free-pod", requests={"cpu": "0.5"})
+        cluster.create("pods", pod)
+        worker.add(pod)
+        worker.provision_once()
+        assert pod.spec.node_name
+
+    def test_fenced_termination_defers_the_cloud_delete(self):
+        """Finalizer-driven teardown acts on the informer view, which is
+        stale while fenced — the cloud delete waits for the control plane
+        (cloud-NOTIFIED interruption terminates stay un-gated)."""
+        from karpenter_tpu.api import labels as lbl
+        from karpenter_tpu.controllers.termination import TerminationController
+        from karpenter_tpu.testing.factories import make_node
+
+        class _Provider:
+            deletes = 0
+
+            def delete(self, node):
+                self.deletes += 1
+
+        fenced = {"v": True}
+        cluster = Cluster()
+        provider = _Provider()
+        tc = TerminationController(
+            cluster, provider, start_queue=False, fenced=lambda: fenced["v"]
+        )
+        node = make_node(name="draining")
+        node.metadata.deletion_timestamp = cluster.clock()
+        node.metadata.finalizers = [lbl.TERMINATION_FINALIZER]
+        cluster.seed("nodes", node)
+        before = _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        )
+        assert tc.reconcile("draining") == tc.DRAIN_REQUEUE
+        assert provider.deletes == 0
+        assert _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        ) == before + 1
+        fenced["v"] = False  # the control plane answered: teardown resumes
+        assert tc.reconcile("draining") is None
+        assert provider.deletes == 1
+
+    def test_gc_sweep_skips_while_fenced(self):
+        from karpenter_tpu.controllers.garbage_collection import (
+            GC_POLL_KEY,
+            GarbageCollectionController,
+        )
+
+        class _Fenced:
+            def owns(self, key):
+                return True
+
+            def fenced(self):
+                return True
+
+        class _Provider:
+            calls = 0
+
+            def list_instances(self):
+                self.calls += 1
+                return []
+
+        provider = _Provider()
+        gc = GarbageCollectionController(
+            Cluster(), provider, ownership=_Fenced(), gc_interval=0.1
+        )
+        before = _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        )
+        gc.reconcile(GC_POLL_KEY)
+        assert provider.calls == 0, "fenced sweep must not touch the cloud"
+        assert _counter(
+            "karpenter_fleet_duplicate_launch_guard_total", {"reason": "fenced"}
+        ) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the chaos harness itself
+# ---------------------------------------------------------------------------
+
+
+class TestApiServerChaos:
+    def test_seeded_injection_is_deterministic(self, env):
+        cluster = env.connect()
+        for i in range(6):
+            cluster.create("pods", make_pod(name=f"seeded-{i}"))
+
+        def run(seed):
+            chaos = ApiServerChaos(per_verb={"GET": 0.5}, seed=seed)
+            env.chaos = chaos
+            outcomes = []
+            for i in range(6):
+                try:
+                    status, _, _ = cluster.transport.request(
+                        VERB_CREATE, "GET", "pods",
+                        lambda i=i: cluster._attempt(
+                            "GET", f"/api/v1/namespaces/default/pods/seeded-{i}",
+                            None, "application/json", None,
+                        ),
+                    )
+                    outcomes.append("ok" if status == 200 else "err")
+                except Exception:
+                    outcomes.append("err")
+            env.chaos = None
+            return outcomes
+
+        a, b = run(42), run(42)
+        assert a == b
+        assert "err" in a and "ok" in a
+
+    def test_blackout_window_drops_connections(self, env):
+        cluster = env.connect()
+        chaos = ApiServerChaos(blackouts=[ChaosWindow(0.0, 30.0)])
+        env.chaos = chaos
+        with pytest.raises(Exception) as ei:
+            cluster.get_live("provisioners", "anything", namespace="")
+        assert is_unreachable(ei.value)
+        assert chaos.counts(chaos.dropped) >= 1
+
+    def test_fail_next_is_exact(self, env):
+        cluster = env.connect()
+        cluster.create("pods", make_pod(name="exact"))
+        chaos = ApiServerChaos(seed=0)
+        env.chaos = chaos
+        chaos.fail_next("GET", 2)
+        got = cluster.get_live("pods", "exact")  # 2 failures, then clean
+        assert got.metadata.name == "exact"
+        assert chaos.counts(chaos.injected) == 2

@@ -1,0 +1,102 @@
+"""Kube transport choke point: no bypassing ``kube/transport.py``.
+
+Partition-tolerance invariant (docs/partition.md): every apiserver call
+must cross the ONE transport choke point — per-verb retries, 429 handling,
+mutation-priority flow control, the circuit breaker, and the
+``karpenter_kube_request_*`` metrics all live there. A controller that
+calls ``ApiCluster._request`` directly, or opens its own ``http.client``
+connection, gets none of that: its calls are unmetered, unthrottled,
+retry-free, and invisible to the breaker the rest of the fleet fences on.
+
+Two detections, both scoped to files OUTSIDE ``kube/``:
+
+- a call to ``<anything>._request(...)`` in a file that does not itself
+  define a ``_request`` method (calling your own private wire helper —
+  the cloud HTTP wire does — is that module's business; reaching into
+  ANOTHER object's ``_request`` is the bypass);
+- importing ``http.client`` (or its connection classes) at all — raw
+  apiserver HTTP belongs in ``kube/``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    register,
+)
+
+
+def _in_kube(path: str) -> bool:
+    return path.startswith("kube/") or "/kube/" in path
+
+
+@register
+class KubeTransportRule(Rule):
+    name = "kube-transport"
+    severity = P1
+    doc = (
+        "direct ApiCluster._request / raw http.client use outside kube/ "
+        "bypasses the transport choke point (retries, flow control, "
+        "breaker, kube metrics) — go through the Cluster surface."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            if _in_kube(src.path):
+                continue
+            # cheap text prefilter: a file that never mentions either token
+            # cannot produce a finding — skip its AST walk entirely
+            if "_request" not in src.text and "http.client" not in src.text:
+                continue
+            # ONE walk per file: collect imports, `_request` definitions,
+            # and `._request(...)` call sites together (the analyze gate
+            # has a wall-clock budget; three walks per file blew ~2s of it)
+            import_lines: List[int] = []
+            call_lines: List[int] = []
+            defines_request = False
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Import):
+                    if any(
+                        a.name == "http.client" or a.name.startswith("http.client.")
+                        for a in node.names
+                    ):
+                        import_lines.append(node.lineno)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "http.client":
+                        import_lines.append(node.lineno)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name == "_request":
+                        defines_request = True
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_request"
+                ):
+                    call_lines.append(node.lineno)
+            for lineno in import_lines:
+                findings.append(self.finding(
+                    src.path, lineno,
+                    "raw `http.client` outside kube/ — apiserver HTTP "
+                    "belongs behind the kube/transport.py choke point",
+                ))
+            if not defines_request:
+                # calling your OWN private wire helper (the cloud HTTP
+                # wire's shape) is that module's transport discipline;
+                # reaching into ANOTHER object's `_request` is the bypass
+                for lineno in call_lines:
+                    findings.append(self.finding(
+                        src.path, lineno,
+                        "direct `._request(...)` bypasses the kube transport "
+                        "(no retries, no flow control, no breaker, no "
+                        "metrics) — use the Cluster surface "
+                        "(get_live/list_live/create/merge_patch/...)",
+                    ))
+        return findings
