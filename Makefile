@@ -42,6 +42,9 @@ bench-compare:  ## regression gate over the checked-in BENCH_r0x trajectory (CI 
 benchmark-notrace:  ## tracing-overhead comparison run (acceptance bar: native leg within 3%)
 	$(PY) bench.py --no-trace
 
+profile-smoke:  ## profiler-overhead gate: headline leg with and without the sampling profiler (<1% self-accounted bar)
+	$(PY) bench.py --profile-overhead-check --pods 2000 --iters 6 --solver ffd
+
 benchmark-grid:  ## the reference's full batch grid
 	$(PY) bench.py --grid
 
@@ -115,6 +118,6 @@ run:  ## start the controller process against the in-memory cluster
 solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
-.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace benchmark-grid \
+.PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace profile-smoke benchmark-grid \
 	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos corruption-chaos partition-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

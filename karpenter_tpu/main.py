@@ -57,6 +57,8 @@ class Runtime:
     ownership: object = None  # fleet.ShardManager when shard leases are configured
     log_watcher: object = None  # LogLevelWatcher when a config file is set
     slo: object = None  # the SloEngine THIS runtime installed (obs/slo.py)
+    profiler: object = None  # the SamplingProfiler THIS runtime installed
+    telemetry: object = None  # the TelemetryPlane THIS runtime installed
     brownout: object = None  # BrownoutController when --brownout is on
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
@@ -92,6 +94,15 @@ class Runtime:
             from karpenter_tpu import obs
 
             obs.shutdown_slo(engine=self.slo)
+        # same ownership-checked teardown for the profiler and the
+        # telemetry plane this runtime installed
+        if self.profiler is not None or self.telemetry is not None:
+            from karpenter_tpu import obs
+
+            if self.profiler is not None:
+                obs.shutdown_profiler(self.profiler)
+            if self.telemetry is not None:
+                obs.shutdown_telemetry(self.telemetry)
         # undo the post-warmup GC policy: a test booting a runtime
         # in-process must not leak a frozen heap into the rest of the run
         from karpenter_tpu.utils.gcpolicy import restore
@@ -147,7 +158,23 @@ def _serve_endpoints(runtime: Runtime) -> None:
     class HealthHandler(BaseHTTPRequestHandler):
         timeout = 10  # a stalled probe client must not wedge the server
 
+        def _send(self, body: bytes, ctype: str = "application/json"):
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802
+            # every /debug/* body comes from a shared obs.debug_*_payload
+            # helper — the sidecar health server serves the SAME bodies
+            # (karplint `debug-endpoint` keeps the parity from drifting)
+            import json
+            from urllib.parse import urlsplit
+
+            from karpenter_tpu import obs
+
+            query = urlsplit(self.path).query
             if self.path in ("/healthz", "/readyz"):
                 ok = manager.healthz()
                 self.send_response(200 if ok else 503)
@@ -155,48 +182,25 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 self.wfile.write(b"ok" if ok else b"unhealthy")
             elif self.path.startswith("/debug/traces"):
                 # the in-memory trace ring: recent span trees, newest
-                # first; ?limit= and ?name= narrow to one trace family
-                import json
-                from urllib.parse import urlsplit
-
-                from karpenter_tpu import obs
-
-                body = json.dumps(
-                    obs.debug_traces_payload(urlsplit(self.path).query)
-                ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # first; ?limit=/?name= narrow to one trace family,
+                # ?trace_id= is the exact lookup
+                self._send(json.dumps(obs.debug_traces_payload(query)).encode())
             elif self.path.startswith("/debug/slo"):
                 # live objective verdicts + burn rates from the online
                 # SLO engine ({} until one is configured)
-                import json
-
-                from karpenter_tpu import obs
-
-                body = json.dumps({"slo": obs.slo_snapshot()}).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(json.dumps(obs.debug_slo_payload(query)).encode())
             elif self.path.startswith("/debug/flight"):
                 # recorded slow-solve incidents (empty when no --flight-dir)
-                import json
-
-                from karpenter_tpu import obs
-
-                rec = obs.flight_recorder()
-                body = json.dumps(
-                    {"records": rec.recent() if rec is not None else []}
-                ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(json.dumps(obs.debug_flight_payload(query)).encode())
+            elif self.path.startswith("/debug/profile"):
+                # sampling-profiler folds: top-N self-time JSON, or the
+                # collapsed-flamegraph corpus with ?format=collapsed
+                ctype, body = obs.debug_profile_payload(query)
+                self._send(body, ctype)
+            elif self.path.startswith("/debug/fleet"):
+                # the fleet telemetry plane: member inventory, fleet SLO
+                # verdicts, stitched-trace index ({} until configured)
+                self._send(json.dumps(obs.debug_fleet_payload(query)).encode())
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -431,6 +435,22 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     runtime.slo = obs.configure_slo(
         objectives=objectives, window_s=runtime.options.slo_window
     )
+    # always-on sampling profiler (docs/telemetry.md): stack folds at
+    # /debug/profile, in-window top folds on every flight record
+    if runtime.options.profile_hz > 0:
+        runtime.profiler = obs.configure_profiler(hz=runtime.options.profile_hz)
+    # fleet telemetry plane: flush this member's trees/histograms/folds to
+    # the shared dir and/or collect peers; /debug/fleet serves the merge
+    if runtime.options.telemetry_dir or runtime.options.telemetry_peers:
+        peers = [
+            p for p in runtime.options.telemetry_peers.split(",") if p.strip()
+        ]
+        runtime.telemetry = obs.configure_telemetry(
+            role="controller",
+            directory=runtime.options.telemetry_dir,
+            peers=peers,
+            flush_interval=runtime.options.telemetry_flush_interval,
+        )
     if runtime.brownout is not None:
         # the ladder's audit panel rides every flight record: a slow-solve
         # incident file answers "was the system already degrading?"

@@ -326,6 +326,58 @@ FLIGHT_RECORDS = Counter(
     registry=REGISTRY,
 )
 
+FLIGHT_PANEL_ERRORS = Counter(
+    "flight_panel_errors_total",
+    "Registered flight-recorder state panels that RAISED while being "
+    "snapshotted for a record, by panel name — the record still lands "
+    "(span tree + the other panels), the broken panel contributes its "
+    "error string.",
+    ["panel"],
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+# Fleet telemetry plane (obs/collector.py, docs/telemetry.md): flush /
+# stitch / profiler accounting. Every process — controller replicas and
+# sidecars — publishes these about its OWN half of the plane.
+TELEMETRY_FLUSHES = Counter(
+    "flushes_total",
+    "Member telemetry payloads (span trees + SLO histogram snapshot + "
+    "profile folds) this process published to the shared backend.",
+    namespace=NAMESPACE,
+    subsystem="telemetry",
+    registry=REGISTRY,
+)
+
+TELEMETRY_STITCHED = Counter(
+    "stitched_traces_total",
+    "NEW cross-process trace joins performed by the collector: a foreign "
+    "member's span tree (e.g. the sidecar's sidecar.pack) attached into "
+    "its parent trace's tree (re-stitching the same flushed tree on a "
+    "later poll does not re-count).",
+    namespace=NAMESPACE,
+    subsystem="telemetry",
+    registry=REGISTRY,
+)
+
+TELEMETRY_PROFILE_SAMPLES = Counter(
+    "profile_samples_total",
+    "Thread-stack samples folded by the in-process sampling profiler "
+    "(one per thread per tick at --profile-hz).",
+    namespace=NAMESPACE,
+    subsystem="telemetry",
+    registry=REGISTRY,
+)
+
+TELEMETRY_PROFILE_OVERHEAD = Gauge(
+    "profile_overhead_ratio",
+    "Sampling-profiler busy time over wall time since it started — the "
+    "self-accounted cost of always-on profiling (bench bar: < 0.01).",
+    namespace=NAMESPACE,
+    subsystem="telemetry",
+    registry=REGISTRY,
+)
+
 # Trace ring residency (obs/export.py): /debug/traces serves whatever the
 # ring holds, and the drop counter alone cannot say whether the ring is
 # near capacity — the gauges make eviction pressure scrapeable per process

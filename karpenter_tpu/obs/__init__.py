@@ -12,6 +12,14 @@ Public surface:
   flight records.
 - ``to_traceparent`` / ``from_traceparent`` — the cross-process id form
   (HTTP header, node annotation, v3 wire trailer).
+- ``configure_profiler(hz)`` — the always-on sampling profiler
+  (obs/profiler.py); ``profiler()`` reads it back.
+- ``configure_telemetry(...)`` — the fleet telemetry plane
+  (obs/collector.py): periodic flush + cross-process collection/stitch;
+  ``telemetry()`` reads it back.
+- ``debug_*_payload`` helpers — the ONE body builder per ``/debug/*``
+  endpoint, shared by the controller and sidecar health servers (karplint
+  ``debug-endpoint`` enforces that handlers route through these).
 
 Never import this package from jit/vmap/pallas-reachable solver code —
 karplint's ``span-closed`` tracer-safety check enforces it (a host-side
@@ -35,9 +43,16 @@ from karpenter_tpu.obs.flight import (  # noqa: F401
     state_snapshot,
     unregister_state,
 )
+from karpenter_tpu.obs.collector import (  # noqa: F401
+    TelemetryPlane,
+    stitch,
+    wire_attribution,
+)
+from karpenter_tpu.obs.profiler import SamplingProfiler  # noqa: F401
 from karpenter_tpu.obs.slo import (  # noqa: F401
     DEFAULT_OBJECTIVES,
     SIDECAR_OBJECTIVES,
+    Histogram,
     SloEngine,
     load_objectives,
 )
@@ -158,8 +173,10 @@ def slo_snapshot() -> dict:
 def debug_traces_payload(query: str = "") -> dict:
     """The ``GET /debug/traces`` body, shared by both health servers.
     ``query`` is the raw URL query string; ``?limit=`` bounds the tree
-    count (default 50) and ``?name=`` keeps only trees containing a span
-    of that name — one trace family instead of a 256-tree payload."""
+    count (default 50), ``?name=`` keeps only trees containing a span of
+    that name — one trace family instead of a 256-tree payload — and
+    ``?trace_id=`` is the exact lookup: a flight record's or SLO
+    exemplar's trace id is one request away from its full tree."""
     from urllib.parse import parse_qs
 
     q = parse_qs(query or "")
@@ -169,20 +186,168 @@ def debug_traces_payload(query: str = "") -> dict:
     except (KeyError, ValueError, IndexError):
         pass
     name = (q.get("name") or [None])[0] or None
+    trace_id = (q.get("trace_id") or [None])[0] or None
     exp = exporter()
     return {
-        "traces": exp.snapshot(limit=limit, name=name),
+        "traces": exp.snapshot(limit=limit, name=name, trace_id=trace_id),
         "stats": exp.stats(),
     }
 
 
+# -- the sampling profiler (obs/profiler.py) ---------------------------------
+
+_profiler: Optional[SamplingProfiler] = None  # guarded-by: _lock
+
+
+def configure_profiler(hz: Optional[float] = None) -> SamplingProfiler:
+    """Install (and start) the process-wide sampling profiler; replaces a
+    previous one. Also registers the ``profile`` flight-recorder panel so
+    every over-budget incident names the in-window hot frames."""
+    global _profiler
+    kwargs = {}
+    if hz is not None:
+        kwargs["hz"] = hz
+    prof = SamplingProfiler(tracer=_tracer, **kwargs)
+    with _lock:
+        old, _profiler = _profiler, prof
+    if old is not None:
+        old.stop()
+    prof.start()
+    register_state("profile", prof.flight_panel)
+    return prof
+
+
+def profiler() -> Optional[SamplingProfiler]:
+    with _lock:
+        return _profiler
+
+
+def shutdown_profiler(prof: Optional[SamplingProfiler] = None) -> None:
+    """Stop and detach (ownership-checked like ``shutdown_slo``; ``None``
+    detaches unconditionally — reset_for_tests)."""
+    global _profiler
+    with _lock:
+        if prof is not None and _profiler is not prof:
+            return
+        old, _profiler = _profiler, None
+    if old is not None:
+        old.stop()
+    unregister_state("profile")
+
+
+# -- the fleet telemetry plane (obs/collector.py) ----------------------------
+
+_telemetry: Optional[TelemetryPlane] = None  # guarded-by: _lock
+
+
+def configure_telemetry(
+    identity: Optional[str] = None,
+    role: str = "controller",
+    directory: str = "",
+    peers=(),
+    flush_interval: Optional[float] = None,
+) -> TelemetryPlane:
+    """Install (and start) this process's telemetry plane: periodic member
+    flushes to the shared ``directory`` (when set) plus a collector over
+    the directory and/or HTTP ``peers`` — ``GET /debug/fleet`` serves its
+    aggregate. Replaces a previous plane."""
+    import os as _os
+
+    global _telemetry
+    kwargs = {}
+    if flush_interval is not None:
+        kwargs["flush_interval"] = flush_interval
+    plane = TelemetryPlane(
+        identity=identity or f"{_os.uname().nodename}-{_os.getpid()}",
+        role=role,
+        directory=directory,
+        peers=peers,
+        **kwargs,
+    )
+    with _lock:
+        old, _telemetry = _telemetry, plane
+    if old is not None:
+        old.stop()
+    plane.start()
+    return plane
+
+
+def telemetry() -> Optional[TelemetryPlane]:
+    with _lock:
+        return _telemetry
+
+
+def shutdown_telemetry(plane: Optional[TelemetryPlane] = None) -> None:
+    """Stop and detach (ownership-checked; ``None`` detaches
+    unconditionally — reset_for_tests)."""
+    global _telemetry
+    with _lock:
+        if plane is not None and _telemetry is not plane:
+            return
+        old, _telemetry = _telemetry, None
+    if old is not None:
+        old.stop()
+
+
+# -- shared /debug payload builders ------------------------------------------
+# One builder per endpoint, used by BOTH health servers (main.py and
+# solver/service.py) — karplint's `debug-endpoint` rule keeps any new
+# handler from re-growing a private copy (the controller/sidecar parity
+# drift the PR-8 filtering fix had to hand-patch).
+
+
+def debug_slo_payload(query: str = "") -> dict:
+    """``GET /debug/slo``: live verdicts plus the mergeable histogram form
+    (the ``histograms`` key is what HTTP-pull telemetry scrapes)."""
+    eng = slo_engine()
+    return {
+        "slo": eng.snapshot() if eng is not None else {},
+        "histograms": eng.histogram_snapshot() if eng is not None else {},
+    }
+
+
+def debug_flight_payload(query: str = "") -> dict:
+    """``GET /debug/flight``: recent slow-span incident records."""
+    rec = flight_recorder()
+    return {"records": rec.recent() if rec is not None else []}
+
+
+def debug_fleet_payload(query: str = "") -> dict:
+    """``GET /debug/fleet``: member inventory with staleness, fleet-merged
+    SLO verdicts, stitched-trace index ({} until telemetry is configured)."""
+    plane = telemetry()
+    return {"fleet": plane.fleet_payload() if plane is not None else {}}
+
+
+def debug_profile_payload(query: str = ""):
+    """``GET /debug/profile`` → ``(content_type, body_bytes)``. Default is
+    the top-N self-time JSON; ``?format=collapsed`` returns the raw
+    collapsed-flamegraph corpus as text (pipe it into any renderer)."""
+    import json as _json
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    prof = profiler()
+    if (q.get("format") or [""])[0] == "collapsed":
+        body = prof.collapsed() if prof is not None else ""
+        return "text/plain", body.encode()
+    payload = {
+        "profile": ({"enabled": False} if prof is None
+                    else {"enabled": True, **prof.snapshot()})
+    }
+    return "application/json", _json.dumps(payload).encode()
+
+
 def reset_for_tests() -> None:
-    """Drop collected traces and detach any flight recorder / SLO engine."""
+    """Drop collected traces and detach any flight recorder / SLO engine /
+    profiler / telemetry plane."""
     global _flight
     with _lock:
         if _flight is not None:
             _tracer.remove_hook(_flight)
         _flight = None
     shutdown_slo()
+    shutdown_profiler()
+    shutdown_telemetry()
     _tracer.exporter.clear()
     _tracer.enabled = True

@@ -75,16 +75,21 @@ class RingExporter:
         limit: Optional[int] = 50,
         newest_first: bool = True,
         name: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
         """JSON-ready trees; newest first by default (the /debug surface).
         ``name`` keeps only trees CONTAINING a span so named (the
         ``?name=`` query filter — one trace family, not the whole ring);
-        ``limit`` applies after the filter, so it bounds what the operator
-        asked for."""
+        ``trace_id`` is the exact lookup (every span in a tree shares its
+        root's trace id, so this is a root-field test, not a walk);
+        ``limit`` applies after the filters, so it bounds what the
+        operator asked for."""
         with self._lock:
             trees = list(self._trees)
         if newest_first:
             trees.reverse()
+        if trace_id is not None:
+            trees = [t for t in trees if t.trace_id == trace_id]
         if name is None:
             # no filter: slice BEFORE serializing — a full 256-tree ring
             # must not pay 256 deep to_dict()s to answer a limit-50 request

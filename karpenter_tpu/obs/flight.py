@@ -56,6 +56,11 @@ def unregister_state(name: str) -> None:
 
 
 def state_snapshot() -> Dict[str, Any]:
+    """Best-effort snapshot of every registered panel: a raising provider
+    contributes its error string (and counts on
+    ``karpenter_flight_panel_errors_total``) instead of aborting the
+    record — the span tree a flight record exists for must never be lost
+    to one broken panel callback."""
     with _state_lock:
         providers = dict(_state_providers)
     out: Dict[str, Any] = {}
@@ -64,6 +69,12 @@ def state_snapshot() -> Dict[str, Any]:
             out[name] = fn()
         except Exception as e:
             out[name] = f"<state provider failed: {e}>"
+            try:
+                from karpenter_tpu import metrics
+
+                metrics.FLIGHT_PANEL_ERRORS.labels(panel=name).inc()
+            except Exception:
+                pass  # trimmed registries
     return out
 
 
@@ -103,11 +114,18 @@ class FlightRecorder:
                 "trace": span.to_dict(),
                 "state": state_snapshot(),
             }
-            # millisecond wall stamp in the name: lexicographic order IS
-            # recency order, which the prune below and recent() rely on
-            fname = f"flight-{int(time.time() * 1e3):013d}-{span.trace_id[:8]}.json"
-            path = os.path.join(self.directory, fname)
             with self._lock:
+                # millisecond wall stamp + write sequence in the name:
+                # lexicographic order IS recency order (prune and recent()
+                # rely on it), and the sequence breaks same-millisecond
+                # ties deterministically — two back-to-back records used
+                # to tie-break on the random trace-id suffix
+                fname = (
+                    f"flight-{int(time.time() * 1e3):013d}"
+                    f"-{self.records_written % 1_000_000:06d}"
+                    f"-{span.trace_id[:8]}.json"
+                )
+                path = os.path.join(self.directory, fname)
                 with open(path, "w", encoding="utf-8") as f:
                     json.dump(payload, f)
                 self.records_written += 1

@@ -331,6 +331,77 @@ class SlidingWindow:
         }
 
 
+class Histogram:
+    """A mergeable log-linear histogram over the FIXED bucket geometry
+    above. Because every window in every process shares ``BASE_S``/
+    ``GROWTH``, merging two snapshots is plain per-bucket addition — the
+    property the fleet telemetry plane (obs/collector.py) leans on: member
+    snapshots merge into fleet-wide quantiles with exactly the same ~2.5%
+    error bar as a single process's sketch, no re-binning, no loss."""
+
+    __slots__ = ("counts", "good", "bad")
+
+    def __init__(self):
+        self.counts: Dict[int, int] = {}
+        self.good = 0
+        self.bad = 0
+
+    def observe(self, value: Optional[float], bad: bool = False) -> None:
+        if value is not None:
+            b = bucket_index(value)
+            self.counts[b] = self.counts.get(b, 0) + 1
+        if bad:
+            self.bad += 1
+        else:
+            self.good += 1
+
+    def merge(self, snapshot) -> "Histogram":
+        """Fold another histogram (or its JSON ``snapshot()`` dict — bucket
+        keys may arrive as strings after a round trip) into this one."""
+        if isinstance(snapshot, Histogram):
+            counts, good, bad = snapshot.counts, snapshot.good, snapshot.bad
+        else:
+            counts = snapshot.get("counts") or {}
+            good = int(snapshot.get("good") or 0)
+            bad = int(snapshot.get("bad") or 0)
+        for b, n in counts.items():
+            b = int(b)
+            self.counts[b] = self.counts.get(b, 0) + int(n)
+        self.good += good
+        self.bad += bad
+        return self
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def events(self) -> int:
+        return self.good + self.bad
+
+    def quantile(self, q: float) -> Optional[float]:
+        return _quantile(self.counts, q)
+
+    def mean(self) -> Optional[float]:
+        return _mean(self.counts)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready form; ``merge`` accepts it back verbatim."""
+        return {
+            "counts": {str(b): n for b, n in self.counts.items()},
+            "good": self.good,
+            "bad": self.bad,
+        }
+
+    @classmethod
+    def from_window(cls, merged: Dict[str, Any]) -> "Histogram":
+        """Wrap a :meth:`SlidingWindow.merged` result (already bucketed in
+        the shared geometry)."""
+        h = cls()
+        h.counts = dict(merged.get("counts") or {})
+        h.good = int(merged.get("good") or 0)
+        h.bad = int(merged.get("bad") or 0)
+        return h
+
+
 def _quantile(counts: Dict[int, int], q: float) -> Optional[float]:
     total = sum(counts.values())
     if not total:
@@ -555,6 +626,26 @@ class SloEngine:
                 name: st.publish() for name, st in self._states.items()
             },
         }
+
+    def histogram_snapshot(self) -> Dict[str, Any]:
+        """The MERGEABLE form of the engine's state: per objective, the
+        fast and slow windows as raw :class:`Histogram` snapshots (fixed
+        bucket geometry) plus the expression to re-judge them with. This is
+        what the telemetry flusher ships — the collector merges member
+        windows bucket-by-bucket into fleet-wide quantiles and burn rates
+        (obs/collector.py), which verdict-only snapshots cannot support."""
+        out: Dict[str, Any] = {}
+        for name, st in self._states.items():
+            fast = st.window.merged(fast=True)
+            slow = st.window.merged(fast=False)
+            out[name] = {
+                "expr": st.objective.expr,
+                "kind": st.objective.kind,
+                "fast": Histogram.from_window(fast).snapshot(),
+                "slow": Histogram.from_window(slow).snapshot(),
+                "breach": fast.get("breach"),
+            }
+        return {"window_s": self.window_s, "objectives": out}
 
     def burning_panel(self) -> Dict[str, Any]:
         """The flight-recorder state panel: which objectives were burning

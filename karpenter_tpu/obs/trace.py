@@ -203,7 +203,10 @@ class _SpanCm:
     (contextvar set/reset, parent attach, export) live in enter/exit so a
     span cannot leak half-open."""
 
-    __slots__ = ("_tracer", "_name", "_attrs", "_parent", "_span", "_token")
+    __slots__ = (
+        "_tracer", "_name", "_attrs", "_parent", "_span", "_token",
+        "_tid", "_prev_active",
+    )
 
     def __init__(self, tracer: "Tracer", name: str, attrs, parent):
         self._tracer = tracer
@@ -212,6 +215,8 @@ class _SpanCm:
         self._parent = parent
         self._span: Optional[Span] = None
         self._token = None
+        self._tid = 0
+        self._prev_active: Optional[Span] = None
 
     def __enter__(self) -> Span:
         tracer = self._tracer
@@ -237,6 +242,13 @@ class _SpanCm:
             )
         self._span = span
         self._token = tracer._current.set(span)
+        # thread registry for out-of-context readers (the sampling
+        # profiler attributes a sampled thread's stack to its ACTIVE span;
+        # a contextvar is unreadable from another thread, this dict isn't).
+        # Plain dict ops: atomic under the GIL, no lock on the hot path.
+        self._tid = threading.get_ident()
+        self._prev_active = tracer._active_by_thread.get(self._tid)
+        tracer._active_by_thread[self._tid] = span
         return span
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -245,6 +257,10 @@ class _SpanCm:
         if exc is not None:
             span.error = f"{exc_type.__name__}: {exc}"
         self._tracer._current.reset(self._token)
+        if self._prev_active is None:
+            self._tracer._active_by_thread.pop(self._tid, None)
+        else:
+            self._tracer._active_by_thread[self._tid] = self._prev_active
         if span.parent is not None:
             # list.append is atomic under the GIL; launches from several
             # executor threads attach to one round span concurrently
@@ -262,6 +278,10 @@ class Tracer:
         )
         self._hooks: List[Callable[[Span], None]] = []  # guarded-by: self._hooks_lock
         self._hooks_lock = threading.Lock()
+        # thread id -> innermost open span on that thread; written by
+        # _SpanCm enter/exit (GIL-atomic dict ops), read by the sampling
+        # profiler from ITS thread — the cross-thread twin of _current
+        self._active_by_thread: Dict[int, Span] = {}
 
     # -- the one sanctioned way to open a span ------------------------------
     def span(self, name: str, attrs: Optional[Dict[str, Any]] = None, parent=_UNSET):
@@ -287,6 +307,12 @@ class Tracer:
     def current(self) -> Optional[Span]:
         """The calling context's active span (None when outside any)."""
         return self._current.get() if self.enabled else None
+
+    def active_spans(self) -> Dict[int, Span]:
+        """Snapshot of thread id -> that thread's innermost OPEN span —
+        the profiler's attribution surface. A copy: the registry mutates
+        under the caller's feet otherwise."""
+        return dict(self._active_by_thread)
 
     # -- completion fan-out -------------------------------------------------
     def add_hook(self, fn: Callable[[Span], None]) -> None:

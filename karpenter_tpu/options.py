@@ -113,6 +113,28 @@ class Options:
     canary_rate: float = field(
         default_factory=lambda: env_float("KARPENTER_CANARY_RATE")
     )
+    # always-on sampling profiler (obs/profiler.py): stack-sample rate in
+    # Hz (0 disables; 19 is deliberately off-aligned from 10/20/100Hz
+    # periodic work). Served at GET /debug/profile; self-accounted cost
+    # rides karpenter_telemetry_profile_overhead_ratio.
+    profile_hz: float = field(
+        default_factory=lambda: float(_env("KARPENTER_PROFILE_HZ", "19"))
+    )
+    # fleet telemetry plane (obs/collector.py, docs/telemetry.md):
+    # - telemetry_dir: shared flock'd directory every member (controller
+    #   replicas + sidecars) flushes span trees / SLO histograms / profile
+    #   folds into; '' = no file backend
+    # - telemetry_peers: comma-separated [name=]http://host:port entries
+    #   whose /debug/* endpoints the collector scrapes (pull mode, no
+    #   shared volume needed)
+    # GET /debug/fleet serves the aggregate when either is set.
+    telemetry_dir: str = field(default_factory=lambda: _env("KARPENTER_TELEMETRY_DIR", ""))
+    telemetry_peers: str = field(
+        default_factory=lambda: _env("KARPENTER_TELEMETRY_PEERS", "")
+    )
+    telemetry_flush_interval: float = field(
+        default_factory=lambda: float(_env("KARPENTER_TELEMETRY_FLUSH", "10"))
+    )
     # SLO-driven brownout ladder (resilience/brownout.py): when an
     # objective burns, walk the ordered degradation ladder (pause probes/
     # consolidation -> shrink admission window -> bias native -> shed
@@ -153,6 +175,10 @@ class Options:
             errs.append("SLO window must be positive seconds")
         if self.brownout_interval <= 0:
             errs.append("brownout tick interval must be positive seconds")
+        if not 0.0 <= self.profile_hz <= 250.0:
+            errs.append("profiler rate must be 0 (off) to 250 Hz")
+        if self.telemetry_flush_interval <= 0:
+            errs.append("telemetry flush interval must be positive seconds")
         if not 0.0 <= self.canary_rate <= 1.0:
             errs.append("canary rate must be a fraction in [0, 1]")
         if self.slo_config:
@@ -270,6 +296,27 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         "brownout ladder has probes paused)",
     )
     ap.add_argument(
+        "--profile-hz", type=float, default=opts.profile_hz,
+        help="sampling-profiler stack-sample rate in Hz (0 disables; "
+        "GET /debug/profile serves the folds, docs/telemetry.md)",
+    )
+    ap.add_argument(
+        "--telemetry-dir", default=opts.telemetry_dir,
+        help="shared directory the fleet telemetry plane flushes member "
+        "payloads into ('' disables the file backend; docs/telemetry.md)",
+    )
+    ap.add_argument(
+        "--telemetry-peers", default=opts.telemetry_peers,
+        help="comma-separated [name=]http://host:port member endpoints the "
+        "collector scrapes (pull mode); GET /debug/fleet serves the "
+        "aggregate",
+    )
+    ap.add_argument(
+        "--telemetry-flush-interval", type=float,
+        default=opts.telemetry_flush_interval,
+        help="seconds between member telemetry flushes",
+    )
+    ap.add_argument(
         "--brownout",
         action=argparse.BooleanOptionalAction,
         default=opts.brownout_enabled,
@@ -321,6 +368,10 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         slo_config=ns.slo_config,
         pack_checksum=ns.pack_checksum,
         canary_rate=ns.canary_rate,
+        profile_hz=ns.profile_hz,
+        telemetry_dir=ns.telemetry_dir,
+        telemetry_peers=ns.telemetry_peers,
+        telemetry_flush_interval=ns.telemetry_flush_interval,
         brownout_enabled=ns.brownout,
         brownout_interval=ns.brownout_interval,
     )

@@ -948,7 +948,13 @@ class SolverService:
             None if deadline_s is None
             else self._clock() + max(deadline_s, 0.0)
         )
+        adm_t0 = time.perf_counter()
         outcome = self.admission.enter(deadline)
+        # queue time precedes the pack span (a backdated child would
+        # corrupt self-time attribution — the provision.round precedent),
+        # so it rides the span as an attribute; the fleet stitcher's
+        # wire_attribution reads it to split wire vs admission-queue time
+        admission_wait_s = time.perf_counter() - adm_t0
         if outcome == "deadline":
             self._count_shed("deadline")
             return self._seal(_status_response(STATUS_DEADLINE_EXCEEDED), checksummed)
@@ -964,11 +970,16 @@ class SolverService:
                 return self._seal(
                     _status_response(STATUS_DEADLINE_EXCEEDED), checksummed
                 )
-            return self._seal(self._solve_admitted(arrays, ctx), checksummed)
+            return self._seal(
+                self._solve_admitted(arrays, ctx, admission_wait_s),
+                checksummed,
+            )
         finally:
             self.admission.leave()
 
-    def _solve_admitted(self, arrays: List[np.ndarray], ctx) -> bytes:
+    def _solve_admitted(
+        self, arrays: List[np.ndarray], ctx, admission_wait_s: float = 0.0
+    ) -> bytes:
         import jax
 
         from karpenter_tpu import obs
@@ -1030,7 +1041,12 @@ class SolverService:
         # serialize_s] trailer so the client can graft the same numbers
         # into its own tree without a trace collector.
         with obs.tracer().span(
-            "sidecar.pack", parent=ctx, attrs={"session": key.hex()[:12]}
+            "sidecar.pack",
+            parent=ctx,
+            attrs={
+                "session": key.hex()[:12],
+                "admission_wait_s": round(admission_wait_s, 6),
+            },
         ) as sp:
             t0 = time.perf_counter()
             with obs.tracer().span("sidecar.solve"):
@@ -1136,6 +1152,7 @@ def _serve_health(service: SolverService, port: int):
 
     class Probe(BaseHTTPRequestHandler):
         def do_GET(self):
+            ctype = "text/plain"
             if self.path == "/healthz":
                 code, body = 200, b"ok"
             elif self.path == "/readyz":
@@ -1149,31 +1166,36 @@ def _serve_health(service: SolverService, port: int):
                 from karpenter_tpu import metrics as _m
 
                 code, body = 200, generate_latest(_m.REGISTRY)
-            elif self.path.startswith("/debug/traces"):
+            elif self.path.startswith("/debug/"):
+                # every /debug/* body comes from the shared
+                # obs.debug_*_payload helpers — byte-parity with the
+                # controller health server by construction (karplint
+                # `debug-endpoint` enforces the routing)
                 from urllib.parse import urlsplit
 
                 from karpenter_tpu import obs
 
-                code = 200
-                body = _json.dumps(
-                    obs.debug_traces_payload(urlsplit(self.path).query)
-                ).encode()
-            elif self.path.startswith("/debug/slo"):
-                from karpenter_tpu import obs
-
-                code = 200
-                body = _json.dumps({"slo": obs.slo_snapshot()}).encode()
-            elif self.path.startswith("/debug/flight"):
-                from karpenter_tpu import obs
-
-                rec = obs.flight_recorder()
-                code = 200
-                body = _json.dumps(
-                    {"records": rec.recent() if rec is not None else []}
-                ).encode()
+                query = urlsplit(self.path).query
+                code, ctype = 200, "application/json"
+                if self.path.startswith("/debug/traces"):
+                    body = _json.dumps(obs.debug_traces_payload(query)).encode()
+                elif self.path.startswith("/debug/slo"):
+                    body = _json.dumps(obs.debug_slo_payload(query)).encode()
+                elif self.path.startswith("/debug/flight"):
+                    body = _json.dumps(obs.debug_flight_payload(query)).encode()
+                elif self.path.startswith("/debug/profile"):
+                    # dual-typed: JSON by default, text/plain collapsed —
+                    # the helper decides, the header must follow it (the
+                    # controller handler does the same)
+                    ctype, body = obs.debug_profile_payload(query)
+                elif self.path.startswith("/debug/fleet"):
+                    body = _json.dumps(obs.debug_fleet_payload(query)).encode()
+                else:
+                    code, ctype, body = 404, "text/plain", b"not found"
             else:
                 code, body = 404, b"not found"
             self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -1709,6 +1731,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--slo-config", default="",
                     help="objectives file ('' = the sidecar defaults: "
                          "sidecar.pack.p99 + session.catalog_hit_rate)")
+    ap.add_argument("--profile-hz", type=float, default=19.0,
+                    help="sampling-profiler stack-sample rate in Hz "
+                         "(0 disables; GET /debug/profile serves the folds)")
+    ap.add_argument("--telemetry-dir", default="",
+                    help="shared fleet-telemetry directory this sidecar "
+                         "flushes its span trees / SLO histograms / profile "
+                         "folds into ('' disables; docs/telemetry.md)")
+    ap.add_argument("--telemetry-flush-interval", type=float, default=10.0,
+                    help="seconds between telemetry flushes")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from karpenter_tpu import obs
@@ -1729,6 +1760,20 @@ def main(argv: Optional[List[str]] = None) -> None:
         ),
         window_s=args.slo_window,
     )
+    if args.profile_hz > 0:
+        # always-on sampling profiler: the sidecar's device/serialize hot
+        # loops are exactly the frames a fleet-wide slow solve needs named
+        obs.configure_profiler(hz=args.profile_hz)
+    if args.telemetry_dir:
+        # flush-only member of the fleet telemetry plane: the controller's
+        # collector stitches this ring's sidecar.pack trees into its own
+        # solver.wire parents (docs/telemetry.md)
+        obs.configure_telemetry(
+            identity=f"sidecar-{args.address}",
+            role="sidecar",
+            directory=args.telemetry_dir,
+            flush_interval=args.telemetry_flush_interval,
+        )
     server = serve(
         args.address, args.max_workers, health_port=args.health_port, warmup=True,
         service=SolverService(

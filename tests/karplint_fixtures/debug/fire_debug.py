@@ -1,0 +1,20 @@
+# karplint-fixture: expect=debug-endpoint
+"""A health handler growing its own private /debug payload: the exact
+controller/sidecar parity drift the shared obs.debug_*_payload helpers
+exist to prevent — this body will diverge from the other server's the
+first time either is touched."""
+import json
+
+
+class SneakyHandler:
+    def do_GET(self):
+        if self.path.startswith("/debug/traces"):
+            # inline payload build: no shared helper, no parity
+            trees = self.exporter.snapshot(limit=50)
+            body = json.dumps({"traces": trees}).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()

@@ -1,0 +1,34 @@
+# karplint-fixture: clean=debug-endpoint
+"""Near-misses that must stay clean: a /debug branch routing through the
+shared obs payload helper, a non-debug branch building whatever it likes,
+and a /debug string outside any do_GET handler."""
+import json
+
+DOC_LINK = "/debug/traces"  # a bare mention outside do_GET is not a handler
+
+
+class ParityHandler:
+    def do_GET(self):
+        if self.path.startswith("/debug/traces"):
+            # the sanctioned shape: the ONE shared body builder
+            from karpenter_tpu import obs
+
+            body = json.dumps(obs.debug_traces_payload("")).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/healthz":
+            # not a /debug path: free to answer inline
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+def do_get_elsewhere(path):
+    # not a do_GET method: handler-shaped strings elsewhere stay clean
+    if path.startswith("/debug/flight"):
+        return {"records": []}
+    return None

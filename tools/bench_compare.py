@@ -47,6 +47,14 @@ HEADLINE_KEYS: Dict[str, int] = {
     "device_p99_s": -1,
     "session_catalog_hit_rate": +1,
     "chaos_provision_success_rate": +1,
+    # fleet telemetry plane (docs/telemetry.md): the stitched-attribution
+    # keys — the worst live-wire solve's fleet-wide critical path and the
+    # transport's share of it — plus the always-on profiler's
+    # self-accounted cost (bar: < 1). Missing on pre-telemetry rounds is
+    # reported, never fatal (the standard new-key salvage).
+    "fleet_critical_path_ms": -1,
+    "wire_share_pct": -1,
+    "profiler_overhead_pct": -1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"

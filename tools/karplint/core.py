@@ -169,6 +169,7 @@ def _load_rules() -> None:
         return
     # import for side effect: each module registers its rules
     from tools.karplint.rules import (  # noqa: F401
+        debug_endpoints,
         kube,
         locks,
         metric_names,

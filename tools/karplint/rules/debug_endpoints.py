@@ -1,0 +1,106 @@
+"""Debug-endpoint parity: ``/debug/*`` handlers route through ``obs``.
+
+Two HTTP health servers serve the same debug surface — the controller's
+(``main.py``) and the sidecar's (``solver/service.py``) — and history
+shows they drift: the PR-8 ``?limit=``/``?name=`` filtering fix had to be
+hand-patched into both because each had grown its own payload-building
+code. The telemetry PR collapsed every ``/debug/*`` body into shared
+``karpenter_tpu.obs.debug_*_payload`` helpers; this rule keeps it that
+way: any ``do_GET`` branch outside ``obs/`` that matches a ``/debug/``
+path must build its body through one of those helpers, never inline.
+
+Detection: inside a ``do_GET`` function, every ``if``/``elif`` whose test
+contains a string literal starting with ``/debug/`` must have at least one
+call to a ``debug_*``-named function (``obs.debug_traces_payload(...)``,
+or the bare name when imported) somewhere in that branch's body.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    register,
+)
+
+
+def _in_obs(path: str) -> bool:
+    return path.startswith("obs/") or "/obs/" in path
+
+
+def _mentions_debug_path(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("/debug/")
+        ):
+            return True
+    return False
+
+
+def _calls_debug_helper(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute):
+                name = func.attr
+            elif isinstance(func, ast.Name):
+                name = func.id
+            if name is not None and name.startswith("debug_"):
+                return True
+    return False
+
+
+@register
+class DebugEndpointRule(Rule):
+    name = "debug-endpoint"
+    severity = P1
+    doc = (
+        "a /debug/* branch in a do_GET handler outside obs/ builds its "
+        "body inline instead of through a shared karpenter_tpu.obs "
+        "debug_*_payload helper — the controller/sidecar parity drift "
+        "the PR-8 filtering fix had to hand-patch."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            if _in_obs(src.path):
+                continue
+            # cheap text prefilter: no /debug/ literal, no finding
+            if "/debug/" not in src.text:
+                continue
+            for node in ast.walk(src.tree):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == "do_GET"
+                ):
+                    findings.extend(self._check_handler(src, node))
+        return findings
+
+    def _check_handler(self, src: SourceFile, fn: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        # ast.walk visits each If in an elif chain individually (an elif
+        # is an If inside the previous If's orelse), so every branch gets
+        # its own body check — nested helpers can't vouch for siblings
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and _mentions_debug_path(node.test):
+                if not _calls_debug_helper(node.body):
+                    findings.append(self.finding(
+                        src.path, node.lineno,
+                        "this /debug/ branch builds its payload inline; "
+                        "route it through a shared karpenter_tpu.obs "
+                        "debug_*_payload helper so both health servers "
+                        "serve the same body",
+                    ))
+        return findings
