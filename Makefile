@@ -79,6 +79,13 @@ overload-chaos:  ## overload-control proof: shed/brownout suites + the >=5x offe
 	$(PY) -m pytest tests/test_overload.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --overload-storm 300
 
+benchmark-streamed:  ## streamed-transport leg: unary vs streamed RTT floors, shm sub-leg, coalescing rate
+	$(PY) bench.py --streamed 2000 --iters 20
+
+stream-chaos:  ## streamed-transport proof: stream lifecycle suite + the >=5x overload storm OVER the stream
+	$(PY) -m pytest tests/test_solver_stream.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --overload-storm 300 --overload-stream
+
 corruption-chaos:  ## pack-integrity proof: checksum/canary/quarantine suites + the 4-mode corruption storm leg
 	$(PY) -m pytest tests/test_integrity.py tests/test_serde_fuzz.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --corruption-storm 200
@@ -119,5 +126,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace profile-smoke benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense chaos fleet-chaos crash-chaos overload-chaos corruption-chaos partition-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos partition-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

@@ -75,6 +75,8 @@ class ProvisionerWorker:
         journal=None,
         pack_checksum: Optional[bool] = None,
         canary_rate: Optional[float] = None,
+        solver_stream: Optional[bool] = None,
+        solver_shm_dir: Optional[str] = None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
@@ -86,6 +88,7 @@ class ProvisionerWorker:
         self.scheduler = scheduler or Scheduler(
             cluster, solver_service_address=solver_service_address,
             pack_checksum=pack_checksum, canary_rate=canary_rate,
+            solver_stream=solver_stream, solver_shm_dir=solver_shm_dir,
         )
         # bounded, priority-aware admission (docs/overload.md): a full
         # queue sheds the oldest lowest-priority pod instead of growing
@@ -551,6 +554,8 @@ class ProvisioningController:
         journal=None,
         pack_checksum: Optional[bool] = None,
         canary_rate: Optional[float] = None,
+        solver_stream: Optional[bool] = None,
+        solver_shm_dir: Optional[str] = None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -562,6 +567,10 @@ class ProvisioningController:
         # KARPENTER_CANARY_RATE env twins
         self.pack_checksum = pack_checksum
         self.canary_rate = canary_rate
+        # streaming solver transport + zero-copy shm arena (None = the
+        # KARPENTER_SOLVER_STREAM / KARPENTER_SOLVER_SHM_DIR env twins)
+        self.solver_stream = solver_stream
+        self.solver_shm_dir = solver_shm_dir
         self.journal = journal  # write-ahead launch journal, shared by workers
         # fleet.ShardManager (or None = this replica owns everything):
         # reconcile only runs workers for owned shards, and each worker's
@@ -697,6 +706,8 @@ class ProvisioningController:
                 journal=self.journal,
                 pack_checksum=self.pack_checksum,
                 canary_rate=self.canary_rate,
+                solver_stream=self.solver_stream,
+                solver_shm_dir=self.solver_shm_dir,
             )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h

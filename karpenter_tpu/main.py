@@ -293,6 +293,10 @@ def build_runtime(
         # sidecar path + the native canary cross-check rate
         pack_checksum=options.pack_checksum,
         canary_rate=options.canary_rate,
+        # streaming solver transport + zero-copy shm arena
+        # (docs/solver-transport.md § Streaming)
+        solver_stream=options.solver_stream,
+        solver_shm_dir=options.solver_shm_dir,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,

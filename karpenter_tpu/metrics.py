@@ -554,6 +554,83 @@ SOLVER_POOL_MEMBERS = Gauge(
     registry=REGISTRY,
 )
 
+# Streaming solver transport (solver/stream.py, docs/solver-transport.md):
+# the persistent multiplexed stream per pool member. Establishment state
+# and break rate say whether the fleet is actually riding the stream or
+# silently living on the unary fallback; credit stalls are the
+# flow-control backpressure signal (the streamed twin of
+# STATUS_OVERLOADED); the coalescing counters say how often concurrent
+# streamed solves shared one device dispatch.
+SOLVER_STREAM_STATE = Gauge(
+    "stream_established",
+    "1 while a persistent solve stream to this sidecar address is "
+    "established, 0 while solves fall back to the unary path.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_BREAKS = Counter(
+    "stream_breaks_total",
+    "Established solve streams that broke (sidecar restart, transport "
+    "error, or a client-side teardown after a wedged future); in-flight "
+    "solves fall back to unary and the stream re-establishes in the "
+    "background.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_SOLVES = Counter(
+    "stream_solves_total",
+    "Solve dispatches by transport: stream_shm (zero-copy arena), stream "
+    "(inline frames over the stream), or unary (no stream up).",
+    ["address", "transport"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_CREDIT_STALLS = Counter(
+    "stream_credit_stalls_total",
+    "Streamed solves refused at the SENDER because the flow-control "
+    "credit window was empty — backpressure before any bytes move; the "
+    "pool's soft backoff consumes the hint, no breaker ever trips.",
+    ["address"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_FALLBACKS = Counter(
+    "stream_fallback_total",
+    "Streamed solves that completed over the unary path after a stream "
+    "error, by reason (broken/timeout/retry/open/envelope).",
+    ["address", "reason"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_COALESCED_DISPATCHES = Counter(
+    "stream_coalesced_dispatches_total",
+    "Device dispatches that carried MORE than one coalesced streamed "
+    "solve (same session, same padded shapes, one vmapped kernel call).",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_STREAM_COALESCED_SOLVES = Counter(
+    "stream_coalesced_solves_total",
+    "Streamed solves that rode a shared (coalesced) device dispatch.",
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
 # Crash-consistent launch path (karpenter_tpu/launch + the GC controller):
 # the journal/adopt/reap loop's three outcomes must be scrapeable — an
 # adoption is a crash the system healed, a leak termination is capacity

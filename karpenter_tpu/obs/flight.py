@@ -36,6 +36,51 @@ DEFAULT_BUDGET_S = 0.100  # the BASELINE <100ms p99 target
 DEFAULT_CAP = 64
 DEFAULT_WATCH = ("solver.solve",)
 
+# wire-dominance watch rule (ROADMAP item 2): a solve whose TRANSPORT
+# self-time exceeds its device/solve share is a transport regression the
+# latency budget alone can hide (a fast solve over a slow wire can still
+# land under 100ms) — it self-reports as a flight record tagged
+# ``wire_dominated=true`` even when under budget. The floor keeps
+# microsecond-scale loopback noise from spamming the ring, and the
+# cooldown keeps a STEADY wire-dominated regime (every solve matching)
+# from turning the hot solve path into per-solve disk writes — one
+# record per window names the regression; the rest add nothing.
+MIN_WIRE_DOMINANCE_S = 0.005
+WIRE_DOMINANCE_COOLDOWN_S = 30.0
+# the transport leg and the sidecar stages it grafts/stitches beneath it
+WIRE_SPAN = "solver.wire"
+SOLVE_SHARE_SPANS = frozenset({"sidecar.solve", "sidecar.fetch"})
+
+
+def _walk(span: Span):
+    yield span
+    for child in span.children:
+        yield from _walk(child)
+
+
+def wire_dominance(span: Span) -> Optional[Dict[str, float]]:
+    """For a ``solver.solve`` tree: the wire's SELF time (the
+    ``solver.wire`` spans minus their grafted/stitched sidecar children)
+    vs the device/solve share (``sidecar.solve`` + ``sidecar.fetch``).
+    None when the solve never crossed a wire (in-process backends)."""
+    wire_self = 0.0
+    solve_share = 0.0
+    crossed = False
+    for s in _walk(span):
+        if s.name == WIRE_SPAN:
+            crossed = True
+            wire_self += max(
+                s.duration_s - sum(c.duration_s for c in s.children), 0.0
+            )
+        elif s.name in SOLVE_SHARE_SPANS:
+            solve_share += s.duration_s
+    if not crossed:
+        return None
+    return {
+        "wire_self_s": round(wire_self, 6),
+        "solve_share_s": round(solve_share, 6),
+    }
+
 # name -> zero-arg callable returning a JSON-serializable snapshot
 _state_lock = threading.Lock()
 _state_providers: Dict[str, Callable[[], Any]] = {}  # guarded-by: _state_lock
@@ -94,16 +139,38 @@ class FlightRecorder:
         self.watch = frozenset(watch)
         self.records_written = 0
         self._lock = threading.Lock()
+        self._last_rule_record = 0.0  # guarded-by: self._lock
         os.makedirs(directory, exist_ok=True)
+
+    def _rule_cooled_down(self) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_rule_record < WIRE_DOMINANCE_COOLDOWN_S:
+                return False
+            self._last_rule_record = now
+            return True
 
     # -- the hook -----------------------------------------------------------
     def __call__(self, span: Span) -> None:
-        if span.name in self.watch and span.duration_s > self.budget_s:
-            self.record(span)
+        if span.name not in self.watch:
+            return
+        extra = None
+        if span.name == "solver.solve":
+            shares = wire_dominance(span)
+            if shares is not None and shares["wire_self_s"] > max(
+                shares["solve_share_s"], MIN_WIRE_DOMINANCE_S
+            ):
+                extra = {"wire_dominated": True, **shares}
+        over_budget = span.duration_s > self.budget_s
+        if not over_budget and extra is not None and not self._rule_cooled_down():
+            return  # steady wire-dominance: one record per cooldown window
+        if over_budget or extra is not None:
+            self.record(span, extra=extra)
 
-    def record(self, span: Span) -> Optional[str]:
+    def record(self, span: Span, extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Write one incident; returns the file path (None on failure —
-        recording must never fail the traced action)."""
+        recording must never fail the traced action). ``extra`` merges
+        watch-rule verdicts (e.g. ``wire_dominated``) into the payload."""
         try:
             payload = {
                 "name": span.name,
@@ -114,6 +181,8 @@ class FlightRecorder:
                 "trace": span.to_dict(),
                 "state": state_snapshot(),
             }
+            if extra:
+                payload.update(extra)
             with self._lock:
                 # millisecond wall stamp + write sequence in the name:
                 # lexicographic order IS recency order (prune and recent()

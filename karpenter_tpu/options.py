@@ -48,6 +48,20 @@ class Options:
     solver_service_address: str = field(
         default_factory=lambda: _env("SOLVER_SERVICE_ADDRESS", "")
     )  # empty = in-process
+    # streaming solver transport (docs/solver-transport.md § Streaming):
+    # persistent multiplexed streams per sidecar (credit flow control,
+    # out-of-order completion, transparent unary fallback). Off by
+    # default like --pack-checksum; ON in deploy/chart — the capability
+    # negotiation makes mixed-version fleets interop in either order.
+    solver_stream: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_SOLVER_STREAM")
+    )
+    # zero-copy colocated fast path: a directory shared with the sidecar
+    # (same host) through which pod-side arrays move as an mmap'd arena —
+    # the stream then carries offsets, not bytes. '' disables.
+    solver_shm_dir: str = field(
+        default_factory=lambda: _env("KARPENTER_SOLVER_SHM_DIR", "")
+    )
     consolidation_enabled: bool = field(
         default_factory=lambda: env_bool("KARPENTER_CONSOLIDATION")
     )
@@ -226,6 +240,21 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
                     help="apiserver URL ('' = in-memory store, 'in-cluster' = pod env)")
     ap.add_argument("--default-solver", default=opts.default_solver)
     ap.add_argument("--solver-service-address", default=opts.solver_service_address)
+    ap.add_argument(
+        "--solver-stream",
+        action=argparse.BooleanOptionalAction,
+        default=opts.solver_stream,
+        help="persistent multiplexed solve streams toward the sidecar(s): "
+        "credit flow control, out-of-order completion, transparent unary "
+        "fallback (capability-gated on PROTO_STREAM, so mixed-version "
+        "fleets interop; docs/solver-transport.md)",
+    )
+    ap.add_argument(
+        "--solver-shm-dir", default=opts.solver_shm_dir,
+        help="zero-copy colocated fast path: a directory shared with the "
+        "sidecar on the same host; pod arrays move via an mmap'd arena "
+        "and the stream carries only offsets ('' disables)",
+    )
     ap.add_argument("--leader-election-lease", default=opts.leader_election_lease)
     ap.add_argument(
         "--shard-lease", default=opts.shard_lease,
@@ -351,6 +380,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         kube_api_server=ns.kube_api_server,
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
+        solver_stream=ns.solver_stream,
+        solver_shm_dir=ns.solver_shm_dir,
         consolidation_enabled=ns.consolidation,
         consolidation_wave_size=ns.consolidation_wave_size,
         leader_election_lease=ns.leader_election_lease,

@@ -39,11 +39,22 @@ RETRY_BUDGET_REFILL_PER_SUCCESS = 0.1
 class OverloadedError(RuntimeError):
     """A dependency shed this request under load (not a failure: the
     dependency is alive and will recover — retry AFTER the hint, or
-    route elsewhere)."""
+    route elsewhere).
 
-    def __init__(self, message: str, retry_after: float = 1.0):
+    ``kind`` names which backpressure mechanism fired: ``"admission"``
+    (the sidecar's bounded queue or HBM floor refused the work) or
+    ``"credits"`` (the streaming transport's client-side flow-control
+    window is empty — docs/solver-transport.md § Credit flow control).
+    Consumers treat both identically (soft backoff for the hint window);
+    the kind exists so backoff sites and metrics can attribute WHICH
+    bound absorbed the excess."""
+
+    def __init__(
+        self, message: str, retry_after: float = 1.0, kind: str = "admission"
+    ):
         super().__init__(message)
         self.retry_after = max(float(retry_after), 0.0)
+        self.kind = kind
 
 
 class DeadlineExceededError(RuntimeError):

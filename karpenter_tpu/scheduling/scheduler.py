@@ -26,6 +26,8 @@ class Scheduler:
         solver_service_address: Optional[str] = None,
         pack_checksum: Optional[bool] = None,
         canary_rate: Optional[float] = None,
+        solver_stream: Optional[bool] = None,
+        solver_shm_dir: Optional[str] = None,
     ):
         self.cluster = cluster
         self.ffd = FFDScheduler(cluster, rng=rng)
@@ -36,6 +38,10 @@ class Scheduler:
         # cross-check rate, threaded to the TPU backend (None = env twins)
         self._pack_checksum = pack_checksum
         self._canary_rate = canary_rate
+        # streaming transport + zero-copy shm arena toward the sidecar(s)
+        # (docs/solver-transport.md § Streaming; None = env twins)
+        self._solver_stream = solver_stream
+        self._solver_shm_dir = solver_shm_dir
 
     def _tpu_scheduler(self):
         if self._tpu is None:
@@ -45,6 +51,8 @@ class Scheduler:
                 self.cluster, rng=self._rng, service_address=self._service_address,
                 pack_checksum=self._pack_checksum,
                 canary_rate=self._canary_rate,
+                solver_stream=self._solver_stream,
+                solver_shm_dir=self._solver_shm_dir,
             )
         return self._tpu
 

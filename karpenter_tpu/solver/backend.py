@@ -113,10 +113,26 @@ class TpuScheduler:
         service_address: Optional[str] = None,
         pack_checksum: Optional[bool] = None,
         canary_rate: Optional[float] = None,
+        solver_stream: Optional[bool] = None,
+        solver_shm_dir: Optional[str] = None,
     ):
+        import os as _os
+
         from karpenter_tpu.options import env_bool, env_float
 
         self.cluster = cluster
+        # streaming transport knobs (docs/solver-transport.md § Streaming):
+        # persistent multiplexed streams toward the sidecar(s), plus the
+        # zero-copy shm arena when controller and sidecar share a host.
+        # None = the env twins, the same contract as the integrity knobs.
+        self.solver_stream = (
+            bool(solver_stream) if solver_stream is not None
+            else env_bool("KARPENTER_SOLVER_STREAM")
+        )
+        self.solver_shm_dir = (
+            solver_shm_dir if solver_shm_dir is not None
+            else _os.environ.get("KARPENTER_SOLVER_SHM_DIR", "")
+        )
         # corruption defense (docs/integrity.md): per-frame wire checksums
         # on the sidecar path (capability-gated; off keeps the wire
         # byte-identical), and the canary cross-check rate — the fraction
@@ -627,6 +643,8 @@ class TpuScheduler:
                             self.service_address.split(","),
                             timeout=REMOTE_SOLVE_TIMEOUT,
                             checksum=self.pack_checksum,
+                            stream=self.solver_stream,
+                            shm_dir=self.solver_shm_dir,
                         )
                         # integrity quarantines fired inside the pool
                         # surface as cluster Warning events through the
@@ -639,6 +657,8 @@ class TpuScheduler:
                         self._remote = RemoteSolver(
                             self.service_address, timeout=REMOTE_SOLVE_TIMEOUT,
                             checksum=self.pack_checksum,
+                            stream=self.solver_stream,
+                            shm_dir=self.solver_shm_dir,
                         )
         return self._remote
 

@@ -118,6 +118,8 @@ class SolverPool:
         client_factory: Optional[Callable[[str], RemoteSolver]] = None,
         clock: Callable[[], float] = time.monotonic,
         checksum: bool = False,
+        stream: bool = False,
+        shm_dir: str = "",
     ):
         addresses = [a.strip() for a in addresses if a.strip()]
         self._clock = clock
@@ -125,10 +127,15 @@ class SolverPool:
         self.addresses = self.ring.members
         self._timeout = timeout
         self._cold_timeout = cold_timeout
+        # streaming transport (docs/solver-transport.md § Streaming): each
+        # member client keeps ONE persistent multiplexed stream; credit
+        # exhaustion surfaces as OverloadedError(kind="credits"), which
+        # the soft-backoff path below consumes exactly like an admission
+        # refusal — backpressure is never a breaker-worthy failure
         self._client_factory = client_factory or (
             lambda addr: RemoteSolver(
                 addr, timeout=timeout, cold_timeout=cold_timeout,
-                checksum=checksum,
+                checksum=checksum, stream=stream, shm_dir=shm_dir,
             )
         )
         from karpenter_tpu.resilience import BreakerBoard

@@ -93,6 +93,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import math
 import struct
 import threading
 import time
@@ -121,6 +122,10 @@ MAGIC = b"KTPU"
 VERSION = 3
 METHOD = "/karpenter.solver.v1.Solver/Pack"
 OPEN_SESSION_METHOD = "/karpenter.solver.v1.Solver/OpenSession"
+# persistent bidirectional stream (solver/stream.py): every message wraps
+# an UNCHANGED unary frame in a correlation-id envelope, so the unary and
+# streamed transports share one codec, one capability set, one test corpus
+STREAM_METHOD = "/karpenter.solver.v1.Solver/SolveStream"
 HEALTH_METHOD = "/karpenter.solver.v1.Solver/Health"
 SERVING = b"SERVING"
 NOT_SERVING = b"NOT_SERVING"
@@ -158,7 +163,15 @@ PROTO_DEADLINE = 2
 # client engages them only after seeing this bit — the same rolling-upgrade
 # contract as the trace/deadline trailers.
 PROTO_CHECKSUM = 4
-PROTO_FEATURES = PROTO_TRACE_TRAILER | PROTO_DEADLINE | PROTO_CHECKSUM
+# PROTO_STREAM advertises the persistent multiplexed stream transport
+# (docs/solver-transport.md § Streaming): a client only opens SolveStream
+# after seeing the bit — an old sidecar never advertises it, a new sidecar
+# keeps serving unary forever — so rolling upgrades interop in either
+# order, exactly like the trailer capabilities.
+PROTO_STREAM = 8
+PROTO_FEATURES = (
+    PROTO_TRACE_TRAILER | PROTO_DEADLINE | PROTO_CHECKSUM | PROTO_STREAM
+)
 
 # Pack-request flags (optional third word of the n_max array; old servers
 # read words 0-1 and ignore the rest, and the client only sends it after
@@ -288,7 +301,7 @@ def unpack_arrays(data: bytes) -> List[np.ndarray]:
         shape = struct.unpack_from(f"<{ndim}I", data, offset)
         offset += 4 * ndim
         dtype = _DTYPES[code]
-        n_items = int(np.prod(shape, dtype=np.int64))  # prod(()) == 1 → scalar
+        n_items = math.prod(shape)  # prod(()) == 1 → scalar
         n_bytes = n_items * dtype.itemsize
         arr = np.frombuffer(data, dtype=dtype, count=n_items, offset=offset).reshape(shape)
         offset += n_bytes
@@ -355,7 +368,7 @@ def _checksum_span(frame: bytes) -> Tuple[Optional[int], Optional[bytes]]:
         shape = struct.unpack_from(f"<{ndim}I", frame, offset)
         offset += 4 * ndim
         dtype = _DTYPES[code]
-        n_bytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        n_bytes = math.prod(shape) * dtype.itemsize
         payload = offset
         offset += n_bytes
         if offset > len(frame):
@@ -380,6 +393,28 @@ def verify_checksum(frame: bytes) -> str:
         return "missing"
     computed = hashlib.blake2b(frame[8:header], digest_size=8).digest()
     return "ok" if computed == digest else "mismatch"
+
+
+# the integrity trailer's on-wire size: BB header + one u32 dim + 12
+# payload bytes (append_checksum and pack_arrays emit the identical form)
+CHECKSUM_TRAILER_BYTES = 18
+
+
+def verify_and_unpack(frame: bytes) -> Tuple[str, List[np.ndarray]]:
+    """Single-walk verify + parse — the streamed transport's hot path
+    (the unary handlers keep the two-walk ``verify_checksum`` →
+    ``unpack_arrays`` sequence; semantics are identical, this just
+    refuses to pay the header walk twice per message). Returns
+    ``(verdict, arrays)`` with the trailer already stripped; raises
+    exactly like :func:`unpack_arrays` on malformed framing."""
+    arrays = unpack_arrays(frame)
+    if not arrays or not is_checksum_array(arrays[-1]):
+        return "missing", arrays
+    digest = np.asarray(arrays[-1])[1:].tobytes()
+    computed = hashlib.blake2b(
+        frame[8:len(frame) - CHECKSUM_TRAILER_BYTES], digest_size=8
+    ).digest()
+    return ("ok" if computed == digest else "mismatch"), arrays[:-1]
 
 
 def is_checksum_array(a: np.ndarray) -> bool:
@@ -610,11 +645,17 @@ class SolverService:
         queue_depth: int = QUEUE_DEPTH,
         overload_retry_after: float = OVERLOAD_RETRY_AFTER_S,
         hbm_floor_bytes: int = 0,
+        features: int = PROTO_FEATURES,
     ):
         self.ready = threading.Event()
         self.session_max = session_max
         self.session_ttl = session_ttl
         self._clock = clock
+        # the capability word this sidecar advertises in its OpenSession
+        # responses; overridable so interop tests can simulate an OLD
+        # build (a server without PROTO_STREAM / PROTO_CHECKSUM) against
+        # a new client without juggling two checkouts
+        self.features = int(features)
         # overload control (docs/overload.md): bounded admission in front
         # of the solve executor, plus an HBM-headroom floor below which
         # NEW session uploads are refused while resident-session solves
@@ -632,6 +673,13 @@ class SolverService:
         # sidecar's own view of wire corruption (the client attributes the
         # same failure to this member's address on its scrape)
         self.checksum_failures: dict = {}  # guarded-by: self._stats_lock
+        # streamed-transport dispatch accounting (solver/stream.py): how
+        # many device dispatches carried >1 coalesced solve, and how many
+        # solves rode them — the bench's stream_coalesced_dispatch_rate
+        self.stream_stats: dict = {
+            "coalesced_dispatches": 0, "coalesced_solves": 0,
+            "stream_dispatches": 0, "stream_solves": 0,
+        }  # guarded-by: self._stats_lock
         self._stats_lock = threading.Lock()
         # key -> [device-resident (join, frontiers, daemon), last_used, fresh];
         # Pack handler threads race OpenSession handler threads on it.
@@ -772,7 +820,7 @@ class SolverService:
         if hit is not None:
             return self._seal(
                 _status_response(
-                    STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+                    STATUS_OK, [np.array([self.features], np.int32)]
                 ),
                 checksummed,
             )
@@ -840,7 +888,7 @@ class SolverService:
         # the integrity pair on PROTO_CHECKSUM)
         return self._seal(
             _status_response(
-                STATUS_OK, [np.array([PROTO_FEATURES], np.int32)]
+                STATUS_OK, [np.array([self.features], np.int32)]
             ),
             checksummed,
         )
@@ -1075,6 +1123,272 @@ class SolverService:
             )
         return response
 
+    # -- the streamed transport (solver/stream.py) ---------------------------
+
+    def stream_parse_solve(self, payload: bytes, respond, arena=None):
+        """Verify and parse one streamed solve message into a
+        :class:`~karpenter_tpu.solver.stream.StreamSolve` awaiting
+        dispatch, or return the immediate error-response frame. The
+        verification ladder is ``solve_bytes``'s exactly — same checksum
+        policy, same typed refusals — because the payload IS a unary
+        frame; only admission/dispatch move to the coalescer.
+
+        ``arena`` (a ``ShmArenaReader``) marks the zero-copy variant: the
+        frame carries one i32 descriptor where the unary frame carries
+        the 7 pod arrays, and the pod arrays materialize as views onto
+        the shared mmap — the first copy is the device upload itself."""
+        from karpenter_tpu.solver.stream import StreamSolve
+
+        try:
+            verdict, arrays = verify_and_unpack(payload)
+        except ValueError as e:
+            if "version" in str(e) or "magic" in str(e):
+                raise  # version skew stays LOUD (breaks the stream; the
+                #        unary fallback then fails loudly at the codec)
+            return self._reject_corrupt("stream_pack")
+        except Exception:
+            return self._reject_corrupt("stream_pack")
+        if verdict == "mismatch":
+            return self._reject_corrupt("stream_pack")
+        checksummed = verdict == "ok"
+        # structural guards BEFORE any positional indexing: a malformed
+        # payload (a byte-flip with checksums off, or a buggy client)
+        # must fail THIS message with the typed refusal — an IndexError
+        # here would kill the reader thread and tear down the whole
+        # multiplexed stream, amplifying one bad message into every
+        # in-flight solve's failure
+        if len(arrays) < 3 or np.asarray(arrays[1]).reshape(-1).size < 1:
+            return self._seal(_status_response(STATUS_INTEGRITY), checksummed)
+        shm = arena is not None
+        if arena is not None:
+            desc = arrays[2]
+            trailer = arrays[3:]
+            try:
+                pod_arrays = arena.read(desc)
+            except ValueError as e:
+                logger.error("shm descriptor rejected: %s", e)
+                return self._seal(
+                    _status_response(STATUS_INTEGRITY), checksummed
+                )
+            if len(pod_arrays) != N_POD_ARRAYS:
+                return self._seal(
+                    _status_response(STATUS_INTEGRITY), checksummed
+                )
+        else:
+            pod_arrays = arrays[2:2 + N_POD_ARRAYS]
+            trailer = arrays[2 + N_POD_ARRAYS:]
+            if len(pod_arrays) != N_POD_ARRAYS:
+                return self._seal(
+                    _status_response(STATUS_INTEGRITY), checksummed
+                )
+        key_arr, n_max_arr = arrays[0], arrays[1]
+        vals = n_max_arr.reshape(-1)
+        ctx, deadline_s = _parse_trailers(trailer)
+        return StreamSolve(
+            key=key_arr.tobytes(),
+            n_max=int(vals[0]),
+            record=bool(vals[1]) if vals.size > 1 else True,
+            flags=int(vals[2]) if vals.size > 2 else 0,
+            pod_arrays=[np.asarray(a) for a in pod_arrays],
+            ctx=ctx,
+            deadline=(
+                None if deadline_s is None
+                else self._clock() + max(deadline_s, 0.0)
+            ),
+            checksummed=checksummed,
+            respond=respond,
+            shm=shm,
+        )
+
+    # the deadline-shed response is constant either way (sealed bytes
+    # digest a constant frame), so the reader-thread fast path pays zero
+    # serialization for it
+    _SHED_RESPONSES: dict = {}
+
+    def shed_if_expired(self, entry) -> Optional[bytes]:
+        """The stream reader's early deadline shed: an already-expired
+        solve answers ``STATUS_DEADLINE_EXCEEDED`` straight from the
+        reader thread — no dispatcher hop, no executor scheduling, no
+        admission slot. Doomed work cannot shed any earlier than this
+        (the group dispatch re-checks for budgets that die while
+        queued, mirroring the unary path's double check)."""
+        if entry.deadline is None or self._clock() < entry.deadline:
+            return None
+        self._count_shed("deadline")
+        cached = self._SHED_RESPONSES.get(entry.checksummed)
+        if cached is None:
+            cached = self._SHED_RESPONSES[entry.checksummed] = self._seal(
+                _status_response(STATUS_DEADLINE_EXCEEDED), entry.checksummed
+            )
+        return cached
+
+    # coalesced groups are padded up to the next power of two by repeating
+    # the tail entry, so the vmapped kernel compiles once per (shape, B
+    # bucket) instead of once per observed group size
+    _COALESCE_BUCKETS = (1, 2, 4, 8)
+
+    def solve_stream_group(self, entries) -> None:
+        """Dispatch one coalesced group of streamed solves (same session
+        key, same padded pod shapes, same ``n_max`` — the coalescer's
+        group key) as ONE admission slot and ONE device dispatch,
+        answering each entry's ``respond`` with its own response frame.
+
+        Everything the unary solve enforces rides along per entry: the
+        propagated deadline is re-checked after queueing (already-doomed
+        work sheds before dispatch), an unknown session answers
+        ``NEEDS_CATALOG``, hit-rate accounting stays solve-true, and —
+        because steady-state streams send no unary traffic — the TTL
+        session sweep runs here too, so stale catalog generations still
+        release their pinned HBM (the PR-4 solve-path sweep, extended to
+        the stream path)."""
+        import jax
+
+        from karpenter_tpu.solver import kernel, session_stats
+
+        from karpenter_tpu.solver.pallas_kernel import pack_best
+
+        outcome = self.admission.enter()
+        if outcome != "admitted":
+            for e in entries:
+                self._count_shed("queue_full")
+                e.reply(
+                    self._seal(self._overloaded_response(), e.checksummed)
+                )
+            return
+        try:
+            now = self._clock()
+            live = []
+            for e in entries:
+                if e.deadline is not None and now >= e.deadline:
+                    self._count_shed("deadline")
+                    e.reply(
+                        self._seal(
+                            _status_response(STATUS_DEADLINE_EXCEEDED),
+                            e.checksummed,
+                        )
+                    )
+                else:
+                    live.append(e)
+            if not live:
+                return
+            key = live[0].key
+            resident = None
+            hits_to_record = 0
+            with self._sessions_lock:
+                hit = self._sessions.get(key)
+                if hit is not None:
+                    hit[1] = self._clock()
+                    self._sessions.move_to_end(key)
+                    resident = hit[0]
+                    for e in live:
+                        if e.record:
+                            if hit[2]:
+                                hit[2] = False  # fresh upload was the miss
+                            else:
+                                hits_to_record += 1
+                # the TTL sweep rides the stream path: steady-state
+                # streams send no unary solves OR opens, so this is the
+                # only place a stale generation's HBM gets released
+                self._evict_sessions_locked()
+            if hit is None:
+                for e in live:
+                    # unsealed, mirroring the unary path: NEEDS_CATALOG is
+                    # the capability-renegotiation channel (docs/integrity.md)
+                    e.reply(_status_response(STATUS_NEEDS_CATALOG))
+                return
+            for _ in range(hits_to_record):
+                session_stats.record(True)
+            # coalescing is a DEVICE-dispatch amortization: one vmapped
+            # kernel call pays the device/tunnel round trip once for B
+            # solves. On a rig where pack_best would route the NATIVE
+            # host packer (no device in the path), there is nothing to
+            # amortize and the vmapped scan kernel would only be slower —
+            # the group keeps its single admission slot but dispatches
+            # per entry through pack_best's own routing.
+            import os as _os
+
+            from karpenter_tpu.solver.pallas_kernel import pallas_available
+
+            forced = _os.environ.get("KARPENTER_PACKER", "auto").lower()
+            device_route = forced in ("scan", "pallas") or (
+                forced != "native" and pallas_available()
+            )
+            coalesced = len(live) > 1 and device_route
+            with self._stats_lock:
+                self.dispatches += 1
+                self.stream_stats["stream_dispatches"] += 1
+                self.stream_stats["stream_solves"] += len(live)
+                if coalesced:
+                    self.stream_stats["coalesced_dispatches"] += 1
+                    self.stream_stats["coalesced_solves"] += len(live)
+            if coalesced:
+                try:
+                    from karpenter_tpu import metrics
+
+                    metrics.SOLVER_STREAM_COALESCED_DISPATCHES.inc()
+                    metrics.SOLVER_STREAM_COALESCED_SOLVES.inc(len(live))
+                except Exception:
+                    pass  # trimmed registries
+            n_max = live[0].n_max
+            t0 = time.perf_counter()
+            if not coalesced:
+                # one entry, or a no-device rig: pack_best's own routing
+                # per entry (native/scan/pallas), still one admission slot
+                results = [
+                    pack_best(*e.pod_arrays, *resident, n_max=n_max)
+                    for e in live
+                ]
+                dispatch_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                bufs = [
+                    jax.device_get(kernel.fuse_result(r)) for r in results
+                ]
+                fetch_s = time.perf_counter() - t0
+            else:
+                from functools import partial
+
+                pad_to = next(
+                    b for b in self._COALESCE_BUCKETS if b >= len(live)
+                )
+                padded = live + [live[-1]] * (pad_to - len(live))
+                stacked = [
+                    np.stack([e.pod_arrays[i] for e in padded])
+                    for i in range(N_POD_ARRAYS)
+                ]
+                batched = jax.vmap(
+                    partial(kernel.pack, n_max=n_max),
+                    in_axes=(0,) * N_POD_ARRAYS + (None,) * 3,
+                )
+                multi = batched(*stacked, *resident)
+                dispatch_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                fused = jax.device_get(jax.vmap(kernel.fuse_result)(multi))
+                fetch_s = time.perf_counter() - t0
+                bufs = [fused[i] for i in range(len(live))]
+            for e, buf in zip(live, bufs):
+                echo = (
+                    [_key_array(key)]
+                    if e.flags & PACK_FLAG_ECHO_SESSION else []
+                )
+                payload = [np.asarray(buf)]
+                if e.ctx is not None:
+                    # the stage trailer the client grafts as sidecar.*
+                    # child records: the dispatch and fetch are SHARED
+                    # across a coalesced group (each solve genuinely
+                    # waited that long); serialize is the per-entry
+                    # response build, negligible and folded into fetch
+                    payload.append(
+                        np.asarray([dispatch_s, fetch_s, 0.0], np.float32)
+                    )
+                payload.extend(echo)
+                e.reply(
+                    self._seal(
+                        _status_response(STATUS_OK, payload), e.checksummed
+                    )
+                )
+        finally:
+            self.admission.leave()
+
 
 def serve(
     address: str = "127.0.0.1:50051",
@@ -1082,6 +1396,8 @@ def serve(
     health_port: int = 0,
     warmup: bool = False,
     service=None,
+    shm_dir: str = "",
+    coalesce_window_s: Optional[float] = None,
 ):
     """Start the sidecar server; returns the grpc server object.
 
@@ -1090,10 +1406,37 @@ def serve(
     solve completes) for kubelet probes (deploy/solver.yaml). ``warmup``
     runs the compile-warming solve in the background; without it readiness
     is immediate (tests, in-process use). ``service`` lets a caller hand in
-    a pre-built (or chaos-wrapped — testing/chaos.py) ``SolverService``."""
+    a pre-built (or chaos-wrapped — testing/chaos.py) ``SolverService``.
+
+    ``shm_dir`` enables the zero-copy colocated fast path toward clients
+    that share the directory; ``coalesce_window_s`` tunes the streamed
+    dispatch-coalescing collection window. The stream machinery (threads,
+    executor) is built LAZILY on the first SolveStream RPC, so unary-only
+    callers — every pre-stream test and deployment — pay nothing."""
     import grpc
 
     service = service if service is not None else SolverService()
+    stream_box: list = [None]  # guarded-by: stream_lock
+    stream_lock = threading.Lock()
+
+    def stream_server():
+        with stream_lock:
+            if stream_box[0] is None:
+                from karpenter_tpu.solver.stream import (
+                    DEFAULT_COALESCE_WINDOW_S,
+                    StreamServer,
+                )
+
+                stream_box[0] = StreamServer(
+                    service,
+                    max_workers=max_workers,
+                    coalesce_window_s=(
+                        DEFAULT_COALESCE_WINDOW_S
+                        if coalesce_window_s is None else coalesce_window_s
+                    ),
+                    shm_dir=shm_dir,
+                )
+            return stream_box[0]
 
     def handler_fn(method_name, unused_handler_call_details=None):
         if method_name.method == METHOD:
@@ -1105,6 +1448,14 @@ def serve(
         if method_name.method == OPEN_SESSION_METHOD:
             return grpc.unary_unary_rpc_method_handler(
                 lambda request, ctx: service.open_session_bytes(request),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        if method_name.method == STREAM_METHOD:
+            return grpc.stream_stream_rpc_method_handler(
+                lambda request_iterator, ctx: stream_server().handle(
+                    request_iterator, ctx
+                ),
                 request_deserializer=None,
                 response_serializer=None,
             )
@@ -1137,6 +1488,22 @@ def serve(
     if health_port:
         server.health_server = _serve_health(service, health_port)
     server.solver_service = service
+    # lazy accessor + "built yet?" box, so bench/tests can read stream
+    # stats without forcing the machinery into unary-only servers
+    server.stream_server = stream_server
+    server.stream_server_box = stream_box
+    # stream teardown rides server.stop: the coalescer thread and solve
+    # executor must die with the server — tests and chaos harnesses
+    # cycle dozens of sidecars per process, and leaked pollers add up
+    grpc_stop = server.stop
+
+    def stop(grace=None):
+        box = stream_box[0]
+        if box is not None:
+            box.stop()
+        return grpc_stop(grace)
+
+    server.stop = stop
     logger.info("solver service listening on %s", address)
     return server
 
@@ -1240,11 +1607,22 @@ class RemoteSolver:
         timeout: float = 30.0,
         cold_timeout: float = 180.0,
         checksum: bool = False,
+        stream: bool = False,
+        shm_dir: str = "",
     ):
         import grpc
 
         self.address = address
         self.timeout = timeout
+        # streaming transport (docs/solver-transport.md § Streaming):
+        # when enabled AND the sidecar advertised PROTO_STREAM, solves
+        # multiplex over one persistent stream (credit flow control,
+        # out-of-order completion) with transparent unary fallback;
+        # shm_dir additionally engages the zero-copy colocated fast path
+        # once the sidecar acks the arena
+        self._stream_enabled = bool(stream)
+        self._shm_dir = shm_dir
+        self._stream = None  # guarded-by: self._lock
         # end-to-end frame integrity (docs/integrity.md): when enabled AND
         # the sidecar advertised PROTO_CHECKSUM, Pack exchanges carry a
         # blake2b trailer both ways and the response must echo the session
@@ -1324,7 +1702,7 @@ class RemoteSolver:
                 self.checksum and (self._server_features & PROTO_CHECKSUM)
             )
         with obs.tracer().span("solver.wire_open", attrs={"address": self.address}):
-            response = self._open_call(request, timeout=timeout)
+            response = self._dispatch_open(request, timeout)
         status, payload = self._receive_open(response, require)
         if status == STATUS_OVERLOADED:
             # HBM pressure or admission refusal: typed so the pool's soft
@@ -1348,6 +1726,55 @@ class RemoteSolver:
             while len(self._opened) > self.OPENED_MAX:
                 self._opened.popitem(last=False)
             self.session_uploads += 1
+
+    # -- streamed transport ---------------------------------------------------
+
+    def _stream_for(self, features: int):
+        """The established stream client, or None (disabled, server too
+        old, or down-and-backing-off — the unary path is the wait-free
+        fallback in every case)."""
+        if not self._stream_enabled or not (features & PROTO_STREAM):
+            return None
+        with self._lock:
+            client = self._stream
+            if client is None:
+                from karpenter_tpu.solver.stream import StreamClient
+
+                client = self._stream = StreamClient(
+                    self._channel, self.address, shm_dir=self._shm_dir
+                )
+        return client if client.ensure() else None
+
+    def _count_stream_fallback(self, reason: str) -> None:
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_STREAM_FALLBACKS.labels(
+                address=self.address, reason=reason
+            ).inc()
+        except Exception:
+            pass  # trimmed registries
+
+    def _dispatch_open(self, request: bytes, timeout: float) -> bytes:
+        """OpenSession, preferring the stream when one is up (the
+        NEEDS_CATALOG re-open after a mid-stream sidecar restart rides
+        the freshly re-established stream, not a unary detour)."""
+        from karpenter_tpu.solver.stream import (
+            StreamBrokenError,
+            StreamUnavailable,
+        )
+
+        with self._lock:
+            client = self._stream
+        if client is not None and client.up:
+            try:
+                return client.open(request).result(timeout=timeout + 5.0)
+            except (StreamBrokenError, StreamUnavailable):
+                self._count_stream_fallback("open")
+            except futures.TimeoutError:
+                self._count_stream_fallback("open_timeout")
+                client.break_stream("open future timed out")
+        return self._open_call(request, timeout=timeout)
 
     @staticmethod
     def _split_status(response: bytes) -> Tuple[int, List[np.ndarray]]:
@@ -1536,9 +1963,8 @@ class RemoteSolver:
         vals = [n_max, 1 if record else 0]
         if integrity_on:
             vals.append(PACK_FLAG_ECHO_SESSION)
-        arrays = [
-            _key_array(key), np.asarray(vals, np.int32)
-        ] + [np.asarray(a) for a in pod_side]
+        head = [_key_array(key), np.asarray(vals, np.int32)]
+        pod_np = [np.asarray(a) for a in pod_side]
         # optional trailers, each capability-gated on the bits the sidecar
         # advertised in its OpenSession response — an untraced (or
         # old-peer) frame is byte-identical to before, so rolling upgrades
@@ -1548,29 +1974,129 @@ class RemoteSolver:
         # - deadline: the round Budget's REMAINING seconds (relative —
         #   clocks never agree across the wire), so the sidecar can shed
         #   already-doomed work before device dispatch (PROTO_DEADLINE)
+        trailers: List[np.ndarray] = []
         span = obs.tracer().current()
         if span is not None and (features & PROTO_TRACE_TRAILER):
-            arrays.append(_trace_ctx_array(span.context))
+            trailers.append(_trace_ctx_array(span.context))
         if budget is not None and (features & PROTO_DEADLINE):
-            arrays.append(np.asarray([budget.remaining()], np.float32))
-        request = pack_arrays(arrays)
-        if integrity_on:
-            # LAST, over the final bytes: the digest covers every trailer
-            request = append_checksum(request)
+            trailers.append(np.asarray([budget.remaining()], np.float32))
+
+        def build_inline() -> bytes:
+            req = pack_arrays(head + pod_np + trailers)
+            # checksum LAST, over the final bytes: the digest covers
+            # every trailer
+            return append_checksum(req) if integrity_on else req
+
+        # transport selection ladder (docs/solver-transport.md):
+        # stream+shm → stream inline → unary. Credit exhaustion raises the
+        # typed OverloadedError (kind="credits") HERE, at the sender —
+        # the pool's soft-backoff path consumes the hint exactly as it
+        # does a STATUS_OVERLOADED refusal. Stream unavailability is
+        # never an error: the unary path is the wait-free fallback.
+        from karpenter_tpu.solver.stream import (
+            StreamBrokenError,
+            StreamUnavailable,
+        )
+
+        request: Optional[bytes] = None
+        stream_fut = None
+        arena_token = None
+        transport = "unary"
+        stream = self._stream_for(features)
+        if stream is not None:
+            wrote = stream.write_arena(pod_np)
+            if wrote is not None:
+                arena_token, desc = wrote
+                shm_req = pack_arrays(head + [desc] + trailers)
+                if integrity_on:
+                    shm_req = append_checksum(shm_req)
+                try:
+                    stream_fut = stream.solve_shm(shm_req)
+                    transport = "stream_shm"
+                except OverloadedError:
+                    stream.free_arena(arena_token)
+                    raise
+                except StreamUnavailable:
+                    stream.free_arena(arena_token)
+                    arena_token = None
+            if stream_fut is None:
+                request = build_inline()
+                try:
+                    stream_fut = stream.solve(request)
+                    transport = "stream"
+                except StreamUnavailable:
+                    pass  # fell down between ensure() and dispatch
+        if stream_fut is None:
+            if request is None:
+                request = build_inline()
+            grpc_future = self._call.future(request, timeout=timeout)
+        else:
+            grpc_future = None
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_STREAM_SOLVES.labels(
+                address=self.address, transport=transport
+            ).inc()
+        except Exception:
+            pass  # trimmed registries
         if prof is not None:
             prof["wire_ser_s"] = (
                 prof.get("wire_ser_s", 0.0) + time.perf_counter() - t0
             )
-        future = self._call.future(request, timeout=timeout)
+            prof["solver_transport"] = transport
+
+        def redispatch(req: bytes) -> bytes:
+            """The synchronous NEEDS_CATALOG retry dispatch: over the
+            stream when one is up (the re-open itself just rode it), else
+            unary. Stream failure mid-retry degrades to unary — the
+            overlap is already lost, correctness wins."""
+            if stream is not None and stream.up:
+                try:
+                    return stream.solve(req).result(timeout=timeout + 5.0)
+                except (StreamBrokenError, StreamUnavailable):
+                    self._count_stream_fallback("retry")
+                except futures.TimeoutError:
+                    self._count_stream_fallback("retry_timeout")
+                    stream.break_stream("retry future timed out")
+                except OverloadedError:
+                    raise  # typed backpressure: the pool backs off
+            return self._call(req, timeout=timeout)
 
         def wait():
+            nonlocal request, arena_token
             with obs.tracer().span(
-                "solver.wire", attrs={"address": self.address}
+                "solver.wire",
+                attrs={"address": self.address, "transport": transport},
             ) as wsp:
                 # belt over the RPC's own deadline: the future resolves by
                 # `timeout` in every healthy case, the slack only bounds a
                 # misbehaving transport (karplint bounded-wait)
-                response = future.result(timeout=timeout + 5.0)
+                if stream_fut is not None:
+                    try:
+                        response = stream_fut.result(timeout=timeout + 5.0)
+                    except StreamBrokenError:
+                        # the stream died with this solve in flight: the
+                        # background thread is already re-establishing;
+                        # THIS solve retries over the unary path now
+                        self._count_stream_fallback("broken")
+                        wsp.set_attribute("stream_fallback", True)
+                        if request is None:
+                            request = build_inline()
+                        response = self._call(request, timeout=timeout)
+                    except futures.TimeoutError:
+                        self._count_stream_fallback("timeout")
+                        wsp.set_attribute("stream_fallback", True)
+                        stream.break_stream("solve future timed out")
+                        if request is None:
+                            request = build_inline()
+                        response = self._call(request, timeout=timeout)
+                    finally:
+                        if arena_token is not None:
+                            stream.free_arena(arena_token)
+                            arena_token = None
+                else:
+                    response = grpc_future.result(timeout=timeout + 5.0)
                 buf = stage = None
                 # integrity expectation for THIS exchange; the forced
                 # re-open below refreshes it, so a member rolled back to a
@@ -1645,7 +2171,9 @@ class RemoteSolver:
                         require = require and bool(
                             self._server_features & PROTO_CHECKSUM
                         )
-                    response = self._call(request, timeout=timeout)
+                    if request is None:
+                        request = build_inline()
+                    response = redispatch(request)
                 with self._lock:
                     self._warm_shapes.add(shape)
                 t1 = time.perf_counter()
@@ -1689,6 +2217,11 @@ class RemoteSolver:
         return self.pack_begin(*inputs, n_max=n_max)()
 
     def close(self) -> None:
+        with self._lock:
+            stream = self._stream
+            self._stream = None
+        if stream is not None:
+            stream.close()
         self._channel.close()
 
 
@@ -1720,6 +2253,19 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "session uploads are refused STATUS_OVERLOADED "
                          "while resident-session solves keep flowing "
                          "(0 disables)")
+    ap.add_argument("--solver-shm-dir", default="",
+                    help="shared-memory directory for the zero-copy "
+                         "colocated fast path: clients on the same host "
+                         "pass pod arrays through an mmap'd arena and the "
+                         "stream carries only offsets ('' disables; "
+                         "docs/solver-transport.md)")
+    ap.add_argument("--solver-coalesce-window", type=float, default=None,
+                    metavar="SECONDS",
+                    help="cross-stream dispatch-coalescing collection "
+                         "window: concurrent streamed solves with matching "
+                         "session/shapes within it share ONE device "
+                         "dispatch (default 0.002; 0 still coalesces "
+                         "whatever is already queued)")
     ap.add_argument("--flight-dir", default="",
                     help="capped on-disk ring for slow-solve flight records "
                          "('' disables; served at GET /debug/flight)")
@@ -1783,6 +2329,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             overload_retry_after=args.overload_retry_after,
             hbm_floor_bytes=args.hbm_floor_bytes,
         ),
+        shm_dir=args.solver_shm_dir,
+        coalesce_window_s=args.solver_coalesce_window,
     )
     try:
         while True:

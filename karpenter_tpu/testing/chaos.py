@@ -43,8 +43,12 @@ CHAOS_METHODS = frozenset({
     "create_node_pool", "delete_node_pool", "delete_instance",
     # solver sidecar (service.SolverService) — a chaos-wrapped service
     # handed to service.serve() simulates a slow/failing device solve, the
-    # pipeline-smoke test's way of proving encode(i+1) hides under solve(i)
-    "solve_bytes", "open_session_bytes",
+    # pipeline-smoke test's way of proving encode(i+1) hides under solve(i).
+    # solve_stream_group is the STREAMED dispatch path (solver/stream.py):
+    # without it a latency-floor policy would slow unary solves while
+    # streamed ones sailed through, and the stream-storm leg would measure
+    # an unthrottled device
+    "solve_bytes", "open_session_bytes", "solve_stream_group",
 })
 
 # The byte-level corruption surface (docs/integrity.md): silent-data-

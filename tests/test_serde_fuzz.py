@@ -526,6 +526,82 @@ def test_checksum_covers_trailers_and_survives_append():
     assert service.verify_checksum(bytes(broken)) == "mismatch"
 
 
+# -- stream messages: the byte-flip corpus over enveloped frames -------------
+#
+# The streaming transport (solver/stream.py) wraps UNCHANGED v3 frames in a
+# 20-byte correlation-id envelope. The corpus contract extends: every
+# single-byte mutation of an enveloped, checksummed message must be loud at
+# the envelope (bad magic / version skew / truncation / CRC), loud at the
+# inner codec, or rejected by the inner checksum — never a silently
+# different parse, and NEVER a changed correlation id that still routes (a
+# routed flip would complete the WRONG future with a checksum-valid
+# result — the one silent-corruption hole multiplexing opens).
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_stream_message_round_trip(seed):
+    from karpenter_tpu.solver import service, stream
+
+    rng = random.Random(seed)
+    frame = service.append_checksum(service.pack_arrays(_random_arrays(rng)))
+    corr = rng.randrange(2**63)
+    msg_type = rng.choice(
+        [stream.MSG_SOLVE, stream.MSG_OPEN, stream.MSG_RESULT,
+         stream.MSG_SOLVE_SHM]
+    )
+    mt, cid, payload = stream.unpack_stream_msg(
+        stream.pack_stream_msg(msg_type, corr, frame)
+    )
+    assert (mt, cid) == (msg_type, corr)
+    assert payload == frame
+    assert _frames_equal(
+        service.unpack_arrays(payload), service.unpack_arrays(frame)
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_stream_byte_flip_corpus_never_silently_differs(seed):
+    """400 random single-byte mutations per enveloped message: detected at
+    the envelope, the codec, or the checksum — never a quiet different
+    parse and never a rerouted correlation id."""
+    from karpenter_tpu.solver import service, stream
+
+    rng = random.Random(seed)
+    arrays = _random_arrays(rng)
+    frame = service.append_checksum(service.pack_arrays(arrays))
+    corr = rng.randrange(2**63)
+    msg = stream.pack_stream_msg(stream.MSG_SOLVE, corr, frame)
+    original = service.unpack_arrays(frame)
+    silent = []
+    for _ in range(400):
+        out = bytearray(msg)
+        pos = rng.randrange(len(out))
+        bit = 1 << rng.randrange(8)
+        out[pos] ^= bit
+        try:
+            msg_type, cid, payload = stream.unpack_stream_msg(bytes(out))
+        except Exception:
+            continue  # loud at the envelope (magic/version/CRC/truncation)
+        if cid != corr or msg_type != stream.MSG_SOLVE:
+            silent.append(("routed header flip", pos, bit))
+            continue
+        try:
+            verdict = service.verify_checksum(payload)
+        except Exception:
+            continue  # loud at the inner codec walk
+        if verdict != "ok":
+            continue  # inner checksum rejected
+        try:
+            parsed = service.unpack_arrays(payload)
+        except Exception:
+            continue
+        if not _frames_equal(parsed, original):
+            silent.append(("silent parse", pos, bit))
+    assert not silent, (
+        f"{len(silent)} mutation(s) slipped the stream defenses: {silent[:5]}"
+    )
+
+
 def test_known_bad_documents_rejected():
     base = serde.to_wire("provisioners", random_provisioner(random.Random(1)))
     bad_op = json.loads(json.dumps(base))

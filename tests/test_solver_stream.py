@@ -1,0 +1,768 @@
+"""Streaming solver transport tests (docs/solver-transport.md § Streaming).
+
+Covers the stream lifecycle satellites end to end: envelope codec
+loudness, out-of-order completion under injected latency, mid-stream
+sidecar restart (NEEDS_CATALOG re-open OVER the stream), credit
+exhaustion → soft backoff → re-admit, corrupt streamed frames →
+STATUS_INTEGRITY/quarantine, PROTO_STREAM interop in both rolling-upgrade
+orders, the zero-copy shm arena, cross-stream dispatch coalescing
+bit-exactness, and the TTL-sweep/HBM-gate parity the stream path must
+keep with the unary path."""
+
+import random
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.resilience.overload import OverloadedError
+from karpenter_tpu.solver import stream as st
+from karpenter_tpu.solver.service import (
+    N_POD_ARRAYS,
+    PROTO_FEATURES,
+    PROTO_STREAM,
+    STATUS_INTEGRITY,
+    STATUS_OK,
+    STATUS_OVERLOADED,
+    RemoteSolver,
+    SolverService,
+    append_checksum,
+    catalog_session_key,
+    pack_arrays,
+    serve,
+    unpack_arrays,
+    _key_array,
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def encoded_args(n_types: int = 8, n_pods: int = 6, seed: int = 3):
+    """A real encoded batch's ``pack_args`` tuple + its pod count."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+    from karpenter_tpu.kube.client import Cluster
+    from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.testing import diverse_pods, make_provisioner
+
+    catalog = sorted(instance_types(n_types), key=lambda it: it.effective_price())
+    constraints = make_provisioner(solver="tpu").spec.constraints
+    constraints.requirements = constraints.requirements.merge(
+        catalog_requirements(catalog)
+    )
+    pods = sort_pods_ffd(diverse_pods(n_pods, random.Random(seed)))
+    cluster = Cluster()
+    Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+    batch = enc.encode(
+        constraints, catalog, pods, daemon_overhead(cluster, constraints)
+    )
+    return [np.asarray(a) for a in batch.pack_args()], len(batch.pod_valid)
+
+
+def wait_until(predicate, timeout=8.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def assert_results_equal(a, b):
+    for f in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+        )
+
+
+# ---------------------------------------------------------------------------
+# envelope codec
+# ---------------------------------------------------------------------------
+
+
+class TestEnvelope:
+    def test_round_trip(self):
+        payload = b"\x01\x02\x03" * 100
+        msg = st.pack_stream_msg(st.MSG_SOLVE, 1234567890123, payload)
+        mt, corr, out = st.unpack_stream_msg(msg)
+        assert (mt, corr, out) == (st.MSG_SOLVE, 1234567890123, payload)
+
+    def test_bad_magic_loud(self):
+        msg = bytearray(st.pack_stream_msg(st.MSG_SOLVE, 1, b"x"))
+        msg[0] ^= 0xFF
+        with pytest.raises(ValueError, match="magic"):
+            st.unpack_stream_msg(bytes(msg))
+
+    @pytest.mark.parametrize("version", [0, 2, 255])
+    def test_version_skew_loud(self, version):
+        msg = bytearray(st.pack_stream_msg(st.MSG_SOLVE, 1, b"x"))
+        struct.pack_into("<H", msg, 4, version)
+        with pytest.raises(ValueError, match=f"stream version {version}"):
+            st.unpack_stream_msg(bytes(msg))
+
+    def test_corr_id_flip_detected(self):
+        """A flipped correlation id must NEVER route: it would complete
+        the wrong future with another solve's checksum-valid result —
+        the one silent-corruption hole multiplexing opens."""
+        msg = bytearray(st.pack_stream_msg(st.MSG_RESULT, 7, b"payload"))
+        msg[8] ^= 0x01  # first corr-id byte
+        with pytest.raises(st.EnvelopeCorrupt):
+            st.unpack_stream_msg(bytes(msg))
+
+    def test_truncated_envelope_loud(self):
+        msg = st.pack_stream_msg(st.MSG_SOLVE, 1, b"")
+        with pytest.raises(ValueError, match="truncated"):
+            st.unpack_stream_msg(msg[:10])
+
+
+# ---------------------------------------------------------------------------
+# shm arena
+# ---------------------------------------------------------------------------
+
+
+class TestShmArena:
+    def _arrays(self):
+        rng = np.random.default_rng(5)
+        return [
+            np.array([True, False, True, True]),
+            rng.integers(0, 100, (4, 3)).astype(np.int32),
+            rng.random((2, 5)).astype(np.float32),
+            np.array(3, np.int32),  # scalar
+        ]
+
+    def test_write_read_round_trip(self, tmp_path):
+        arena = st.ShmArena(str(tmp_path), size=1 << 20)
+        reader = st.ShmArenaReader(arena.path)
+        arrays = self._arrays()
+        token, desc = arena.write(arrays)
+        out = reader.read(desc)
+        assert len(out) == len(arrays)
+        for a, b in zip(arrays, out):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+        arena.free(token)
+        assert arena.live_blocks() == 0
+        reader.close()
+        arena.close()
+
+    def test_header_corruption_rejected(self, tmp_path):
+        arena = st.ShmArena(str(tmp_path), size=1 << 20)
+        reader = st.ShmArenaReader(arena.path)
+        token, desc = arena.write(self._arrays())
+        bad = desc.copy()
+        bad[0] += 1  # token mismatch vs the in-arena header
+        with pytest.raises(ValueError):
+            reader.read(bad)
+        # clobber the in-arena header itself: CRC catches it
+        base = int(desc[1]) | (int(desc[2]) << 31)
+        arena._map[base + 4:base + 8] = b"\xff\xff\xff\xff"
+        with pytest.raises(ValueError):
+            reader.read(desc)
+        reader.close()
+        arena.close()
+
+    def test_full_arena_returns_none(self, tmp_path):
+        arena = st.ShmArena(str(tmp_path), size=4096)
+        big = [np.zeros(8192, np.float32)]
+        assert arena.write(big) is None  # larger than the arena
+        small = [np.zeros(256, np.float32)]
+        tokens = []
+        while True:
+            wrote = arena.write(small)
+            if wrote is None:
+                break
+            tokens.append(wrote[0])
+        assert tokens, "at least one small block must fit"
+        # freeing makes room again (the wraparound path)
+        arena.free(tokens[0])
+        assert arena.write(small) is not None
+        arena.close()
+
+    def test_out_of_bounds_descriptor_rejected(self, tmp_path):
+        arena = st.ShmArena(str(tmp_path), size=1 << 16)
+        reader = st.ShmArenaReader(arena.path)
+        desc = np.asarray([1, 1 << 20, 0, 1, 2, 1, 4], np.int32)
+        with pytest.raises(ValueError):
+            reader.read(desc)
+        reader.close()
+        arena.close()
+
+
+# ---------------------------------------------------------------------------
+# live stream lifecycle
+# ---------------------------------------------------------------------------
+
+
+class _Harness:
+    """One live sidecar + unary reference client; streamed clients are
+    created per test and closed by :meth:`stop`."""
+
+    def __init__(self, service=None, shm_dir="", coalesce_window_s=None,
+                 checksum=True):
+        self.address = f"127.0.0.1:{free_port()}"
+        self.server = serve(
+            self.address, service=service, shm_dir=shm_dir,
+            coalesce_window_s=coalesce_window_s,
+        )
+        self.checksum = checksum
+        self.clients = []
+
+    def client(self, stream=True, shm_dir="", checksum=None) -> RemoteSolver:
+        c = RemoteSolver(
+            self.address, timeout=10.0, cold_timeout=60.0,
+            checksum=self.checksum if checksum is None else checksum,
+            stream=stream, shm_dir=shm_dir,
+        )
+        self.clients.append(c)
+        return c
+
+    def restart(self, service=None, **kw):
+        self.server.stop(grace=0)
+        self.server = serve(self.address, service=service, **kw)
+
+    def stop(self):
+        for c in self.clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.server.stop(grace=0)
+
+
+@pytest.fixture
+def args16():
+    args, p = encoded_args()
+    return args, p
+
+
+class TestStreamLifecycle:
+    def test_streamed_solve_matches_unary(self, args16):
+        args, _ = args16
+        h = _Harness()
+        try:
+            ref = h.client(stream=False).pack(*args, n_max=16)
+            rs = h.client(stream=True)
+            rs.pack(*args, n_max=16)  # opens session, establishes stream
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            prof = {}
+            out = rs.pack_begin(*args, n_max=16, prof=prof)()
+            assert_results_equal(out, ref)
+            assert prof["solver_transport"] == "stream"
+            assert h.server.solver_service.stream_stats["stream_solves"] >= 1
+        finally:
+            h.stop()
+
+    def test_out_of_order_completion_under_latency(self, args16):
+        """A slow solve dispatched FIRST must not head-of-line-block a
+        fast one dispatched after it: responses complete out of order
+        into their own futures (the multiplexing contract)."""
+        args, _ = args16
+        sleeps = {24: 1.0, 16: 0.0}
+
+        class Laggy(SolverService):
+            def solve_stream_group(self, entries):
+                time.sleep(sleeps.get(entries[0].n_max, 0.0))
+                super().solve_stream_group(entries)
+
+        h = _Harness(service=Laggy())
+        try:
+            rs = h.client(stream=True)
+            ref16 = h.client(stream=False).pack(*args, n_max=16)
+            ref24 = h.client(stream=False).pack(*args, n_max=24)
+            rs.pack(*args, n_max=16)  # warm + establish
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            prof_a, prof_b = {}, {}
+            t0 = time.perf_counter()
+            wait_slow = rs.pack_begin(*args, n_max=24, prof=prof_a)
+            wait_fast = rs.pack_begin(*args, n_max=16, prof=prof_b)
+            out_fast = wait_fast()
+            fast_done = time.perf_counter() - t0
+            out_slow = wait_slow()
+            assert prof_a["solver_transport"] == "stream"
+            assert prof_b["solver_transport"] == "stream"
+            # the fast solve completed while the slow one was still
+            # sleeping server-side — out-of-order completion for real
+            assert fast_done < 0.9, fast_done
+            assert_results_equal(out_fast, ref16)
+            assert_results_equal(out_slow, ref24)
+        finally:
+            h.stop()
+
+    def test_midstream_restart_reopens_over_stream(self, args16):
+        """Sidecar restart: the stream breaks, re-establishes in the
+        background against the fresh (empty-store) service, and the
+        NEEDS_CATALOG recovery — re-open AND retry — rides the NEW
+        stream, not a unary detour."""
+        args, _ = args16
+        h = _Harness()
+        try:
+            rs = h.client(stream=True)
+            ref = h.client(stream=False).pack(*args, n_max=16)
+            rs.pack(*args, n_max=16)
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            uploads_before = rs.session_uploads
+            established_before = rs._stream.established_count
+            h.restart()  # fresh service: empty session store, same address
+            # wait for the RE-establishment, not the stale pre-break "up"
+            # (the client may not have noticed the kill yet)
+            assert wait_until(
+                lambda: rs._stream.established_count > established_before
+                and rs._stream.up,
+                timeout=20.0,
+            )
+            out = rs.pack(*args, n_max=16)
+            assert_results_equal(out, ref)
+            # the re-open happened (fresh store answered NEEDS_CATALOG)...
+            assert rs.session_uploads > uploads_before
+            # ...and it rode the stream: the NEW server's stream handler
+            # saw an MSG_OPEN
+            box = h.server.stream_server_box[0]
+            assert box is not None and box.snapshot()["stream_opens"] >= 1
+        finally:
+            h.stop()
+
+    def test_credit_exhaustion_typed_and_readmits(self, args16):
+        """Window empty → OverloadedError(kind='credits') at the SENDER,
+        with the server's hint; once a result returns the credit, the
+        next solve is admitted again."""
+        args, _ = args16
+        gate = threading.Event()
+
+        class Gated(SolverService):
+            def solve_stream_group(self, entries):
+                gate.wait(timeout=20.0)
+                super().solve_stream_group(entries)
+
+        h = _Harness(
+            service=Gated(max_inflight=1, queue_depth=0,
+                          overload_retry_after=0.05),
+        )
+        try:
+            rs = h.client(stream=True)
+            gate.set()
+            rs.pack(*args, n_max=16)  # warm + establish (window = 1)
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            gate.clear()
+            blocked = rs.pack_begin(*args, n_max=16)  # holds the 1 credit
+            with pytest.raises(OverloadedError) as ei:
+                rs.pack_begin(*args, n_max=16)
+            assert ei.value.kind == "credits"
+            assert ei.value.retry_after == pytest.approx(0.05)
+            assert rs._stream.credit_stalls >= 1
+            gate.set()
+            blocked()  # completes; credit returns
+            assert wait_until(lambda: rs._stream.credits_available() >= 1)
+            rs.pack(*args, n_max=16)  # re-admitted
+        finally:
+            gate.set()
+            h.stop()
+
+    def test_credit_exhaustion_soft_backoff_in_pool(self, args16):
+        """The pool consumes a credit stall exactly like an admission
+        refusal: soft backoff (typed OverloadedError upward), ZERO
+        breaker state touched, member re-admitted after the hint."""
+        from karpenter_tpu.solver.pool import SolverPool
+
+        args, _ = args16
+        gate = threading.Event()
+
+        class Gated(SolverService):
+            def solve_stream_group(self, entries):
+                gate.wait(timeout=20.0)
+                super().solve_stream_group(entries)
+
+        h = _Harness(
+            service=Gated(max_inflight=1, queue_depth=0,
+                          overload_retry_after=0.05),
+        )
+        pool = SolverPool(
+            [h.address], timeout=10.0,
+            client_factory=lambda addr: h.client(stream=True),
+        )
+        try:
+            gate.set()
+            pool.pack(*args, n_max=16)  # warm
+            member = h.clients[-1]
+            assert wait_until(lambda: member._stream is not None and member._stream.up)
+            gate.clear()
+            blocked = pool.pack_begin(*args, n_max=16)
+            with pytest.raises(OverloadedError):
+                pool.pack_begin(*args, n_max=16)
+            # backpressure, not failure: the real breaker never moved
+            assert pool._breaker(h.address).available()
+            assert pool.failovers == 0
+            assert pool.overload_skips >= 1
+            gate.set()
+            blocked()
+            assert wait_until(
+                lambda: member._stream.credits_available() >= 1
+            )
+            # sit out the hint window, then the member re-admits
+            time.sleep(0.06)
+            pool.pack(*args, n_max=16)
+        finally:
+            gate.set()
+            pool.close()
+            h.stop()
+
+    def test_corrupt_streamed_response_quarantines(self, args16):
+        """A corrupted streamed response is a typed IntegrityError at the
+        client (frame checksum), and the pool QUARANTINES the member —
+        trip, not a windowed failure."""
+        from karpenter_tpu.resilience.integrity import IntegrityError
+        from karpenter_tpu.solver.pool import PoolExhausted, SolverPool
+
+        args, _ = args16
+        corrupt = {"on": False}
+
+        class Corrupting(SolverService):
+            def solve_stream_group(self, entries):
+                if corrupt["on"]:
+                    for e in entries:
+                        orig = e.respond
+
+                        def bad(b, _o=orig):
+                            flipped = bytearray(b)
+                            flipped[len(flipped) // 2] ^= 0x10
+                            _o(bytes(flipped))
+
+                        e.respond = bad
+                super().solve_stream_group(entries)
+
+        h = _Harness(service=Corrupting())
+        pool = SolverPool(
+            [h.address], timeout=10.0,
+            client_factory=lambda addr: h.client(stream=True),
+        )
+        try:
+            pool.pack(*args, n_max=16)  # warm + establish + negotiate
+            member = h.clients[-1]
+            assert wait_until(lambda: member._stream is not None and member._stream.up)
+            corrupt["on"] = True
+            with pytest.raises((PoolExhausted, IntegrityError)):
+                pool.pack(*args, n_max=16)
+            # quarantined: the member's breaker is OPEN right now
+            assert not pool._breaker(h.address).available()
+        finally:
+            pool.close()
+            h.stop()
+
+    def test_corrupt_streamed_request_answers_integrity(self, args16):
+        """Server side of the same contract: a streamed solve frame whose
+        checksum disagrees answers STATUS_INTEGRITY — never a solve
+        against garbage."""
+        args, _ = args16
+        h = _Harness()
+        try:
+            rs = h.client(stream=True)
+            rs.pack(*args, n_max=16)
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            key = catalog_session_key(*args[N_POD_ARRAYS:])
+            frame = append_checksum(pack_arrays(
+                [_key_array(key), np.asarray([16, 1], np.int32)]
+                + list(args[:N_POD_ARRAYS])
+            ))
+            bad = bytearray(frame)
+            bad[len(bad) // 2] ^= 0x04
+            fut = rs._stream.solve(bytes(bad))
+            response = fut.result(timeout=10.0)
+            status = int(unpack_arrays(response)[0].reshape(-1)[0])
+            assert status == STATUS_INTEGRITY
+        finally:
+            h.stop()
+
+
+class TestInterop:
+    def test_new_client_old_server_stays_unary(self, args16):
+        """A server that never advertises PROTO_STREAM (an old build)
+        keeps a stream-enabled client on the unary path — no stream is
+        ever attempted, solves keep working."""
+        args, _ = args16
+        h = _Harness(
+            service=SolverService(features=PROTO_FEATURES & ~PROTO_STREAM)
+        )
+        try:
+            ref = h.client(stream=False).pack(*args, n_max=16)
+            rs = h.client(stream=True)
+            out = rs.pack(*args, n_max=16)
+            out2 = rs.pack(*args, n_max=16)
+            assert_results_equal(out, ref)
+            assert_results_equal(out2, ref)
+            assert rs._stream is None  # never even constructed
+        finally:
+            h.stop()
+
+    def test_old_client_new_server_unary_untouched(self, args16):
+        """An old client (stream disabled — the pre-stream build) against
+        a new server: pure unary, byte-identical protocol, and the
+        server's stream machinery is never built."""
+        args, _ = args16
+        h = _Harness()
+        try:
+            rs = h.client(stream=False)
+            out = rs.pack(*args, n_max=16)
+            assert out is not None
+            assert h.server.stream_server_box[0] is None
+        finally:
+            h.stop()
+
+
+class TestShmFastPath:
+    def test_shm_solves_and_frees(self, args16, tmp_path):
+        args, _ = args16
+        shm = str(tmp_path)
+        h = _Harness(shm_dir=shm)
+        try:
+            ref = h.client(stream=False).pack(*args, n_max=16)
+            rs = h.client(stream=True, shm_dir=shm)
+            rs.pack(*args, n_max=16)
+            assert wait_until(
+                lambda: rs._stream is not None and rs._stream.shm_active
+            )
+            prof = {}
+            out = rs.pack_begin(*args, n_max=16, prof=prof)()
+            assert prof["solver_transport"] == "stream_shm"
+            assert_results_equal(out, ref)
+            # the arena block was freed on completion
+            assert rs._stream._arena.live_blocks() == 0
+            box = h.server.stream_server_box[0]
+            assert box.snapshot()["shm_solves"] >= 1
+        finally:
+            h.stop()
+
+    def test_server_without_shm_declines_arena(self, args16, tmp_path):
+        args, _ = args16
+        h = _Harness()  # no shm_dir server-side
+        try:
+            rs = h.client(stream=True, shm_dir=str(tmp_path))
+            rs.pack(*args, n_max=16)
+            assert wait_until(lambda: rs._stream is not None and rs._stream.up)
+            prof = {}
+            rs.pack_begin(*args, n_max=16, prof=prof)()
+            # declined arena → inline stream frames, still streamed
+            assert prof["solver_transport"] == "stream"
+            assert not rs._stream.shm_active
+        finally:
+            h.stop()
+
+
+class TestCoalescing:
+    def test_coalesced_group_dispatch_bit_exact(self, args16, monkeypatch):
+        """Deterministic unit-level proof: a multi-entry group through
+        ``solve_stream_group`` takes ONE coalesced (vmapped) dispatch and
+        every demuxed response is bit-exact with the unary solve."""
+        monkeypatch.setenv("KARPENTER_PACKER", "scan")
+        args, _ = args16
+        service = SolverService()
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        resp = service.open_session_bytes(
+            pack_arrays([_key_array(key)] + list(args[N_POD_ARRAYS:]))
+        )
+        assert int(unpack_arrays(resp)[0].reshape(-1)[0]) == STATUS_OK
+        ref_frame = service.solve_bytes(
+            pack_arrays(
+                [_key_array(key), np.asarray([16, 1], np.int32)]
+                + list(args[:N_POD_ARRAYS])
+            )
+        )
+        ref_buf = unpack_arrays(ref_frame)[1]
+        responses = []
+        entries = [
+            service.stream_parse_solve(
+                pack_arrays(
+                    [_key_array(key), np.asarray([16, 1], np.int32)]
+                    + list(args[:N_POD_ARRAYS])
+                ),
+                respond=responses.append,
+            )
+            for _ in range(3)
+        ]
+        before = dict(service.stream_stats)
+        service.solve_stream_group(entries)
+        assert len(responses) == 3
+        for r in responses:
+            arrays = unpack_arrays(r)
+            assert int(arrays[0].reshape(-1)[0]) == STATUS_OK
+            np.testing.assert_array_equal(arrays[1], ref_buf)
+        assert (
+            service.stream_stats["coalesced_dispatches"]
+            == before["coalesced_dispatches"] + 1
+        )
+        assert (
+            service.stream_stats["coalesced_solves"]
+            == before["coalesced_solves"] + 3
+        )
+
+    def test_concurrent_same_shape_solves_coalesce_bit_exact(
+        self, args16, monkeypatch
+    ):
+        # pin the scan kernel: coalescing only engages on a DEVICE route
+        # (on the CPU rig pack_best would route native, where a vmapped
+        # dispatch amortizes nothing), and scan is the same kernel family
+        # the real device runs — the bit-exactness claim under test
+        monkeypatch.setenv("KARPENTER_PACKER", "scan")
+        args, _ = args16
+        h = _Harness(coalesce_window_s=0.25)
+        try:
+            ref = h.client(stream=False).pack(*args, n_max=16)
+            clients = [h.client(stream=True) for _ in range(2)]
+            for c in clients:
+                c.pack(*args, n_max=16)  # warm + establish both streams
+                assert wait_until(lambda c=c: c._stream is not None and c._stream.up)
+            svc = h.server.solver_service
+            before = dict(svc.stream_stats)
+
+            # group formation is timing-dependent (entries must land
+            # inside one collection window); fire salvos until one
+            # coalesces — bounded, and every result must stay bit-exact
+            for _ in range(10):
+                waits, errs = [], []
+
+                def fire(c):
+                    try:
+                        waits.append(c.pack_begin(*args, n_max=16))
+                    except Exception as e:  # pragma: no cover - diagnostic
+                        errs.append(e)
+
+                threads = [
+                    threading.Thread(target=fire, args=(clients[i % 2],))
+                    for i in range(4)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=20.0)
+                assert not errs and len(waits) == 4
+                for w in waits:
+                    assert_results_equal(w(), ref)  # coalesced stays bit-exact
+                if (
+                    svc.stream_stats["coalesced_dispatches"]
+                    > before["coalesced_dispatches"]
+                ):
+                    break
+            after = svc.stream_stats
+            assert after["coalesced_dispatches"] > before["coalesced_dispatches"]
+            assert after["coalesced_solves"] - before["coalesced_solves"] >= 2
+        finally:
+            h.stop()
+
+
+class TestStreamPathParity:
+    """The PR-4 store-maintenance contracts the stream path must keep:
+    steady-state streams send no unary traffic, so the TTL sweep and the
+    HBM-pressure OpenSession gate must ride the stream too."""
+
+    def test_ttl_sweep_rides_streamed_solves(self):
+        clock = [0.0]
+        service = SolverService(session_ttl=5.0, clock=lambda: clock[0])
+        args_a, _ = encoded_args(n_types=8, seed=3)
+        args_b, _ = encoded_args(n_types=6, seed=9)
+        key_a = catalog_session_key(*args_a[N_POD_ARRAYS:])
+        key_b = catalog_session_key(*args_b[N_POD_ARRAYS:])
+        assert key_a != key_b
+        for args, key in ((args_a, key_a), (args_b, key_b)):
+            resp = service.open_session_bytes(
+                pack_arrays([_key_array(key)] + list(args[N_POD_ARRAYS:]))
+            )
+            assert int(unpack_arrays(resp)[0].reshape(-1)[0]) == STATUS_OK
+        assert service.session_count() == 2
+        clock[0] = 10.0  # past session A and B's TTL
+        responses = []
+        entry = service.stream_parse_solve(
+            pack_arrays(
+                [_key_array(key_b), np.asarray([16, 1], np.int32)]
+                + list(args_b[:N_POD_ARRAYS])
+            ),
+            respond=responses.append,
+        )
+        assert not isinstance(entry, bytes)
+        service.solve_stream_group([entry])
+        assert responses
+        assert int(unpack_arrays(responses[0])[0].reshape(-1)[0]) == STATUS_OK
+        # B was touched by its own solve; stale A's HBM was released by
+        # the sweep riding the STREAM path
+        assert service.session_count() == 1
+
+    def test_hbm_gate_refuses_streamed_open(self, args16, monkeypatch):
+        args, _ = args16
+        from karpenter_tpu.solver import service as svc_mod
+
+        monkeypatch.setattr(
+            svc_mod, "publish_device_headroom", lambda: 1024
+        )
+        h = _Harness(
+            service=SolverService(hbm_floor_bytes=1 << 30),
+        )
+        try:
+            rs = h.client(stream=True, checksum=False)
+            # force the stream up without an open: drive the raw client
+            assert rs._stream_for(PROTO_FEATURES) is not None
+            key = catalog_session_key(*args[N_POD_ARRAYS:])
+            frame = pack_arrays(
+                [_key_array(key)] + list(args[N_POD_ARRAYS:])
+            )
+            response = rs._stream.open(frame).result(timeout=10.0)
+            status = int(unpack_arrays(response)[0].reshape(-1)[0])
+            assert status == STATUS_OVERLOADED
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder wire-dominance watch rule
+# ---------------------------------------------------------------------------
+
+
+class TestWireDominanceWatchRule:
+    def _solve_tree(self, wire_s: float, sidecar_s: float):
+        from karpenter_tpu import obs
+
+        tracer = obs.tracer()
+        with tracer.span("solver.solve") as root:
+            with tracer.span("solver.wire") as w:
+                time.sleep(wire_s)
+                w.add_child_record("sidecar.solve", sidecar_s)
+                w.add_child_record("sidecar.fetch", sidecar_s / 2)
+        return root
+
+    def test_wire_dominated_solve_self_reports(self, tmp_path):
+        from karpenter_tpu.obs.flight import FlightRecorder
+
+        rec = FlightRecorder(str(tmp_path), budget_s=10.0)  # never on budget
+        root = self._solve_tree(wire_s=0.03, sidecar_s=0.001)
+        rec(root)
+        records = rec.recent()
+        assert records, "wire-dominated solve must flight-record"
+        assert records[0]["wire_dominated"] is True
+        assert records[0]["wire_self_s"] > records[0]["solve_share_s"]
+
+    def test_solve_dominated_solve_stays_quiet(self, tmp_path):
+        from karpenter_tpu.obs.flight import FlightRecorder
+
+        rec = FlightRecorder(str(tmp_path), budget_s=10.0)
+        root = self._solve_tree(wire_s=0.006, sidecar_s=0.2)
+        rec(root)
+        assert rec.recent() == []
+
+    def test_in_process_solve_never_fires(self, tmp_path):
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs.flight import FlightRecorder
+
+        rec = FlightRecorder(str(tmp_path), budget_s=10.0)
+        tracer = obs.tracer()
+        with tracer.span("solver.solve") as root:
+            with tracer.span("solve.pack_fetch"):
+                time.sleep(0.01)
+        rec(root)
+        assert rec.recent() == []

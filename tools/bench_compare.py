@@ -55,6 +55,14 @@ HEADLINE_KEYS: Dict[str, int] = {
     "fleet_critical_path_ms": -1,
     "wire_share_pct": -1,
     "profiler_overhead_pct": -1,
+    # streamed solver transport (docs/solver-transport.md § Streaming):
+    # throughput over the persistent stream, its per-solve transport
+    # floor, and the share of streamed solves that coalesced into shared
+    # device dispatches. Missing on pre-stream rounds is reported, never
+    # fatal (the standard new-key salvage).
+    "streamed_pods_per_sec": +1,
+    "streamed_rtt_floor_ms": -1,
+    "stream_coalesced_dispatch_rate": +1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"
