@@ -45,6 +45,9 @@ benchmark-notrace:  ## tracing-overhead comparison run (acceptance bar: native l
 profile-smoke:  ## profiler-overhead gate: headline leg with and without the sampling profiler (<1% self-accounted bar)
 	$(PY) bench.py --profile-overhead-check --pods 2000 --iters 6 --solver ffd
 
+explain-smoke:  ## explain-overhead gate: per-round decision records + attribution vs --no-explain (<1% self-accounted bar)
+	$(PY) bench.py --explain-overhead-check --pods 4000 --iters 6
+
 benchmark-grid:  ## the reference's full batch grid
 	$(PY) bench.py --grid
 

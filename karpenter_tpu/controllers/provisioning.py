@@ -77,10 +77,19 @@ class ProvisionerWorker:
         canary_rate: Optional[float] = None,
         solver_stream: Optional[bool] = None,
         solver_shm_dir: Optional[str] = None,
+        unschedulable_event_rounds: int = 3,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud_provider = cloud_provider
+        # decision observability (docs/decisions.md): every round lands in
+        # the decision audit log; a pod failing this many CONSECUTIVE
+        # rounds gets its PodUnschedulable Warning event
+        self.unschedulable_event_rounds = unschedulable_event_rounds
+        # the current round's decision id — Warning events emitted from
+        # this worker's decision path annotate it (karplint
+        # `event-decision-id`); "" until the first record lands
+        self.last_decision_id = ""
         # write-ahead launch journal (launch/journal.py): intent recorded
         # BEFORE the cloud create, resolved only after the bind — the
         # breadcrumb crash recovery replays. None = journaling off.
@@ -226,6 +235,7 @@ class ProvisionerWorker:
             "Provisioner", self.provisioner.name, "PodShed",
             f"pod {key} shed from the admission queue ({reason}); it "
             "re-enters selection when capacity recovers", type="Warning",
+            decision_id=self.last_decision_id,
         )
 
     # -- the provision loop ------------------------------------------------
@@ -308,6 +318,10 @@ class ProvisionerWorker:
                 )
                 nodes = self.scheduler.solve(self.provisioner, instance_types, pods)
                 self._observe_stages()
+                # the decision audit record lands BEFORE any launch: even
+                # a round whose launches crash leaves its decision (and
+                # any per-pod elimination verdicts) replayable
+                self._record_decision(pods, nodes, round_sp)
                 # parallel launch per virtual node (reference: provisioner.go:113)
                 with ThreadPoolExecutor(max_workers=min(8, max(len(nodes), 1))) as pool:
                     # executor threads don't inherit contextvars: each launch
@@ -332,6 +346,46 @@ class ProvisionerWorker:
                 except Exception:
                     logger.debug("lastScaleTime write failed", exc_info=True)
             return nodes
+
+    def _record_decision(self, pods: List[Pod], nodes: List[VirtualNode], round_sp) -> None:
+        """Append this round to the decision audit log (obs/decisions.py):
+        considered pods, the chosen packing, per-pod elimination
+        attribution for whatever stayed unplaced, route/session
+        provenance, and the brownout/fence state at decision time — then
+        close the Kubernetes loop (PodUnschedulable Warning events for
+        pods past the consecutive-failure threshold). Best-effort: the
+        audit plane must never fail a reconcile round."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs import decisions as dec
+
+        if not dec.enabled():
+            return
+        try:
+            log = obs.decision_log()
+            state = {
+                "fenced": bool(self.fenced()),
+                **obs.state_snapshot(only=("brownout",)),
+            }
+            rec = log.record_round(
+                provisioner=self.provisioner.name,
+                pods=pods,
+                nodes=nodes,
+                context=self.scheduler.last_decision_context(),
+                trace_id=round_sp.trace_id,
+                state=state,
+            )
+            if rec is not None:
+                self.last_decision_id = rec["id"]
+                round_sp.set_attribute("decision_id", rec["id"])
+                if rec["unschedulable_count"]:
+                    round_sp.set_attribute(
+                        "unschedulable", rec["unschedulable_count"]
+                    )
+            log.emit_unschedulable_events(
+                self.cluster, threshold=self.unschedulable_event_rounds
+            )
+        except Exception:
+            logger.debug("decision record failed", exc_info=True)
 
     def _observe_stages(self) -> None:
         """Plumb the solve's per-stage timings onto the scrape: the <100ms
@@ -471,6 +525,7 @@ class ProvisionerWorker:
             recorder_for(self.cluster).event(
                 "Provisioner", self.provisioner.name, "LaunchFailed",
                 "node launch failed; see controller logs", type="Warning",
+                decision_id=self.last_decision_id,
             )
             # fast retry: the pods are still provisionable — re-enter the
             # batcher for the NEXT round (paced by the batch idle window)
@@ -556,10 +611,14 @@ class ProvisioningController:
         canary_rate: Optional[float] = None,
         solver_stream: Optional[bool] = None,
         solver_shm_dir: Optional[str] = None,
+        unschedulable_event_rounds: int = 3,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.start_workers = start_workers  # False: tests drive provision_once inline
+        # decision observability: consecutive failed rounds before a pod's
+        # PodUnschedulable Warning event (docs/decisions.md)
+        self.unschedulable_event_rounds = unschedulable_event_rounds
         self.default_solver = default_solver
         self.solver_service_address = solver_service_address
         # pack-integrity knobs (docs/integrity.md), threaded to every
@@ -708,6 +767,7 @@ class ProvisioningController:
                 canary_rate=self.canary_rate,
                 solver_stream=self.solver_stream,
                 solver_shm_dir=self.solver_shm_dir,
+                unschedulable_event_rounds=self.unschedulable_event_rounds,
             )
             self.workers[provisioner.name] = worker
             self._hashes[provisioner.name] = h

@@ -330,9 +330,37 @@ class SelectionController:
                 if self.wait:
                     gate.wait(timeout=30)
                 return True
+        # the decision plane's admission feed (docs/decisions.md): an
+        # every-provisioner rejection is a decision too — classify the
+        # dimension (taint intolerance vs requirement mismatch), extend
+        # the pod's consecutive-failure streak, and close the loop with
+        # the PodUnschedulable Warning event once the streak crosses the
+        # threshold. Best-effort: audit trouble never changes routing.
+        self._note_admission_failure(pod, errs)
         raise NoProvisionerMatched(
             f"pod {pod.key} matched 0/{len(workers)} provisioners: {'; '.join(errs)}"
         )
+
+    def _note_admission_failure(self, pod: Pod, errs: List[str]) -> None:
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs import decisions as dec
+
+        if not dec.enabled():
+            return
+        try:
+            log = obs.decision_log()
+            log.note_admission_failure(pod, errs)
+            # per-pod emission: this feed runs once per rejected pod, so
+            # only THIS pod's streak is checked (a whole-table sweep here
+            # would be O(rejected x failing) event writes per pass)
+            log.maybe_emit_for(
+                self.cluster, pod.key,
+                threshold=getattr(
+                    self.provisioners, "unschedulable_event_rounds", 3
+                ),
+            )
+        except Exception:
+            logger.debug("admission-failure audit failed", exc_info=True)
 
     def _defer_to_foreign_owner(self, pod: Pod) -> bool:
         """True when the FIRST cluster-wide provisioner (sorted by name —

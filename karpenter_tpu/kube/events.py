@@ -31,6 +31,13 @@ AGGREGATION_WINDOW = 600.0  # repeats inside this window bump count
 # /debug/traces (and the flight dir) by trace id
 TRACE_ID_ANNOTATION = "karpenter.sh/trace-id"
 
+# annotation linking an emitted Event to the DECISION that caused it: the
+# id greps straight into /debug/decisions (and the --decision-dir ring,
+# where tools/replay_decision.py can re-solve it). karplint's
+# `event-decision-id` rule requires every Warning event on a
+# provisioning/consolidation decision path to carry it.
+DECISION_ID_ANNOTATION = "karpenter.sh/decision-id"
+
 
 class EventRecorder:
     def __init__(self, cluster: Cluster, component: str = "karpenter-tpu"):
@@ -73,6 +80,7 @@ class EventRecorder:
         message: str,
         type: str = "Normal",
         namespace: str = "",
+        decision_id: str = "",
     ) -> Optional[Event]:
         """Record an event; returns the stored object (or None on failure —
         recording is never allowed to break the calling controller)."""
@@ -115,6 +123,10 @@ class EventRecorder:
             span = obs.tracer().current()
             if span is not None:
                 meta.annotations[TRACE_ID_ANNOTATION] = span.trace_id
+            # the decision-id annotation (empty = the emitter predates any
+            # decision, e.g. a shed before the first round recorded)
+            if decision_id:
+                meta.annotations[DECISION_ID_ANNOTATION] = decision_id
             ev = Event(
                 metadata=meta,
                 involved_kind=involved_kind,

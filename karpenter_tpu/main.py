@@ -201,6 +201,15 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 # the fleet telemetry plane: member inventory, fleet SLO
                 # verdicts, stitched-trace index ({} until configured)
                 self._send(json.dumps(obs.debug_fleet_payload(query)).encode())
+            elif self.path.startswith("/debug/decisions"):
+                # the decision audit log: newest provisioning-round
+                # records (?limit=/?provisioner= narrow the window)
+                self._send(json.dumps(obs.debug_decisions_payload(query)).encode())
+            elif self.path.startswith("/debug/explain"):
+                # per-pod scheduling explainability: ?pod=<name> returns
+                # the newest decision's per-candidate elimination
+                # breakdown (or the chosen placement when it scheduled)
+                self._send(json.dumps(obs.debug_explain_payload(query)).encode())
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -297,6 +306,9 @@ def build_runtime(
         # (docs/solver-transport.md § Streaming)
         solver_stream=options.solver_stream,
         solver_shm_dir=options.solver_shm_dir,
+        # decision observability (docs/decisions.md): the consecutive-
+        # failure threshold behind PodUnschedulable Warning events
+        unschedulable_event_rounds=options.unschedulable_event_rounds,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,
@@ -439,6 +451,15 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     runtime.slo = obs.configure_slo(
         objectives=objectives, window_s=runtime.options.slo_window
     )
+    # the decision audit log (docs/decisions.md): /debug/decisions and
+    # /debug/explain answer from the memory ring either way; a configured
+    # --decision-dir additionally persists replayable records
+    # (tools/replay_decision.py) across restarts
+    from karpenter_tpu.obs import decisions as _decisions
+
+    _decisions.set_enabled(runtime.options.explain_enabled)
+    if runtime.options.decision_dir:
+        obs.configure_decisions(runtime.options.decision_dir)
     # always-on sampling profiler (docs/telemetry.md): stack folds at
     # /debug/profile, in-window top folds on every flight record
     if runtime.options.profile_hz > 0:

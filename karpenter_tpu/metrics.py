@@ -337,6 +337,55 @@ FLIGHT_PANEL_ERRORS = Counter(
     registry=REGISTRY,
 )
 
+# Decision observability plane (obs/decisions.py, docs/decisions.md):
+# every provisioning round is recorded into the decision audit ring with
+# per-pod elimination attribution for whatever the solve left unplaced.
+DECISIONS_RECORDED = Counter(
+    "decisions_recorded_total",
+    "Provisioning-round decision records appended to the decision audit "
+    "log (in-memory ring always; the on-disk replayable ring when "
+    "--decision-dir is set).",
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+DECISIONS_DROPPED = Counter(
+    "decisions_dropped_total",
+    "Decision records lost, by reason: \"evicted\" = the capped on-disk "
+    "ring pruned an old record, \"write_failed\" = a full/read-only "
+    "--decision-dir refused the write (the round itself never fails — "
+    "best-effort by contract), \"queue_full\" = the async writer's "
+    "bounded queue refused the enqueue, \"error\" = the record builder "
+    "broke.",
+    ["reason"],
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+PODS_UNSCHEDULABLE = Gauge(
+    "pods_unschedulable",
+    "Pods currently on an unbroken selection/placement failure streak, "
+    "by top elimination reason (solver/explain.py vocabulary: "
+    "resource_fit, requirement, zone_topology, daemon_overhead, "
+    "capacity_frontier, hostname, taint; \"unknown\" = the round could "
+    "not attribute, e.g. an FFD-degraded solve).",
+    ["reason"],
+    namespace=NAMESPACE,
+    registry=REGISTRY,
+)
+
+DECISION_EXPLAIN_DURATION = Histogram(
+    "decision_explain_duration_seconds",
+    "Time spent building one round's decision record: elimination "
+    "attribution (mask reductions off the hot path) plus the bounded "
+    "record assembly — the explain_overhead_pct bench bar (<1%) is "
+    "judged on this work.",
+    namespace=NAMESPACE,
+    buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0],
+    registry=REGISTRY,
+)
+
 # Fleet telemetry plane (obs/collector.py, docs/telemetry.md): flush /
 # stitch / profiler accounting. Every process — controller replicas and
 # sidecars — publishes these about its OWN half of the plane.

@@ -48,6 +48,7 @@ from karpenter_tpu.obs.collector import (  # noqa: F401
     stitch,
     wire_attribution,
 )
+from karpenter_tpu.obs.decisions import DecisionLog  # noqa: F401
 from karpenter_tpu.obs.profiler import SamplingProfiler  # noqa: F401
 from karpenter_tpu.obs.slo import (  # noqa: F401
     DEFAULT_OBJECTIVES,
@@ -235,6 +236,42 @@ def shutdown_profiler(prof: Optional[SamplingProfiler] = None) -> None:
     unregister_state("profile")
 
 
+# -- the decision audit log (obs/decisions.py) -------------------------------
+
+# memory-only default: /debug/decisions and /debug/explain answer from the
+# first round onward even when no --decision-dir is configured
+_decisions = DecisionLog()  # guarded-by: _lock (replacement only)
+
+
+def decision_log() -> DecisionLog:
+    with _lock:
+        return _decisions
+
+
+def configure_decisions(
+    directory: str = "",
+    cap: Optional[int] = None,
+    write_interval: Optional[float] = None,
+) -> DecisionLog:
+    """Install (or replace) the process decision log — an on-disk capped
+    ring under ``directory`` ('' keeps memory-only), the flight-recorder
+    discipline (best-effort async writes, evictions counted,
+    interval-thinned persistence)."""
+    global _decisions
+    kwargs = {}
+    if cap is not None:
+        kwargs["cap"] = cap
+    if write_interval is not None:
+        kwargs["write_interval"] = write_interval
+    log = DecisionLog(directory=directory, **kwargs)
+    with _lock:
+        old, _decisions = _decisions, log
+    # stop the replaced log's writer thread (it drains, then exits) — a
+    # reconfigure must not strand an immortal thread pinning the old ring
+    old.close()
+    return log
+
+
 # -- the fleet telemetry plane (obs/collector.py) ----------------------------
 
 _telemetry: Optional[TelemetryPlane] = None  # guarded-by: _lock
@@ -319,6 +356,39 @@ def debug_fleet_payload(query: str = "") -> dict:
     return {"fleet": plane.fleet_payload() if plane is not None else {}}
 
 
+def debug_decisions_payload(query: str = "") -> dict:
+    """``GET /debug/decisions``: the newest decision records (the audit
+    log behind every provisioning round). ``?limit=`` bounds the count
+    (default 20), ``?provisioner=`` filters to one provisioner."""
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    limit = 20
+    try:
+        limit = max(int(q["limit"][0]), 0)
+    except (KeyError, ValueError, IndexError):
+        pass
+    provisioner = (q.get("provisioner") or [None])[0] or None
+    return {
+        "decisions": decision_log().recent(limit=limit, provisioner=provisioner)
+    }
+
+
+def debug_explain_payload(query: str = "") -> dict:
+    """``GET /debug/explain?pod=<name>``: the newest decision's verdict
+    for that pod — the per-candidate elimination breakdown when it failed
+    placement, the chosen instance type when it placed, null when no
+    recorded decision mentions it."""
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    pod = (q.get("pod") or [None])[0] or ""
+    return {
+        "pod": pod,
+        "explain": decision_log().explain(pod) if pod else None,
+    }
+
+
 def debug_profile_payload(query: str = ""):
     """``GET /debug/profile`` → ``(content_type, body_bytes)``. Default is
     the top-N self-time JSON; ``?format=collapsed`` returns the raw
@@ -340,14 +410,19 @@ def debug_profile_payload(query: str = ""):
 
 def reset_for_tests() -> None:
     """Drop collected traces and detach any flight recorder / SLO engine /
-    profiler / telemetry plane."""
-    global _flight
+    profiler / telemetry plane / decision log."""
+    global _flight, _decisions
     with _lock:
         if _flight is not None:
             _tracer.remove_hook(_flight)
         _flight = None
+        old_decisions, _decisions = _decisions, DecisionLog()
+    old_decisions.close()
     shutdown_slo()
     shutdown_profiler()
     shutdown_telemetry()
+    from karpenter_tpu.obs import decisions as _dec
+
+    _dec.set_enabled(None)
     _tracer.exporter.clear()
     _tracer.enabled = True

@@ -425,6 +425,7 @@ class HttpTelemetryBackend:
                 continue  # unreachable: staleness accounting shows it
             slo = self._get_json(f"{url}/debug/slo") or {}
             profile = self._get_json(f"{url}/debug/profile") or {}
+            decisions = self._get_json(f"{url}/debug/decisions?limit=16") or {}
             out.append({
                 "version": PAYLOAD_VERSION,
                 "identity": name,
@@ -433,6 +434,7 @@ class HttpTelemetryBackend:
                 "traces": traces.get("traces") or [],
                 "slo": slo.get("histograms") or {},
                 "profile": profile.get("profile") or {},
+                "decisions": decisions.get("decisions") or [],
             })
         return out
 
@@ -444,7 +446,9 @@ class HttpTelemetryBackend:
 
 def member_payload(identity: str, role: str) -> Dict[str, Any]:
     """This process's flush body: newest ring trees, the SLO engine's
-    mergeable histogram snapshot, the profiler's fold summary."""
+    mergeable histogram snapshot, the profiler's fold summary, and the
+    decision audit log's bounded summaries (a dead replica's decisions
+    survive it in /debug/fleet through these)."""
     from karpenter_tpu import obs
 
     eng = obs.slo_engine()
@@ -460,6 +464,7 @@ def member_payload(identity: str, role: str) -> Dict[str, Any]:
         "traces": exp.snapshot(limit=FLUSH_TREE_LIMIT, newest_first=True),
         "slo": eng.histogram_snapshot() if eng is not None else {},
         "profile": prof.snapshot(top_n=10) if prof is not None else {},
+        "decisions": obs.decision_log().summaries(),
     }
 
 
@@ -610,6 +615,26 @@ class TelemetryCollector:
             }
         return merge_objective_snapshots(snaps)
 
+    def fleet_decisions(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Cross-member decision index, newest first: every member's
+        flushed decision summaries tagged with who recorded them. A dead
+        replica's rounds stay visible for as long as its last payload
+        does — exactly the flight-recorder property the per-process
+        /debug/decisions ring cannot give."""
+        with self._lock:
+            payloads = list(self._members.items())
+        out: List[Dict[str, Any]] = []
+        seen: set = set()
+        for identity, p in payloads:
+            for d in p.get("decisions") or []:
+                did = d.get("id")
+                if not did or did in seen:
+                    continue
+                seen.add(did)
+                out.append({**d, "member": identity})
+        out.sort(key=lambda d: -float(d.get("recorded_at") or 0.0))
+        return out[:limit]
+
     def fleet_payload(self) -> Dict[str, Any]:
         """The ``GET /debug/fleet`` body."""
         self._refresh_if_stale()
@@ -637,6 +662,7 @@ class TelemetryCollector:
         out: Dict[str, Any] = {
             "members": self.members(),
             "slo": self.fleet_slo(),
+            "decisions": self.fleet_decisions(),
             "traces": {
                 "roots": len(roots),
                 "stitched": sum(1 for e in index if e["stitched"]),

@@ -100,14 +100,18 @@ def unregister_state(name: str) -> None:
         _state_providers.pop(name, None)
 
 
-def state_snapshot() -> Dict[str, Any]:
+def state_snapshot(only=None) -> Dict[str, Any]:
     """Best-effort snapshot of every registered panel: a raising provider
     contributes its error string (and counts on
     ``karpenter_flight_panel_errors_total``) instead of aborting the
     record — the span tree a flight record exists for must never be lost
-    to one broken panel callback."""
+    to one broken panel callback. ``only`` restricts to a subset of panel
+    names (the decision audit log snapshots just the brownout panel, not
+    the full router/breaker/session spread a flight record wants)."""
     with _state_lock:
         providers = dict(_state_providers)
+    if only is not None:
+        providers = {k: v for k, v in providers.items() if k in only}
     out: Dict[str, Any] = {}
     for name, fn in providers.items():
         try:

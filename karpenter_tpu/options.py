@@ -149,6 +149,26 @@ class Options:
     telemetry_flush_interval: float = field(
         default_factory=lambda: float(_env("KARPENTER_TELEMETRY_FLUSH", "10"))
     )
+    # decision observability plane (obs/decisions.py, docs/decisions.md):
+    # - explain_enabled: per-round decision records + elimination
+    #   attribution (--no-explain turns the whole plane off; the bench
+    #   overhead gate measures the delta)
+    # - decision_dir: capped on-disk ring of REPLAYABLE decision records
+    #   (tools/replay_decision.py re-solves them offline); '' keeps the
+    #   memory-only ring backing /debug/decisions and /debug/explain
+    # - unschedulable_event_rounds: consecutive failed rounds before a pod
+    #   gets its PodUnschedulable Warning event
+    explain_enabled: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_EXPLAIN", default=True)
+    )
+    decision_dir: str = field(
+        default_factory=lambda: _env("KARPENTER_DECISION_DIR", "")
+    )
+    unschedulable_event_rounds: int = field(
+        default_factory=lambda: int(
+            _env("KARPENTER_UNSCHEDULABLE_EVENT_ROUNDS", "3")
+        )
+    )
     # SLO-driven brownout ladder (resilience/brownout.py): when an
     # objective burns, walk the ordered degradation ladder (pause probes/
     # consolidation -> shrink admission window -> bias native -> shed
@@ -189,6 +209,8 @@ class Options:
             errs.append("SLO window must be positive seconds")
         if self.brownout_interval <= 0:
             errs.append("brownout tick interval must be positive seconds")
+        if self.unschedulable_event_rounds < 1:
+            errs.append("unschedulable event rounds must be >= 1")
         if not 0.0 <= self.profile_hz <= 250.0:
             errs.append("profiler rate must be 0 (off) to 250 Hz")
         if self.telemetry_flush_interval <= 0:
@@ -346,6 +368,27 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         help="seconds between member telemetry flushes",
     )
     ap.add_argument(
+        "--explain",
+        action=argparse.BooleanOptionalAction,
+        default=opts.explain_enabled,
+        help="per-pod decision observability: round decision records + "
+        "elimination attribution (--no-explain disables the plane; "
+        "/debug/decisions and /debug/explain serve it — docs/decisions.md)",
+    )
+    ap.add_argument(
+        "--decision-dir", default=opts.decision_dir,
+        help="capped on-disk ring of replayable decision records "
+        "('' = memory-only; tools/replay_decision.py re-solves a "
+        "persisted record offline and diffs the assignment)",
+    )
+    ap.add_argument(
+        "--unschedulable-event-rounds", type=int,
+        default=opts.unschedulable_event_rounds,
+        help="consecutive failed selection/placement rounds before a pod "
+        "gets a PodUnschedulable Warning event carrying its top "
+        "elimination reason and the decision id",
+    )
+    ap.add_argument(
         "--brownout",
         action=argparse.BooleanOptionalAction,
         default=opts.brownout_enabled,
@@ -405,6 +448,9 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         telemetry_flush_interval=ns.telemetry_flush_interval,
         brownout_enabled=ns.brownout,
         brownout_interval=ns.brownout_interval,
+        explain_enabled=ns.explain,
+        decision_dir=ns.decision_dir,
+        unschedulable_event_rounds=ns.unschedulable_event_rounds,
     )
     errs = out.validate()
     if errs:

@@ -72,6 +72,15 @@ class Scheduler:
             return {}
         return self._tpu.completed_profile()
 
+    def last_decision_context(self) -> dict:
+        """The calling thread's most recent accelerated solve's decision
+        context (encoded batch + assignment + route provenance) for the
+        decision audit log — CONSUMED on read, {} for the FFD backend or
+        when the decision plane is disabled (docs/decisions.md)."""
+        if self._tpu is None:
+            return {}
+        return self._tpu.completed_decision()
+
     def solve(
         self,
         provisioner: Provisioner,

@@ -202,6 +202,14 @@ class TpuScheduler:
         # OWN solve's stages, not whichever solve completed last.
         self.last_completed_profile: Dict[str, float] = {}
         self._completed_tl = threading.local()
+        # the most recent COMPLETED solve's decision context (encoded
+        # batch + assignment + route provenance) — what the decision
+        # audit log (obs/decisions.py) attributes eliminations from.
+        # Thread-local like the profile (a worker sharing this scheduler
+        # must record ITS round, not a concurrent one's), and CONSUMED on
+        # read so a finished round's multi-MB EncodedBatch is not pinned
+        # until the next solve.
+        self._decision_tl = threading.local()
         # measured-cost backend routing (VERDICT r4 weak #3: `auto` used to
         # prefer the device by platform, never by cost)
         from karpenter_tpu.solver.router import default_router
@@ -916,6 +924,21 @@ class TpuScheduler:
         prof = getattr(self._completed_tl, "profile", None)
         return dict(prof if prof is not None else self.last_completed_profile)
 
+    def _publish_decision(self, ctx: Dict) -> None:
+        from karpenter_tpu.obs import decisions as _dec
+
+        if _dec.enabled():
+            self._decision_tl.ctx = ctx
+
+    def completed_decision(self) -> Dict:
+        """This THREAD's most recent solve's decision context — consumed
+        on read (one record per round; holding the batch longer would pin
+        it). {} when nothing completed since the last read or the
+        decision plane is disabled (docs/decisions.md)."""
+        ctx = getattr(self._decision_tl, "ctx", None)
+        self._decision_tl.ctx = None
+        return ctx or {}
+
     def _solve(
         self,
         constraints: Constraints,
@@ -1113,6 +1136,21 @@ class TpuScheduler:
         # path and compared — the layer that catches a plausible-shaped,
         # screen-clean pack computed from corrupt inputs
         self._maybe_canary(batch, result, prof)
+        # decision context for the audit log (obs/decisions.py): the
+        # encoded batch + served assignment + provenance. Attribution is a
+        # pure function of these, so the verdicts are identical whichever
+        # route (native/device/pool/streamed/coalesced) produced the
+        # bit-exact assignment. The assignment slice is copied — the
+        # result buffers must not stay pinned through the record's life.
+        self._publish_decision({
+            "batch": batch,
+            "assignment": np.asarray(result[0])[: batch.n_pods].copy(),
+            "n_max": int(np.asarray(result[1]).shape[0]),
+            "route": prof.get("packer_backend"),
+            "transport": prof.get("solver_transport"),
+            "address": prof.get("solver_address"),
+            "session_key": prof.get("session_key"),
+        })
         return nodes
 
     @staticmethod
@@ -1151,6 +1189,10 @@ class TpuScheduler:
         """The degradation ladder's floor: materialize the topology plan
         into the pods' selectors (restored afterwards — the TPU path's
         never-mutate contract) and serve the batch with the host FFD."""
+        # a degraded round still lands in the decision audit log with its
+        # route; tensor-level attribution needs the accelerated result
+        # (docs/decisions.md documents the asymmetry)
+        self._publish_decision({"route": "ffd-degraded"})
         saved = snapshot_selectors(pods)
         try:
             plan.materialize(list(pods))

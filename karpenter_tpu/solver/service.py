@@ -1557,6 +1557,14 @@ def _serve_health(service: SolverService, port: int):
                     ctype, body = obs.debug_profile_payload(query)
                 elif self.path.startswith("/debug/fleet"):
                     body = _json.dumps(obs.debug_fleet_payload(query)).encode()
+                elif self.path.startswith("/debug/decisions"):
+                    body = _json.dumps(
+                        obs.debug_decisions_payload(query)
+                    ).encode()
+                elif self.path.startswith("/debug/explain"):
+                    body = _json.dumps(
+                        obs.debug_explain_payload(query)
+                    ).encode()
                 else:
                     code, ctype, body = 404, "text/plain", b"not found"
             else:
@@ -2045,6 +2053,10 @@ class RemoteSolver:
                 prof.get("wire_ser_s", 0.0) + time.perf_counter() - t0
             )
             prof["solver_transport"] = transport
+            # decision-audit provenance (docs/decisions.md): which pinned
+            # catalog generation this solve rode — the replay tool and the
+            # decision record name the session the sidecar solved against
+            prof["session_key"] = key.hex()
 
         def redispatch(req: bytes) -> bytes:
             """The synchronous NEEDS_CATALOG retry dispatch: over the

@@ -1,0 +1,19 @@
+# karplint-fixture: expect=event-decision-id
+"""A provisioning decision path emitting a Warning event WITHOUT the
+decision-id keyword: the operator's `kubectl describe` shows "launch
+failed" with no path back into /debug/decisions — the audit dead end the
+event-decision-id rule exists to close."""
+
+
+class Worker:
+    def __init__(self, cluster, recorder):
+        self.cluster = cluster
+        self.recorder = recorder
+        self.last_decision_id = "d-abc"
+
+    def launch_failed(self, name):
+        # Warning on the decision path, no decision_id= — must fire
+        self.recorder.event(
+            "Provisioner", name, "LaunchFailed",
+            "node launch failed; see controller logs", type="Warning",
+        )

@@ -1,0 +1,25 @@
+# karplint-fixture: clean=event-decision-id
+"""Near-misses that must stay clean: a decision-path Warning that DOES
+carry decision_id (empty before the first record is honest and allowed),
+and a Normal event which needs no id."""
+
+
+class Worker:
+    def __init__(self, cluster, recorder):
+        self.cluster = cluster
+        self.recorder = recorder
+        self.last_decision_id = ""
+
+    def launch_failed(self, name):
+        # the sanctioned shape: the decision id rides the event annotation
+        self.recorder.event(
+            "Provisioner", name, "LaunchFailed",
+            "node launch failed; see controller logs", type="Warning",
+            decision_id=self.last_decision_id,
+        )
+
+    def launched(self, name):
+        # Normal events carry no decision obligation
+        self.recorder.event(
+            "Node", name, "Launched", "launched a node",
+        )

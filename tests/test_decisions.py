@@ -1,0 +1,582 @@
+"""The decision audit log (docs/decisions.md): per-round records, the
+capped replayable ring, the unschedulable event loop, debug endpoints on
+both health servers, fleet indexing, and the offline replay tool."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import metrics, obs
+from karpenter_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+)
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.kube.events import DECISION_ID_ANNOTATION
+from karpenter_tpu.obs import decisions as dec
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.solver import explain as expl
+from tests.factories import make_pod, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset_for_tests()
+    dec.set_enabled(True)
+    yield
+    obs.reset_for_tests()
+
+
+def solved_context(pods, catalog=None, n_types=10):
+    """One accelerated solve through the production facade, returning
+    (nodes, consumed decision context)."""
+    catalog = catalog or instance_types(n_types)
+    prov = make_provisioner(solver="tpu")
+    c = prov.spec.constraints
+    c.requirements = c.requirements.merge(catalog_requirements(catalog))
+    sched = Scheduler(Cluster(), rng=random.Random(1))
+    nodes = sched.solve(prov, catalog, pods)
+    return nodes, sched.last_decision_context()
+
+
+def stuck_pods(n_ok=3, n_stuck=1):
+    pods = [make_pod(requests={"cpu": "0.5"}) for _ in range(n_ok)]
+    pods += [
+        make_pod(name=f"stuck-{i}", requests={"cpu": "100000"})
+        for i in range(n_stuck)
+    ]
+    return pods
+
+
+def _counter(metric, **labels):
+    child = metric.labels(**labels) if labels else metric
+    return child._value.get()
+
+
+class TestDecisionRecord:
+    def test_round_recorded_with_attribution_and_provenance(self):
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        rec = log.record_round(
+            "default", pods, nodes, context=ctx, trace_id="t-1",
+            state={"fenced": False},
+        )
+        assert rec["pods_considered"] == 4
+        assert rec["unschedulable_count"] == 1
+        assert rec["route"] in ("native", "device")
+        assert rec["trace_id"] == "t-1"
+        v = rec["unschedulable"][0]
+        assert v["pod"].endswith("stuck-0")
+        assert v["top_reason"] == expl.REASON_RESOURCE
+        assert v["reasons"][expl.REASON_RESOURCE] == 10
+        # lazy listings materialize on read
+        out = log.recent(limit=1)[0]
+        assert out["packing"], "chosen packing must be listed"
+        assert out["packing"][0]["instance_type"]
+        assert out["pod_keys"]
+
+    def test_explain_lookup_unplaced_and_placed(self):
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        log.record_round("default", pods, nodes, context=ctx)
+        bad = log.explain("stuck-0")
+        assert bad["placed"] is False
+        assert bad["top_reason"] == expl.REASON_RESOURCE
+        assert bad["candidates"]
+        good = log.explain(pods[0].metadata.name)
+        assert good["placed"] is True
+        assert good["instance_type"]
+        assert log.explain("no-such-pod") is None
+
+    def test_disabled_plane_records_nothing(self):
+        dec.set_enabled(False)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        assert log.record_round("default", pods, nodes, context=ctx) is None
+        assert log.recent() == []
+
+    def test_ffd_context_falls_back_to_key_difference(self):
+        pods = stuck_pods()
+        nodes, _ = solved_context(pods)
+        log = dec.DecisionLog()
+        rec = log.record_round("default", pods, nodes, context={})
+        assert rec["unschedulable_count"] == 1
+        assert rec["unschedulable"] == []  # no tensors, no attribution
+
+    def test_streak_reuse_and_refresh(self):
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        r1 = log.record_round("default", pods, nodes, context=ctx)
+        v1 = r1["unschedulable"][0]
+        # mid-streak rounds reuse the cached verdict object
+        r2 = log.record_round("default", pods, nodes, context=ctx)
+        assert r2["unschedulable"][0] is v1
+        assert log.failure_streak(v1["pod"]) == 2
+
+    def test_placement_resets_streak(self):
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        log.record_round("default", pods, nodes, context=ctx)
+        assert log.failure_streak(ctx["batch"].pods[-1].key) >= 0
+        stuck_key = next(
+            p.key for p in pods if p.metadata.name == "stuck-0"
+        )
+        assert log.failure_streak(stuck_key) == 1
+        # a later round where the pod PLACES resets the streak
+        ok_pods = [p for p in pods if p.metadata.name != "stuck-0"]
+        ok_pods.append(make_pod(name="stuck-0", requests={"cpu": "0.5"}))
+        nodes2, ctx2 = solved_context(ok_pods)
+        log.record_round("default", ok_pods, nodes2, context=ctx2)
+        assert log.failure_streak(stuck_key) == 0
+
+
+class TestDecisionRing:
+    def test_ring_cap_evicts_and_counts(self, tmp_path):
+        before = _counter(metrics.DECISIONS_DROPPED, reason="evicted")
+        log = dec.DecisionLog(
+            directory=str(tmp_path), cap=3, write_interval=0.0
+        )
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        for _ in range(6):
+            log.record_round("default", pods, nodes, context=ctx)
+            assert log.flush(10.0)
+        names = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        assert len(names) == 3
+        assert _counter(metrics.DECISIONS_DROPPED, reason="evicted") >= before + 3
+        # replay sidecars are pruned with their records
+        stems = {n[:-len(".json")] for n in names}
+        for n in os.listdir(tmp_path):
+            if n.endswith(".npz"):
+                assert n[:-len(".npz")] in stems
+
+    def test_full_disk_never_fails_the_round(self, tmp_path, monkeypatch):
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+
+        def enospc(*a, **k):
+            raise OSError(28, "No space left on device")
+
+        # every disk touch fails (chmod tricks don't bind when the test
+        # runs as root); the reconcile-side contract must hold anyway
+        monkeypatch.setattr(dec.np, "savez", enospc)
+        before = _counter(metrics.DECISIONS_DROPPED, reason="write_failed")
+        rec = log.record_round("default", pods, nodes, context=ctx)
+        assert rec is not None  # the round's record still exists
+        assert log.flush(10.0)
+        assert (
+            _counter(metrics.DECISIONS_DROPPED, reason="write_failed")
+            == before + 1
+        )
+        assert log.recent(limit=1)  # memory ring intact
+
+    def test_write_interval_thins_disk_not_memory(self, tmp_path):
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=3600.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        for _ in range(5):
+            log.record_round("default", pods, nodes, context=ctx)
+        assert log.flush(10.0)
+        files = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        assert len(files) == 1  # one write per interval
+        assert len(log.recent(limit=10)) == 5  # memory keeps every round
+
+    def test_recorded_counter_and_explain_histogram(self):
+        before = _counter(metrics.DECISIONS_RECORDED)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        log.record_round("default", pods, nodes, context=ctx)
+        assert _counter(metrics.DECISIONS_RECORDED) == before + 1
+
+    def test_unschedulable_gauge_by_reason(self):
+        pods = stuck_pods(n_stuck=2)
+        nodes, ctx = solved_context(pods)
+        log = dec.DecisionLog()
+        log.record_round("default", pods, nodes, context=ctx)
+        assert (
+            metrics.PODS_UNSCHEDULABLE.labels(
+                reason=expl.REASON_RESOURCE
+            )._value.get() == 2
+        )
+
+
+class TestReplay:
+    def test_replay_reproduces_persisted_assignment_bit_exact(self, tmp_path):
+        from karpenter_tpu.solver.native import native_available
+        from tools import replay_decision as rd
+
+        if not native_available(wait=240.0):
+            pytest.skip("native packer unavailable")
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        rec = log.record_round("default", pods, nodes, context=ctx)
+        assert log.flush(10.0)
+        path = rd.find_record(str(tmp_path))
+        assert path is not None
+        verdict = rd.replay(rd.load_record(path), record_path=path)
+        assert verdict["ok"] is True
+        assert verdict["decision_id"] == rec["id"]
+        assert verdict["replay_unschedulable"] == 1
+        # the CLI entry agrees
+        assert rd.main(["--decision-dir", str(tmp_path)]) == 0
+
+    def test_replay_detects_a_divergent_assignment(self, tmp_path):
+        from karpenter_tpu.solver.native import native_available
+        from tools import replay_decision as rd
+
+        if not native_available(wait=240.0):
+            pytest.skip("native packer unavailable")
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        # corrupt the served assignment: replay must catch the lie
+        ctx["assignment"] = ctx["assignment"].copy()
+        ctx["assignment"][0] = 7
+        log.record_round("default", pods, nodes, context=ctx)
+        assert log.flush(10.0)
+        path = rd.find_record(str(tmp_path))
+        verdict = rd.replay(rd.load_record(path), record_path=path)
+        assert verdict["ok"] is False
+        assert "differs" in verdict["diff"]
+        assert rd.main(["--decision-dir", str(tmp_path)]) == 1
+
+    def test_memory_only_record_is_not_replayable(self):
+        from tools import replay_decision as rd
+
+        with pytest.raises(ValueError):
+            rd.replay({"id": "d-x"}, record_path="")
+
+
+class TestKubernetesLoop:
+    def _provision_rounds(self, rounds, threshold=3):
+        cluster = Cluster()
+        catalog = instance_types(10)
+        provider = FakeCloudProvider(catalog)
+        controller = ProvisioningController(
+            cluster, provider, start_workers=False,
+            unschedulable_event_rounds=threshold,
+        )
+        prov = make_provisioner(solver="tpu")
+        cluster.create("provisioners", prov)
+        controller.apply(prov)
+        worker = controller.workers[prov.name]
+        worker.batcher.idle_duration = 0.01
+        pods = stuck_pods()
+        for p in pods:
+            cluster.create("pods", p)
+        for _ in range(rounds):
+            for p in pods:
+                worker.batcher.add(p)
+            worker.provision_once()
+        controller.stop()
+        return cluster, worker
+
+    def test_pod_unschedulable_event_after_n_rounds(self):
+        cluster, worker = self._provision_rounds(3, threshold=3)
+        events = [
+            e for e in cluster.list("events", None)
+            if e.reason == "PodUnschedulable"
+        ]
+        assert events, "threshold crossed: the Warning event must exist"
+        ev = events[0]
+        assert ev.type == "Warning"
+        assert ev.involved_name == "stuck-0"
+        assert expl.REASON_RESOURCE in ev.message
+        # the decision id rides the annotation (karplint event-decision-id)
+        assert ev.metadata.annotations[DECISION_ID_ANNOTATION].startswith("d-")
+        assert worker.last_decision_id.startswith("d-")
+
+    def test_repeated_rounds_aggregate_into_one_event(self):
+        """The event message is streak-count-free by design: rounds past
+        the threshold BUMP the existing Event (EventRecorder aggregates
+        on the message) instead of minting a fresh apiserver object per
+        round — one stuck pod must not become an event storm."""
+        cluster, _ = self._provision_rounds(6, threshold=3)
+        events = [
+            e for e in cluster.list("events", None)
+            if e.reason == "PodUnschedulable"
+        ]
+        assert len(events) == 1
+        assert events[0].count >= 3  # rounds 3..6 bumped, never re-created
+        assert "3+" in events[0].message
+
+    def test_deleted_pod_stops_eventing_and_drops_from_tracker(self):
+        """A pod deleted while stuck never re-enters a batch to reset its
+        streak — the emit path's existence check must drop the ghost
+        instead of eventing a nonexistent object every round forever."""
+        cluster = Cluster()
+        log = obs.decision_log()
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        stuck_key = next(p.key for p in pods if p.metadata.name == "stuck-0")
+        for _ in range(3):
+            log.record_round("default", pods, nodes, context=ctx)
+        assert log.failure_streak(stuck_key) == 3
+        # the pod does NOT exist in this cluster (deleted while stuck)
+        emitted = log.emit_unschedulable_events(cluster, threshold=3)
+        assert emitted == 0
+        assert log.failure_streak(stuck_key) == 0
+        assert not [
+            e for e in cluster.list("events", None)
+            if e.reason == "PodUnschedulable"
+        ]
+
+    def test_no_event_below_threshold(self):
+        cluster, _ = self._provision_rounds(2, threshold=3)
+        assert not [
+            e for e in cluster.list("events", None) if e.reason == "PodUnschedulable"
+        ]
+
+    def test_round_span_carries_decision_id(self):
+        self._provision_rounds(1)
+        trees = obs.exporter().trees()
+        rounds = [t for t in trees if t.get("name") == "provision.round"]
+        assert rounds
+        assert rounds[-1]["attrs"]["decision_id"].startswith("d-")
+
+    def test_admission_failure_classified_as_taint(self):
+        from karpenter_tpu.api.objects import Taint
+
+        log = obs.decision_log()
+        pod = make_pod(requests={"cpu": "1"})
+        prov = make_provisioner(taints=[Taint(key="dedicated", value="x")])
+        errs = prov.spec.constraints.validate_pod(pod)
+        assert errs
+        verdict = log.note_admission_failure(pod, errs)
+        assert verdict["top_reason"] == expl.REASON_TAINT
+        assert log.failure_streak(pod.key) == 1
+
+    def test_selection_feed_emits_event_at_threshold(self):
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.controllers.selection import (
+            NoProvisionerMatched,
+            SelectionController,
+        )
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(5))
+        controller = ProvisioningController(
+            cluster, provider, start_workers=False,
+            unschedulable_event_rounds=2,
+        )
+        prov = make_provisioner(taints=[Taint(key="dedicated", value="x")])
+        cluster.create("provisioners", prov)
+        controller.apply(prov)
+        selection = SelectionController(cluster, controller, wait=False)
+        pod = make_pod(requests={"cpu": "1"})
+        cluster.create("pods", pod)
+        for _ in range(2):
+            with pytest.raises(NoProvisionerMatched):
+                selection.select_provisioner(pod)
+        controller.stop()
+        events = [
+            e for e in cluster.list("events", None) if e.reason == "PodUnschedulable"
+        ]
+        assert events
+        assert "tolerate" in events[0].message
+        assert expl.REASON_TAINT in events[0].message
+
+
+class TestDebugSurface:
+    def test_payload_builders(self):
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        obs.decision_log().record_round(
+            "default", pods, nodes, context=ctx, trace_id="t-9"
+        )
+        body = obs.debug_decisions_payload("limit=5")
+        assert len(body["decisions"]) == 1
+        assert body["decisions"][0]["trace_id"] == "t-9"
+        assert obs.debug_decisions_payload("provisioner=nope")["decisions"] == []
+        ex = obs.debug_explain_payload("pod=stuck-0")
+        assert ex["explain"]["top_reason"] == expl.REASON_RESOURCE
+        assert ex["explain"]["consecutive_failures"] == 1
+        assert obs.debug_explain_payload("")["explain"] is None
+        # both payloads must be JSON-serializable end to end
+        json.dumps(body)
+        json.dumps(ex)
+
+    def test_sidecar_health_server_serves_decisions_and_explain(self):
+        from karpenter_tpu.solver.service import SolverService, _serve_health
+
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        obs.decision_log().record_round("default", pods, nodes, context=ctx)
+        service = SolverService()
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd = _serve_health(service, port)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/decisions?limit=2", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["decisions"][0]["provisioner"] == "default"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/explain?pod=stuck-0", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["explain"]["top_reason"] == expl.REASON_RESOURCE
+        finally:
+            httpd.shutdown()
+
+    def test_controller_health_server_parity(self):
+        """The controller server routes through the same obs.debug_*
+        helpers (karplint enforces it); serve one real runtime's health
+        endpoint and read both bodies."""
+        from karpenter_tpu.main import build_runtime, _serve_endpoints
+        from karpenter_tpu.options import Options
+        import socket
+
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        obs.decision_log().record_round("default", pods, nodes, context=ctx)
+        for s in (socket.socket(), socket.socket()):
+            s.close()
+        with socket.socket() as s1, socket.socket() as s2:
+            s1.bind(("127.0.0.1", 0))
+            s2.bind(("127.0.0.1", 0))
+            mport, hport = s1.getsockname()[1], s2.getsockname()[1]
+        options = Options(metrics_port=mport, health_probe_port=hport)
+        runtime = build_runtime(options, start_workers=False)
+        try:
+            _serve_endpoints(runtime)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hport}/debug/decisions", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["decisions"][0]["provisioner"] == "default"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hport}/debug/explain?pod=stuck-0",
+                timeout=5,
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["explain"]["pod"].endswith("stuck-0")
+        finally:
+            runtime.stop()
+
+
+class TestFleetIndexing:
+    def test_member_payload_ships_decision_summaries(self):
+        from karpenter_tpu.obs.collector import member_payload
+
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        obs.decision_log().record_round(
+            "default", pods, nodes, context=ctx, trace_id="t-f"
+        )
+        payload = member_payload("replica-a", "controller")
+        assert payload["decisions"]
+        d = payload["decisions"][0]
+        assert d["unschedulable_count"] == 1
+        assert d["top_reasons"] == [expl.REASON_RESOURCE]
+        json.dumps(payload["decisions"])
+
+    def test_dead_members_decisions_survive_in_fleet_payload(self, tmp_path):
+        """A member flushes its decisions to the file backend and DIES;
+        the collector still indexes its rounds in /debug/fleet."""
+        from karpenter_tpu.obs.collector import (
+            FileTelemetryBackend,
+            TelemetryCollector,
+        )
+
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        obs.decision_log().record_round("default", pods, nodes, context=ctx)
+        from karpenter_tpu.obs.collector import member_payload
+
+        backend = FileTelemetryBackend(str(tmp_path), identity="dead-replica")
+        backend.publish(member_payload("dead-replica", "controller"))
+        # the dead replica's process state is gone; only the file remains
+        obs.reset_for_tests()
+        dec.set_enabled(True)
+        collector = TelemetryCollector(
+            [FileTelemetryBackend(str(tmp_path), identity="survivor")]
+        )
+        collector.refresh()
+        fleet = collector.fleet_payload()
+        assert fleet["decisions"]
+        assert fleet["decisions"][0]["member"] == "dead-replica"
+        assert fleet["decisions"][0]["unschedulable_count"] == 1
+
+
+class TestWriterLifecycle:
+    def test_replaced_log_writer_thread_exits(self, tmp_path):
+        """configure_decisions replaces the log; the old writer must
+        drain and EXIT instead of surviving as an immortal once-a-second
+        thread pinning the old memory ring."""
+        log = obs.configure_decisions(str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        log.record_round("default", pods, nodes, context=ctx)
+        assert log.flush(10.0)
+        writer = log._writer
+        assert writer is not None and writer.is_alive()
+        obs.configure_decisions("")  # replaces + closes the old log
+        writer.join(timeout=5.0)
+        assert not writer.is_alive()
+
+    def test_reader_gets_a_stable_copy_not_the_live_dict(self, tmp_path, monkeypatch):
+        """recent() returns copies taken under the lock: the async
+        writer later inserts `path` into the live record, and a reader
+        json-serializing the live dict at that moment would crash."""
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        # gate the writer so the snapshot deterministically precedes the
+        # disk write (on a warm machine the write can win the race)
+        import threading
+
+        release = threading.Event()
+        real_write = log._write_now
+
+        def gated(*a, **k):
+            release.wait(timeout=10.0)
+            return real_write(*a, **k)
+
+        monkeypatch.setattr(log, "_write_now", gated)
+        rec = log.record_round("default", pods, nodes, context=ctx)
+        snapshot = log.recent(limit=1)[0]
+        assert snapshot is not rec
+        release.set()
+        assert log.flush(10.0)
+        assert "path" in rec  # the live record gained the key...
+        assert "path" not in snapshot  # ...the reader's copy did not move
+
+
+class TestQueueContainment:
+    def test_full_write_queue_drops_and_counts(self, tmp_path, monkeypatch):
+        log = dec.DecisionLog(directory=str(tmp_path), write_interval=0.0)
+        pods = stuck_pods()
+        nodes, ctx = solved_context(pods)
+        # wedge the writer so the queue can only fill
+        monkeypatch.setattr(
+            log, "_write_now", lambda *a, **k: time.sleep(0.2)
+        )
+        before = _counter(metrics.DECISIONS_DROPPED, reason="queue_full")
+        for _ in range(dec.MAX_WRITE_QUEUE + 4):
+            log.record_round("default", pods, nodes, context=ctx)
+        assert (
+            _counter(metrics.DECISIONS_DROPPED, reason="queue_full") > before
+        )
+        # every round's record still landed in memory
+        assert len(log.recent(limit=50)) == dec.MAX_WRITE_QUEUE + 4

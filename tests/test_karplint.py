@@ -47,12 +47,13 @@ def test_full_repo_analyze_under_10s():
     assert time.perf_counter() - t0 < 10.0
 
 
-def test_all_twelve_rules_registered():
+def test_all_thirteen_rules_registered():
     from tools.karplint import rule_names
 
     assert rule_names() == [
         "bounded-wait",
         "debug-endpoint",
+        "event-decision-id",
         "kube-transport",
         "lock-guard",
         "metric-name",

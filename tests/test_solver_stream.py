@@ -557,6 +557,115 @@ class TestShmFastPath:
             h.stop()
 
 
+class TestDecisionParity:
+    def test_coalesced_entries_yield_identical_decision_records(
+        self, monkeypatch
+    ):
+        """Decision-observability parity (docs/decisions.md): a coalesced
+        multi-solve dispatch must yield per-entry decision records — and
+        per-pod elimination attribution — BIT-IDENTICAL to solo solves.
+        Attribution is a pure function of (encoded batch, assignment), so
+        this holds exactly as long as the coalesced assignment stays
+        bit-exact; the test pins both links of that chain."""
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+        from karpenter_tpu.kube.client import Cluster
+        from karpenter_tpu.obs import decisions as dec
+        from karpenter_tpu.scheduling.ffd import daemon_overhead, sort_pods_ffd
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import encode as enc
+        from karpenter_tpu.solver import explain as expl
+        from karpenter_tpu.solver import kernel
+        from karpenter_tpu.testing import diverse_pods, make_provisioner
+        from karpenter_tpu.testing.factories import make_pod
+
+        monkeypatch.setenv("KARPENTER_PACKER", "scan")
+        dec.set_enabled(True)
+        catalog = sorted(
+            instance_types(8), key=lambda it: it.effective_price()
+        )
+        constraints = make_provisioner(solver="tpu").spec.constraints
+        constraints.requirements = constraints.requirements.merge(
+            catalog_requirements(catalog)
+        )
+        pods = diverse_pods(5, random.Random(3))
+        pods.append(make_pod(name="stuck-x", requests={"cpu": "100000"}))
+        pods = sort_pods_ffd(pods)
+        cluster = Cluster()
+        Topology(cluster, rng=random.Random(1)).inject(constraints, pods)
+        batch = enc.encode(
+            constraints, catalog, pods, daemon_overhead(cluster, constraints)
+        )
+        args = [np.asarray(a) for a in batch.pack_args()]
+        p = len(batch.pod_valid)
+        r = batch.pod_req.shape[1]
+
+        service = SolverService()
+        key = catalog_session_key(*args[N_POD_ARRAYS:])
+        resp = service.open_session_bytes(
+            pack_arrays([_key_array(key)] + list(args[N_POD_ARRAYS:]))
+        )
+        assert int(unpack_arrays(resp)[0].reshape(-1)[0]) == STATUS_OK
+        solo_frame = service.solve_bytes(
+            pack_arrays(
+                [_key_array(key), np.asarray([16, 1], np.int32)]
+                + list(args[:N_POD_ARRAYS])
+            )
+        )
+        solo_buf = unpack_arrays(solo_frame)[1]
+        solo = kernel.split_result(np.asarray(solo_buf), p, 16, r)
+        solo_assignment = np.asarray(solo.assignment)[: batch.n_pods].copy()
+        assert (solo_assignment < 0).any(), "scenario needs a stuck pod"
+
+        responses = []
+        entries = [
+            service.stream_parse_solve(
+                pack_arrays(
+                    [_key_array(key), np.asarray([16, 1], np.int32)]
+                    + list(args[:N_POD_ARRAYS])
+                ),
+                respond=responses.append,
+            )
+            for _ in range(3)
+        ]
+        service.solve_stream_group(entries)
+        assert len(responses) == 3
+
+        def record_of(assignment):
+            # a fixed clock and no packing nodes: everything left in the
+            # record is a pure function of (batch, assignment)
+            log = dec.DecisionLog(clock=lambda: 0.0)
+            rec = log.record_round(
+                "parity", batch.pods[: batch.n_pods], [],
+                context={
+                    "batch": batch,
+                    "assignment": assignment,
+                    "n_max": 16,
+                    "route": "device",
+                },
+                trace_id="t",
+            )
+            return {
+                k: rec[k]
+                for k in (
+                    "pods_considered", "unschedulable_count",
+                    "unschedulable", "route",
+                )
+            }
+
+        solo_record = record_of(solo_assignment)
+        solo_verdicts = expl.explain_batch(batch, solo_assignment)
+        assert solo_verdicts, "attribution must cover the stuck pod"
+        for resp_frame in responses:
+            arrays = unpack_arrays(resp_frame)
+            assert int(arrays[0].reshape(-1)[0]) == STATUS_OK
+            coal = kernel.split_result(np.asarray(arrays[1]), p, 16, r)
+            coal_assignment = np.asarray(coal.assignment)[: batch.n_pods].copy()
+            np.testing.assert_array_equal(solo_assignment, coal_assignment)
+            assert record_of(coal_assignment) == solo_record
+            assert expl.explain_batch(batch, coal_assignment) == solo_verdicts
+
+
 class TestCoalescing:
     def test_coalesced_group_dispatch_bit_exact(self, args16, monkeypatch):
         """Deterministic unit-level proof: a multi-entry group through

@@ -63,6 +63,11 @@ HEADLINE_KEYS: Dict[str, int] = {
     "streamed_pods_per_sec": +1,
     "streamed_rtt_floor_ms": -1,
     "stream_coalesced_dispatch_rate": +1,
+    # decision observability plane (docs/decisions.md): the self-accounted
+    # hot-path cost of per-round decision records + elimination
+    # attribution on the headline leg (bar: < 1). Missing on pre-decision
+    # rounds is reported, never fatal (the standard new-key salvage).
+    "explain_overhead_pct": -1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"

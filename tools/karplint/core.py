@@ -170,6 +170,7 @@ def _load_rules() -> None:
     # import for side effect: each module registers its rules
     from tools.karplint.rules import (  # noqa: F401
         debug_endpoints,
+        events,
         kube,
         locks,
         metric_names,

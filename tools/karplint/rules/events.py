@@ -1,0 +1,90 @@
+"""Event auditability: decision-path Warning events carry a decision id.
+
+The decision observability plane (docs/decisions.md) made every
+provisioning round a recorded, replayable ``DecisionRecord`` — and the
+``karpenter.sh/decision-id`` Event annotation is how an operator walks
+from a ``kubectl describe`` Warning straight into ``/debug/decisions``
+(and the ``--decision-dir`` ring ``tools/replay_decision.py`` re-solves).
+A Warning emitted from a provisioning/consolidation decision path WITHOUT
+the id is an audit dead end: the operator sees "pod shed" / "launch
+failed" with no way back to the decision that caused it.
+
+Detection: in any file on a decision path (path contains ``provision`` or
+``consolidation``), every ``.event(...)`` call that passes
+``type="Warning"`` must also pass a ``decision_id=`` keyword (the
+recorder annotates it; an empty value is allowed — it means "before the
+first record", which is honest). Normal events and non-decision-path
+files stay clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    register,
+)
+
+
+def _on_decision_path(path: str) -> bool:
+    base = path.rsplit("/", 1)[-1]
+    return ("provision" in base or "consolidation" in base) and not (
+        "/obs/" in path or path.startswith("obs/")
+    )
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+@register
+class EventDecisionIdRule(Rule):
+    name = "event-decision-id"
+    severity = P1
+    doc = (
+        "a Warning event emitted from a provisioning/consolidation "
+        "decision path does not carry the decision-id annotation "
+        "(decision_id= keyword) — the operator's path from `kubectl "
+        "describe` into /debug/decisions and the replayable ring is "
+        "severed (docs/decisions.md)."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            if not _on_decision_path(src.path):
+                continue
+            # cheap text prefilter: no Warning literal, no finding
+            if "Warning" not in src.text:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (isinstance(func, ast.Attribute) and func.attr == "event"):
+                    continue
+                type_kw = _kw(node, "type")
+                if type_kw is None or not (
+                    isinstance(type_kw.value, ast.Constant)
+                    and type_kw.value.value == "Warning"
+                ):
+                    continue
+                if _kw(node, "decision_id") is None:
+                    findings.append(self.finding(
+                        src.path, node.lineno,
+                        "Warning event on a decision path without a "
+                        "decision_id= keyword; pass the current round's "
+                        "decision id (empty string before the first "
+                        "record) so the event annotates "
+                        "karpenter.sh/decision-id",
+                    ))
+        return findings
