@@ -97,6 +97,11 @@ partition-chaos:  ## control-plane partition proof: transport/fencing suites + t
 	$(PY) -m pytest tests/test_partition.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --partition-storm 240
 
+FORECAST_STORM_S ?= 30
+forecast-chaos:  ## predictive-provisioning proof: forecast/warm-pool/what-if suites + the diurnal+flash storm leg, cold vs warm
+	$(PY) -m pytest tests/test_forecast.py tests/test_warmpool.py tests/test_whatif.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --forecast-storm $(FORECAST_STORM_S)
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \

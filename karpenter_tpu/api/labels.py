@@ -29,6 +29,11 @@ DO_NOT_EVICT_ANNOTATION = LABEL_DOMAIN + "/do-not-evict"
 # and the Node object at create — the idempotency key that pairs them for
 # crash recovery (launch/journal.py) and the GC/adoption cross-check
 LAUNCH_TOKEN_ANNOTATION = LABEL_DOMAIN + "/launch-token"
+# present (value "true") on a node the warm-pool controller launched
+# speculatively and no demand has claimed yet; removed at claim time by
+# the worker's warm-hit steal — its absence is how the GC ladder tells a
+# claimed warm node from stale speculation (controllers/warmpool.py)
+WARM_POOL_ANNOTATION = LABEL_DOMAIN + "/warm-pool"
 EMPTINESS_TIMESTAMP_ANNOTATION = LABEL_DOMAIN + "/emptiness-timestamp"
 TERMINATION_FINALIZER = LABEL_DOMAIN + "/termination"
 

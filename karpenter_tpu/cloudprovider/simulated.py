@@ -207,6 +207,11 @@ class SimCloudAPI:
         # double itself (not only the HTTP wire's replay cache), so every
         # caller gets idempotent creates.
         self._fleet_tokens: Dict[str, str] = {}  # guarded-by: self._mu
+        # simulated provisioning latency: create_fleet sleeps this long
+        # OUTSIDE the mutex (parallel launches overlap, like the real
+        # control plane) — what makes a cold launch measurably slower than
+        # a warm-pool claim in the bench storm legs
+        self.launch_latency_s: float = 0.0
 
     # -- error injection ----------------------------------------------------
     def inject_error(self, method: str, error: Exception) -> None:
@@ -257,6 +262,8 @@ class SimCloudAPI:
         the recorded instance: same token, same instance, never a second
         launch."""
         self._enter("create_fleet")
+        if self.launch_latency_s > 0:
+            time.sleep(self.launch_latency_s)
         errors: List[Tuple[str, str, str]] = []
         with self._mu:
             if client_token:

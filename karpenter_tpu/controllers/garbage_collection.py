@@ -68,6 +68,7 @@ class GarbageCollectionController:
         gc_interval: float = GC_INTERVAL,
         grace_period: float = LEAK_GRACE_PERIOD,
         replay_after: Optional[float] = None,
+        warm_pool_ttl: float = recovery.DEFAULT_WARM_POOL_TTL,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -76,6 +77,9 @@ class GarbageCollectionController:
         self.ownership = ownership  # fleet.ShardManager, or None = own all
         self.gc_interval = gc_interval
         self.grace_period = grace_period
+        # unclaimed speculative (warm-pool) launches older than this are
+        # reclaimed by the replay ladder even though their instance is live
+        self.warm_pool_ttl = warm_pool_ttl
         # entries younger than this may still have a live launching
         # process. The floor is recovery.DEFAULT_REPLAY_AFTER, sized past
         # the WORST-case intent-to-commit window (fleet-limiter stall +
@@ -93,6 +97,7 @@ class GarbageCollectionController:
         self.leaks_terminated = 0
         self.replays = 0
         self.sweeps = 0
+        self.speculation_reclaimed = 0
 
     # -- shard routing -----------------------------------------------------
     def _owns(self, shard: str) -> bool:
@@ -211,13 +216,26 @@ class GarbageCollectionController:
                 outcome = recovery.replay_entry(
                     self.journal, self.cluster, self.cloud_provider,
                     entry, by_token, now, replay_after=self.replay_after,
-                    index=index,
+                    index=index, warm_pool_ttl=self.warm_pool_ttl,
+                    reap=self._reap,
                 )
                 sp.set_attribute("outcome", outcome)
             if outcome == recovery.PENDING:
                 continue
             self.replays += 1
             metrics.LAUNCH_JOURNAL_REPLAYS.labels(outcome=outcome).inc()
+            if outcome == recovery.SPECULATION_EXPIRED:
+                self.speculation_reclaimed += 1
+                metrics.WARMPOOL_EXPIRED.inc()
+                from karpenter_tpu.kube.events import recorder_for
+
+                recorder_for(self.cluster).event(
+                    "Node", by_token[entry.token].id, "SpeculationExpired",
+                    f"reclaimed speculative warm-pool capacity for "
+                    f"provisioner {entry.provisioner}: no demand landed "
+                    f"within the {self.warm_pool_ttl:.0f}s TTL",
+                    type="Warning",
+                )
             if outcome == recovery.ADOPTED:
                 self.adopted += 1
                 metrics.LAUNCH_ORPHANS_ADOPTED.inc()

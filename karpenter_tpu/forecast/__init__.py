@@ -1,0 +1,24 @@
+"""karpenter_tpu.forecast — arrival-rate forecasting (ROADMAP item 5).
+
+The predictive half of provisioning: an online per-provisioner arrival
+model fed by the span stream (``forecast/model.py``), consumed by the
+speculative warm-pool controller (``controllers/warmpool.py``) and the
+offline what-if simulator (``tools/whatif.py``). Install the process
+forecaster with ``obs.configure_forecast``; read it back with
+``obs.forecaster()``.
+"""
+
+from karpenter_tpu.forecast.model import (  # noqa: F401
+    DEFAULT_BAND_SIGMA,
+    DEFAULT_BUCKET_S,
+    DEFAULT_HORIZON_S,
+    MAX_HORIZON_S,
+    MIN_HORIZON_S,
+    MODEL_EWMA,
+    MODEL_HOLT_WINTERS,
+    ArrivalForecaster,
+    Ewma,
+    HoltWinters,
+    ShardForecast,
+    build_model,
+)

@@ -52,6 +52,12 @@ class LaunchRecord:
     node_name: str = ""
     trace: str = ""
     created_at: float = 0.0
+    # warm-pool marker (controllers/warmpool.py): this launch was created
+    # ahead of demand. A speculative entry stays OPEN after the Node write
+    # — it resolves when a warm-hit claims the node, and the GC ladder
+    # reclaims it past --warm-pool-ttl if demand never lands. Defaults
+    # keep old journal docs (no key) parsing as ordinary launches.
+    speculative: bool = False
 
     def to_doc(self) -> Dict:
         return asdict(self)
@@ -65,6 +71,7 @@ class LaunchRecord:
             node_name=str(doc.get("node_name", "")),
             trace=str(doc.get("trace", "")),
             created_at=float(doc.get("created_at", 0.0)),
+            speculative=bool(doc.get("speculative", False)),
         )
 
 
@@ -73,7 +80,10 @@ class LaunchJournal:
     call with unknown tokens (a resolve of an already-resolved entry is a
     no-op) — recovery and the live launch path may race benignly."""
 
-    def record_intent(self, token: str, provisioner: str, trace: str = "") -> None:
+    def record_intent(
+        self, token: str, provisioner: str, trace: str = "",
+        speculative: bool = False,
+    ) -> None:
         raise NotImplementedError
 
     def mark_created(self, token: str, node_name: str) -> None:
@@ -98,11 +108,14 @@ class MemoryLaunchJournal(LaunchJournal):
         self._mu = threading.Lock()
         self._entries: Dict[str, LaunchRecord] = {}  # guarded-by: self._mu
 
-    def record_intent(self, token: str, provisioner: str, trace: str = "") -> None:
+    def record_intent(
+        self, token: str, provisioner: str, trace: str = "",
+        speculative: bool = False,
+    ) -> None:
         with self._mu:
             self._entries[token] = LaunchRecord(
                 token=token, provisioner=provisioner, trace=trace,
-                created_at=self.clock(),
+                created_at=self.clock(), speculative=speculative,
             )
 
     def mark_created(self, token: str, node_name: str) -> None:
@@ -164,10 +177,13 @@ class FileLaunchJournal(LaunchJournal):
             json.dump(record, f)
         os.replace(tmp, self.path)
 
-    def record_intent(self, token: str, provisioner: str, trace: str = "") -> None:
+    def record_intent(
+        self, token: str, provisioner: str, trace: str = "",
+        speculative: bool = False,
+    ) -> None:
         entry = LaunchRecord(
             token=token, provisioner=provisioner, trace=trace,
-            created_at=self.clock(),
+            created_at=self.clock(), speculative=speculative,
         )
         with self._locked():
             self._sweep_stale_tmp()
@@ -261,10 +277,13 @@ class KubeLaunchJournal(LaunchJournal):
             except (Conflict, NotFound):
                 logger.debug("journal lease update raced for %s", name)
 
-    def record_intent(self, token: str, provisioner: str, trace: str = "") -> None:
+    def record_intent(
+        self, token: str, provisioner: str, trace: str = "",
+        speculative: bool = False,
+    ) -> None:
         self._put(LaunchRecord(
             token=token, provisioner=provisioner, trace=trace,
-            created_at=self.clock(),
+            created_at=self.clock(), speculative=speculative,
         ))
 
     def mark_created(self, token: str, node_name: str) -> None:

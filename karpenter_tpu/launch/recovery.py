@@ -42,6 +42,14 @@ ADOPTED = "adopted"
 NODE_EXISTS = "node_exists"
 NEVER_LAUNCHED = "never_launched"
 PENDING = "pending"
+# a speculative (warm-pool) entry aged past --warm-pool-ttl with no
+# demand claiming its node: the instance is reclaimed even though it is
+# live — the one case where "journaled and running" is NOT protection
+SPECULATION_EXPIRED = "speculation_expired"
+
+# Default --warm-pool-ttl: how long an unclaimed speculative launch may
+# stand before the GC ladder reclaims it (controllers/warmpool.py).
+DEFAULT_WARM_POOL_TTL = 600.0
 
 # How old an unresolved entry must be before replay touches it: younger
 # entries may belong to a live process still between its journal write and
@@ -61,6 +69,7 @@ def node_for_instance(
     live: LiveInstance,
     provisioner_name: str = "",
     trace: str = "",
+    speculative: bool = False,
 ) -> Node:
     """Fabricate the Node object a crashed launch never wrote.
 
@@ -112,6 +121,10 @@ def node_for_instance(
     labels.update(live.labels)
 
     annotations = {"karpenter.sh/adopted": "true"}
+    if speculative:
+        # an adopted speculative orphan re-enters the warm pool: claimable
+        # by the worker's warm-hit steal, reclaimable past the TTL
+        annotations[lbl.WARM_POOL_ANNOTATION] = "true"
     if live.launch_token:
         annotations[lbl.LAUNCH_TOKEN_ANNOTATION] = live.launch_token
     if trace:
@@ -185,11 +198,21 @@ def replay_entry(
     now: float,
     replay_after: float = DEFAULT_REPLAY_AFTER,
     index: Optional[NodeIndex] = None,
+    warm_pool_ttl: float = DEFAULT_WARM_POOL_TTL,
+    reap=None,
 ) -> str:
     """Run the adopt/confirm ladder for ONE unresolved entry; returns the
     outcome constant. Safe against the live launch path: a racing resolve
     (the launching process finished after all) is a benign no-op, and the
-    grace window keeps replay off entries young enough to have one."""
+    grace window keeps replay off entries young enough to have one.
+
+    Speculative (warm-pool) entries get the extra rungs: a STANDING warm
+    node keeps its entry open (the entry is the TTL breadcrumb, not an
+    orphan), a CLAIMED one resolves, and one past ``warm_pool_ttl`` is
+    reclaimed through ``reap`` even though the instance is live — without
+    this rung an untracked-but-journaled instance is protected forever.
+    ``reap`` terminates one live instance (the GC controller passes its
+    terminator-backed reaper); None falls back to the provider delete."""
     if now - entry.created_at < replay_after:
         return PENDING
     live = instances_by_token.get(entry.token)
@@ -199,6 +222,11 @@ def replay_entry(
         journal.resolve(entry.token)
         return NEVER_LAUNCHED
     tracked = node_tracking(cluster, live, index=index)
+    if entry.speculative:
+        return _replay_speculative(
+            journal, cluster, cloud_provider, entry, live, tracked,
+            now, warm_pool_ttl, reap,
+        )
     if tracked is not None:
         # crash landed between Node write and bind: the Node tracks the
         # instance, unbound pods re-enter selection on their own
@@ -221,3 +249,102 @@ def replay_entry(
         live.id, entry.token[:12], entry.provisioner,
     )
     return ADOPTED
+
+
+def _replay_speculative(
+    journal: LaunchJournal,
+    cluster,
+    cloud_provider,
+    entry: LaunchRecord,
+    live: LiveInstance,
+    tracked: Optional[Node],
+    now: float,
+    warm_pool_ttl: float,
+    reap,
+) -> str:
+    """The warm-pool rungs of the ladder (one live instance, speculative
+    entry). Claimed → resolve; standing within TTL → leave open; past
+    TTL → reclaim instance AND entry, zero leaks, zero double-launches
+    (the instance dies under its own token, so a token replay can never
+    resurrect it)."""
+    expired = (now - entry.created_at) >= warm_pool_ttl
+    if tracked is not None:
+        claimed = (
+            lbl.WARM_POOL_ANNOTATION not in tracked.metadata.annotations
+        )
+        if claimed:
+            # demand landed: the worker's warm-hit steal removed the
+            # marker (its resolve may have raced this sweep — benign)
+            journal.resolve(entry.token)
+            return NODE_EXISTS
+        if not expired:
+            # standing warm capacity awaiting demand: the open entry IS
+            # the TTL breadcrumb — resolving it would protect the
+            # instance forever (the bug this rung exists to fix)
+            return PENDING
+        _reap_speculative(cluster, cloud_provider, live, tracked, reap)
+        journal.resolve(entry.token)
+        logger.warning(
+            "reclaimed expired speculative node %s (token %s, provisioner "
+            "%s): no demand landed within the warm-pool TTL (%.0fs)",
+            tracked.metadata.name, entry.token[:12], entry.provisioner,
+            warm_pool_ttl,
+        )
+        return SPECULATION_EXPIRED
+    if expired:
+        # untracked AND stale: the crash ate the Node write and the TTL
+        # already passed — reclaim straight from the cloud
+        _reap_speculative(cluster, cloud_provider, live, None, reap)
+        journal.resolve(entry.token)
+        logger.warning(
+            "reclaimed expired speculative instance %s (token %s, "
+            "provisioner %s): untracked past the warm-pool TTL (%.0fs)",
+            live.id, entry.token[:12], entry.provisioner, warm_pool_ttl,
+        )
+        return SPECULATION_EXPIRED
+    # untracked, within TTL: adopt back INTO the warm pool (Node carries
+    # the warm marker, entry stays open so the TTL still applies)
+    node = node_for_instance(
+        cluster, cloud_provider, live,
+        provisioner_name=entry.provisioner, trace=entry.trace,
+        speculative=True,
+    )
+    from karpenter_tpu.kube.client import Conflict
+
+    try:
+        cluster.create("nodes", node)
+    except Conflict:
+        pass  # a racer won the write
+    logger.warning(
+        "adopted speculative orphan %s (token %s, provisioner %s) back "
+        "into the warm pool — its launching process died before the Node "
+        "write",
+        live.id, entry.token[:12], entry.provisioner,
+    )
+    return ADOPTED
+
+
+def _reap_speculative(
+    cluster, cloud_provider, live: LiveInstance, tracked: Optional[Node],
+    reap,
+) -> None:
+    """Terminate one expired speculative launch: instance first (under
+    its own token, so the fleet ledger forgets it), then the Node object
+    (unclaimed warm nodes carry no pods, so no drain is owed)."""
+    if reap is not None:
+        reap(live)
+    else:
+        node = tracked or node_for_instance(cluster, cloud_provider, live)
+        node.metadata.finalizers = []
+        cloud_provider.delete(node)
+    if tracked is not None:
+        try:
+            if tracked.metadata.finalizers:
+                tracked.metadata.finalizers = []
+                cluster.update("nodes", tracked)
+            cluster.delete("nodes", tracked.metadata.name, namespace="")
+        except Exception:
+            logger.debug(
+                "warm node object delete raced for %s",
+                tracked.metadata.name, exc_info=True,
+            )

@@ -30,6 +30,7 @@ from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.pvc import PVCController
 from karpenter_tpu.controllers.selection import SelectionController
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.controllers.warmpool import WarmPoolController
 from karpenter_tpu.kube.client import Cluster
 from karpenter_tpu.options import Options
 from karpenter_tpu.webhook import Webhook
@@ -60,6 +61,8 @@ class Runtime:
     profiler: object = None  # the SamplingProfiler THIS runtime installed
     telemetry: object = None  # the TelemetryPlane THIS runtime installed
     brownout: object = None  # BrownoutController when --brownout is on
+    warmpool: WarmPoolController = None  # when --warm-pool is on
+    forecast: object = None  # the ArrivalForecaster THIS runtime installed
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
     def stop(self) -> None:
@@ -94,6 +97,12 @@ class Runtime:
             from karpenter_tpu import obs
 
             obs.shutdown_slo(engine=self.slo)
+        # detach the arrival forecaster this runtime installed (same
+        # ownership-checked discipline)
+        if self.forecast is not None:
+            from karpenter_tpu import obs
+
+            obs.shutdown_forecast(engine=self.forecast)
         # same ownership-checked teardown for the profiler and the
         # telemetry plane this runtime installed
         if self.profiler is not None or self.telemetry is not None:
@@ -205,6 +214,11 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 # the decision audit log: newest provisioning-round
                 # records (?limit=/?provisioner= narrow the window)
                 self._send(json.dumps(obs.debug_decisions_payload(query)).encode())
+            elif self.path.startswith("/debug/forecast"):
+                # per-provisioner arrival-rate predictions + warm-pool
+                # horizon from the arrival forecaster ({} until one is
+                # configured)
+                self._send(json.dumps(obs.debug_forecast_payload(query)).encode())
             elif self.path.startswith("/debug/explain"):
                 # per-pod scheduling explainability: ?pod=<name> returns
                 # the newest decision's per-candidate elimination
@@ -309,6 +323,9 @@ def build_runtime(
         # decision observability (docs/decisions.md): the consecutive-
         # failure threshold behind PodUnschedulable Warning events
         unschedulable_event_rounds=options.unschedulable_event_rounds,
+        # warm-pool claiming (docs/forecasting.md): workers steal onto
+        # standing speculative nodes before solving
+        warm_pool=options.warm_pool,
     )
     selection = SelectionController(
         cluster, provisioning, allow_pod_affinity=allow_pod_affinity,
@@ -344,7 +361,22 @@ def build_runtime(
         ownership=ownership,
         gc_interval=options.gc_interval,
         grace_period=options.gc_grace_period,
+        warm_pool_ttl=options.warm_pool_ttl,
     )
+    # predictive provisioning (docs/forecasting.md): the warm-pool wave
+    # turns the arrival forecaster's upper band into standing speculative
+    # capacity; the worker's steal claims it, the GC ladder reclaims it
+    warmpool = None
+    if options.warm_pool:
+        warmpool = WarmPoolController(
+            cluster,
+            cloud_provider,
+            provisioning,
+            journal=journal,
+            ownership=ownership,
+            warm_pool_ttl=options.warm_pool_ttl,
+            max_nodes=options.warm_pool_max_nodes,
+        )
     # the SLO-driven brownout ladder (docs/overload.md): consumes burn
     # state from whatever SLO engine is installed (run_controller_process
     # installs it; the sensor reads lazily, so construction order is free)
@@ -359,6 +391,7 @@ def build_runtime(
             provisioning=provisioning,
             consolidation=consolidation,
             router=default_router(),
+            warmpool=warmpool,
             cluster=cluster,
             interval=options.brownout_interval,
         )
@@ -377,6 +410,8 @@ def build_runtime(
     manager.register("node", node.reconcile, concurrency=10)
     manager.register("consolidation", consolidation.reconcile, concurrency=2)
     manager.register("garbage_collection", garbage_collection.reconcile, concurrency=1)
+    if warmpool is not None:
+        manager.register("warmpool", warmpool.reconcile, concurrency=1)
     manager.register("counter", counter.reconcile, concurrency=2)
     manager.register("pvc", pvc.reconcile, concurrency=2)
     manager.register("metrics_node", metrics_node.reconcile, concurrency=2)
@@ -399,6 +434,8 @@ def build_runtime(
     node.register(manager)
     interruption.register(manager)
     garbage_collection.register(manager)
+    if warmpool is not None:
+        warmpool.register(manager)
     consolidation.register(manager)
     counter.register(manager)
     pvc.register(manager)
@@ -420,6 +457,7 @@ def build_runtime(
         journal=journal,
         ownership=ownership,
         brownout=brownout,
+        warmpool=warmpool,
     )
 
 
@@ -450,6 +488,14 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
     )
     runtime.slo = obs.configure_slo(
         objectives=objectives, window_s=runtime.options.slo_window
+    )
+    # the arrival-rate forecaster (docs/forecasting.md): always on — it is
+    # a finish-hook over spans the tracer already emits, and its
+    # predictions back /debug/forecast whether or not --warm-pool spends
+    # them on speculative capacity
+    runtime.forecast = obs.configure_forecast(
+        model=runtime.options.forecast_model,
+        alpha=runtime.options.forecast_alpha,
     )
     # the decision audit log (docs/decisions.md): /debug/decisions and
     # /debug/explain answer from the memory ring either way; a configured

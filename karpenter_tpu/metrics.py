@@ -713,6 +713,103 @@ LAUNCH_JOURNAL_REPLAYS = Counter(
     registry=REGISTRY,
 )
 
+# Predictive provisioning (docs/forecasting.md): the arrival forecaster's
+# readout and the warm-pool controller's speculation ledger. A speculative
+# node is capacity bought on a prediction — every launch, hit, and
+# expiry-reclaim must be attributable on the scrape or the warm pool is
+# just a slow leak with extra steps.
+FORECAST_RATE = Gauge(
+    "predicted_rate_pods_per_s",
+    "Predicted pod-arrival rate per provisioner shard, by band (point: "
+    "the model level; upper: point + band-sigma standard deviations — "
+    "what the warm pool speculates against).",
+    ["provisioner", "band"],
+    namespace=NAMESPACE,
+    subsystem="forecast",
+    registry=REGISTRY,
+)
+
+FORECAST_HORIZON = Gauge(
+    "horizon_seconds",
+    "The forecast horizon: measured launch-to-ready p99 off node.ready "
+    "spans (clamped; the configured default until the first ready "
+    "transition lands). Predictions are pod counts expected within one "
+    "horizon.",
+    namespace=NAMESPACE,
+    subsystem="forecast",
+    registry=REGISTRY,
+)
+
+FORECAST_ARRIVALS = Counter(
+    "observed_arrivals_total",
+    "Pod admissions observed by the forecaster off provision.round spans, "
+    "per provisioner shard — the arrival series the models train on.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="forecast",
+    registry=REGISTRY,
+)
+
+WARMPOOL_SPECULATIVE_LAUNCHES = Counter(
+    "speculative_launches_total",
+    "Speculative (warm-pool) node launches, per provisioner: capacity "
+    "created ahead of demand on the forecaster's upper band, journaled "
+    "with the speculative marker.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
+WARMPOOL_HITS = Counter(
+    "hits_total",
+    "Warm-pool hits, per provisioner: pods bound onto a standing warm "
+    "node by the pre-solve steal, skipping the launch path entirely.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
+WARMPOOL_MISSES = Counter(
+    "misses_total",
+    "Warm-pool misses, per provisioner: pods that reached the solver with "
+    "no compatible warm node standing — the counterpart of hits_total for "
+    "the hit-rate denominator.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
+WARMPOOL_EXPIRED = Counter(
+    "expired_total",
+    "Speculative launches reclaimed by the GC ladder after --warm-pool-ttl "
+    "with no demand landing (the speculation_expired replay outcome).",
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
+WARMPOOL_SIZE = Gauge(
+    "size",
+    "Unclaimed warm nodes currently standing, per provisioner.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
+WARMPOOL_PAUSED = Gauge(
+    "paused",
+    "1 while warm-pool speculation is paused (brownout rung 1+ — "
+    "speculative capacity is the cheapest thing to stop buying under "
+    "burn), 0 otherwise.",
+    namespace=NAMESPACE,
+    subsystem="warmpool",
+    registry=REGISTRY,
+)
+
 # Overload control (docs/overload.md): past saturation the system decides
 # what to drop instead of letting the queues decide. Every shed — batcher
 # or sidecar admission — must be attributable on the scrape, and the

@@ -236,6 +236,73 @@ def shutdown_profiler(prof: Optional[SamplingProfiler] = None) -> None:
     unregister_state("profile")
 
 
+# -- the arrival-rate forecaster (forecast/model.py) -------------------------
+
+_forecaster = None  # guarded-by: _lock
+
+
+def configure_forecast(
+    bucket_s: Optional[float] = None,
+    model: Optional[str] = None,
+    alpha: Optional[float] = None,
+    season_len: Optional[int] = None,
+    band_sigma: Optional[float] = None,
+    default_horizon_s: Optional[float] = None,
+    clock=None,
+):
+    """Install (or replace) the arrival-rate forecaster on the default
+    tracer: a span finish-hook over ``provision.round`` (admission
+    counts) and ``node.ready`` (the launch-to-ready horizon), plus the
+    ``forecast`` flight-recorder state panel so every slow-solve record
+    snapshots what the forecaster believed at the time."""
+    from karpenter_tpu.forecast.model import ArrivalForecaster
+
+    global _forecaster
+    kwargs = {}
+    if bucket_s is not None:
+        kwargs["bucket_s"] = bucket_s
+    if model is not None:
+        kwargs["model"] = model
+    if alpha is not None:
+        kwargs["alpha"] = alpha
+    if season_len is not None:
+        kwargs["season_len"] = season_len
+    if band_sigma is not None:
+        kwargs["band_sigma"] = band_sigma
+    if default_horizon_s is not None:
+        kwargs["default_horizon_s"] = default_horizon_s
+    if clock is not None:
+        kwargs["clock"] = clock
+    eng = ArrivalForecaster(**kwargs)
+    with _lock:
+        if _forecaster is not None:
+            _tracer.remove_hook(_forecaster)
+        _forecaster = eng
+    _tracer.add_hook(eng)
+    register_state("forecast", eng.panel)
+    return eng
+
+
+def forecaster():
+    with _lock:
+        return _forecaster
+
+
+def shutdown_forecast(engine=None) -> None:
+    """Detach the forecaster (hook + flight panel). Ownership-checked like
+    ``shutdown_slo``: pass the engine you installed so a stopped replica
+    cannot tear down a LATER configure's engine; ``None`` detaches
+    unconditionally (reset_for_tests)."""
+    global _forecaster
+    with _lock:
+        if engine is not None and _forecaster is not engine:
+            return  # someone else's engine is current — not ours to kill
+        if _forecaster is not None:
+            _tracer.remove_hook(_forecaster)
+        _forecaster = None
+    unregister_state("forecast")
+
+
 # -- the decision audit log (obs/decisions.py) -------------------------------
 
 # memory-only default: /debug/decisions and /debug/explain answer from the
@@ -389,6 +456,14 @@ def debug_explain_payload(query: str = "") -> dict:
     }
 
 
+def debug_forecast_payload(query: str = "") -> dict:
+    """``GET /debug/forecast``: per-provisioner arrival predictions, the
+    measured launch-to-ready horizon, and the model parameters ({} while
+    no forecaster is configured)."""
+    eng = forecaster()
+    return {"forecast": eng.snapshot() if eng is not None else {}}
+
+
 def debug_profile_payload(query: str = ""):
     """``GET /debug/profile`` → ``(content_type, body_bytes)``. Default is
     the top-N self-time JSON; ``?format=collapsed`` returns the raw
@@ -418,6 +493,7 @@ def reset_for_tests() -> None:
         _flight = None
         old_decisions, _decisions = _decisions, DecisionLog()
     old_decisions.close()
+    shutdown_forecast()
     shutdown_slo()
     shutdown_profiler()
     shutdown_telemetry()

@@ -179,6 +179,30 @@ class Options:
     brownout_interval: float = field(
         default_factory=lambda: float(_env("KARPENTER_BROWNOUT_INTERVAL", "5"))
     )
+    # predictive provisioning (karpenter_tpu/forecast/, docs/forecasting.md):
+    # - warm_pool: the speculative warm-pool controller (launch ahead of
+    #   forecast demand; the provisioning worker claims warm nodes before
+    #   solving). Requires the arrival forecaster, which is always on.
+    # - warm_pool_ttl: seconds an unclaimed speculative node may stand
+    #   before the GC replay ladder reclaims it
+    # - warm_pool_max_nodes: per-provisioner standing-pool ceiling
+    # - forecast_model: ewma | holt-winters (the seasonal option)
+    # - forecast_alpha: EWMA/Holt-Winters level smoothing factor
+    warm_pool: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_WARM_POOL")
+    )
+    warm_pool_ttl: float = field(
+        default_factory=lambda: float(_env("KARPENTER_WARM_POOL_TTL", "600"))
+    )
+    warm_pool_max_nodes: int = field(
+        default_factory=lambda: int(_env("KARPENTER_WARM_POOL_MAX_NODES", "10"))
+    )
+    forecast_model: str = field(
+        default_factory=lambda: _env("KARPENTER_FORECAST_MODEL", "ewma")
+    )
+    forecast_alpha: float = field(
+        default_factory=lambda: float(_env("KARPENTER_FORECAST_ALPHA", "0.3"))
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -209,6 +233,16 @@ class Options:
             errs.append("SLO window must be positive seconds")
         if self.brownout_interval <= 0:
             errs.append("brownout tick interval must be positive seconds")
+        if self.warm_pool_ttl <= 0:
+            errs.append("warm-pool TTL must be positive seconds")
+        if self.warm_pool_max_nodes < 1:
+            errs.append("warm-pool max nodes must be >= 1")
+        if self.forecast_model not in ("ewma", "holt-winters"):
+            errs.append(
+                f"forecast model must be ewma|holt-winters, got {self.forecast_model}"
+            )
+        if not 0.0 < self.forecast_alpha <= 1.0:
+            errs.append("forecast alpha must be a fraction in (0, 1]")
         if self.unschedulable_event_rounds < 1:
             errs.append("unschedulable event rounds must be >= 1")
         if not 0.0 <= self.profile_hz <= 250.0:
@@ -400,6 +434,32 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         help="seconds between brownout ladder evaluations",
     )
     ap.add_argument(
+        "--warm-pool",
+        action=argparse.BooleanOptionalAction,
+        default=opts.warm_pool,
+        help="speculative warm-pool provisioning: launch nodes ahead of "
+        "forecast demand and claim them before solving "
+        "(docs/forecasting.md; pauses at brownout rung 1)",
+    )
+    ap.add_argument(
+        "--warm-pool-ttl", type=float, default=opts.warm_pool_ttl,
+        help="seconds an unclaimed speculative node may stand before the "
+        "GC replay ladder reclaims it",
+    )
+    ap.add_argument(
+        "--warm-pool-max-nodes", type=int, default=opts.warm_pool_max_nodes,
+        help="per-provisioner ceiling on standing warm-pool nodes",
+    )
+    ap.add_argument(
+        "--forecast-model", default=opts.forecast_model,
+        help="arrival-rate forecaster model: ewma | holt-winters "
+        "(the additive-seasonal option)",
+    )
+    ap.add_argument(
+        "--forecast-alpha", type=float, default=opts.forecast_alpha,
+        help="forecaster level smoothing factor in (0, 1]",
+    )
+    ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
         default=opts.consolidation_enabled,
@@ -448,6 +508,11 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         telemetry_flush_interval=ns.telemetry_flush_interval,
         brownout_enabled=ns.brownout,
         brownout_interval=ns.brownout_interval,
+        warm_pool=ns.warm_pool,
+        warm_pool_ttl=ns.warm_pool_ttl,
+        warm_pool_max_nodes=ns.warm_pool_max_nodes,
+        forecast_model=ns.forecast_model,
+        forecast_alpha=ns.forecast_alpha,
         explain_enabled=ns.explain,
         decision_dir=ns.decision_dir,
         unschedulable_event_rounds=ns.unschedulable_event_rounds,

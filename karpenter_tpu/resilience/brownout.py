@@ -11,9 +11,11 @@ its reversal provable.
 The ladder, in order (cheapest capability first):
 
 1. **Pause exploration and voluntary disruption.** Router shadow probes
-   re-measure LOSING backends — pure exploration — and consolidation
+   re-measure LOSING backends — pure exploration — consolidation
    waves evict pods into the very pending-pod queue an overloaded
-   provisioner is drowning in. Neither costs any user anything to stop.
+   provisioner is drowning in, and warm-pool speculation buys capacity
+   for *predicted* demand while real demand burns. None of these costs
+   any user anything to stop.
 2. **Shrink the batcher admission window.** Small frequent rounds over
    giant stale ones: queued work stops aging a full ``max_duration``
    before its first solve (the queue IS the latency).
@@ -95,6 +97,7 @@ class BrownoutController:
         provisioning=None,
         consolidation=None,
         router=None,
+        warmpool=None,
         cluster=None,
         interval: float = DEFAULT_TICK_INTERVAL,
         escalate_after: int = ESCALATE_AFTER,
@@ -105,6 +108,9 @@ class BrownoutController:
         self.provisioning = provisioning
         self.consolidation = consolidation
         self.router = router
+        # WarmPoolController: speculation is pure exploration spend, so it
+        # pauses at rung 1 with the probes and consolidation waves
+        self.warmpool = warmpool
         self.cluster = cluster
         self.interval = float(interval)
         self.escalate_after = max(int(escalate_after), 1)
@@ -223,6 +229,8 @@ class BrownoutController:
             self.router.set_brownout_bias(ROUTER_BIAS if level >= 3 else 1.0)
         if self.consolidation is not None:
             self.consolidation.set_paused(level >= 1)
+        if self.warmpool is not None:
+            self.warmpool.set_paused(level >= 1)
         pressure = PRESSURE_BY_LEVEL.get(level, PRESSURE_BY_LEVEL[MAX_LEVEL])
         for batcher in self._batchers():
             batcher.set_pressure(pressure)

@@ -1291,25 +1291,39 @@ class TpuScheduler:
             uniq_lists = [list(types_arr[ok_all[int(r)]]) for r in uniq_row]
             row_of = row_of.reshape(-1)
         nodes: List[VirtualNode] = []
+        if not live:
+            return nodes
+        # bulk host conversion for the per-node readout: one vectorized
+        # division + three .tolist() calls replace per-element numpy
+        # scalar boxing (float(total[i]) / scales[i] boxed a scalar per
+        # axis per node — THE remaining decode hot spot at 1k+ nodes).
+        # Same IEEE float64 divide, so the requests dicts are bit-exact.
+        totals_live = np.asarray(node_req)[live_idx]  # [L, R]
+        totals_l = totals_live.tolist()
+        scaled_l = (totals_live / scales[None, :]).tolist()
+        sig_l = np.asarray(node_sig)[live_idx].tolist()
+        host_l = np.asarray(node_host)[live_idx].tolist()
+        row_of_l = row_of.tolist()
         # hostname requirement fast path: all nodes of one signature share
         # (reqs tuple, sets minus hostname); per node only the hostname
         # ValueSet intersection and one tuple splice differ —
         # assignment-identical to sig.requirements.add(hostname In [h])
         sig_host_cache: Dict[int, tuple] = {}
         for row, n in enumerate(live):
-            sig = batch.signatures[int(node_sig[n])]
-            total = node_req[n]
-            surviving = uniq_lists[int(row_of[row])]
+            sig = batch.signatures[sig_l[row]]
+            total = totals_l[row]
+            scaled = scaled_l[row]
+            surviving = uniq_lists[row_of_l[row]]
             node_constraints = constraints.clone()
             reqs = sig.requirements
-            h = int(node_host[n])
+            h = host_l[row]
             if h >= 0:
                 reqs = _with_hostname(
                     reqs, batch.hostnames[h], sig_host_cache
                 )
             node_constraints.requirements = reqs
             requests = {
-                name: float(total[i]) / scales[i]
+                name: scaled[i]
                 for i, name in enumerate(axis_names)
                 if total[i]
             }

@@ -1561,6 +1561,10 @@ def _serve_health(service: SolverService, port: int):
                     body = _json.dumps(
                         obs.debug_decisions_payload(query)
                     ).encode()
+                elif self.path.startswith("/debug/forecast"):
+                    body = _json.dumps(
+                        obs.debug_forecast_payload(query)
+                    ).encode()
                 elif self.path.startswith("/debug/explain"):
                     body = _json.dumps(
                         obs.debug_explain_payload(query)

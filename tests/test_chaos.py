@@ -492,3 +492,61 @@ class TestChaosEndToEnd:
             assert rt.cloud_provider.breakers.open_dependencies() == []
         finally:
             rt.stop()
+
+
+class TestArrivalPattern:
+    """The seeded diurnal + flash-crowd generator behind the
+    forecast-storm bench leg."""
+
+    def _pattern(self, **kwargs):
+        from karpenter_tpu.testing.chaos import ArrivalPattern
+
+        kwargs.setdefault("base_pods_per_tick", 4.0)
+        kwargs.setdefault("period_s", 60.0)
+        kwargs.setdefault("tick_s", 5.0)
+        kwargs.setdefault("seed", 7)
+        return ArrivalPattern(**kwargs)
+
+    def test_schedule_is_deterministic_from_seed(self):
+        a = self._pattern(flash_at=(20.0,))
+        b = self._pattern(flash_at=(20.0,))
+        assert a.schedule(120.0) == b.schedule(120.0)
+        c = self._pattern(flash_at=(20.0,), seed=8)
+        assert a.schedule(120.0) != c.schedule(120.0)
+
+    def test_schedule_covers_duration_in_tick_order(self):
+        p = self._pattern()
+        sched = p.schedule(60.0)
+        times = [t for t, _ in sched]
+        assert times == sorted(times)
+        assert times[0] == 0.0
+        assert times[-1] < 60.0
+        assert all(n >= 0 for _, n in sched)
+
+    def test_diurnal_rate_bounds(self):
+        p = self._pattern(amplitude=0.75)
+        rates = [p.rate_at(t) for t in range(0, 60)]
+        assert max(rates) == pytest.approx(4.0 * 1.75, rel=0.01)
+        assert min(rates) == pytest.approx(4.0 * 0.25, rel=0.05)
+        assert all(r >= 0 for r in rates)
+
+    def test_flash_crowd_folds_extra_pods_in(self):
+        calm = self._pattern()
+        stormy = self._pattern(flash_at=(20.0,), flash_pods=40,
+                               flash_len_s=10.0)
+        assert stormy.total_pods(60.0) >= calm.total_pods(60.0) + 40
+
+    def test_in_flash_window_boundaries(self):
+        p = self._pattern(flash_at=(20.0, 40.0), flash_len_s=10.0)
+        assert not p.in_flash(19.9)
+        assert p.in_flash(20.0)
+        assert p.in_flash(29.9)
+        assert not p.in_flash(30.0)
+        assert p.in_flash(45.0)
+        assert not p.in_flash(55.0)
+
+    def test_flash_past_duration_ignored(self):
+        p = self._pattern(flash_at=(999.0,), flash_pods=40)
+        with_f = p.total_pods(60.0)
+        without = self._pattern().total_pods(60.0)
+        assert with_f == without

@@ -1,0 +1,303 @@
+"""Arrival-rate forecasting unit tests (karpenter_tpu/forecast/): the
+models, the per-shard bucket accumulator, the tracer finish-hook, and the
+obs wiring (configure/shutdown + /debug/forecast payload)."""
+
+import math
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.forecast import (
+    DEFAULT_HORIZON_S,
+    MAX_HORIZON_S,
+    MIN_HORIZON_S,
+    MODEL_EWMA,
+    MODEL_HOLT_WINTERS,
+    ArrivalForecaster,
+    Ewma,
+    HoltWinters,
+    ShardForecast,
+    build_model,
+)
+from karpenter_tpu.obs.trace import Span
+
+
+def _span(name, **attrs):
+    """A bare finished span — the hook only reads .name and .attrs."""
+    return Span(name=name, trace_id="t" * 32, span_id="s" * 16,
+                parent_id=None, parent=None, attrs=attrs)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestEwma:
+    def test_cold_start_adopts_first_value(self):
+        m = Ewma(alpha=0.3)
+        assert m.predict() == 0.0
+        m.update(10.0)
+        assert m.level == 10.0
+        assert m.predict() == 10.0
+        assert m.std() == 0.0
+
+    def test_converges_toward_series(self):
+        m = Ewma(alpha=0.5)
+        for _ in range(20):
+            m.update(4.0)
+        assert m.predict() == pytest.approx(4.0)
+
+    def test_variance_widens_on_surprise_then_decays(self):
+        m = Ewma(alpha=0.5)
+        for _ in range(10):
+            m.update(2.0)
+        calm = m.std()
+        m.update(50.0)
+        assert m.std() > calm
+        spiked = m.std()
+        for _ in range(30):
+            m.update(2.0)
+        assert m.std() < spiked
+
+    def test_prediction_is_flat_regardless_of_steps(self):
+        m = Ewma()
+        m.update(3.0)
+        m.update(5.0)
+        assert m.predict(1) == m.predict(100)
+
+    @pytest.mark.parametrize("alpha", [0.0, -0.1, 1.5])
+    def test_alpha_validation(self, alpha):
+        with pytest.raises(ValueError):
+            Ewma(alpha=alpha)
+
+
+class TestHoltWinters:
+    def test_cold_start(self):
+        m = HoltWinters(season_len=4)
+        assert m.predict() == 0.0
+        m.update(7.0)
+        assert m.level == 7.0
+
+    def test_learns_seasonal_shape_better_than_ewma(self):
+        """A strict square wave with period == season_len: after a few
+        seasons HW predicts the NEXT phase's value; EWMA can only sit in
+        the middle."""
+        season = [0.0, 0.0, 10.0, 10.0]
+        hw = HoltWinters(alpha=0.3, beta=0.0, gamma=0.5, season_len=4)
+        ew = Ewma(alpha=0.3)
+        series = season * 12
+        for v in series:
+            hw.update(v)
+            ew.update(v)
+        # next value is series[48 % 4] == 0.0
+        assert abs(hw.predict(1) - 0.0) < abs(ew.predict(1) - 0.0)
+
+    def test_predict_never_negative(self):
+        m = HoltWinters(alpha=0.9, beta=0.9, season_len=2)
+        m.update(10.0)
+        m.update(0.0)
+        m.update(0.0)
+        assert m.predict(5) >= 0.0
+
+    def test_trend_tracks_ramp(self):
+        m = HoltWinters(alpha=0.5, beta=0.5, gamma=0.0, season_len=2)
+        for v in range(1, 20):
+            m.update(float(v))
+        assert m.trend > 0.0
+        assert m.predict(1) > m.level
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [{"alpha": 0.0}, {"beta": -0.1}, {"gamma": 2.0}, {"season_len": 1}],
+    )
+    def test_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            HoltWinters(**kwargs)
+
+
+class TestBuildModel:
+    def test_grammar(self):
+        assert isinstance(build_model(MODEL_EWMA), Ewma)
+        assert isinstance(build_model(MODEL_HOLT_WINTERS, season_len=6),
+                          HoltWinters)
+        with pytest.raises(ValueError):
+            build_model("arima")
+
+
+class TestShardForecast:
+    def test_rate_zero_until_first_closed_bucket(self):
+        s = ShardForecast(bucket_s=10.0)
+        s.observe(5, now=0.0)
+        point, upper = s.rate(now=5.0)  # same bucket still open
+        assert point == 0.0 and upper == 0.0
+
+    def test_closed_bucket_feeds_rate(self):
+        s = ShardForecast(bucket_s=10.0, alpha=1.0)
+        s.observe(20, now=0.0)
+        point, upper = s.rate(now=10.0)  # bucket closed: 20 pods / 10s
+        assert point == pytest.approx(2.0)
+        assert upper >= point
+
+    def test_silence_decays_rate(self):
+        s = ShardForecast(bucket_s=10.0, alpha=0.5)
+        s.observe(20, now=0.0)
+        busy, _ = s.rate(now=10.0)
+        quiet, _ = s.rate(now=60.0)  # four empty buckets replayed
+        assert 0.0 <= quiet < busy
+
+    def test_long_gap_resets_without_replay_storm(self):
+        s = ShardForecast(bucket_s=1.0, alpha=0.5)
+        s.observe(10, now=0.0)
+        s.rate(now=1.0)
+        obs_before = s.model.observations
+        # a week of silence: bounded number of updates, rate near zero
+        point, _ = s.rate(now=7 * 24 * 3600.0)
+        assert s.model.observations <= obs_before + 2
+        assert point == pytest.approx(0.0, abs=1e-9)
+
+    def test_total_arrivals_accumulates(self):
+        s = ShardForecast(bucket_s=10.0)
+        s.observe(3, now=0.0)
+        s.observe(4, now=1.0)
+        assert s.total_arrivals == 7
+
+    def test_negative_counts_clamped(self):
+        s = ShardForecast(bucket_s=10.0)
+        s.observe(-5, now=0.0)
+        assert s.total_arrivals == 0
+        point, _ = s.rate(now=10.0)
+        assert point == 0.0
+
+
+class TestArrivalForecaster:
+    def _engine(self, **kwargs):
+        kwargs.setdefault("bucket_s", 10.0)
+        kwargs.setdefault("clock", FakeClock())
+        return ArrivalForecaster(**kwargs)
+
+    def test_all_zero_before_any_round(self):
+        eng = self._engine()
+        out = eng.predict("nobody")
+        assert out["rate_point_per_s"] == 0.0
+        assert out["rate_upper_per_s"] == 0.0
+        assert out["predicted_pods_upper"] == 0.0
+        assert out["observations"] == 0
+
+    def test_round_spans_feed_the_shard(self):
+        clock = FakeClock()
+        eng = self._engine(clock=clock, alpha=1.0)
+        eng(_span("provision.round", provisioner="p1", batch=30))
+        clock.t = 10.0  # close the bucket
+        out = eng.predict("p1")
+        assert out["rate_point_per_s"] == pytest.approx(3.0)
+        assert out["predicted_pods"] == pytest.approx(3.0 * out["horizon_s"])
+        assert out["rate_upper_per_s"] >= out["rate_point_per_s"]
+        assert eng.provisioners() == ["p1"]
+
+    def test_rounds_shard_per_provisioner(self):
+        clock = FakeClock()
+        eng = self._engine(clock=clock, alpha=1.0)
+        eng(_span("provision.round", provisioner="a", batch=10))
+        eng(_span("provision.round", provisioner="b", batch=40))
+        clock.t = 10.0
+        assert eng.predict("b")["rate_point_per_s"] > eng.predict("a")[
+            "rate_point_per_s"
+        ]
+
+    def test_hook_ignores_malformed_spans(self):
+        eng = self._engine()
+        eng(_span("provision.round", batch=5))  # no provisioner
+        eng(_span("provision.round", provisioner="p", batch="not-a-number"))
+        eng(_span("node.ready", since_creation_s="nan?"))
+        eng(_span("node.ready", since_creation_s=-3))
+        eng(_span("some.other.span", provisioner="p", batch=99))
+        assert eng.provisioners() == []
+        assert eng.horizon_s() == DEFAULT_HORIZON_S
+
+    def test_horizon_defaults_then_tracks_ready_p99(self):
+        eng = self._engine(default_horizon_s=45.0)
+        assert eng.horizon_s() == 45.0
+        for _ in range(50):
+            eng(_span("node.ready", since_creation_s=120.0))
+        h = eng.horizon_s()
+        # log-linear sketch: ~2.5% bucket error around the true 120s
+        assert h == pytest.approx(120.0, rel=0.1)
+
+    def test_horizon_clamps(self):
+        eng = self._engine()
+        for _ in range(20):
+            eng(_span("node.ready", since_creation_s=0.01))
+        assert eng.horizon_s() == MIN_HORIZON_S
+        for _ in range(400):
+            eng(_span("node.ready", since_creation_s=86400.0))
+        assert eng.horizon_s() == MAX_HORIZON_S
+
+    def test_pods_per_node_floor_and_ewma(self):
+        eng = self._engine()
+        assert eng.pods_per_node() == 1.0
+        eng(_span("provision.round", provisioner="p", batch=12, nodes=3))
+        assert eng.pods_per_node() == pytest.approx(4.0)
+        eng(_span("provision.round", provisioner="p", batch=1, nodes=4))
+        assert eng.pods_per_node() >= 1.0  # never below one pod per node
+
+    def test_snapshot_and_panel_shapes(self):
+        clock = FakeClock()
+        eng = self._engine(clock=clock)
+        eng(_span("provision.round", provisioner="p", batch=5))
+        clock.t = 10.0
+        snap = eng.snapshot()
+        assert snap["model"] == MODEL_EWMA
+        assert "p" in snap["shards"]
+        assert snap["shards"]["p"]["observations"] == 1
+        panel = eng.panel()
+        assert set(panel) == {"horizon_s", "shards"}
+        assert "p" in panel["shards"]
+
+
+class TestObsWiring:
+    def test_configure_installs_tracer_hook(self):
+        eng = obs.configure_forecast(bucket_s=10.0, clock=FakeClock())
+        try:
+            assert obs.forecaster() is eng
+            with obs.tracer().span(
+                "provision.round", attrs={"provisioner": "wired", "batch": 4}
+            ):
+                pass
+            assert eng.provisioners() == ["wired"]
+            payload = obs.debug_forecast_payload()
+            assert "wired" in payload["forecast"]["shards"]
+        finally:
+            obs.shutdown_forecast(eng)
+        assert obs.forecaster() is None
+        assert obs.debug_forecast_payload() == {"forecast": {}}
+
+    def test_shutdown_is_ownership_checked(self):
+        eng1 = obs.configure_forecast(bucket_s=10.0)
+        eng2 = obs.configure_forecast(bucket_s=10.0)
+        try:
+            obs.shutdown_forecast(eng1)  # stale owner: must not detach eng2
+            assert obs.forecaster() is eng2
+        finally:
+            obs.shutdown_forecast(eng2)
+        assert obs.forecaster() is None
+
+    def test_configure_rejects_bad_model_eagerly(self):
+        with pytest.raises(ValueError):
+            obs.configure_forecast(model="prophet")
+        assert obs.forecaster() is None
+
+    def test_forecast_arrivals_metric_increments(self):
+        from karpenter_tpu import metrics
+
+        eng = ArrivalForecaster(bucket_s=10.0, clock=FakeClock())
+        before = metrics.FORECAST_ARRIVALS.labels(
+            provisioner="metered"
+        )._value.get()
+        eng(_span("provision.round", provisioner="metered", batch=6))
+        assert metrics.FORECAST_ARRIVALS.labels(
+            provisioner="metered"
+        )._value.get() == before + 6

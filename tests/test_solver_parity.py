@@ -529,3 +529,187 @@ class TestClosureMemo:
             ])
         table = next(iter(sched._encode_cache.tables.values()))[1]
         assert len(table._closure_memo) == 2  # k=2 and k=5 vocabularies
+
+
+class TestDecodeBitExact:
+    """The vectorized ``_decode`` readout (bulk ``.tolist()`` + one
+    vectorized division) must reproduce the original per-node scalar loop
+    bit for bit: same pod grouping, same surviving-type lists, same
+    requirements, and requests dicts whose floats match to the last ULP."""
+
+    def _setup(self):
+        catalog = instance_types(20)
+        c0 = make_provisioner(solver="tpu").spec.constraints
+        c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
+        return catalog, c0, TpuScheduler(Cluster(), rng=random.Random(0))
+
+    @staticmethod
+    def _scalar_reference(batch, result, typemask, constraints, catalog):
+        """The pre-vectorization decode loop, kept verbatim as the oracle:
+        per-element ``float(total[i]) / scales[i]`` numpy scalar boxing."""
+        import numpy as np
+
+        from karpenter_tpu.solver.backend import _with_hostname
+
+        assignment, node_sig, node_host, node_req, n_nodes_arr = result
+        assignment = assignment[: batch.n_pods]
+        n_nodes = int(np.asarray(n_nodes_arr).reshape(-1)[0])
+        pods_by_node = {}
+        for i, a in enumerate(np.asarray(assignment).tolist()):
+            if 0 <= a < n_nodes:
+                pods_by_node.setdefault(int(a), []).append(batch.pods[i])
+        axis_names = batch.axis_names
+        scales = np.array(
+            [res.AXIS_SCALES.get(nm, res._DEFAULT_SCALE) for nm in axis_names]
+        )
+        out = []
+        for n in sorted(pods_by_node):
+            total = np.asarray(node_req)[n]
+            if typemask is not None:
+                ok = np.asarray(typemask)[n]
+            else:
+                fit = np.all(batch.usable >= total[None, :], axis=-1)
+                ok = fit & batch.type_mask_matrix()[int(np.asarray(node_sig)[n])]
+            surviving = [t for t, o in zip(catalog, ok.tolist()) if o]
+            node_constraints = constraints.clone()
+            reqs = batch.signatures[int(np.asarray(node_sig)[n])].requirements
+            h = int(np.asarray(node_host)[n])
+            if h >= 0:
+                reqs = _with_hostname(reqs, batch.hostnames[h], {})
+            node_constraints.requirements = reqs
+            requests = {
+                name: float(total[i]) / scales[i]
+                for i, name in enumerate(axis_names)
+                if total[i]
+            }
+            out.append((pods_by_node[n], surviving, reqs, requests))
+        return out
+
+    @staticmethod
+    def _assert_bitexact(ref, nodes):
+        assert len(ref) == len(nodes), f"node count {len(ref)} != {len(nodes)}"
+        for (r_pods, r_types, r_reqs, r_requests), v in zip(ref, nodes):
+            assert [p.metadata.name for p in r_pods] == [
+                p.metadata.name for p in v.pods
+            ], "pod grouping diverged"
+            assert [t.name for t in r_types] == [
+                t.name for t in v.instance_type_options
+            ], "surviving-type list diverged"
+            vr = v.constraints.requirements
+            assert {k: str(r_reqs.get(k)) for k in sorted(r_reqs.keys())} == {
+                k: str(vr.get(k)) for k in sorted(vr.keys())
+            }, "node requirements diverged"
+            assert set(r_requests) == set(v.requests), "requests keys diverged"
+            for k in r_requests:
+                assert float(r_requests[k]).hex() == float(v.requests[k]).hex(), (
+                    f"requests[{k}] not bit-exact: "
+                    f"{float(r_requests[k]).hex()} vs {float(v.requests[k]).hex()}"
+                )
+
+    def _solve_and_compare(self, pods, catalog=None, provisioner=None):
+        if catalog is None:
+            catalog, c0, sched = self._setup()
+        else:
+            c0 = (provisioner or make_provisioner(solver="tpu")).spec.constraints
+            c0.requirements = c0.requirements.merge(catalog_requirements(catalog))
+            sched = TpuScheduler(Cluster(), rng=random.Random(0))
+        captured = {}
+        orig = sched._decode
+
+        def spy(batch, result, typemask, constraints, its):
+            out = orig(batch, result, typemask, constraints, its)
+            captured["args"] = (batch, result, typemask, constraints, its)
+            captured["nodes"] = out
+            return out
+
+        sched._decode = spy
+        try:
+            sched.solve(c0.clone(), catalog, pods)
+        finally:
+            sched._decode = orig
+        if "args" not in captured:
+            assert not pods, "decode never ran for a non-empty batch"
+            return
+        batch, result, typemask, constraints, its = captured["args"]
+        # whichever surviving-type branch the live solve took...
+        self._assert_bitexact(
+            self._scalar_reference(batch, result, typemask, constraints, its),
+            captured["nodes"],
+        )
+        # ...and force the host-side [T, R] fit-scan branch too
+        self._assert_bitexact(
+            self._scalar_reference(batch, result, None, constraints, its),
+            sched._decode(batch, result, None, constraints, its),
+        )
+
+    def test_generic_batch(self):
+        self._solve_and_compare(
+            [
+                make_pod(requests={"cpu": str(1 + i % 3), "memory": f"{512 * (1 + i % 4)}Mi"})
+                for i in range(24)
+            ]
+        )
+
+    def test_fractional_requests_exercise_division(self):
+        # awkward decimal fractions are where a changed divide order would
+        # show up in the last ULP
+        self._solve_and_compare(
+            [
+                make_pod(requests={"cpu": "0.1", "memory": "333Mi"})
+                for _ in range(7)
+            ]
+            + [make_pod(requests={"cpu": "1.3"}) for _ in range(5)]
+        )
+
+    def test_zone_selectors_multiple_signatures(self):
+        catalog = instance_types_assorted()
+        pods = (
+            [make_pod(requests={"cpu": "0.5"}) for _ in range(6)]
+            + [
+                make_pod(
+                    requests={"cpu": "1"},
+                    node_selector={lbl.TOPOLOGY_ZONE: "test-zone-2"},
+                )
+                for _ in range(6)
+            ]
+        )
+        self._solve_and_compare(pods, catalog=catalog)
+
+    def test_hostname_spread_pins_hosts(self):
+        # hostname topology forces node_host >= 0 → the _with_hostname
+        # splice path must match the reference add()-equivalent
+        pods = [
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "web"},
+                topology=[hostname_spread(labels={"app": "web"})],
+            )
+            for _ in range(8)
+        ]
+        self._solve_and_compare(pods)
+
+    def test_randomized_mixed_batches(self):
+        rng = random.Random(7)
+        catalog = instance_types_assorted()
+        for trial in range(5):
+            pods = []
+            for i in range(rng.randint(5, 30)):
+                kwargs = {
+                    "requests": {
+                        "cpu": str(rng.choice(["0.25", "0.5", "1", "2", "3.7"])),
+                        "memory": f"{rng.choice([128, 300, 512, 1000])}Mi",
+                    }
+                }
+                roll = rng.random()
+                if roll < 0.25:
+                    kwargs["node_selector"] = {
+                        lbl.TOPOLOGY_ZONE: f"test-zone-{rng.randint(1, 2)}"
+                    }
+                elif roll < 0.4:
+                    kwargs["labels"] = {"grp": "a"}
+                    kwargs["topology"] = [hostname_spread(labels={"grp": "a"})]
+                pods.append(make_pod(**kwargs))
+            self._solve_and_compare(pods, catalog=catalog)
+
+    def test_empty_batch_decodes_empty(self):
+        self._solve_and_compare([])

@@ -1,0 +1,564 @@
+"""Speculative warm-pool provisioning tests: the wave controller
+(controllers/warmpool.py), the worker's warm-hit steal
+(controllers/provisioning.py), the speculative rungs of the journal
+replay ladder (launch/recovery.py), and the brownout interaction."""
+
+import time
+
+import pytest
+
+from karpenter_tpu import metrics, obs
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.simulated import (
+    SimCloudAPI,
+    SimulatedCloudProvider,
+)
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.warmpool import (
+    WARM_POOL_KEY,
+    WarmPoolController,
+)
+from karpenter_tpu.kube.client import Cluster
+from karpenter_tpu.launch import recovery
+from karpenter_tpu.launch.journal import MemoryLaunchJournal
+from karpenter_tpu.obs.trace import Span
+from karpenter_tpu.resilience.brownout import BrownoutController
+from tests.factories import make_pod, make_provisioner
+
+
+def _span(name, **attrs):
+    return Span(name=name, trace_id="t" * 32, span_id="s" * 16,
+                parent_id=None, parent=None, attrs=attrs)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.configure_decisions("")  # memory-only decision ring per test
+    yield
+    obs.shutdown_forecast()
+    obs.configure_decisions("")
+
+
+class _Env:
+    """One provisioner ('wp'), a simulated cloud, a memory journal, the
+    provisioning controller (warm_pool=True so the steal runs), the wave
+    controller, and a forecaster on a fake clock."""
+
+    def __init__(self, max_nodes=10, ttl=600.0, ownership=None,
+                 provisioner=None, horizon_s=5.0):
+        self.cluster = Cluster()
+        self.api = SimCloudAPI()
+        self.provider = SimulatedCloudProvider(self.api)
+        self.journal = MemoryLaunchJournal()
+        self.prov = provisioner or make_provisioner(name="wp")
+        self.cluster.create("provisioners", self.prov)
+        self.controller = ProvisioningController(
+            self.cluster, self.provider, start_workers=False,
+            journal=self.journal, warm_pool=True,
+        )
+        self.controller.apply(self.prov)
+        self.worker = self.controller.workers[self.prov.metadata.name]
+        self.worker.batcher.idle_duration = 0.01
+        self.wp = WarmPoolController(
+            self.cluster, self.provider, self.controller,
+            journal=self.journal, ownership=ownership,
+            warm_pool_ttl=ttl, max_nodes=max_nodes,
+        )
+        self.clock = FakeClock()
+        self.eng = obs.configure_forecast(
+            bucket_s=1.0, alpha=1.0, default_horizon_s=horizon_s,
+            clock=self.clock,
+        )
+
+    def forecast_demand(self, pods_per_s, pods_per_node=1.0):
+        """Prime the forecaster: one closed 1s bucket of ``pods_per_s``
+        arrivals packing at ``pods_per_node``. With alpha=1 and a single
+        observation the upper band equals the point rate."""
+        self.eng(_span(
+            "provision.round", provisioner=self.prov.metadata.name,
+            batch=pods_per_s, nodes=pods_per_s / pods_per_node,
+        ))
+        self.clock.t += 1.0  # close the bucket
+
+    def warm_nodes(self):
+        return [
+            n for n in self.cluster.nodes()
+            if lbl.WARM_POOL_ANNOTATION in n.metadata.annotations
+        ]
+
+    def stop(self):
+        self.controller.stop()
+
+
+class TestWarmPoolWave:
+    def test_wave_launches_forecast_deficit(self):
+        env = _Env(horizon_s=5.0)
+        try:
+            env.forecast_demand(pods_per_s=2, pods_per_node=2.0)
+            # want = ceil(2 pods/s * 5s / 2 pods-per-node) = 5 nodes
+            assert env.wp.reconcile(WARM_POOL_KEY) == env.wp.interval
+            warm = env.warm_nodes()
+            assert len(warm) == 5
+            assert env.wp.speculative_launches == 5
+            for n in warm:
+                assert n.metadata.labels[lbl.PROVISIONER_NAME_LABEL] == "wp"
+                assert n.metadata.annotations[lbl.WARM_POOL_ANNOTATION] == "true"
+                assert n.metadata.annotations[lbl.LAUNCH_TOKEN_ANNOTATION]
+            # every speculative entry is journaled, marked, and OPEN
+            open_entries = env.journal.unresolved()
+            assert len(open_entries) == 5
+            assert all(e.speculative for e in open_entries)
+            assert all(e.node_name for e in open_entries)
+            # the wave landed in the decision ring for whatif replay
+            waves = [r for r in obs.decision_log().recent(limit=32)
+                     if r.get("state", {}).get("warm_pool_wave")]
+            assert len(waves) == 1
+            assert waves[0]["state"]["deficit"] == 5
+        finally:
+            env.stop()
+
+    def test_standing_capacity_counts_against_want(self):
+        env = _Env()
+        try:
+            env.forecast_demand(pods_per_s=2, pods_per_node=2.0)
+            env.wp.reconcile(WARM_POOL_KEY)
+            first = env.wp.speculative_launches
+            env.wp.reconcile(WARM_POOL_KEY)  # same forecast, pool standing
+            assert env.wp.speculative_launches == first
+            assert len(env.warm_nodes()) == first
+        finally:
+            env.stop()
+
+    def test_max_nodes_caps_speculation(self):
+        env = _Env(max_nodes=3)
+        try:
+            env.forecast_demand(pods_per_s=40)  # wants 200 nodes
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert len(env.warm_nodes()) == 3
+        finally:
+            env.stop()
+
+    def test_no_forecaster_no_speculation(self):
+        env = _Env()
+        try:
+            obs.shutdown_forecast(env.eng)
+            assert env.wp.reconcile(WARM_POOL_KEY) == env.wp.interval
+            assert env.warm_nodes() == []
+        finally:
+            env.stop()
+
+    def test_zero_forecast_no_speculation(self):
+        env = _Env()
+        try:
+            env.wp.reconcile(WARM_POOL_KEY)  # no rounds observed at all
+            assert env.warm_nodes() == []
+            assert env.journal.unresolved() == []
+        finally:
+            env.stop()
+
+    def test_paused_wave_skips(self):
+        env = _Env()
+        try:
+            env.forecast_demand(pods_per_s=4)
+            env.wp.set_paused(True)
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() == []
+            env.wp.set_paused(False)
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() != []
+        finally:
+            env.stop()
+
+    def test_limits_block_speculation(self):
+        from karpenter_tpu.utils import resources as res
+
+        prov = make_provisioner(name="wp", limits={"cpu": "4"})
+        prov.status.resources = {res.CPU: 4.0}
+        env = _Env(provisioner=prov)
+        try:
+            env.forecast_demand(pods_per_s=4)
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() == []
+        finally:
+            env.stop()
+
+
+class TestFencing:
+    def test_fenced_replica_never_speculates(self):
+        class Fenced:
+            def fenced(self):
+                return True
+
+            def owns(self, name):
+                return True
+
+        env = _Env(ownership=Fenced())
+        try:
+            env.forecast_demand(pods_per_s=4)
+            before = metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                reason="fenced"
+            )._value.get()
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() == []
+            assert env.journal.unresolved() == []
+            assert len(env.api.instances) == 0
+            assert metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                reason="fenced"
+            )._value.get() == before + 1
+        finally:
+            env.stop()
+
+    def test_worker_fence_rechecked_per_create(self):
+        """A fence that lands after the wave's top-of-loop check still
+        stops every create (the per-launch re-check)."""
+        env = _Env()
+        try:
+            env.forecast_demand(pods_per_s=4)
+            env.worker.fenced = lambda: True
+            before = metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                reason="fenced"
+            )._value.get()
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() == []
+            assert len(env.api.instances) == 0
+            assert metrics.FLEET_DUPLICATE_LAUNCH_GUARD.labels(
+                reason="fenced"
+            )._value.get() > before
+        finally:
+            env.stop()
+
+    def test_lost_ownership_rechecked_per_create(self):
+        env = _Env()
+        try:
+            env.forecast_demand(pods_per_s=4)
+            env.worker.owned = lambda: False
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert env.warm_nodes() == []
+            assert len(env.api.instances) == 0
+        finally:
+            env.stop()
+
+
+class TestBrownoutInteraction:
+    def test_rung1_freezes_wave_midflight_and_resumes(self):
+        """Brownout rung 1 arriving DURING a wave freezes the not-yet-
+        started launches; dropping back to rung 0 lets the next wave top
+        the pool back up. Deterministic because every create pauses the
+        pool before returning: any launch task started after the first
+        completion sees paused() and freezes."""
+        env = _Env(max_nodes=12)
+        try:
+            env.forecast_demand(pods_per_s=12, pods_per_node=1.0)
+            ctl = BrownoutController(
+                burning_fn=lambda: True, warmpool=env.wp, escalate_after=1,
+            )
+            orig_create = env.provider.create
+
+            def create_then_brownout(request):
+                node = orig_create(request)
+                ctl.tick()  # rung 0 → 1: set_paused(True) mid-wave
+                return node
+
+            env.provider.create = create_then_brownout
+            env.wp.reconcile(WARM_POOL_KEY)
+            frozen_at = env.wp.speculative_launches
+            # the wave wanted 12 (capped); the executor admits at most 8
+            # concurrently, so the freeze provably cut the wave short
+            assert 1 <= frozen_at <= 8
+            assert len(env.warm_nodes()) == frozen_at
+            assert env.wp.paused()
+            # stop() fully reverses: speculation resumes on the next wave
+            env.provider.create = orig_create
+            ctl.stop()
+            assert not env.wp.paused()
+            env.wp.reconcile(WARM_POOL_KEY)
+            assert len(env.warm_nodes()) == 12
+        finally:
+            env.stop()
+
+    def test_standing_nodes_survive_brownout_and_stay_claimable(self):
+        env = _Env()
+        try:
+            env.forecast_demand(pods_per_s=2, pods_per_node=2.0)
+            env.wp.reconcile(WARM_POOL_KEY)
+            standing = len(env.warm_nodes())
+            assert standing > 0
+            ctl = BrownoutController(
+                burning_fn=lambda: True, warmpool=env.wp, escalate_after=1,
+            )
+            ctl.tick()
+            assert env.wp.paused()
+            assert len(env.warm_nodes()) == standing  # nothing torn down
+            # demand still claims warm capacity while speculation is paused
+            pod = make_pod(requests={"cpu": "0.25"})
+            env.cluster.create("pods", pod)
+            env.worker.batcher.add(pod)
+            env.worker.provision_once()
+            bound = env.cluster.get("pods", pod.metadata.name, pod.metadata.namespace)
+            assert bound.spec.node_name in {
+                n.metadata.name for n in env.cluster.nodes()
+            }
+            assert len(env.warm_nodes()) == standing - 1
+            ctl.stop()
+        finally:
+            env.stop()
+
+
+class TestWarmSteal:
+    def _standing_pool(self, env, pods_per_s=2, pods_per_node=2.0):
+        env.forecast_demand(pods_per_s=pods_per_s, pods_per_node=pods_per_node)
+        env.wp.reconcile(WARM_POOL_KEY)
+        warm = env.warm_nodes()
+        assert warm
+        return warm
+
+    def test_hit_binds_claims_and_resolves(self):
+        env = _Env()
+        try:
+            warm = self._standing_pool(env)
+            instances_before = len(env.api.instances)
+            hits_before = metrics.WARMPOOL_HITS.labels(
+                provisioner="wp"
+            )._value.get()
+            # sized to fit the cheapest sim type the speculation launched
+            pods = [make_pod(requests={"cpu": "0.25"}) for _ in range(2)]
+            for p in pods:
+                env.cluster.create("pods", p)
+                env.worker.batcher.add(p)
+            env.worker.provision_once()
+            warm_names = {n.metadata.name for n in warm}
+            for p in pods:
+                bound = env.cluster.get("pods", p.metadata.name, p.metadata.namespace)
+                assert bound.spec.node_name in warm_names
+            # the claim removed the marker and resolved the entry
+            claimed = [
+                n for n in env.cluster.nodes()
+                if n.metadata.name in warm_names
+                and lbl.WARM_POOL_ANNOTATION not in n.metadata.annotations
+            ]
+            assert len(claimed) >= 1
+            open_tokens = {e.node_name for e in env.journal.unresolved()}
+            for n in claimed:
+                assert n.metadata.name not in open_tokens
+            # a hit pays no launch
+            assert len(env.api.instances) == instances_before
+            assert metrics.WARMPOOL_HITS.labels(
+                provisioner="wp"
+            )._value.get() == hits_before + 2
+        finally:
+            env.stop()
+
+    def test_stolen_round_still_records_a_decision(self):
+        """A round fully absorbed by the steal must land in the decision
+        ring (state.warm_claim) — whatif replays the ring as the demand
+        record, and a missing round under-counts arrivals by exactly the
+        hit rate."""
+        env = _Env()
+        try:
+            self._standing_pool(env)
+            pod = make_pod(requests={"cpu": "0.25"})
+            env.cluster.create("pods", pod)
+            env.worker.batcher.add(pod)
+            env.worker.provision_once()
+            claims = [r for r in obs.decision_log().recent(limit=32)
+                      if r.get("state", {}).get("warm_claim")]
+            assert len(claims) == 1
+            rec = claims[0]
+            assert rec["provisioner"] == "wp"
+            assert rec["pods_considered"] == 1
+            assert rec["unschedulable_count"] == 0
+            assert rec["state"]["warm_nodes"]
+        finally:
+            env.stop()
+
+    def test_selector_mismatch_misses(self):
+        env = _Env()
+        try:
+            self._standing_pool(env)
+            misses_before = metrics.WARMPOOL_MISSES.labels(
+                provisioner="wp"
+            )._value.get()
+            pod = make_pod(requests={"cpu": "1"},
+                           node_selector={"disk": "nvme"})
+            env.cluster.create("pods", pod)
+            env.worker.batcher.add(pod)
+            env.worker.provision_once()
+            assert metrics.WARMPOOL_MISSES.labels(
+                provisioner="wp"
+            )._value.get() > misses_before
+            # warm pool untouched — the selector can't match the template
+            assert all(
+                lbl.WARM_POOL_ANNOTATION in n.metadata.annotations
+                for n in env.warm_nodes()
+            )
+        finally:
+            env.stop()
+
+    def test_lost_claim_falls_back_to_solver(self):
+        env = _Env()
+        try:
+            self._standing_pool(env)
+            orig = env.cluster.merge_patch
+
+            def failing_patch(kind, name, patch, namespace=""):
+                if kind == "nodes":
+                    raise RuntimeError("node raced away")
+                return orig(kind, name, patch, namespace=namespace)
+
+            env.cluster.merge_patch = failing_patch
+            pod = make_pod(requests={"cpu": "0.25"})
+            env.cluster.create("pods", pod)
+            env.worker.batcher.add(pod)
+            env.worker.provision_once()
+            env.cluster.merge_patch = orig
+            bound = env.cluster.get("pods", pod.metadata.name, pod.metadata.namespace)
+            assert bound.spec.node_name  # solver provided after the lost claim
+            # the un-claimed warm nodes keep their marker (TTL will reap)
+            assert env.warm_nodes()
+        finally:
+            env.stop()
+
+
+class TestSpeculativeReplayLadder:
+    """The GC replay rungs for speculative entries — including the
+    regression this PR fixes: an entry past the TTL is GC-eligible EVEN
+    THOUGH its instance is alive and tracked."""
+
+    def _standing(self, env):
+        # one pod per horizon at 5 pods-per-node → exactly one warm node
+        env.forecast_demand(pods_per_s=1, pods_per_node=5.0)
+        env.wp.reconcile(WARM_POOL_KEY)
+        entries = env.journal.unresolved()
+        assert len(entries) == 1
+        return entries[0]
+
+    @staticmethod
+    def _forget_node(env, name):
+        """Simulate the crash that ate the Node write: drop the object
+        (finalizers cleared so the fake apiserver really deletes)."""
+        node = env.cluster.get("nodes", name, "")
+        node.metadata.finalizers = []
+        env.cluster.delete("nodes", name, namespace="")
+
+    def _by_token(self, env):
+        return {i.launch_token: i for i in env.provider.list_instances()
+                if i.launch_token}
+
+    def _replay(self, env, entry, now):
+        return recovery.replay_entry(
+            env.journal, env.cluster, env.provider, entry,
+            self._by_token(env), now=now, replay_after=0.0,
+            warm_pool_ttl=env.wp.warm_pool_ttl,
+        )
+
+    def test_standing_within_ttl_stays_open(self):
+        env = _Env(ttl=600.0)
+        try:
+            entry = self._standing(env)
+            out = self._replay(env, entry, now=entry.created_at + 10)
+            assert out == recovery.PENDING
+            assert env.journal.get(entry.token) is not None
+            assert env.warm_nodes()  # untouched
+        finally:
+            env.stop()
+
+    def test_claimed_entry_resolves(self):
+        env = _Env()
+        try:
+            entry = self._standing(env)
+            env.cluster.merge_patch(
+                "nodes", entry.node_name,
+                {"metadata": {"annotations": {lbl.WARM_POOL_ANNOTATION: None}}},
+                namespace="",
+            )
+            out = self._replay(env, entry, now=entry.created_at + 10)
+            assert out == recovery.NODE_EXISTS
+            assert env.journal.get(entry.token) is None
+            # claimed node is NOT reaped
+            assert env.cluster.try_get(
+                "nodes", entry.node_name, namespace=""
+            ) is not None
+        finally:
+            env.stop()
+
+    def test_expired_standing_entry_reaped_despite_live_instance(self):
+        """THE regression: live instance + tracked Node + open speculative
+        entry past TTL → reclaim instance AND node AND entry. Without the
+        TTL rung the open entry protects the instance forever."""
+        env = _Env(ttl=60.0)
+        try:
+            entry = self._standing(env)
+            assert self._by_token(env)  # instance is alive
+            out = self._replay(env, entry, now=entry.created_at + 61)
+            assert out == recovery.SPECULATION_EXPIRED
+            assert env.journal.get(entry.token) is None
+            assert entry.token not in self._by_token(env)  # terminated
+            assert env.cluster.try_get(
+                "nodes", entry.node_name, namespace=""
+            ) is None
+            # nothing leaked: every live instance maps to a node
+            assert env.provider.list_instances() == []
+        finally:
+            env.stop()
+
+    def test_expired_untracked_instance_reaped(self):
+        env = _Env(ttl=60.0)
+        try:
+            entry = self._standing(env)
+            self._forget_node(env, entry.node_name)
+            out = self._replay(env, entry, now=entry.created_at + 61)
+            assert out == recovery.SPECULATION_EXPIRED
+            assert env.journal.get(entry.token) is None
+            assert entry.token not in self._by_token(env)
+        finally:
+            env.stop()
+
+    def test_untracked_within_ttl_adopted_back_into_pool(self):
+        env = _Env(ttl=600.0)
+        try:
+            entry = self._standing(env)
+            self._forget_node(env, entry.node_name)
+            out = self._replay(env, entry, now=entry.created_at + 10)
+            assert out == recovery.ADOPTED
+            # entry stays open (the TTL breadcrumb) and the node carries
+            # the warm marker again — claimable standing capacity
+            assert env.journal.get(entry.token) is not None
+            adopted = env.warm_nodes()
+            assert len(adopted) == 1
+            assert adopted[0].metadata.annotations[
+                lbl.LAUNCH_TOKEN_ANNOTATION
+            ] == entry.token
+        finally:
+            env.stop()
+
+    def test_gc_controller_reaps_expired_speculation(self):
+        """End-to-end through the GC sweep: short TTL, clock advanced past
+        it → the sweep reclaims the warm node and closes the journal."""
+        from karpenter_tpu.controllers.garbage_collection import (
+            GarbageCollectionController,
+        )
+
+        env = _Env(ttl=0.05)
+        try:
+            entry = self._standing(env)
+            gc = GarbageCollectionController(
+                env.cluster, env.provider, journal=env.journal,
+                gc_interval=0.01, replay_after=0.0, warm_pool_ttl=0.05,
+            )
+            deadline = time.time() + 5.0
+            while time.time() < deadline and env.journal.unresolved():
+                gc.reconcile("__gc__")
+                time.sleep(0.02)
+            assert env.journal.unresolved() == []
+            assert env.warm_nodes() == []
+            assert entry.token not in self._by_token(env)
+        finally:
+            env.stop()

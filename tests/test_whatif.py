@@ -1,0 +1,251 @@
+"""tools/whatif.py: the decision-ring what-if simulator — loaders over a
+real on-disk ring, the discrete-event counterfactual, and the CLI."""
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu import obs
+from tools import whatif
+
+
+@pytest.fixture()
+def ring(tmp_path):
+    """A real on-disk decision ring written through the production log
+    (write_interval=0 → every round persists)."""
+    d = str(tmp_path / "ring")
+    log = obs.configure_decisions(d, write_interval=0.0)
+    yield d, log
+    obs.configure_decisions("")
+
+
+def _record_round(log, provisioner, pods_considered, state=None):
+    from types import SimpleNamespace
+
+    pods = [
+        SimpleNamespace(
+            metadata=SimpleNamespace(name=f"p{i}", namespace="default"),
+            key=f"default/p{i}",
+        )
+        for i in range(pods_considered)
+    ]
+    rec = log.record_round(
+        provisioner=provisioner,
+        pods=pods,
+        nodes=[SimpleNamespace(instance_type_options=[], pods=list(pods))]
+        if pods else [],
+        trace_id="t" * 32,
+        state=state or {},
+    )
+    # drain the async writer: rapid-fire test writes would overflow its
+    # bounded queue (best-effort drops are the production behavior)
+    log.flush()
+    return rec
+
+
+class TestLoaders:
+    def test_load_records_roundtrip(self, ring):
+        d, log = ring
+        _record_round(log, "a", 3)
+        _record_round(log, "a", 5)
+        log.flush()
+        records = whatif.load_records(d)
+        assert len(records) == 2
+        assert [r["pods_considered"] for r in records] == [3, 5]
+        assert all("recorded_at" in r for r in records)
+
+    def test_load_records_skips_garbage(self, ring):
+        d, log = ring
+        _record_round(log, "a", 1)
+        log.flush()
+        with open(os.path.join(d, "decision-9999999999999-zzzzzz-bad.json"),
+                  "w") as f:
+            f.write("{not json")
+        assert len(whatif.load_records(d)) == 1
+
+    def test_load_records_missing_dir(self):
+        assert whatif.load_records("/nonexistent/ring") == []
+
+    def test_load_series_excludes_wave_records(self, ring):
+        d, log = ring
+        _record_round(log, "a", 4)
+        _record_round(log, "a", 0, state={"warm_pool_wave": True,
+                                          "deficit": 3})
+        _record_round(log, "a", 2, state={"warm_claim": True})
+        _record_round(log, "b", 7)
+        log.flush()
+        series = whatif.load_series(d)
+        assert sorted(series) == ["a", "b"]
+        # the wave audit entry is not demand; the warm CLAIM is
+        assert [p for _, p in series["a"]] == [4.0, 2.0]
+        assert [p for _, p in series["b"]] == [7.0]
+
+    def test_load_series_provisioner_filter(self, ring):
+        d, log = ring
+        _record_round(log, "a", 1)
+        _record_round(log, "b", 1)
+        log.flush()
+        assert sorted(whatif.load_series(d, provisioner="b")) == ["b"]
+
+    def test_measured_pods_per_node(self):
+        records = [
+            {"pods_considered": 8, "nodes": 2},
+            {"pods_considered": 4, "nodes": 1},
+            {"pods_considered": 0, "nodes": 0},  # placing rounds only
+            {"pods_considered": 99, "nodes": 1,
+             "state": {"warm_pool_wave": True}},  # audit entry excluded
+        ]
+        assert whatif.measured_pods_per_node(records) == pytest.approx(4.0)
+        assert whatif.measured_pods_per_node([]) == 1.0
+
+
+class TestSimulate:
+    def _steady(self, n=60, period=5.0, pods=4.0):
+        return [(1000.0 + i * period, pods) for i in range(n)]
+
+    def test_empty_series(self):
+        out = whatif.simulate([])
+        assert out["pods"] == 0
+        assert out["warm_hit_rate"] == 0.0
+        assert out["speculative_launches"] == 0
+
+    def test_deterministic(self):
+        series = self._steady()
+        kwargs = dict(warm_pool_ttl=60.0, max_nodes=8, interval_s=5.0,
+                      launch_to_ready_s=30.0, bind_latency_s=1.0,
+                      pods_per_node=4.0, alpha=0.5, bucket_s=5.0,
+                      horizon_s=30.0)
+        assert whatif.simulate(series, **kwargs) == whatif.simulate(
+            series, **kwargs
+        )
+
+    def test_warm_pool_beats_cold_on_steady_demand(self):
+        out = whatif.simulate(
+            self._steady(), warm_pool_ttl=120.0, max_nodes=10,
+            interval_s=5.0, launch_to_ready_s=30.0, bind_latency_s=1.0,
+            pods_per_node=4.0, alpha=0.5, bucket_s=5.0, horizon_s=30.0,
+        )
+        assert out["pods"] == 240
+        # the cold ramp (nothing warm until the first wave is ready)
+        # bounds the hit rate below 1.0; steady state is all hits
+        assert 0.5 < out["warm_hit_rate"] < 1.0
+        assert out["p99_without_pool_s"] == 30.0
+        assert out["p99_with_pool_s"] <= out["p99_without_pool_s"]
+        assert out["speculative_launches"] > 0
+        assert out["speculative_cost_usd"] >= 0.0
+
+    def test_long_window_p99_drops_to_bind_latency(self):
+        # long enough that the cold ramp is under 1% of arrivals: the
+        # with-pool p99 is the warm bind, not the cold launch
+        out = whatif.simulate(
+            self._steady(n=800, period=5.0, pods=4.0),
+            warm_pool_ttl=120.0, max_nodes=10, interval_s=5.0,
+            launch_to_ready_s=20.0, bind_latency_s=1.0, pods_per_node=4.0,
+            alpha=0.5, bucket_s=5.0, horizon_s=20.0,
+        )
+        assert out["p99_with_pool_s"] == 1.0
+        assert out["p99_without_pool_s"] == 20.0
+
+    def test_zero_max_nodes_is_the_cold_baseline(self):
+        out = whatif.simulate(
+            self._steady(), max_nodes=0, interval_s=5.0,
+            launch_to_ready_s=30.0, pods_per_node=4.0, bucket_s=5.0,
+        )
+        assert out["warm_hits"] == 0
+        assert out["speculative_launches"] == 0
+        assert out["p99_with_pool_s"] == 30.0
+
+    def test_unclaimed_speculation_expires_and_is_billed(self):
+        # one early burst, then silence: the pool it bought must expire
+        series = [(0.0, 10.0), (5.0, 10.0)] + [
+            (10.0 + i * 5.0, 0.0) for i in range(30)
+        ]
+        out = whatif.simulate(
+            series, warm_pool_ttl=20.0, max_nodes=6, interval_s=5.0,
+            launch_to_ready_s=10.0, pods_per_node=2.0, alpha=0.9,
+            bucket_s=5.0, horizon_s=10.0,
+        )
+        assert out["speculative_launches"] > 0
+        # every speculative node was either claimed or expired — the
+        # bill covers all of them (node-hours > 0) and none linger
+        assert out["speculative_expired"] > 0
+        assert out["speculative_node_hours"] > 0.0
+
+    def test_tighter_ttl_costs_less(self):
+        series = [(0.0, 8.0), (5.0, 8.0)] + [
+            (10.0 + i * 5.0, 0.0) for i in range(60)
+        ]
+        kwargs = dict(max_nodes=8, interval_s=5.0, launch_to_ready_s=10.0,
+                      pods_per_node=2.0, alpha=0.9, bucket_s=5.0,
+                      horizon_s=10.0)
+        loose = whatif.simulate(series, warm_pool_ttl=300.0, **kwargs)
+        tight = whatif.simulate(series, warm_pool_ttl=30.0, **kwargs)
+        assert tight["speculative_node_hours"] < loose[
+            "speculative_node_hours"
+        ]
+
+
+class TestWhatifEntryPoint:
+    def test_per_provisioner_panels_and_combined(self, ring):
+        d, log = ring
+        for _ in range(10):
+            _record_round(log, "a", 4)
+            _record_round(log, "b", 2)
+        log.flush()
+        out = whatif.whatif(d, interval_s=5.0, launch_to_ready_s=30.0,
+                            horizon_s=30.0, bucket_s=5.0)
+        assert sorted(out["provisioners"]) == ["a", "b"]
+        assert out["records"] == 20
+        assert out["combined"]["pods"] == 60
+        # pods_per_node defaulted to the window-measured ratio
+        assert out["pods_per_node"] == pytest.approx(3.0)
+
+    def test_pods_per_node_override(self, ring):
+        d, log = ring
+        _record_round(log, "a", 4)
+        log.flush()
+        out = whatif.whatif(d, pods_per_node=7.5)
+        assert out["pods_per_node"] == 7.5
+
+
+class TestCli:
+    def test_exit_2_on_empty_ring(self, tmp_path, capsys):
+        assert whatif.main(["--decision-dir", str(tmp_path)]) == 2
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["records"] == 0
+
+    def test_prints_panel(self, ring, capsys):
+        d, log = ring
+        for _ in range(5):
+            _record_round(log, "a", 3)
+        log.flush()
+        assert whatif.main([
+            "--decision-dir", d, "--interval-s", "5",
+            "--launch-to-ready-s", "20", "--horizon-s", "20",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["provisioners"]["a"]["pods"] == 15
+        assert "warm_hit_rate" in doc["combined"]
+
+    def test_ttl_sweep(self, ring, capsys):
+        d, log = ring
+        for _ in range(5):
+            _record_round(log, "a", 3)
+        log.flush()
+        assert whatif.main([
+            "--decision-dir", d, "--sweep-ttl", "30,300",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [run["warm_pool_ttl"] for run in doc["sweep"]] == [30.0, 300.0]
+
+    def test_seasonal_flag(self, ring, capsys):
+        d, log = ring
+        for _ in range(5):
+            _record_round(log, "a", 3)
+        log.flush()
+        assert whatif.main([
+            "--decision-dir", d, "--seasonal", "--season-len", "12",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["params"]["model"] == "holt-winters"

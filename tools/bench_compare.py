@@ -68,6 +68,12 @@ HEADLINE_KEYS: Dict[str, int] = {
     # attribution on the headline leg (bar: < 1). Missing on pre-decision
     # rounds is reported, never fatal (the standard new-key salvage).
     "explain_overhead_pct": -1,
+    # predictive provisioning (docs/forecasting.md): the warm pool's hit
+    # rate and the resulting time-to-ready p99 on the forecast-storm leg.
+    # Missing on pre-forecast rounds (or runs without the leg) is
+    # reported, never fatal (the standard new-key salvage).
+    "warm_hit_rate": +1,
+    "time_to_ready_p99_s": -1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"

@@ -1,0 +1,407 @@
+"""What-if simulator: replay a recorded decision window against a
+hypothetical warm-pool policy, offline.
+
+The decision audit ring (docs/decisions.md) already records every
+provisioning round — when it happened (``recorded_at``), which
+provisioner, and how many pods were considered. That IS the arrival
+series the forecaster (karpenter_tpu/forecast) would have seen live. This
+tool re-runs that series through the real forecast models and a
+discrete-event model of the warm-pool controller's wave/claim/TTL
+lifecycle, so an operator can answer "what would the time-to-ready tail
+and the speculation bill have looked like under THESE knobs" from a
+support bundle, without touching the fleet:
+
+    python -m tools.whatif --decision-dir DIR
+    python -m tools.whatif --decision-dir DIR --warm-pool-ttl 300 \
+        --seasonal --launch-to-ready-s 120 --node-price-per-h 4.2
+
+Outputs one JSON document: per-provisioner predicted warm-hit rate,
+time-to-ready p99 with and without the pool, speculative node-hours and
+their $-cost. ``--sweep-ttl`` compares several TTLs in one run.
+
+The same entry points are a library: ``bench.py``'s forecast-storm leg
+calls :func:`load_series` + :func:`simulate` over the ring it just
+recorded and cross-checks the predicted warm-hit rate against what the
+live controller actually measured (the acceptance gate is agreement
+within 20%).
+
+``--replay`` additionally re-solves the newest replayable record through
+``tools.replay_decision`` first — proving the window itself reproduces
+bit-exact before trusting counterfactuals built on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.forecast import (
+    DEFAULT_BAND_SIGMA,
+    DEFAULT_BUCKET_S,
+    MODEL_EWMA,
+    MODEL_HOLT_WINTERS,
+    ShardForecast,
+)
+
+# Defaults mirror the live controller's knobs (options.py) so a bare
+# `python -m tools.whatif --decision-dir DIR` models the shipped policy.
+DEFAULT_TTL_S = 600.0
+DEFAULT_MAX_WARM_NODES = 10
+DEFAULT_WAVE_INTERVAL_S = 10.0
+DEFAULT_LAUNCH_TO_READY_S = 90.0
+DEFAULT_BIND_LATENCY_S = 2.0
+# GCE a2-highgpu-ish list price; purely illustrative — override it.
+DEFAULT_NODE_PRICE_PER_H = 3.67
+
+
+# -- decision-ring intake ----------------------------------------------------
+
+
+def load_records(decision_dir: str) -> List[Dict[str, Any]]:
+    """Every parseable ``decision-*.json`` in the ring, oldest first
+    (lexicographic filename IS time order — the flight-recorder
+    discipline). Unreadable files are skipped, not fatal: a pruned ring
+    mid-read is normal."""
+    try:
+        names = sorted(
+            n for n in os.listdir(decision_dir)
+            if n.startswith("decision-") and n.endswith(".json")
+        )
+    except OSError:
+        return []
+    out: List[Dict[str, Any]] = []
+    for name in names:
+        path = os.path.join(decision_dir, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if "recorded_at" not in rec:
+            # older record shape: fall back to the filename's ms stamp
+            try:
+                rec["recorded_at"] = int(name.split("-")[1]) / 1e3
+            except (IndexError, ValueError):
+                continue
+        out.append(rec)
+    return out
+
+
+def load_series(
+    decision_dir: str, provisioner: Optional[str] = None
+) -> Dict[str, List[Tuple[float, float]]]:
+    """Per-provisioner arrival series ``[(t, pods), ...]`` from the ring.
+
+    Warm-pool wave records (``state.warm_pool_wave``) are audit entries,
+    not demand — they are excluded so a pool that was ALREADY running
+    does not feed its own speculation back into the counterfactual."""
+    series: Dict[str, List[Tuple[float, float]]] = {}
+    for rec in load_records(decision_dir):
+        if (rec.get("state") or {}).get("warm_pool_wave"):
+            continue
+        name = rec.get("provisioner") or ""
+        if not name or (provisioner and name != provisioner):
+            continue
+        pods = float(rec.get("pods_considered") or 0.0)
+        series.setdefault(name, []).append((float(rec["recorded_at"]), pods))
+    for points in series.values():
+        points.sort(key=lambda p: p[0])
+    return series
+
+
+def measured_pods_per_node(records: Iterable[Dict[str, Any]]) -> float:
+    """Mean pods-per-node over rounds that placed anything — the same
+    unit conversion the live forecaster learns from round spans."""
+    ratios = [
+        float(r["pods_considered"]) / float(r["nodes"])
+        for r in records
+        if float(r.get("nodes") or 0) > 0
+        and float(r.get("pods_considered") or 0) > 0
+        and not (r.get("state") or {}).get("warm_pool_wave")
+    ]
+    if not ratios:
+        return 1.0
+    return max(sum(ratios) / len(ratios), 1.0)
+
+
+# -- the counterfactual ------------------------------------------------------
+
+
+def _p99(values: Sequence[float]) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(int(math.ceil(len(ordered) * 0.99)) - 1, len(ordered) - 1)
+    return ordered[max(idx, 0)]
+
+
+def simulate(
+    series: Sequence[Tuple[float, float]],
+    *,
+    warm_pool_ttl: float = DEFAULT_TTL_S,
+    max_nodes: int = DEFAULT_MAX_WARM_NODES,
+    interval_s: float = DEFAULT_WAVE_INTERVAL_S,
+    launch_to_ready_s: float = DEFAULT_LAUNCH_TO_READY_S,
+    bind_latency_s: float = DEFAULT_BIND_LATENCY_S,
+    pods_per_node: float = 1.0,
+    model: str = MODEL_EWMA,
+    alpha: float = 0.3,
+    season_len: int = 24,
+    bucket_s: float = DEFAULT_BUCKET_S,
+    band_sigma: float = DEFAULT_BAND_SIGMA,
+    horizon_s: float = DEFAULT_LAUNCH_TO_READY_S,
+    node_price_per_h: float = DEFAULT_NODE_PRICE_PER_H,
+) -> Dict[str, Any]:
+    """Discrete-event replay of ONE provisioner's arrival series under a
+    warm-pool policy.
+
+    The event loop mirrors controllers/warmpool.py and the worker claim
+    path exactly: a wave every ``interval_s`` sizes the pool off the
+    forecaster's UPPER band over one horizon (ceil(pods/pods_per_node),
+    capped at ``max_nodes`` standing); a speculative node becomes
+    claimable ``launch_to_ready_s`` after its wave and is TTL-reclaimed
+    ``warm_pool_ttl`` after it unless demand lands first. An arriving pod
+    claims a ready warm node (time-to-ready = ``bind_latency_s``) when
+    one fits, else pays the full cold ``launch_to_ready_s``. The no-pool
+    baseline is the same series with every pod cold.
+
+    Returns the prediction panel ``bench.py`` cross-checks against the
+    live run: warm-hit rate, both p99s, and the speculation bill."""
+    pods_per_node = max(float(pods_per_node), 1.0)
+    shard = ShardForecast(
+        bucket_s=bucket_s, model=model, alpha=alpha, season_len=season_len
+    )
+    # each speculative node: [ready_at, expires_at, slots_left]
+    warm: List[List[float]] = []
+    latencies: List[float] = []
+    hits = 0
+    total_pods = 0
+    launched = 0
+    expired = 0
+    node_seconds = 0.0  # speculative life: launch -> claim/expiry
+
+    if not series:
+        return {
+            "pods": 0, "warm_hits": 0, "warm_hit_rate": 0.0,
+            "p99_with_pool_s": 0.0, "p99_without_pool_s": 0.0,
+            "speculative_launches": 0, "speculative_expired": 0,
+            "speculative_node_hours": 0.0, "speculative_cost_usd": 0.0,
+        }
+
+    t0 = series[0][0]
+    t_end = series[-1][0]
+    arrivals = list(series)
+    ai = 0
+    t = t0
+    while t <= t_end + interval_s:
+        # arrivals BEFORE this wave tick, in order (the worker's steal
+        # runs on every round; waves only add capacity)
+        while ai < len(arrivals) and arrivals[ai][0] <= t:
+            at, count = arrivals[ai]
+            ai += 1
+            shard.observe(count, at)
+            n = int(count)
+            if n <= 0:
+                continue
+            total_pods += n
+            # TTL-expire first, oldest first — the controller's name-sort
+            # makes claiming deterministic too
+            still: List[List[float]] = []
+            for node in warm:
+                if node[1] <= at and node[2] > 0:
+                    expired += 1
+                    node_seconds += node[1] - (node[0] - launch_to_ready_s)
+                else:
+                    still.append(node)
+            warm = still
+            # claim ready nodes for this tick's batch: a claimed node
+            # serves up to its slot count from THIS batch, then leaves
+            # the pool even partially filled — exactly the live steal
+            # (the claim patch removes the warm marker, so a node claimed
+            # by a small batch is spent capacity)
+            pods_left = n
+            for node in warm:
+                if pods_left <= 0:
+                    break
+                if node[0] <= at and node[2] > 0:
+                    take = min(int(node[2]), pods_left)
+                    hits += take
+                    pods_left -= take
+                    latencies.extend([bind_latency_s] * take)
+                    node_seconds += at - (node[0] - launch_to_ready_s)
+                    node[2] = 0
+            latencies.extend([launch_to_ready_s] * pods_left)
+            warm = [x for x in warm if x[2] > 0]
+        # the wave: size the pool off the upper band, like _wave does
+        point, upper = shard.rate(t, band_sigma=band_sigma)
+        want = int(math.ceil((upper * horizon_s) / pods_per_node))
+        standing = len(warm)
+        deficit = min(want, max_nodes) - standing
+        for _ in range(max(deficit, 0)):
+            warm.append([
+                t + launch_to_ready_s, t + warm_pool_ttl, pods_per_node,
+            ])
+            launched += 1
+        t += interval_s
+    # drain: whatever is still standing at the end expires at its TTL
+    for node in warm:
+        if node[2] > 0:
+            expired += 1
+            node_seconds += node[1] - (node[0] - launch_to_ready_s)
+
+    hours = node_seconds / 3600.0
+    return {
+        "pods": total_pods,
+        "warm_hits": hits,
+        "warm_hit_rate": (hits / total_pods) if total_pods else 0.0,
+        "p99_with_pool_s": _p99(latencies),
+        "p99_without_pool_s": launch_to_ready_s if total_pods else 0.0,
+        "speculative_launches": launched,
+        "speculative_expired": expired,
+        "speculative_node_hours": round(hours, 4),
+        "speculative_cost_usd": round(hours * node_price_per_h, 2),
+    }
+
+
+def whatif(
+    decision_dir: str,
+    provisioner: Optional[str] = None,
+    **params: Any,
+) -> Dict[str, Any]:
+    """The library entry point: ring directory -> per-provisioner
+    counterfactual panels. ``params`` are :func:`simulate` keywords;
+    ``pods_per_node`` defaults to the ratio measured FROM the window
+    itself (the live forecaster's EWMA does the same job online)."""
+    records = load_records(decision_dir)
+    series = load_series(decision_dir, provisioner=provisioner)
+    if "pods_per_node" not in params:
+        params["pods_per_node"] = measured_pods_per_node(records)
+    out: Dict[str, Any] = {
+        "decision_dir": decision_dir,
+        "records": len(records),
+        "pods_per_node": params["pods_per_node"],
+        "params": {
+            k: v for k, v in sorted(params.items()) if k != "pods_per_node"
+        },
+        "provisioners": {
+            name: simulate(points, **params)
+            for name, points in sorted(series.items())
+        },
+    }
+    panels = out["provisioners"].values()
+    pods = sum(p["pods"] for p in panels)
+    hits = sum(p["warm_hits"] for p in panels)
+    out["combined"] = {
+        "pods": pods,
+        "warm_hit_rate": (hits / pods) if pods else 0.0,
+        "speculative_cost_usd": round(
+            sum(p["speculative_cost_usd"] for p in panels), 2
+        ),
+    }
+    return out
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="whatif",
+        description="replay a recorded decision window against a "
+        "hypothetical warm-pool policy and print the predicted "
+        "time-to-ready / cost panel",
+    )
+    ap.add_argument("--decision-dir", required=True,
+                    help="decision audit ring directory")
+    ap.add_argument("--provisioner", default=None,
+                    help="limit to one provisioner (default: all)")
+    ap.add_argument("--warm-pool-ttl", type=float, default=DEFAULT_TTL_S)
+    ap.add_argument("--max-warm-nodes", type=int,
+                    default=DEFAULT_MAX_WARM_NODES)
+    ap.add_argument("--interval-s", type=float,
+                    default=DEFAULT_WAVE_INTERVAL_S,
+                    help="warm-pool wave interval")
+    ap.add_argument("--launch-to-ready-s", type=float,
+                    default=DEFAULT_LAUNCH_TO_READY_S,
+                    help="cold launch-to-schedulable latency to model")
+    ap.add_argument("--bind-latency-s", type=float,
+                    default=DEFAULT_BIND_LATENCY_S,
+                    help="warm-claim bind latency to model")
+    ap.add_argument("--horizon-s", type=float,
+                    default=DEFAULT_LAUNCH_TO_READY_S,
+                    help="forecast horizon (live: measured ready p99)")
+    ap.add_argument("--pods-per-node", type=float, default=None,
+                    help="override the window-measured pods/node ratio")
+    ap.add_argument("--ewma-alpha", type=float, default=0.3)
+    ap.add_argument("--seasonal", action="store_true",
+                    help="use the Holt-Winters seasonal model")
+    ap.add_argument("--season-len", type=int, default=24)
+    ap.add_argument("--band-sigma", type=float, default=DEFAULT_BAND_SIGMA)
+    ap.add_argument("--node-price-per-h", type=float,
+                    default=DEFAULT_NODE_PRICE_PER_H)
+    ap.add_argument("--sweep-ttl", default="",
+                    help="comma-separated TTLs to compare (overrides "
+                    "--warm-pool-ttl)")
+    ap.add_argument("--replay", action="store_true",
+                    help="first re-solve the newest replayable record "
+                    "bit-exact (tools.replay_decision)")
+    args = ap.parse_args(argv)
+
+    replay_verdict: Optional[Dict[str, Any]] = None
+    if args.replay:
+        from tools import replay_decision
+
+        path = replay_decision.find_record(args.decision_dir)
+        if path:
+            try:
+                replay_verdict = replay_decision.replay(
+                    replay_decision.load_record(path), record_path=path
+                )
+            except (ValueError, RuntimeError, OSError) as e:
+                replay_verdict = {"ok": None, "diff": str(e)}
+
+    params: Dict[str, Any] = dict(
+        max_nodes=args.max_warm_nodes,
+        interval_s=args.interval_s,
+        launch_to_ready_s=args.launch_to_ready_s,
+        bind_latency_s=args.bind_latency_s,
+        horizon_s=args.horizon_s,
+        model=MODEL_HOLT_WINTERS if args.seasonal else MODEL_EWMA,
+        alpha=args.ewma_alpha,
+        season_len=args.season_len,
+        band_sigma=args.band_sigma,
+        node_price_per_h=args.node_price_per_h,
+    )
+    if args.pods_per_node is not None:
+        params["pods_per_node"] = args.pods_per_node
+
+    ttls = (
+        [float(x) for x in args.sweep_ttl.split(",") if x.strip()]
+        if args.sweep_ttl else [args.warm_pool_ttl]
+    )
+    runs = [
+        whatif(args.decision_dir, provisioner=args.provisioner,
+               warm_pool_ttl=ttl, **params)
+        for ttl in ttls
+    ]
+    doc: Dict[str, Any] = runs[0] if len(runs) == 1 else {
+        "sweep": [
+            {"warm_pool_ttl": ttl, **run}
+            for ttl, run in zip(ttls, runs)
+        ]
+    }
+    if replay_verdict is not None:
+        doc["replay"] = replay_verdict
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    if not runs[0].get("records"):
+        print("whatif: no decision records found", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
