@@ -97,6 +97,10 @@ partition-chaos:  ## control-plane partition proof: transport/fencing suites + t
 	$(PY) -m pytest tests/test_partition.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --partition-storm 240
 
+consolidation-chaos:  ## disruption-safe consolidation proof: budget/repack/wave suites + the mid-wave-kill re-pack storm leg
+	$(PY) -m pytest tests/test_consolidation.py tests/test_disruption_budget.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --consolidation-storm 48 --solver ffd
+
 FORECAST_STORM_S ?= 30
 forecast-chaos:  ## predictive-provisioning proof: forecast/warm-pool/what-if suites + the diurnal+flash storm leg, cold vs warm
 	$(PY) -m pytest tests/test_forecast.py tests/test_warmpool.py tests/test_whatif.py -q -m 'not slow' $(TESTFLAGS)
@@ -134,5 +138,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace profile-smoke benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos partition-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos partition-chaos consolidation-chaos forecast-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

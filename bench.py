@@ -2814,6 +2814,451 @@ def _sample(m, name: str) -> float:
     return total
 
 
+def bench_consolidation_storm(
+    n_pods: int = 48,
+    n_provisioners: int = 2,
+    n_replicas: int = 3,
+    lease_duration: float = 1.5,
+    renew_interval: float = 0.3,
+    gc_interval: float = 1.0,
+    replay_after: float = 12.0,
+    budget: str = "2",
+    wave_size: int = 3,
+    error_rate: float = 0.05,
+    seed: int = 20260807,
+    solver: str = "ffd",
+):
+    """Disruption-safe consolidation storm (docs/consolidation.md): N
+    replicas over one cluster run budgeted, journaled re-pack waves at
+    ~70% utilization while pods churn, the cloud API injects seeded
+    errors, and one replica is killed MID-WAVE (first victim cordoned,
+    nothing else done — the exact window the journal entry exists for).
+    Bars: zero evicted-unready pods, zero budget violations (never more
+    than ``budget`` concurrently-disrupted nodes per provisioner), zero
+    leaked/duplicate instances, the crashed wave replayed by a survivor
+    (victim un-cordoned, entry resolved), and every surviving pod bound
+    at the end. Reports the headline pair: consolidation_nodes_reclaimed
+    and consolidation_cost_delta_usd (negative = cheaper cluster)."""
+    import tempfile
+    import threading
+
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.api import labels as lbl
+    from karpenter_tpu.api.objects import (
+        NodeSelectorRequirement,
+        OwnerReference,
+        PodCondition,
+    )
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.interruption.types import DisruptionNotice
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.testing.chaos import (
+        ChaosPolicy,
+        LaunchCrash,
+        ReplicaChaos,
+        chaos_wrap,
+    )
+    from karpenter_tpu.testing.factories import make_pod
+
+    t_start = time.perf_counter()
+    lease_path = tempfile.mktemp(prefix="karpenter-cons-lease-")
+    journal_path = tempfile.mktemp(prefix="karpenter-cons-journal-")
+    cluster = Cluster()
+    api = SimCloudAPI()
+    # the replicas see the misbehaving control plane; the audit below reads
+    # the RAW double, so injected describe errors can't fake a leak
+    proxy = chaos_wrap(api, ChaosPolicy(error_rate=error_rate, seed=seed))
+    fleet = ReplicaChaos()
+    budget_allowed = int(budget)
+
+    evicted_before = _sample(m, "karpenter_consolidation_evicted_unready_total")
+    blocked_before = _sample(m, "karpenter_consolidation_budget_blocked_total")
+    waves_before = _sample(m, "karpenter_consolidation_waves_total")
+    reclaimed_before = _sample(m, "karpenter_consolidation_reclaimed_nodes_total")
+
+    opts = dict(
+        shard_lease=lease_path,
+        shard_lease_duration=lease_duration,
+        launch_journal=journal_path,
+        gc_interval=gc_interval,
+        gc_grace_period=max(gc_interval * 4, 4.0),
+        default_solver=solver,
+        consolidation_wave_size=wave_size,
+        consolidation_budget=budget,
+    )
+    names = [f"cons-{i}" for i in range(n_provisioners)]
+    owner_ref = OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="storm-rs")
+    churn_stop = threading.Event()
+    churn_failures = []
+
+    # no kubelet in this substrate: a background "kubelet" marks launched
+    # nodes Ready, because candidacy (and the done-bar itself) is defined
+    # over READY capacity only
+    kubelet_stop = threading.Event()
+
+    def kubelet():
+        while not kubelet_stop.is_set():
+            for node in cluster.nodes():
+                if not any(
+                    c.type == "Ready" and c.status == "True"
+                    for c in node.status.conditions
+                ):
+                    node.status.conditions.append(
+                        PodCondition(type="Ready", status="True")
+                    )
+            time.sleep(0.05)
+
+    def enqueue_all():
+        for rt in list(fleet.replicas.values()):
+            for name in names:
+                try:
+                    rt.manager.enqueue("consolidation", name)
+                except Exception:
+                    pass  # a replica mid-kill
+
+    try:
+        for i in range(n_replicas):
+            rt = build_runtime(
+                Options(**opts),
+                cluster=cluster,
+                cloud_provider=SimulatedCloudProvider(api=proxy),
+                consolidation_enabled=True,
+                shard_identity=f"replica-{i}",
+            )
+            rt.ownership.renew_interval = renew_interval
+            rt.garbage_collection.replay_after = replay_after
+            # the shared store is in-memory, but the storm exercises the
+            # REAL (apiserver) migration mode: taint→replace→drain per
+            # victim, workload controllers notionally recreating
+            rt.consolidation.migration = "evict"
+            rt.ownership.start()
+            rt.manager.start()
+            fleet.add(f"replica-{i}", rt)
+
+        threading.Thread(target=kubelet, daemon=True).start()
+
+        for name in names:
+            cluster.create("provisioners", make_provisioner(
+                name=name, solver=solver,
+                requirements=[
+                    NodeSelectorRequirement(
+                        key="consfleet", operator="In", values=[name],
+                    ),
+                    # pin the fleet to one small shape so the storm builds
+                    # a MANY-node world (4 pods per gp-2x) — re-packing one
+                    # huge node would trivialize budgets and wave pacing
+                    NodeSelectorRequirement(
+                        key=lbl.INSTANCE_TYPE, operator="In",
+                        values=["sim.gp-2x"],
+                    ),
+                ],
+            ))
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            owners = {name: fleet.owner_named(name) for name in names}
+            if all(
+                rt is not None and name in rt.provisioning.workers
+                for name, (_, rt) in owners.items()
+            ):
+                break
+            time.sleep(0.05)
+        assert all(fleet.owner_named(n)[0] for n in names), "shards never all owned"
+        for rt in fleet.replicas.values():
+            for w in rt.provisioning.workers.values():
+                w.batcher.idle_duration = 0.1
+
+        # phase A: build the running world — 4 pods per gp-2x with a
+        # sliver of headroom left, so churn pods SEAT on live capacity
+        # instead of minting one-pod nodes (which would turn the churn
+        # into a perpetual empty-node consolidation treadmill)
+        for i in range(n_pods):
+            cluster.create("pods", make_pod(
+                name=f"cons-pod-{i}", requests={"cpu": "0.4"},
+                node_selector={"consfleet": names[i % n_provisioners]},
+                owner=owner_ref,
+            ))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            pods = [p for p in cluster.pods() if p.metadata.name.startswith("cons-pod-")]
+            if len(pods) == n_pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        assert all(
+            p.spec.node_name for p in cluster.pods()
+            if p.metadata.name.startswith("cons-pod-")
+        ), "storm pods never all bound"
+
+        # phase B: fragment to ~70% utilization — every third pod leaves,
+        # stranding capacity the re-pack exists to hand back
+        for i in range(0, n_pods, 3):
+            cluster.delete("pods", f"cons-pod-{i}", namespace="default")
+        survivors = {
+            p.metadata.name for p in cluster.pods()
+            if p.metadata.name.startswith("cons-pod-")
+        }
+        price_by_type = {
+            it.name: it.effective_price()
+            for it in SimulatedCloudProvider(api=api).get_instance_types(None)
+        }
+
+        def cluster_price():
+            return sum(
+                price_by_type.get(n.metadata.labels.get(lbl.INSTANCE_TYPE, ""), 0.0)
+                for n in cluster.nodes()
+            )
+
+        nodes_before_storm = len(cluster.nodes())
+        price_before_storm = cluster_price()
+
+        # phase C: kill the owner of cons-0 MID-WAVE — after the wave is
+        # journaled and its first victim cordoned, before anything drains
+        class _CrashAfterCordon:
+            """Orchestrator proxy: the first consolidate() cordons the
+            victim (the wave's first real write), then dies like a SIGKILL
+            — a BaseException, so the worker thread is gone, not requeued."""
+
+            def __init__(self, real):
+                self.real = real
+                self.fired = threading.Event()
+                self.crash_node = ""
+
+            def consolidate(self, node, decision_id="", on_release=None):
+                if not self.fired.is_set():
+                    self.real._taint_and_cordon(node, DisruptionNotice(
+                        kind="consolidation", node_name=node.metadata.name,
+                        grace_period_seconds=0.0,
+                    ))
+                    self.crash_node = node.metadata.name
+                    self.fired.set()
+                    raise LaunchCrash(
+                        f"simulated crash mid-consolidation-wave "
+                        f"({node.metadata.name})"
+                    )
+                return self.real.consolidate(
+                    node, decision_id=decision_id, on_release=on_release
+                )
+
+            def __getattr__(self, name):
+                return getattr(self.real, name)
+
+        victim_name, victim_rt = fleet.owner_named(names[0])
+        assert victim_rt is not None
+        crasher = _CrashAfterCordon(victim_rt.consolidation.orchestrator)
+        victim_rt.consolidation.orchestrator = crasher
+        victim_rt.manager.enqueue("consolidation", names[0])
+        if not crasher.fired.wait(timeout=60):
+            raise AssertionError("mid-wave crash never fired")
+        t_kill = time.perf_counter()
+        fleet.kill(victim_name)
+
+        # a survivor's GC must replay the crashed wave: entry resolved,
+        # the cordoned victim un-cordoned (its pods never moved)
+        replay_s = None
+        deadline = time.time() + max(replay_after * 5, 45)
+        while time.time() < deadline:
+            replays = sum(
+                rt.garbage_collection.consolidation_waves_replayed
+                for rt in fleet.replicas.values()
+            )
+            node = cluster.try_get("nodes", crasher.crash_node, namespace="")
+            if replays >= 1 and node is not None and not node.spec.unschedulable:
+                replay_s = time.perf_counter() - t_kill
+                break
+            time.sleep(0.1)
+        waves_replayed = sum(
+            rt.garbage_collection.consolidation_waves_replayed
+            for rt in fleet.replicas.values()
+        )
+
+        # wait for the dead replica's shards to re-home before driving waves
+        deadline = time.time() + lease_duration * 20
+        while time.time() < deadline:
+            if all(fleet.owner_named(n)[0] for n in names):
+                break
+            time.sleep(0.05)
+
+        # phase D: budgeted waves under churn + seeded cloud errors.
+        # the budget watcher samples the observable the budget bounds:
+        # concurrently-disrupted (consolidation-tainted) nodes per
+        # provisioner, across every settling wave
+        max_tainted = {name: 0 for name in names}
+        violations = []
+        watcher_stop = threading.Event()
+
+        def watch_budget():
+            while not watcher_stop.is_set():
+                tainted = {name: 0 for name in names}
+                for node in cluster.nodes():
+                    prov = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, "")
+                    if prov in tainted and any(
+                        t.key == lbl.INTERRUPTION_TAINT_KEY
+                        and t.value == "consolidation"
+                        for t in node.spec.taints
+                    ):
+                        tainted[prov] += 1
+                for name, count in tainted.items():
+                    if count > max_tainted[name]:
+                        max_tainted[name] = count
+                    if count > budget_allowed:
+                        violations.append((name, count))
+                time.sleep(0.03)
+
+        def churn():
+            i = 0
+            while not churn_stop.is_set():
+                name = f"churn-{i}"
+                try:
+                    cluster.create("pods", make_pod(
+                        name=name, requests={"cpu": "0.15"},
+                        node_selector={"consfleet": names[i % n_provisioners]},
+                        owner=owner_ref,
+                    ))
+                    time.sleep(0.2)
+                    cluster.delete("pods", name, namespace="default")
+                except Exception:
+                    churn_failures.append(name)
+                time.sleep(0.1)
+                i += 1
+
+        watcher = threading.Thread(target=watch_budget, daemon=True)
+        churner = threading.Thread(target=churn, daemon=True)
+        watcher.start()
+        churner.start()
+
+        # drive waves through a fixed churn window (churn keeps perturbing
+        # the optimum, so the controller never "finishes" while it runs —
+        # that standing pressure is the storm), then stop the churn and
+        # keep driving until the re-pack genuinely dries up: node count
+        # stable, every surviving pod re-seated, every wave's journal
+        # entry resolved
+        journal = fleet.replicas[next(iter(fleet.replicas))].journal
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            enqueue_all()
+            time.sleep(0.5)
+        churn_stop.set()
+        churner.join(timeout=10)
+        deadline = time.time() + 90
+        last_nodes = len(cluster.nodes())
+        stable_since = time.time()
+        while time.time() < deadline:
+            enqueue_all()
+            count = len(cluster.nodes())
+            if count != last_nodes:
+                last_nodes = count
+                stable_since = time.time()
+            bound = all(
+                p.spec.node_name for p in cluster.pods()
+                if p.metadata.name in survivors
+            )
+            if (
+                time.time() - stable_since > 10
+                and bound
+                and not journal.unresolved()
+            ):
+                break
+            time.sleep(0.5)
+        for p in list(cluster.pods()):
+            if p.metadata.name.startswith("churn-"):
+                try:
+                    cluster.delete("pods", p.metadata.name, namespace="default")
+                except Exception:
+                    pass
+        # one more pass so a wave mid-settle when the loop broke resolves
+        # and every displaced survivor re-seats
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not journal.unresolved() and all(
+                p.spec.node_name for p in cluster.pods()
+                if p.metadata.name in survivors
+            ):
+                break
+            enqueue_all()
+            time.sleep(0.25)
+        watcher_stop.set()
+        watcher.join(timeout=5)
+
+        # audits (all against the RAW cloud double)
+        pods = [p for p in cluster.pods() if p.metadata.name in survivors]
+        bound = [p for p in pods if p.spec.node_name]
+        node_names = {n.metadata.name for n in cluster.nodes()}
+        provider_ids = {n.spec.provider_id for n in cluster.nodes()}
+        live = [i for i in api.list_instances() if i.state != "terminated"]
+        leaked = [
+            i for i in live
+            if i.id not in node_names
+            and f"sim:///{i.zone}/{i.id}" not in provider_ids
+        ]
+        token_counts = {}
+        for inst in live:
+            if inst.launch_token:
+                token_counts[inst.launch_token] = (
+                    token_counts.get(inst.launch_token, 0) + 1
+                )
+        dup_tokens = {t: c for t, c in token_counts.items() if c > 1}
+
+        nodes_after = len(cluster.nodes())
+        price_after = cluster_price()
+        return {
+            "pods": n_pods,
+            "provisioners": n_provisioners,
+            "replicas": n_replicas,
+            "solver": solver,
+            "budget": budget,
+            "wave_size": wave_size,
+            "error_rate": error_rate,
+            "chaos_injected": proxy.injected_total(),
+            "consolidation_success_rate": round(
+                len(bound) / max(len(survivors), 1), 4
+            ),
+            "evicted_unready": int(
+                _sample(m, "karpenter_consolidation_evicted_unready_total")
+                - evicted_before
+            ),
+            "budget_violations": len(violations),
+            "budget_blocked": int(
+                _sample(m, "karpenter_consolidation_budget_blocked_total")
+                - blocked_before
+            ),
+            "max_concurrent_disruptions": max_tainted,
+            "waves_executed": int(
+                _sample(m, "karpenter_consolidation_waves_total") - waves_before
+            ),
+            "waves_replayed": int(waves_replayed),
+            "replay_s": round(replay_s, 3) if replay_s is not None else None,
+            "leaked_instances": len(leaked),
+            "duplicate_launches": len(dup_tokens),
+            "journal_unresolved_after": len(journal.unresolved()),
+            "nodes_before": nodes_before_storm,
+            "nodes_after": nodes_after,
+            # headline = NET fleet shrink; the gross counter also tallies
+            # retire->relaunch cycles where churn re-perturbed the optimum
+            "consolidation_nodes_reclaimed": max(
+                nodes_before_storm - nodes_after, 0
+            ),
+            "nodes_retired_gross": int(
+                _sample(m, "karpenter_consolidation_reclaimed_nodes_total")
+                - reclaimed_before
+            ),
+            "consolidation_cost_delta_usd": round(
+                price_after - price_before_storm, 4
+            ),
+            "churn_failures": len(churn_failures),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        churn_stop.set()
+        kubelet_stop.set()
+        fleet.stop_all()
+        for path in (lease_path, journal_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
 def bench_overload_storm(
     n_pods: int = 300,
     overload_factor: float = 5.0,
@@ -3726,6 +4171,20 @@ def main():
                          "duplicate_launches (bar: 0), adoption latency vs "
                          "the one-GC-period bar, and "
                          "chaos_provision_success_rate (bar: 1.0)")
+    ap.add_argument("--consolidation-storm", type=int, metavar="N_PODS",
+                    default=0,
+                    help="disruption-safe consolidation storm "
+                         "(docs/consolidation.md): replicas run budgeted, "
+                         "journaled re-pack waves at ~70%% utilization with "
+                         "pod churn, seeded cloud errors, and a mid-wave "
+                         "replica kill; bars: zero evicted-unready pods, "
+                         "zero budget violations, zero leaked/duplicate "
+                         "instances, crashed wave replayed; reports "
+                         "consolidation_nodes_reclaimed and "
+                         "consolidation_cost_delta_usd")
+    ap.add_argument("--consolidation-budget", default="2",
+                    help="per-provisioner disruption budget for "
+                         "--consolidation-storm (count or percent)")
     ap.add_argument("--corruption-storm", type=int, metavar="N_PODS", default=0,
                     help="silent-data-corruption storm: the serving sidecar "
                          "pool member emits seeded corrupt frames (payload "
@@ -4130,6 +4589,39 @@ def main():
             **{k: v for k, v in r.items()
                if k != "chaos_provision_success_rate"},
             "chaos_provision_success_rate": r["chaos_provision_success_rate"],
+        }))
+        return
+
+    if args.consolidation_storm:
+        r = bench_consolidation_storm(
+            args.consolidation_storm,
+            n_provisioners=args.fleet_provisioners or 2,
+            n_replicas=args.fleet_replicas,
+            budget=args.consolidation_budget,
+            seed=args.chaos_seed,
+            solver=args.solver,
+        )
+        ok = (
+            r["consolidation_success_rate"] == 1.0
+            and r["evicted_unready"] == 0
+            and r["budget_violations"] == 0
+            and r["leaked_instances"] == 0
+            and r["duplicate_launches"] == 0
+            and r["waves_replayed"] >= 1
+            and r["consolidation_nodes_reclaimed"] > 0
+        )
+        print(json.dumps({
+            "metric": (
+                f"consolidation-storm ({r['pods']} pods, {r['replicas']} "
+                f"replicas, budget {r['budget']}, mid-wave kill + "
+                f"{int(r['error_rate'] * 100)}% cloud errors)"
+            ),
+            "value": r["consolidation_nodes_reclaimed"],
+            "unit": "nodes reclaimed with zero unsafe evictions",
+            "consolidation_ok": ok,
+            **{k: v for k, v in r.items()
+               if k != "consolidation_nodes_reclaimed"},
+            "consolidation_nodes_reclaimed": r["consolidation_nodes_reclaimed"],
         }))
         return
 

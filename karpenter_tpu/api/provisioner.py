@@ -128,6 +128,12 @@ class ProvisionerSpec:
     # Scheduling backend: "ffd" (in-process) or "tpu" (batched tensor solve);
     # "" = unset, resolved to the process default at admission/apply.
     solver: str = ""
+    # Disruption budget for voluntary consolidation (docs/consolidation.md):
+    # a maxUnavailable-style count ("3") or percent ("20%") of this
+    # provisioner's nodes that may be disrupted concurrently, across every
+    # settling wave. "0" disables voluntary disruption entirely; None
+    # defers to the controller-level --consolidation-budget default.
+    disruption_budget: Optional[str] = None
 
 
 def default_provisioner(provisioner: Provisioner, default_solver: str = SOLVER_FFD) -> None:
@@ -234,6 +240,13 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
         errs.append("ttlSecondsUntilExpired must be non-negative")
     if spec.solver not in (SOLVER_FFD, SOLVER_TPU):
         errs.append(f"solver must be one of [{SOLVER_FFD}, {SOLVER_TPU}], got {spec.solver}")
+    if spec.disruption_budget is not None:
+        from karpenter_tpu.controllers.disruption import parse_budget
+
+        try:
+            parse_budget(spec.disruption_budget)
+        except ValueError as e:
+            errs.append(f"disruptionBudget: {e}")
     c = spec.constraints
     for key, value in c.labels.items():
         errs.extend(lbl.check_qualified_name(key))

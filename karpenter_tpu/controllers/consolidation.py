@@ -8,20 +8,38 @@ same batched solver used for pending pods and compare the proposed packing's
 price against what is currently running.
 
 Plan: collect the provisioner's consolidatable nodes (ready, not deleting,
-no do-not-evict pods) and their reschedulable pods, re-solve in one batch,
-price both sides. Execute has two migration modes:
+no do-not-evict pods, no PDB-frozen pods) and their reschedulable pods,
+re-solve in one batch on the normal solver routes (the proposal inherits
+bit-exact route parity from the scheduler), then reduce the proposal to a
+MINIMAL-MOVE wave (solver/repack.py): nodes already holding their proposed
+packing are kept untouched, the rest retire cheapest-disruption-first —
+price discounted by the ``poll_disruptions``-fed interruption risk, plus a
+per-pod move charge.
+
+Execute has two migration modes:
 
 - ``bind``: launch replacements and rebind pods directly — valid only where
   the store permits rebinding (the in-memory cluster; a real apiserver
   rejects Binding a pod that already has a nodeName);
-- ``evict`` (auto-selected for ``ApiCluster``): delete the old nodes — the
-  termination controller cordons/drains them (PDB-respecting evictions),
-  workload controllers recreate the pods, and the recreated pending pods
-  flow through the NORMAL provisioning path, whose solver launches the
-  same cost-optimal capacity the plan priced. No replacements are
-  pre-launched: this framework (like the reference) never packs pods onto
-  existing nodes itself — that is the kube-scheduler's job — so a
-  pre-launched node would sit empty while the provisioner built another.
+- ``evict`` (auto-selected for ``ApiCluster``): retire the victims — with
+  an orchestrator wired, each runs the PR-1 taint→replace→drain sequence
+  (replacement pods injected BEFORE any eviction); without one, the legacy
+  delete→termination-drain path. Workload recreations flow through the
+  NORMAL provisioning path, whose solver launches the same cost-optimal
+  capacity the plan priced.
+
+The robustness envelope around an evict wave (docs/consolidation.md):
+
+- the disruption budget (controllers/disruption.py) — provisioner-level
+  ``maxUnavailable``-style count/percent, enforced per wave AND across
+  concurrently-settling waves through a shared ledger;
+- the journal (launch/journal.py, ``consolidation`` marker): the wave's
+  victims are journaled BEFORE the first cordon, so a mid-wave crash is
+  replayed by the recovery ladder — survivors un-cordoned, entry resolved;
+- the decision id (obs/decisions.py): every wave records an audit entry
+  and stamps its id on the journal entry and every wave/move event;
+- brownout rung 1 pauses new waves; a fenced or non-owning replica never
+  executes one.
 """
 
 from __future__ import annotations
@@ -29,17 +47,26 @@ from __future__ import annotations
 import copy
 import logging
 import threading
+import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_tpu import metrics
 from karpenter_tpu.api import labels as lbl
 from karpenter_tpu.api.objects import Node, Pod
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, NodeRequest
+from karpenter_tpu.controllers.disruption import (
+    BudgetLedger,
+    pdb_frozen_pod_keys,
+    resolve_budget,
+    risk_tracker,
+)
 from karpenter_tpu.controllers.provisioning import REQUEUE_INTERVAL
 from karpenter_tpu.kube.client import Cluster, Conflict
 from karpenter_tpu.scheduling.ffd import VirtualNode
 from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.solver.repack import minimal_move_match, order_retirement
 from karpenter_tpu.utils import node as nodeutil
 from karpenter_tpu.utils import pod as podutil
 
@@ -58,8 +85,10 @@ MIN_SAVINGS_FRACTION = 0.05
 EVICT_WAVE_SIZE = 5
 WAVE_CHECK_INTERVAL = 10.0
 # safety valve: a wave that has not settled after this long (e.g. an
-# unrelated permanently-unschedulable pod appeared) stops blocking further
-# consolidation — bounded disruption must not become unbounded deadlock
+# unrelated permanently-unschedulable pod appeared, or a replacement
+# launch failed terminally) stops blocking further consolidation — and is
+# FINISHED cleanly (survivors un-cordoned, journal resolved, budget
+# released), because bounded disruption must not become unbounded deadlock
 WAVE_SETTLE_TIMEOUT = 300.0
 
 
@@ -71,6 +100,14 @@ class ConsolidationPlan:
     proposed: List[VirtualNode] = field(default_factory=list)  # new world
     current_price: float = 0.0
     proposed_price: float = 0.0
+    # the minimal-move reduction (solver/repack.py): candidates whose
+    # proposed packing is what they already run stay untouched; only the
+    # rest retire (cheapest-disruption-first) / launch
+    keep: List[Node] = field(default_factory=list)
+    retire: List[Node] = field(default_factory=list)
+    launch: List[VirtualNode] = field(default_factory=list)
+    moves: List[Pod] = field(default_factory=list)
+    node_pods: Dict[str, List[Pod]] = field(default_factory=dict)
 
     @property
     def savings(self) -> float:
@@ -83,6 +120,9 @@ class ConsolidationPlan:
         # every reschedulable pod must have a seat in the new world
         placed = sum(len(v.pods) for v in self.proposed)
         if placed < len(self.pods):
+            return False
+        if not self.retire:
+            # minimal-move says the cluster already IS the proposal
             return False
         return self.savings / self.current_price >= MIN_SAVINGS_FRACTION
 
@@ -100,6 +140,12 @@ class ConsolidationController:
         migration: Optional[str] = None,  # "bind" | "evict" | None = auto
         wave_size: int = EVICT_WAVE_SIZE,
         ownership=None,
+        orchestrator=None,  # interruption.Orchestrator (taint→replace→drain)
+        journal=None,  # launch.journal.LaunchJournal (wave crash safety)
+        decisions=None,  # obs.decisions.DecisionLog override (tests)
+        ledger: Optional[BudgetLedger] = None,
+        risk=None,  # disruption.InterruptionRiskTracker override (tests)
+        default_budget: Optional[str] = None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -110,6 +156,22 @@ class ConsolidationController:
         # wave — N un-sharded replicas would each retire wave_size nodes
         # concurrently (N× the configured disruption pacing)
         self.ownership = ownership
+        # the disruption-safety envelope — each piece optional so the
+        # legacy construction (tests, bind-mode callers) keeps working:
+        # no orchestrator → legacy delete path, no journal → no crash
+        # breadcrumb, no budget → wave-size pacing only
+        self.orchestrator = orchestrator
+        self.journal = journal
+        self._decisions = decisions
+        self.ledger = ledger if ledger is not None else BudgetLedger()
+        self.risk = risk if risk is not None else risk_tracker()
+        self.default_budget = default_budget
+        # bench/test observability beside the prometheus counters
+        self.waves_executed = 0
+        self.moves_executed = 0
+        self.nodes_reclaimed = 0
+        self.budget_blocked = 0
+        self.cost_delta_usd = 0.0
         from karpenter_tpu.kube.apiserver import ApiCluster
 
         if migration is None:
@@ -120,7 +182,8 @@ class ConsolidationController:
         self.wave_size = max(1, wave_size)
         # in-flight evict wave PER PROVISIONER (reconciles of different
         # provisioners run concurrently): name -> (node names, pod keys
-        # already pending when the wave launched, settle deadline)
+        # already pending when the wave launched, settle deadline, journal
+        # token, decision id)
         self._wave_lock = threading.Lock()
         self._pending_waves: Dict[str, tuple] = {}
         # brownout ladder rung 1 (resilience/brownout.py): consolidation is
@@ -138,14 +201,23 @@ class ConsolidationController:
             )
         self.migration = migration
 
+    def _decision_log(self):
+        if self._decisions is not None:
+            return self._decisions
+        from karpenter_tpu import obs
+
+        return obs.decision_log()
+
     # -- planning ----------------------------------------------------------
     def plan(self, provisioner: Provisioner) -> ConsolidationPlan:
         catalog = self.cloud_provider.get_instance_types(
             provisioner.spec.constraints.provider
         )
         price_by_type: Dict[str, float] = {it.name: it.effective_price() for it in catalog}
-        nodes, pods = self._candidates(provisioner)
-        plan = ConsolidationPlan(provisioner=provisioner, nodes=nodes, pods=pods)
+        nodes, pods, node_pods = self._candidates(provisioner)
+        plan = ConsolidationPlan(
+            provisioner=provisioner, nodes=nodes, pods=pods, node_pods=node_pods
+        )
         if not nodes:
             return plan
         plan.current_price = sum(
@@ -167,6 +239,14 @@ class ConsolidationController:
         plan.proposed_price = sum(
             v.instance_type_options[0].effective_price() for v in plan.proposed
         )
+        # minimal-move reduction + disruption-cost retirement order
+        match = minimal_move_match(nodes, node_pods, plan.proposed)
+        plan.keep = match.keep
+        plan.launch = match.launch
+        plan.moves = match.moves
+        plan.retire = order_retirement(
+            match.retire, node_pods, price_by_type, self.risk.risk
+        )
         return plan
 
     def _shadow_cluster(self, excluded_nodes: List[Node], excluded_pods: List[Pod]) -> Cluster:
@@ -187,16 +267,24 @@ class ConsolidationController:
             shadow.seed("daemonsets", ds)
         return shadow
 
-    def _candidates(self, provisioner: Provisioner) -> Tuple[List[Node], List[Pod]]:
+    def _candidates(
+        self, provisioner: Provisioner
+    ) -> Tuple[List[Node], List[Pod], Dict[str, List[Pod]]]:
         """Nodes safe to consolidate and the pods that must be re-seated."""
         nodes: List[Node] = []
         pods: List[Pod] = []
+        node_pods: Dict[str, List[Pod]] = {}
         # one pass over pods instead of a per-node scan (1k nodes × 10k pods
         # would otherwise be 10M predicate evaluations)
         by_node: Dict[str, List[Pod]] = {}
         for p in self.cluster.pods():
             if p.spec.node_name:
                 by_node.setdefault(p.spec.node_name, []).append(p)
+        # plan-time victim screening: a pod whose PDB allows zero
+        # disruptions right now freezes its node out of candidacy HERE —
+        # discovering it at drain time would strand a cordoned node
+        # mid-wave with its replacement already paid for
+        frozen = pdb_frozen_pod_keys(self.cluster) if self.migration == "evict" else set()
         for node in self.cluster.nodes():
             if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) != provisioner.name:
                 continue
@@ -204,7 +292,7 @@ class ConsolidationController:
                 continue
             if not nodeutil.is_ready(node) or node.spec.unschedulable:
                 continue
-            node_pods = [
+            its_pods = [
                 p
                 for p in by_node.get(node.metadata.name, [])
                 if not podutil.is_terminal(p)
@@ -213,11 +301,13 @@ class ConsolidationController:
             ]
             if any(
                 p.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true"
-                for p in node_pods
+                for p in its_pods
             ):
                 continue
+            if frozen and any(p.key in frozen for p in its_pods):
+                continue
             if self.migration == "evict" and any(
-                not p.metadata.owner_references for p in node_pods
+                not p.metadata.owner_references for p in its_pods
             ):
                 # voluntary disruption must not destroy workloads: an
                 # ownerless pod has no controller to recreate it after the
@@ -225,17 +315,34 @@ class ConsolidationController:
                 # the pod itself and has no such constraint)
                 continue
             nodes.append(node)
-            pods.extend(node_pods)
-        return nodes, pods
+            pods.extend(its_pods)
+            node_pods[node.metadata.name] = its_pods
+        return nodes, pods, node_pods
 
     # -- execution ---------------------------------------------------------
+    def _budget_allowed(self, provisioner: Provisioner) -> Optional[int]:
+        """Resolve the provisioner's disruption budget against its CURRENT
+        node count (like PDB percentages resolve against matching pods).
+        Provisioner spec wins over the controller-level default; None =
+        no budget configured."""
+        spec = getattr(provisioner.spec, "disruption_budget", None) or self.default_budget
+        if spec is None or str(spec).strip() == "":
+            return None
+        total = sum(
+            1 for n in self.cluster.nodes()
+            if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+            == provisioner.name
+        )
+        return resolve_budget(spec, total)
+
     def execute(self, plan: ConsolidationPlan) -> List[Node]:
         """Retire the old world; build the new one per the migration mode
         (bind: launch + rebind here; evict: the provisioning path rebuilds
         from the recreated pending pods)."""
         launched: List[Node] = []
+        prov_name = plan.provisioner.metadata.name
         if self.migration == "bind":
-            for vnode in plan.proposed:
+            for vnode in plan.launch:
                 node = self.cloud_provider.create(
                     NodeRequest(
                         template=vnode.constraints,
@@ -265,53 +372,208 @@ class ConsolidationController:
                     )
                     if live is not None:
                         self.cluster.bind(live, node.metadata.name)
-        # retire the old world: deletion hands the nodes to the termination
-        # controller, whose cordon/drain evicts the remaining pods with PDB
-        # respect. In bind mode every pod was already rebound above, so the
-        # drains are empty and all nodes retire at once. In evict mode the
-        # drain IS the migration (workload controllers recreate, and the
-        # pending recreations drive the provisioner to rebuild capacity) —
-        # so retirement is PACED: at most wave_size nodes per reconcile,
-        # the rest after this wave settles (reconcile gates on it).
-        retire = plan.nodes
-        if self.migration == "evict" and len(retire) > self.wave_size:
-            retire = retire[: self.wave_size]
-        # baseline BEFORE the deletes: pods already pending before this wave
-        # must not gate settlement, but pods displaced BY the wave (evicted
-        # and recreated while the delete loop runs) must — snapshotting
-        # after the deletes would let them slip into the baseline
+        # retire the old world. In bind mode every pod was already rebound
+        # above, so the drains are empty and all retired nodes go at once.
+        # In evict mode the drain IS the migration (workload controllers
+        # recreate, and the pending recreations drive the provisioner to
+        # rebuild capacity) — so retirement is PACED (at most wave_size per
+        # reconcile, cheapest disruption first) and BUDGETED (the ledger
+        # admits only what the maxUnavailable-style budget allows across
+        # every concurrently-settling wave).
+        retire = plan.retire
+        decision_id = ""
+        if self.migration == "evict":
+            wanted = [n.metadata.name for n in retire[: self.wave_size]]
+            allowed = self._budget_allowed(plan.provisioner)
+            if allowed is None:
+                admitted_names = self.ledger.reserve(prov_name, wanted, 10**9)
+            else:
+                admitted_names = self.ledger.reserve(prov_name, wanted, allowed)
+            admitted = set(admitted_names)
+            retire = [n for n in retire[: self.wave_size] if n.metadata.name in admitted]
+            blocked = len(wanted) - len(retire)
+            log = self._decision_log()
+            record = (
+                log.record_consolidation(
+                    prov_name,
+                    victims=admitted_names,
+                    keep=len(plan.keep),
+                    moves=sum(
+                        len(plan.node_pods.get(n, ())) for n in admitted_names
+                    ),
+                    savings=plan.savings,
+                    context={
+                        "budget": allowed,
+                        "budget_blocked": blocked,
+                        "candidates": len(plan.nodes),
+                        "plan_retire": len(plan.retire),
+                    },
+                )
+                if log is not None else None
+            )
+            decision_id = record["id"] if record else ""
+            if blocked:
+                metrics.CONSOLIDATION_BUDGET_BLOCKED.labels(prov_name).inc(blocked)
+                self.budget_blocked += blocked
+                from karpenter_tpu.kube.events import recorder_for
+
+                recorder_for(self.cluster).event(
+                    "Provisioner", prov_name, "ConsolidationBudgetBlocked",
+                    f"disruption budget admitted {len(retire)} of "
+                    f"{len(wanted)} wave victim(s) "
+                    f"({allowed if allowed is not None else 'unbounded'} "
+                    "concurrent disruptions allowed)",
+                    type="Warning", decision_id=decision_id,
+                )
+            if not retire:
+                return launched
+        # baseline BEFORE the retirement: pods already pending before this
+        # wave must not gate settlement, but pods displaced BY the wave
+        # (evicted and recreated while the loop runs) must — snapshotting
+        # after would let them slip into the baseline
         baseline = (
             {p.key for p in self.cluster.pods() if podutil.is_provisionable(p)}
             if self.migration == "evict"
             else set()
         )
-        for old in retire:
-            try:
-                self.cluster.delete("nodes", old.metadata.name, namespace="")
-            except Exception:
-                logger.exception("retiring node %s", old.metadata.name)
+        from karpenter_tpu import obs
+
+        token = ""
+        moves = 0
+        with obs.tracer().span(
+            "consolidation.wave",
+            attrs={
+                "provisioner": prov_name,
+                "victims": len(retire),
+                "decision_id": decision_id,
+            },
+        ) as wave_sp:
+            if self.migration == "evict" and self.journal is not None:
+                # journal the WHOLE wave before the first victim is
+                # touched: the entry is what recovery replays after a
+                # mid-wave crash (launch/recovery.py un-cordons survivors)
+                token = f"consolidation-{uuid.uuid4().hex[:16]}"
+                self.journal.record_intent(
+                    token, prov_name, trace=obs.to_traceparent(wave_sp),
+                    marker="consolidation",
+                    victims=[n.metadata.name for n in retire],
+                    decision_id=decision_id,
+                )
+            for old in retire:
+                try:
+                    if self.migration == "evict" and self.orchestrator is not None:
+                        # taint→replace→drain per victim: replacement pods
+                        # are injected into provisioning BEFORE any eviction
+                        resp = self.orchestrator.consolidate(
+                            old, decision_id=decision_id
+                        )
+                        if resp is not None:
+                            moves += len(resp.migrated)
+                            if resp.blocked:
+                                # plan-time screening should make this
+                                # impossible; a non-zero count is the hard
+                                # bar's tripwire, not business as usual
+                                metrics.CONSOLIDATION_EVICTED_UNREADY.inc(
+                                    len(resp.blocked)
+                                )
+                    else:
+                        moves += len(plan.node_pods.get(old.metadata.name, ()))
+                        self.cluster.delete("nodes", old.metadata.name, namespace="")
+                except Exception:
+                    logger.exception("retiring node %s", old.metadata.name)
         if self.migration == "evict":
             with self._wave_lock:
-                self._pending_waves[plan.provisioner.metadata.name] = (
+                self._pending_waves[prov_name] = (
                     [n.metadata.name for n in retire],
                     baseline,
                     self.cluster.clock() + WAVE_SETTLE_TIMEOUT,
+                    token,
+                    decision_id,
                 )
+        # plan-time estimate of the wave's $-delta: the admitted victims'
+        # prices leave, the launch side's share of the proposal arrives
+        # with them (settled waves confirm node counts; prices are catalog
+        # facts either way)
+        wave_fraction = len(retire) / max(len(plan.retire), 1)
+        wave_delta = -plan.savings * wave_fraction
+        self.cost_delta_usd += wave_delta
+        metrics.CONSOLIDATION_COST_DELTA.labels(prov_name).set(self.cost_delta_usd)
+        metrics.CONSOLIDATION_WAVES.labels(prov_name).inc()
+        metrics.CONSOLIDATION_MOVES.labels(prov_name).inc(moves or len(plan.moves))
+        self.waves_executed += 1
+        self.moves_executed += moves or len(plan.moves)
         logger.info(
-            "consolidating %d of %d candidate nodes -> %d planned (%s migration), "
-            "price %.3f -> %.3f (saving %.3f)",
-            len(retire), len(plan.nodes), len(plan.proposed), self.migration,
-            plan.current_price, plan.proposed_price, plan.savings,
+            "consolidating %d of %d candidate nodes (kept %d in place) -> "
+            "%d launched (%s migration), price %.3f -> %.3f (saving %.3f)",
+            len(retire), len(plan.nodes), len(plan.keep), len(plan.launch),
+            self.migration, plan.current_price, plan.proposed_price,
+            plan.savings,
         )
         from karpenter_tpu.kube.events import recorder_for
 
         recorder_for(self.cluster).event(
-            "Provisioner", plan.provisioner.metadata.name, "Consolidated",
-            f"retiring {len(retire)} of {len(plan.nodes)} candidate node(s) "
-            f"({self.migration} migration), hourly price "
-            f"{plan.current_price:.3f} -> {plan.proposed_price:.3f}",
+            "Provisioner", prov_name, "Consolidated",
+            f"retiring {len(retire)} of {len(plan.nodes)} candidate node(s), "
+            f"{len(plan.keep)} kept in place ({self.migration} migration), "
+            f"hourly price {plan.current_price:.3f} -> {plan.proposed_price:.3f}",
+            decision_id=decision_id,
         )
         return launched
+
+    def _finish_wave(
+        self, provisioner_name: str, wave: tuple, timed_out: bool
+    ) -> None:
+        """Close out one wave — on clean settlement AND on the settle
+        timeout (a victim deleted out-of-band or a terminally-failed
+        replacement launch must not wedge the loop): un-cordon any victim
+        still standing (its drain never finished; a cordoned survivor is
+        pure capacity loss), resolve the journal entry, release the
+        budget, and count what was actually reclaimed."""
+        node_names, _baseline, _deadline, token, decision_id = wave
+        reclaimed = 0
+        for name in node_names:
+            node = self.cluster.try_get("nodes", name, namespace="")
+            if node is None:
+                reclaimed += 1
+                continue
+            if node.metadata.deletion_timestamp is not None:
+                continue  # drain in flight; termination finishes it
+            if not node.spec.unschedulable:
+                continue
+            from karpenter_tpu.kube.serde import taint_to_wire
+
+            taints_wire = [
+                taint_to_wire(t) for t in node.spec.taints
+                if not (
+                    t.key == lbl.INTERRUPTION_TAINT_KEY
+                    and t.value == "consolidation"
+                )
+            ]
+            try:
+                self.cluster.merge_patch(
+                    "nodes", name,
+                    {"spec": {"unschedulable": False, "taints": taints_wire}},
+                    namespace="",
+                )
+                logger.warning(
+                    "consolidation wave for %s: un-cordoned surviving "
+                    "victim %s (%s)",
+                    provisioner_name, name,
+                    "settle timeout" if timed_out else "settled without it",
+                )
+            except Exception:
+                logger.exception("un-cordon of wave victim %s", name)
+        if self.journal is not None and token:
+            try:
+                self.journal.resolve(token)
+            except Exception:
+                logger.exception("resolving wave journal entry %s", token)
+        self.ledger.release(provisioner_name, node_names)
+        if reclaimed:
+            metrics.CONSOLIDATION_RECLAIMED_NODES.labels(provisioner_name).inc(
+                reclaimed
+            )
+            self.nodes_reclaimed += reclaimed
 
     def wave_settled(self, provisioner_name: str) -> bool:
         """Has this provisioner's in-flight evict wave fully landed? True
@@ -319,20 +581,26 @@ class ConsolidationController:
         and no pod that appeared SINCE the wave launched is still waiting
         for capacity (pods already pending before the wave don't gate it) —
         only then may the next wave disrupt more nodes. A wave past its
-        settle deadline stops gating (logged): bounded disruption must not
-        become unbounded deadlock on an unrelated stuck pod."""
+        settle deadline stops gating AND is finished cleanly (survivors
+        un-cordoned, journal resolved, budget released): bounded
+        disruption must not become unbounded deadlock on an out-of-band
+        node delete, a dead replacement launch, or an unrelated stuck
+        pod."""
         with self._wave_lock:
             wave = self._pending_waves.get(provisioner_name)
         if wave is None:
             return True
-        node_names, baseline, deadline = wave
+        node_names, baseline, deadline = wave[0], wave[1], wave[2]
         if self.cluster.clock() >= deadline:
             logger.warning(
                 "consolidation wave for %s did not settle within %.0fs; "
-                "releasing the gate", provisioner_name, WAVE_SETTLE_TIMEOUT,
+                "finishing it and releasing the gate",
+                provisioner_name, WAVE_SETTLE_TIMEOUT,
             )
             with self._wave_lock:
-                self._pending_waves.pop(provisioner_name, None)
+                wave = self._pending_waves.pop(provisioner_name, None)
+            if wave is not None:
+                self._finish_wave(provisioner_name, wave, timed_out=True)
             return True
         for name in node_names:
             if self.cluster.try_get("nodes", name, namespace="") is not None:
@@ -343,7 +611,9 @@ class ConsolidationController:
         ):
             return False
         with self._wave_lock:
-            self._pending_waves.pop(provisioner_name, None)
+            wave = self._pending_waves.pop(provisioner_name, None)
+        if wave is not None:
+            self._finish_wave(provisioner_name, wave, timed_out=False)
         return True
 
     # -- brownout ----------------------------------------------------------
@@ -370,6 +640,16 @@ class ConsolidationController:
             )
 
             return OWNERSHIP_RECHECK_INTERVAL
+        if self.ownership is not None and getattr(
+            self.ownership, "fenced", lambda: False
+        )():
+            # a fenced replica (lease expired mid-partition) must not
+            # mutate the cluster — same rule as the GC sweep
+            from karpenter_tpu.controllers.provisioning import (
+                OWNERSHIP_RECHECK_INTERVAL,
+            )
+
+            return OWNERSHIP_RECHECK_INTERVAL
         if self.paused():
             # brownout: no new voluntary disruption while the ladder is
             # engaged — re-check on the wave cadence so recovery picks the
@@ -379,6 +659,11 @@ class ConsolidationController:
             # the previous wave's pods have not all re-seated: no new
             # disruption yet, check back shortly
             return WAVE_CHECK_INTERVAL
+        allowed = self._budget_allowed(provisioner)
+        if allowed == 0:
+            # budget "0": voluntary disruption disabled entirely — don't
+            # even pay for planning
+            return REQUEUE_INTERVAL
         plan = self.plan(provisioner)
         if plan.worthwhile:
             self.execute(plan)

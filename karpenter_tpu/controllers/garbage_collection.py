@@ -98,6 +98,7 @@ class GarbageCollectionController:
         self.replays = 0
         self.sweeps = 0
         self.speculation_reclaimed = 0
+        self.consolidation_waves_replayed = 0
 
     # -- shard routing -----------------------------------------------------
     def _owns(self, shard: str) -> bool:
@@ -224,6 +225,19 @@ class GarbageCollectionController:
                 continue
             self.replays += 1
             metrics.LAUNCH_JOURNAL_REPLAYS.labels(outcome=outcome).inc()
+            if outcome == recovery.CONSOLIDATION_REPLAYED:
+                self.consolidation_waves_replayed += 1
+                from karpenter_tpu.kube.events import recorder_for
+
+                recorder_for(self.cluster).event(
+                    "Provisioner", entry.provisioner,
+                    "ConsolidationWaveReplayed",
+                    f"replayed crashed consolidation wave "
+                    f"{entry.token[:20]} (decision "
+                    f"{entry.decision_id or 'unknown'}): surviving victims "
+                    "un-cordoned, journal entry resolved",
+                    type="Warning",
+                )
             if outcome == recovery.SPECULATION_EXPIRED:
                 self.speculation_reclaimed += 1
                 metrics.WARMPOOL_EXPIRED.inc()

@@ -157,6 +157,18 @@ class InterruptionController:
         metrics.INTERRUPTION_NOTICES.labels(
             kind=notice.kind, provider=self.cloud_provider.name()
         ).inc()
+        # feed the consolidation risk model: every notice raises the EWMA
+        # for this node's (capacity_type, zone), so the re-pack's
+        # disruption-cost dimension retires reclaim-prone capacity first
+        node = self.cluster.try_get("nodes", notice.node_name, namespace="")
+        if node is not None:
+            from karpenter_tpu.api import labels as lbl
+            from karpenter_tpu.controllers.disruption import risk_tracker
+
+            risk_tracker().observe(
+                node.metadata.labels.get(lbl.CAPACITY_TYPE, ""),
+                node.metadata.labels.get(lbl.TOPOLOGY_ZONE, ""),
+            )
         notice_time = self.cluster.clock()
 
         def on_release(pod) -> None:

@@ -122,6 +122,61 @@ class Orchestrator:
             migrated=migrated, blocked=blocked,
         )
 
+    # -- the consolidation path --------------------------------------------
+    def consolidate(
+        self, node: Node, decision_id: str = "", on_release=None
+    ) -> Optional[Response]:
+        """Retire one node VOLUNTARILY with the same ordering guarantee as
+        a notice: taint+cordon (taint value ``consolidation``, so a
+        mid-wave crash leaves a recognizable breadcrumb the journal replay
+        un-cordons) → event → replacement injection BEFORE any eviction →
+        drain handoff. The consolidation controller journals the whole
+        wave before calling this per victim; ``decision_id`` rejoins the
+        audit record that proposed the wave. Unlike ``handle`` there is no
+        cloud deadline — the only clock on a voluntary wave is the
+        controller's settle timeout — and do-not-evict pods cannot appear
+        (plan-time screening excludes their nodes), but if one slips in it
+        still blocks exactly as on the notice path."""
+        node = self.cluster.try_get("nodes", node.metadata.name, namespace="")
+        if node is None or node.metadata.deletion_timestamp is not None:
+            return None
+        from karpenter_tpu import obs
+
+        notice = DisruptionNotice(
+            kind="consolidation", node_name=node.metadata.name,
+            grace_period_seconds=0.0, reason="consolidation re-pack",
+        )
+        with obs.tracer().span(
+            "consolidation.move",
+            attrs={"node": node.metadata.name, "decision_id": decision_id},
+        ) as sp:
+            with obs.tracer().span("interruption.taint_cordon"):
+                self._taint_and_cordon(node, notice)
+            from karpenter_tpu.kube.events import recorder_for
+
+            recorder_for(self.cluster).event(
+                "Node", node.metadata.name, "ConsolidationDrain",
+                "consolidation re-pack is retiring this node; "
+                "replacing pods proactively",
+                type="Warning", decision_id=decision_id,
+            )
+            with obs.tracer().span("interruption.replace") as rep_sp:
+                migrated, blocked = self._migrate(node, on_release)
+                rep_sp.set_attribute("migrated", len(migrated))
+                rep_sp.set_attribute("blocked", len(blocked))
+            with obs.tracer().span("interruption.drain_handoff"):
+                self.cluster.delete("nodes", node.metadata.name, namespace="")
+            sp.set_attribute("migrated", len(migrated))
+        logger.info(
+            "consolidation: retiring %s — %d pod(s) injected for "
+            "replacement, %d blocked",
+            node.metadata.name, len(migrated), len(blocked),
+        )
+        return Response(
+            node_name=node.metadata.name, deadline=0.0,
+            migrated=migrated, blocked=blocked,
+        )
+
     def _taint_and_cordon(self, node: Node, notice: DisruptionNotice) -> None:
         """One merge patch: interruption taint + cordon + ensure the
         termination finalizer (a self-registered node may not carry it yet,

@@ -643,6 +643,7 @@ def _provisioner_to_wire(p: Provisioner) -> Dict[str, Any]:
                 else None
             ),
             "solver": p.spec.solver or None,
+            "disruptionBudget": p.spec.disruption_budget,
         }
     )
     return {
@@ -687,6 +688,11 @@ def _provisioner_from_wire(doc: Dict[str, Any]) -> Provisioner:
                 else None
             ),
             solver=spec.get("solver", "") or "",
+            disruption_budget=(
+                str(spec["disruptionBudget"])
+                if spec.get("disruptionBudget") is not None
+                else None
+            ),
         ),
         status=ProvisionerStatus(
             last_scale_time=parse_ts(status.get("lastScaleTime")),

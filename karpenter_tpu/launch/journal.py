@@ -29,7 +29,7 @@ import os
 import threading
 import time
 import uuid
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from karpenter_tpu.utils.lease import FileLease
@@ -58,6 +58,16 @@ class LaunchRecord:
     # reclaims it past --warm-pool-ttl if demand never lands. Defaults
     # keep old journal docs (no key) parsing as ordinary launches.
     speculative: bool = False
+    # wave marker (controllers/consolidation.py): a "consolidation" entry
+    # is not a launch at all but a whole disruption wave journaled BEFORE
+    # the first victim is touched — ``victims`` names the nodes the wave
+    # cordons, ``decision_id`` ties it to the audit record that proposed
+    # it. A crash mid-wave leaves the entry open; recovery replays it by
+    # un-cordoning surviving victims (launch/recovery.py) instead of the
+    # adopt/reap ladder. Defaults keep old docs parsing unchanged.
+    marker: str = ""
+    victims: List[str] = field(default_factory=list)
+    decision_id: str = ""
 
     def to_doc(self) -> Dict:
         return asdict(self)
@@ -72,6 +82,9 @@ class LaunchRecord:
             trace=str(doc.get("trace", "")),
             created_at=float(doc.get("created_at", 0.0)),
             speculative=bool(doc.get("speculative", False)),
+            marker=str(doc.get("marker", "")),
+            victims=[str(v) for v in doc.get("victims", []) or []],
+            decision_id=str(doc.get("decision_id", "")),
         )
 
 
@@ -82,7 +95,8 @@ class LaunchJournal:
 
     def record_intent(
         self, token: str, provisioner: str, trace: str = "",
-        speculative: bool = False,
+        speculative: bool = False, marker: str = "",
+        victims: Optional[List[str]] = None, decision_id: str = "",
     ) -> None:
         raise NotImplementedError
 
@@ -110,12 +124,15 @@ class MemoryLaunchJournal(LaunchJournal):
 
     def record_intent(
         self, token: str, provisioner: str, trace: str = "",
-        speculative: bool = False,
+        speculative: bool = False, marker: str = "",
+        victims: Optional[List[str]] = None, decision_id: str = "",
     ) -> None:
         with self._mu:
             self._entries[token] = LaunchRecord(
                 token=token, provisioner=provisioner, trace=trace,
                 created_at=self.clock(), speculative=speculative,
+                marker=marker, victims=list(victims or []),
+                decision_id=decision_id,
             )
 
     def mark_created(self, token: str, node_name: str) -> None:
@@ -179,11 +196,14 @@ class FileLaunchJournal(LaunchJournal):
 
     def record_intent(
         self, token: str, provisioner: str, trace: str = "",
-        speculative: bool = False,
+        speculative: bool = False, marker: str = "",
+        victims: Optional[List[str]] = None, decision_id: str = "",
     ) -> None:
         entry = LaunchRecord(
             token=token, provisioner=provisioner, trace=trace,
             created_at=self.clock(), speculative=speculative,
+            marker=marker, victims=list(victims or []),
+            decision_id=decision_id,
         )
         with self._locked():
             self._sweep_stale_tmp()
@@ -279,11 +299,14 @@ class KubeLaunchJournal(LaunchJournal):
 
     def record_intent(
         self, token: str, provisioner: str, trace: str = "",
-        speculative: bool = False,
+        speculative: bool = False, marker: str = "",
+        victims: Optional[List[str]] = None, decision_id: str = "",
     ) -> None:
         self._put(LaunchRecord(
             token=token, provisioner=provisioner, trace=trace,
             created_at=self.clock(), speculative=speculative,
+            marker=marker, victims=list(victims or []),
+            decision_id=decision_id,
         ))
 
     def mark_created(self, token: str, node_name: str) -> None:

@@ -46,6 +46,15 @@ PENDING = "pending"
 # demand claiming its node: the instance is reclaimed even though it is
 # live — the one case where "journaled and running" is NOT protection
 SPECULATION_EXPIRED = "speculation_expired"
+# a consolidation-wave entry whose owning replica crashed mid-wave: the
+# surviving cordoned victims are un-cordoned (schedulable again) and the
+# entry resolved — the half-executed wave is rolled forward to a safe
+# state, and the next consolidation pass re-plans from scratch
+CONSOLIDATION_REPLAYED = "consolidation_replayed"
+
+# LaunchRecord.marker value for journaled consolidation waves
+# (controllers/consolidation.py writes it, replay matches on it).
+CONSOLIDATION_MARKER = "consolidation"
 
 # Default --warm-pool-ttl: how long an unclaimed speculative launch may
 # stand before the GC ladder reclaims it (controllers/warmpool.py).
@@ -215,6 +224,11 @@ def replay_entry(
     terminator-backed reaper); None falls back to the provider delete."""
     if now - entry.created_at < replay_after:
         return PENDING
+    if entry.marker == CONSOLIDATION_MARKER:
+        # BEFORE the live-instance lookup: a wave entry carries no launch
+        # token of its own (replacement launches journal separately), so
+        # the ladder below would wrongly read it as NEVER_LAUNCHED
+        return _replay_consolidation(journal, cluster, entry)
     live = instances_by_token.get(entry.token)
     if live is None:
         # the create never committed (or the instance already terminated):
@@ -249,6 +263,54 @@ def replay_entry(
         live.id, entry.token[:12], entry.provisioner,
     )
     return ADOPTED
+
+
+def _replay_consolidation(journal, cluster, entry: LaunchRecord) -> str:
+    """Roll a crashed consolidation wave forward to safety. The entry was
+    written BEFORE the first victim was touched, so the victims list is
+    the complete blast radius; any subset may be cordoned, drained, or
+    already deleted. Surviving victims are un-cordoned — the consolidation
+    taint removed and scheduling re-enabled — because a dead wave's
+    cordons are pure capacity loss (its replacements journaled and
+    recovered separately through the ordinary ladder; displaced pods are
+    pending and re-enter selection on their own). Deleted victims need
+    nothing: their drains finished. Then the entry resolves — the next
+    consolidation pass re-plans from the real, recovered world."""
+    uncordoned = 0
+    for name in entry.victims:
+        node = cluster.try_get("nodes", name, namespace="")
+        if node is None or node.metadata.deletion_timestamp is not None:
+            continue
+        from karpenter_tpu.kube.serde import taint_to_wire
+
+        taints_wire = [
+            taint_to_wire(t) for t in node.spec.taints
+            if not (
+                t.key == lbl.INTERRUPTION_TAINT_KEY
+                and t.value == CONSOLIDATION_MARKER
+            )
+        ]
+        try:
+            cluster.merge_patch(
+                "nodes", name,
+                {"spec": {"unschedulable": False, "taints": taints_wire}},
+                namespace="",
+            )
+            uncordoned += 1
+        except Exception:
+            logger.warning(
+                "un-cordon of crashed-wave victim %s failed; next sweep "
+                "retries", name, exc_info=True,
+            )
+            return PENDING
+    journal.resolve(entry.token)
+    logger.warning(
+        "replayed crashed consolidation wave %s (provisioner %s, decision "
+        "%s): %d of %d victim(s) un-cordoned, entry resolved",
+        entry.token[:20], entry.provisioner, entry.decision_id or "-",
+        uncordoned, len(entry.victims),
+    )
+    return CONSOLIDATION_REPLAYED
 
 
 def _replay_speculative(

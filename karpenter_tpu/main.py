@@ -63,6 +63,7 @@ class Runtime:
     brownout: object = None  # BrownoutController when --brownout is on
     warmpool: WarmPoolController = None  # when --warm-pool is on
     forecast: object = None  # the ArrivalForecaster THIS runtime installed
+    consolidation: ConsolidationController = None
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
     def stop(self) -> None:
@@ -352,6 +353,13 @@ def build_runtime(
         solver_service_address=options.solver_service_address or None,
         wave_size=options.consolidation_wave_size,
         ownership=ownership,
+        # disruption-safe waves (docs/consolidation.md): retirements run
+        # through the interruption orchestrator's taint→replace→drain
+        # ladder, every wave journals intent first so a crash mid-wave is
+        # replayed by GC, and the budget caps concurrent disruption
+        orchestrator=interruption.orchestrator,
+        journal=journal,
+        default_budget=options.consolidation_budget or None,
     )
     garbage_collection = GarbageCollectionController(
         cluster,
@@ -458,6 +466,7 @@ def build_runtime(
         ownership=ownership,
         brownout=brownout,
         warmpool=warmpool,
+        consolidation=consolidation,
     )
 
 

@@ -713,6 +713,73 @@ LAUNCH_JOURNAL_REPLAYS = Counter(
     registry=REGISTRY,
 )
 
+# Disruption-safe consolidation (docs/consolidation.md): the whole-cluster
+# re-pack's safety ledger. Voluntary disruption is the one place this
+# controller CHOOSES to hurt availability for cost, so every wave, move,
+# budget refusal, and reclaimed node must be attributable on the scrape —
+# and evicted_unready_total is the contract itself: it must stay 0, every
+# displaced pod replaced before its node drains.
+CONSOLIDATION_WAVES = Counter(
+    "waves_total",
+    "Consolidation waves executed, per provisioner: one journaled "
+    "taint→replace→drain pass over the budget-admitted victims.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
+CONSOLIDATION_MOVES = Counter(
+    "moves_total",
+    "Pod moves executed by consolidation waves, per provisioner: each is "
+    "one release+replacement injection (the minimal-move objective exists "
+    "to keep this small relative to nodes reclaimed).",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
+CONSOLIDATION_BUDGET_BLOCKED = Counter(
+    "budget_blocked_total",
+    "Consolidation victims refused by the disruption budget, per "
+    "provisioner: the plan wanted the node but the maxUnavailable-style "
+    "budget (per wave AND across settling waves) had no room.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
+CONSOLIDATION_EVICTED_UNREADY = Counter(
+    "evicted_unready_total",
+    "Pods a consolidation wave evicted without a replacement ready — the "
+    "hard bar of voluntary disruption; any non-zero value is a bug.",
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
+CONSOLIDATION_RECLAIMED_NODES = Counter(
+    "reclaimed_nodes_total",
+    "Nodes fully retired by settled consolidation waves, per provisioner.",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
+CONSOLIDATION_COST_DELTA = Gauge(
+    "cost_delta_usd",
+    "Cumulative hourly-price delta from executed consolidation waves, per "
+    "provisioner (negative = cheaper cluster; the $-readout of the "
+    "re-pack).",
+    ["provisioner"],
+    namespace=NAMESPACE,
+    subsystem="consolidation",
+    registry=REGISTRY,
+)
+
 # Predictive provisioning (docs/forecasting.md): the arrival forecaster's
 # readout and the warm-pool controller's speculation ledger. A speculative
 # node is capacity bought on a prediction — every launch, hit, and

@@ -69,6 +69,13 @@ class Options:
     consolidation_wave_size: int = field(
         default_factory=lambda: int(_env("KARPENTER_CONSOLIDATION_WAVE_SIZE", "5"))
     )
+    # controller-level default disruption budget for provisioners that
+    # leave spec.disruptionBudget unset: a count ("3") or percent ("20%")
+    # of a provisioner's nodes disruptable at once across settling waves;
+    # "0" disables voluntary disruption, "" = no budget (wave size paces)
+    consolidation_budget: str = field(
+        default_factory=lambda: _env("KARPENTER_CONSOLIDATION_BUDGET", "")
+    )
     # leader election: path to a shared lease file; empty = single-process,
     # no election (reference: cmd/controller/main.go:84-85)
     leader_election_lease: str = field(
@@ -216,6 +223,13 @@ class Options:
             errs.append("kube client burst must be positive")
         if self.consolidation_wave_size <= 0:
             errs.append("consolidation wave size must be positive")
+        if self.consolidation_budget:
+            from karpenter_tpu.controllers.disruption import parse_budget
+
+            try:
+                parse_budget(self.consolidation_budget)
+            except ValueError as e:
+                errs.append(f"consolidation budget: {e}")
         if self.shard_lease_duration <= 0:
             errs.append("shard lease duration must be positive seconds")
         if self.gc_interval <= 0:
@@ -471,6 +485,13 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         default=opts.consolidation_wave_size,
         help="evict-mode pacing: nodes retired per consolidation wave",
     )
+    ap.add_argument(
+        "--consolidation-budget", default=opts.consolidation_budget,
+        help="default disruption budget for provisioners without "
+        "spec.disruptionBudget: a count ('3') or percent ('20%%') of "
+        "nodes disruptable at once; '0' disables voluntary disruption, "
+        "'' = unbudgeted (docs/consolidation.md)",
+    )
     ns = ap.parse_args(argv)
     out = Options(
         cluster_name=ns.cluster_name,
@@ -487,6 +508,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         solver_shm_dir=ns.solver_shm_dir,
         consolidation_enabled=ns.consolidation,
         consolidation_wave_size=ns.consolidation_wave_size,
+        consolidation_budget=ns.consolidation_budget,
         leader_election_lease=ns.leader_election_lease,
         shard_lease=ns.shard_lease,
         shard_lease_duration=ns.shard_lease_duration,

@@ -1,0 +1,21 @@
+# karplint-fixture: expect=event-decision-id
+"""A consolidation wave emitting its Warning event WITHOUT the
+decision-id keyword: the operator sees "budget blocked" with no path
+back into /debug/decisions to ask WHICH wave's plan was deferred — the
+audit dead end rule #13 closes on consolidation event sites too."""
+
+
+class WaveRunner:
+    def __init__(self, cluster, recorder):
+        self.cluster = cluster
+        self.recorder = recorder
+        self.decision_id = "d-1234"
+
+    def budget_blocked(self, provisioner, blocked, allowed):
+        # Warning on the consolidation decision path, no decision_id= —
+        # must fire
+        self.recorder.event(
+            "Provisioner", provisioner, "ConsolidationBudgetBlocked",
+            f"disruption budget deferred {blocked} victim(s) "
+            f"({allowed} allowed)", type="Warning",
+        )

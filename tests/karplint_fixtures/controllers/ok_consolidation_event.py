@@ -1,0 +1,28 @@
+# karplint-fixture: clean=event-decision-id
+"""The sanctioned consolidation event shapes: every Warning carries the
+wave's decision id (empty before the first record is honest and
+allowed), and the Normal `Consolidated` event may carry one too."""
+
+
+class WaveRunner:
+    def __init__(self, cluster, recorder):
+        self.cluster = cluster
+        self.recorder = recorder
+        self.decision_id = ""
+
+    def budget_blocked(self, provisioner, blocked, allowed):
+        self.recorder.event(
+            "Provisioner", provisioner, "ConsolidationBudgetBlocked",
+            f"disruption budget deferred {blocked} victim(s) "
+            f"({allowed} allowed)", type="Warning",
+            decision_id=self.decision_id,
+        )
+
+    def consolidated(self, provisioner, retired, kept):
+        # Normal events carry no decision obligation, but stamping the id
+        # anyway keeps the audit trail greppable
+        self.recorder.event(
+            "Provisioner", provisioner, "Consolidated",
+            f"retiring {retired} node(s), {kept} kept in place",
+            decision_id=self.decision_id,
+        )

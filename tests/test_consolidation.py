@@ -370,3 +370,498 @@ class TestEvictWavePacing:
         # ...until the settle deadline passes — then it releases (logged)
         now[0] += WAVE_SETTLE_TIMEOUT + 1
         assert controller.wave_settled(provisioner.metadata.name) is True
+
+
+# ---------------------------------------------------------------------------
+# Minimal-move matching + disruption-cost ordering (solver/repack.py)
+# ---------------------------------------------------------------------------
+
+
+class TestMinimalMove:
+    def _node(self, name, itype, capacity_type="on-demand", zone="test-zone-1"):
+        return make_node(
+            name=name, provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: itype, lbl.CAPACITY_TYPE: capacity_type,
+                    lbl.TOPOLOGY_ZONE: zone},
+        )
+
+    def _vnode(self, itype_name, pods):
+        from karpenter_tpu.scheduling.ffd import VirtualNode
+
+        return VirtualNode(
+            constraints=None,
+            instance_type_options=[new_instance_type(itype_name)],
+            pods=pods,
+        )
+
+    def test_exact_match_is_kept_not_moved(self):
+        from karpenter_tpu.solver.repack import minimal_move_match
+
+        p1, p2, p3 = (make_pod(name=f"p{i}") for i in (1, 2, 3))
+        a = self._node("a", "it-big")
+        b = self._node("b", "it-big")
+        node_pods = {"a": [p1, p2], "b": [p3]}
+        # the proposal re-creates a's packing verbatim and re-seats p3
+        # elsewhere: a is its own replacement; only b churns
+        proposed = [self._vnode("it-big", [p1, p2]), self._vnode("it-small", [p3])]
+        match = minimal_move_match([a, b], node_pods, proposed)
+        assert [n.metadata.name for n in match.keep] == ["a"]
+        assert [n.metadata.name for n in match.retire] == ["b"]
+        assert len(match.launch) == 1
+        assert [p.metadata.name for p in match.moves] == ["p3"]
+
+    def test_same_pods_different_instance_type_is_not_a_match(self):
+        from karpenter_tpu.solver.repack import minimal_move_match
+
+        p1 = make_pod(name="p1")
+        a = self._node("a", "it-big")
+        # the proposal wants the same pod set on a CHEAPER type — the
+        # signature must not pair them, or the downsize would never happen
+        proposed = [self._vnode("it-small", [p1])]
+        match = minimal_move_match([a], {"a": [p1]}, proposed)
+        assert match.keep == []
+        assert [n.metadata.name for n in match.retire] == ["a"]
+        assert len(match.launch) == 1
+
+    def test_duplicate_signatures_pair_one_to_one(self):
+        from karpenter_tpu.solver.repack import minimal_move_match
+
+        p1, p2 = make_pod(name="p1"), make_pod(name="p2")
+        a = self._node("a", "it-big")
+        b = self._node("b", "it-big")
+        # two empty-identical worlds, but the proposal needs only one of
+        # the signature — the pool must not double-spend the match
+        proposed = [self._vnode("it-big", [p1]), self._vnode("it-big", [p2])]
+        match = minimal_move_match(
+            [a, b], {"a": [p1], "b": [p1]}, proposed
+        )
+        # only a (name-ordered) holds [p1]; the [p2] vnode has no twin
+        assert [n.metadata.name for n in match.keep] == ["a"]
+        assert [n.metadata.name for n in match.retire] == ["b"]
+
+    def test_retirement_orders_cheapest_disruption_first(self):
+        from karpenter_tpu.solver.repack import order_retirement
+
+        cheap = self._node("cheap", "it-small")
+        pricey = self._node("pricey", "it-big")
+        out = order_retirement(
+            [pricey, cheap], {},
+            {"it-small": 0.1, "it-big": 2.0},
+            lambda ct, z: 0.0,
+        )
+        assert [n.metadata.name for n in out] == ["cheap", "pricey"]
+
+    def test_interruption_risk_discounts_doomed_capacity(self):
+        from karpenter_tpu.solver.repack import order_retirement
+
+        stable = self._node("stable", "it-big", capacity_type="on-demand")
+        doomed = self._node("doomed", "it-big", capacity_type="spot")
+        # same price, but the cloud keeps reclaiming spot in this zone:
+        # the voluntary wave should spend its budget there first
+        out = order_retirement(
+            [stable, doomed], {},
+            {"it-big": 1.0},
+            lambda ct, z: 0.9 if ct == "spot" else 0.0,
+        )
+        assert [n.metadata.name for n in out] == ["doomed", "stable"]
+
+    def test_move_charge_prefers_emptier_nodes(self):
+        from karpenter_tpu.solver.repack import order_retirement
+
+        empty = self._node("empty", "it-big")
+        crowded = self._node("crowded", "it-big")
+        out = order_retirement(
+            [crowded, empty],
+            {"crowded": [make_pod(name=f"c{i}") for i in range(5)], "empty": []},
+            {"it-big": 1.0},
+            lambda ct, z: 0.0,
+        )
+        assert [n.metadata.name for n in out] == ["empty", "crowded"]
+
+    def test_disruption_cost_clamps_risk(self):
+        from karpenter_tpu.solver.repack import MOVE_COST, disruption_cost
+
+        node = self._node("n", "it")
+        # risk over 1 must not turn the cost negative
+        assert disruption_cost(node, [], 2.0, 5.0) == 0.0
+        assert disruption_cost(node, [make_pod()], 2.0, 5.0) == MOVE_COST
+        assert disruption_cost(node, [], 2.0, -1.0) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Plan-time PDB victim screening (controllers/disruption.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPDBScreening:
+    def _evict_env(self):
+        from karpenter_tpu.api.objects import OwnerReference
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(instance_types(20))
+        provisioner = make_provisioner(solver="ffd")
+        c = provisioner.spec.constraints
+        c.requirements = c.requirements.merge(
+            catalog_requirements(provider.get_instance_types())
+        )
+        cluster.create("provisioners", provisioner)
+        controller = ConsolidationController(cluster, provider, migration="evict")
+        owner = OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="rs")
+        for i in range(2):
+            node = make_node(
+                name=f"big-{i}",
+                capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+                provisioner_name="default",
+                labels={lbl.INSTANCE_TYPE: "fake-it-19",
+                        lbl.TOPOLOGY_ZONE: "test-zone-1",
+                        lbl.CAPACITY_TYPE: "on-demand"},
+            )
+            cluster.create("nodes", node)
+            cluster.create(
+                "pods",
+                make_pod(name=f"db-{i}", labels={"app": "db"},
+                         requests={"cpu": "0.5"}, node_name=node.metadata.name,
+                         unschedulable=False, owner=owner),
+            )
+        return cluster, controller, provisioner
+
+    def test_frozen_pdb_excludes_nodes_at_plan_time(self):
+        from tests.factories import make_pdb
+
+        cluster, controller, provisioner = self._evict_env()
+        # minAvailable == replica count: zero disruptions allowed RIGHT NOW
+        cluster.create("pdbs", make_pdb(labels={"app": "db"}, min_available=2))
+        plan = controller.plan(provisioner)
+        assert plan.nodes == []  # both nodes screened out before any cordon
+
+    def test_pdb_with_headroom_does_not_freeze(self):
+        from tests.factories import make_pdb
+
+        cluster, controller, provisioner = self._evict_env()
+        cluster.create("pdbs", make_pdb(labels={"app": "db"}, min_available=1))
+        plan = controller.plan(provisioner)
+        assert len(plan.nodes) == 2
+
+    def test_max_unavailable_zero_freezes(self):
+        from karpenter_tpu.controllers.disruption import pdb_frozen_pod_keys
+        from tests.factories import make_pdb
+
+        cluster, controller, provisioner = self._evict_env()
+        cluster.create("pdbs", make_pdb(labels={"app": "db"}, max_unavailable=0))
+        frozen = pdb_frozen_pod_keys(cluster)
+        assert len(frozen) == 2
+        assert controller.plan(provisioner).nodes == []
+
+    def test_unrelated_pdb_does_not_freeze(self):
+        from karpenter_tpu.controllers.disruption import pdb_frozen_pod_keys
+        from tests.factories import make_pdb
+
+        cluster, controller, provisioner = self._evict_env()
+        cluster.create("pdbs", make_pdb(labels={"app": "other"}, min_available=5))
+        assert pdb_frozen_pod_keys(cluster) == set()
+
+
+# ---------------------------------------------------------------------------
+# The journaled, orchestrated wave + crash replay (launch/recovery.py)
+# ---------------------------------------------------------------------------
+
+
+def orchestrated_env(n_nodes, clock=None, journal=None):
+    """Evict-mode controller wired the way main.py wires it: the
+    taint→replace→drain orchestrator plus a crash journal."""
+    from karpenter_tpu.api.objects import OwnerReference
+    from karpenter_tpu.interruption.orchestrator import Orchestrator
+    from karpenter_tpu.launch.journal import MemoryLaunchJournal
+
+    cluster = Cluster(clock=clock) if clock else Cluster()
+    provider = FakeCloudProvider(instance_types(20))
+    provisioner = make_provisioner(solver="ffd")
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(
+        catalog_requirements(provider.get_instance_types())
+    )
+    cluster.create("provisioners", provisioner)
+    journal = journal if journal is not None else MemoryLaunchJournal()
+    controller = ConsolidationController(
+        cluster, provider, migration="evict",
+        orchestrator=Orchestrator(cluster, provider, None, None),
+        journal=journal,
+    )
+    owner = OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="rs")
+    for i in range(n_nodes):
+        node = make_node(
+            name=f"big-{i}",
+            capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+            provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: "fake-it-19",
+                    lbl.TOPOLOGY_ZONE: "test-zone-1",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+        )
+        cluster.create("nodes", node)
+        cluster.create(
+            "pods",
+            make_pod(name=f"pod-{i}", requests={"cpu": "0.5"},
+                     node_name=node.metadata.name, unschedulable=False,
+                     owner=owner),
+        )
+    return cluster, controller, provisioner, journal
+
+
+class TestJournaledWave:
+    def test_wave_journaled_before_first_victim_is_touched(self):
+        from karpenter_tpu.launch.journal import MemoryLaunchJournal
+
+        cordoned_at_record = []
+
+        class SpyJournal(MemoryLaunchJournal):
+            def record_intent(self, *args, **kwargs):
+                cordoned_at_record.append(
+                    sum(1 for n in spy_cluster.nodes() if n.spec.unschedulable)
+                )
+                return super().record_intent(*args, **kwargs)
+
+        cluster, controller, provisioner, journal = orchestrated_env(
+            12, journal=SpyJournal()
+        )
+        spy_cluster = cluster
+        controller.reconcile("default")
+        # the intent was written while ZERO victims were cordoned — the
+        # entry is the complete blast radius for a crash at ANY point
+        assert cordoned_at_record == [0]
+        (entry,) = journal.unresolved()
+        assert entry.marker == "consolidation"
+        assert entry.decision_id  # tied to the audit record
+        assert len(entry.victims) == controller.wave_size
+        # every journaled victim is now draining (orchestrator handoff)
+        for name in entry.victims:
+            node = cluster.try_get("nodes", name, namespace="")
+            assert node is not None and node.metadata.deletion_timestamp is not None
+
+    def test_settled_wave_resolves_journal_and_counts_reclaimed(self):
+        cluster, controller, provisioner, journal = orchestrated_env(12)
+        controller.reconcile("default")
+        (entry,) = journal.unresolved()
+        # finish the drains (the termination controller's job) and re-seat
+        # the displaced pods
+        for name in entry.victims:
+            node = cluster.try_get("nodes", name, namespace="")
+            cluster.remove_finalizer("nodes", node, lbl.TERMINATION_FINALIZER)
+        survivor = next(
+            n for n in cluster.nodes() if n.metadata.name not in entry.victims
+        )
+        for p in cluster.pods():
+            if not p.spec.node_name:
+                cluster.bind(p, survivor.metadata.name)
+        assert controller.wave_settled("default") is True
+        assert journal.unresolved() == []
+        assert controller.nodes_reclaimed == len(entry.victims)
+        assert controller.ledger.in_flight("default") == 0
+
+    def test_events_carry_the_decision_id(self):
+        from karpenter_tpu.kube.events import DECISION_ID_ANNOTATION
+
+        cluster, controller, provisioner, journal = orchestrated_env(12)
+        controller.reconcile("default")
+        (entry,) = journal.unresolved()
+        stamped = [
+            e for e in cluster.list("events")
+            if e.metadata.annotations.get(DECISION_ID_ANNOTATION)
+            == entry.decision_id
+        ]
+        # the wave summary (Consolidated) and every per-victim drain
+        # warning rejoin the same audit record
+        reasons = {e.reason for e in stamped}
+        assert "Consolidated" in reasons
+        assert "ConsolidationDrain" in reasons
+
+
+class TestCrashedWaveReplay:
+    def _crashed_wave(self):
+        """The post-crash world: intent journaled, some victims cordoned,
+        the owning replica dead before any drain handoff."""
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.launch.journal import MemoryLaunchJournal
+
+        cluster = Cluster()
+        journal = MemoryLaunchJournal(clock=lambda: 0.0)
+        for i in range(3):
+            node = make_node(name=f"victim-{i}", provisioner_name="default")
+            cluster.create("nodes", node)
+        for i in range(2):  # the crash hit after cordoning two of three
+            node = cluster.get("nodes", f"victim-{i}", namespace="")
+            node.spec.unschedulable = True
+            node.spec.taints.append(
+                Taint(key=lbl.INTERRUPTION_TAINT_KEY, value="consolidation",
+                      effect="NoSchedule")
+            )
+        journal.record_intent(
+            "consolidation-deadbeef", "default", marker="consolidation",
+            victims=["victim-0", "victim-1", "victim-2"],
+            decision_id="d-123",
+        )
+        (entry,) = journal.unresolved()
+        return cluster, journal, entry
+
+    def test_replay_uncordons_survivors_and_resolves(self):
+        from karpenter_tpu.launch.recovery import (
+            CONSOLIDATION_REPLAYED,
+            replay_entry,
+        )
+
+        cluster, journal, entry = self._crashed_wave()
+        out = replay_entry(
+            journal, cluster, None, entry, {}, now=100.0, replay_after=10.0
+        )
+        assert out == CONSOLIDATION_REPLAYED
+        assert journal.unresolved() == []
+        for i in range(3):
+            node = cluster.get("nodes", f"victim-{i}", namespace="")
+            assert node.spec.unschedulable is False
+            assert not any(
+                t.key == lbl.INTERRUPTION_TAINT_KEY for t in node.spec.taints
+            )
+
+    def test_replay_preserves_unrelated_taints(self):
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.launch.recovery import replay_entry
+
+        cluster, journal, entry = self._crashed_wave()
+        node = cluster.get("nodes", "victim-0", namespace="")
+        node.spec.taints.append(
+            Taint(key="dedicated", value="gpu", effect="NoSchedule")
+        )
+        replay_entry(journal, cluster, None, entry, {}, now=100.0,
+                     replay_after=10.0)
+        node = cluster.get("nodes", "victim-0", namespace="")
+        assert [t.key for t in node.spec.taints] == ["dedicated"]
+
+    def test_replay_skips_already_deleted_victims(self):
+        from karpenter_tpu.launch.recovery import (
+            CONSOLIDATION_REPLAYED,
+            replay_entry,
+        )
+
+        cluster, journal, entry = self._crashed_wave()
+        cluster.delete("nodes", "victim-2", namespace="")
+        out = replay_entry(
+            journal, cluster, None, entry, {}, now=100.0, replay_after=10.0
+        )
+        assert out == CONSOLIDATION_REPLAYED
+        assert journal.unresolved() == []
+
+    def test_young_entry_is_left_for_the_live_wave(self):
+        from karpenter_tpu.launch.recovery import PENDING, replay_entry
+
+        cluster, journal, entry = self._crashed_wave()
+        # younger than the replay grace: the owning replica may still be
+        # alive mid-wave — replay must not race it
+        out = replay_entry(
+            journal, cluster, None, entry, {}, now=5.0, replay_after=10.0
+        )
+        assert out == PENDING
+        assert len(journal.unresolved()) == 1
+        assert cluster.get("nodes", "victim-0", namespace="").spec.unschedulable
+
+    def test_uncordon_failure_retries_next_sweep(self):
+        from karpenter_tpu.launch.recovery import PENDING, replay_entry
+
+        cluster, journal, entry = self._crashed_wave()
+
+        def failing_patch(*args, **kwargs):
+            raise RuntimeError("apiserver blip")
+
+        cluster.merge_patch = failing_patch
+        out = replay_entry(
+            journal, cluster, None, entry, {}, now=100.0, replay_after=10.0
+        )
+        assert out == PENDING
+        # the entry survives for the next sweep — resolving on a failed
+        # un-cordon would strand the victims cordoned forever
+        assert len(journal.unresolved()) == 1
+
+    def test_wave_entry_never_reads_as_never_launched(self):
+        from karpenter_tpu.launch.recovery import (
+            CONSOLIDATION_REPLAYED,
+            NEVER_LAUNCHED,
+            replay_entry,
+        )
+
+        cluster, journal, entry = self._crashed_wave()
+        # a wave entry carries no launch token, so the generic ladder
+        # would misread it as NEVER_LAUNCHED and resolve without
+        # un-cordoning anything — the marker branch must win
+        out = replay_entry(
+            journal, cluster, None, entry, {}, now=100.0, replay_after=10.0
+        )
+        assert out == CONSOLIDATION_REPLAYED
+        assert out != NEVER_LAUNCHED
+
+
+class TestWaveSettleHardening:
+    def test_out_of_band_victim_delete_settles_cleanly(self):
+        """A victim force-deleted by an operator mid-wave must settle the
+        wave, resolve its journal entry, and release the budget."""
+        cluster, controller, provisioner, journal = orchestrated_env(12)
+        controller.reconcile("default")
+        (entry,) = journal.unresolved()
+        for name in entry.victims:
+            node = cluster.try_get("nodes", name, namespace="")
+            node.metadata.finalizers = []
+            cluster.remove_finalizer("nodes", node, lbl.TERMINATION_FINALIZER)
+        survivor = next(
+            n for n in cluster.nodes() if n.metadata.name not in entry.victims
+        )
+        for p in cluster.pods():
+            if not p.spec.node_name:
+                cluster.bind(p, survivor.metadata.name)
+        assert controller.wave_settled("default") is True
+        assert journal.unresolved() == []
+        assert controller.ledger.in_flight("default") == 0
+
+    def test_timeout_uncordons_stranded_victims(self):
+        """A victim whose drain handoff died (cordoned, NOT deleting — the
+        terminally-failed-replacement shape) must be un-cordoned when the
+        settle timeout finishes the wave: a cordoned survivor is pure
+        capacity loss."""
+        from karpenter_tpu.controllers.consolidation import WAVE_SETTLE_TIMEOUT
+        from karpenter_tpu.interruption.types import DisruptionNotice
+
+        now = [1000.0]
+        cluster, controller, provisioner, journal = orchestrated_env(
+            12, clock=lambda: now[0]
+        )
+        real = controller.orchestrator
+
+        class CordonOnly:
+            """Taints+cordons the victim, then dies before the drain —
+            the mid-wave failure the timeout path must clean up."""
+
+            def consolidate(self, node, decision_id="", on_release=None):
+                real._taint_and_cordon(
+                    node,
+                    DisruptionNotice(
+                        kind="consolidation", node_name=node.metadata.name,
+                        grace_period_seconds=0.0,
+                    ),
+                )
+                return None
+
+        controller.orchestrator = CordonOnly()
+        controller.reconcile("default")
+        (entry,) = journal.unresolved()
+        stranded = entry.victims
+        for name in stranded:
+            assert cluster.get("nodes", name, namespace="").spec.unschedulable
+        # cordoned victims still standing: the gate holds...
+        assert controller.wave_settled("default") is False
+        now[0] += WAVE_SETTLE_TIMEOUT + 1
+        # ...until the deadline — then the wave is FINISHED, not abandoned
+        assert controller.wave_settled("default") is True
+        for name in stranded:
+            node = cluster.get("nodes", name, namespace="")
+            assert node.spec.unschedulable is False
+            assert not any(
+                t.key == lbl.INTERRUPTION_TAINT_KEY for t in node.spec.taints
+            )
+        assert journal.unresolved() == []
+        assert controller.ledger.in_flight("default") == 0

@@ -263,18 +263,22 @@ class TestConflictRequeue:
         manager.register("stormy", reconcile, concurrency=1)
         manager.start()
         try:
+            def backoff_logged():
+                return any(
+                    "conflicted" in r.message and "backing off" in r.message
+                    for r in caplog.records
+                )
+
             with caplog.at_level(logging.WARNING, logger="karpenter.manager"):
                 manager.enqueue("stormy", "obj")
                 deadline = time.monotonic() + 10
                 reg = manager._controllers["stormy"]
-                while (
-                    time.monotonic() < deadline
-                    and reg.conflicts.get("obj", 0) < Manager.CONFLICT_RETRY_CAP
-                ):
+                # the worker bumps the counter BEFORE emitting the warning,
+                # so wait for the log record itself, not just the count
+                while time.monotonic() < deadline and not backoff_logged():
                     time.sleep(0.05)
             assert reg.conflicts["obj"] >= Manager.CONFLICT_RETRY_CAP
-            assert any("conflicted" in r.message and "backing off" in r.message
-                       for r in caplog.records)
+            assert backoff_logged()
         finally:
             manager.stop()
 

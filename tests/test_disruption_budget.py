@@ -1,0 +1,280 @@
+"""Disruption-budget arithmetic and enforcement (docs/consolidation.md):
+the budget grammar, the PDB-style percent resolution, the cross-wave
+ledger, and the consolidation controller honoring all of it — per wave
+AND across concurrently-settling waves."""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.requirements import catalog_requirements
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.controllers.disruption import (
+    BudgetLedger,
+    parse_budget,
+    resolve_budget,
+)
+from karpenter_tpu.controllers.provisioning import REQUEUE_INTERVAL
+from karpenter_tpu.kube.client import Cluster
+from tests.factories import make_node, make_pod, make_provisioner
+
+
+class TestParseBudget:
+    def test_counts_and_percents_normalize(self):
+        assert parse_budget("3") == "3"
+        assert parse_budget("20%") == "20%"
+        assert parse_budget(" 20% ") == "20%"
+        assert parse_budget("007") == "7"
+
+    def test_unset_is_none(self):
+        assert parse_budget(None) is None
+        assert parse_budget("") is None
+        assert parse_budget("   ") is None
+
+    def test_zero_is_preserved_not_none(self):
+        # "0" is the explicit off switch — it must survive normalization,
+        # not collapse into "unset"
+        assert parse_budget("0") == "0"
+        assert parse_budget("0%") == "0%"
+
+    @pytest.mark.parametrize("bad", ["abc", "-1", "-5%", "150%", "1.5", "3%%"])
+    def test_garbage_fails_admission(self, bad):
+        # a typo'd budget must fail validation, not silently disable the
+        # safety layer
+        with pytest.raises(ValueError):
+            parse_budget(bad)
+
+
+class TestResolveBudget:
+    def test_count_is_absolute(self):
+        assert resolve_budget("3", 10) == 3
+        assert resolve_budget("3", 2) == 3  # count may exceed the cluster
+
+    def test_percent_rounds_up_like_pdb(self):
+        # intstr.GetScaledValueFromIntOrPercent with roundUp=true
+        assert resolve_budget("20%", 10) == 2
+        assert resolve_budget("25%", 10) == 3  # ceil(2.5)
+        assert resolve_budget("50%", 3) == 2  # ceil(1.5)
+
+    def test_small_cluster_never_rounds_to_zero(self):
+        # a non-zero percent on a non-empty cluster must pace disruption,
+        # not quietly become the off switch
+        assert resolve_budget("1%", 3) == 1
+        assert resolve_budget("10%", 1) == 1
+
+    def test_zero_disables(self):
+        assert resolve_budget("0", 10) == 0
+        assert resolve_budget("0%", 10) == 0
+
+    def test_empty_cluster_allows_nothing(self):
+        assert resolve_budget("20%", 0) == 0
+
+    def test_unset_is_none(self):
+        assert resolve_budget(None, 10) is None
+
+
+class TestBudgetLedger:
+    def test_reserve_admits_prefix_up_to_allowed(self):
+        ledger = BudgetLedger()
+        # prefix, not arbitrary subset: callers pass victims
+        # cheapest-disruption-first and the admitted set honors that order
+        assert ledger.reserve("p", ["a", "b", "c", "d"], 2) == ["a", "b"]
+        assert ledger.in_flight("p") == 2
+
+    def test_concurrent_waves_share_one_account(self):
+        ledger = BudgetLedger()
+        assert ledger.reserve("p", ["a", "b"], 3) == ["a", "b"]
+        # a second wave of the SAME provisioner draws from the same
+        # account: only one more slot left
+        assert ledger.reserve("p", ["c", "d"], 3) == ["c"]
+        # other provisioners have their own account
+        assert ledger.reserve("q", ["x", "y"], 3) == ["x", "y"]
+        assert ledger.in_flight("p") == 3
+        assert ledger.in_flight("q") == 2
+
+    def test_already_held_names_do_not_double_count(self):
+        ledger = BudgetLedger()
+        ledger.reserve("p", ["a"], 2)
+        # re-reserving a held victim is a no-op, not a second slot
+        assert ledger.reserve("p", ["a", "b"], 2) == ["b"]
+        assert ledger.in_flight("p") == 2
+
+    def test_release_returns_capacity(self):
+        ledger = BudgetLedger()
+        ledger.reserve("p", ["a", "b"], 2)
+        assert ledger.reserve("p", ["c"], 2) == []
+        ledger.release("p", ["a"])  # partial settle (out-of-band delete)
+        assert ledger.reserve("p", ["c"], 2) == ["c"]
+        ledger.release("p", ["b", "c"])
+        assert ledger.in_flight("p") == 0
+
+    def test_release_unknown_is_harmless(self):
+        ledger = BudgetLedger()
+        ledger.release("p", ["never-reserved"])
+        assert ledger.in_flight("p") == 0
+
+    def test_zero_allowed_admits_nothing(self):
+        ledger = BudgetLedger()
+        assert ledger.reserve("p", ["a"], 0) == []
+        assert ledger.in_flight("p") == 0
+
+
+def evict_env(n_nodes, budget=None, default_budget=None, ledger=None):
+    """An evict-mode controller over a fragmented cluster whose plan would
+    happily retire everything — the budget is the only brake under test."""
+    cluster = Cluster()
+    provider = FakeCloudProvider(instance_types(20))
+    provisioner = make_provisioner(solver="ffd")
+    provisioner.spec.disruption_budget = budget
+    c = provisioner.spec.constraints
+    c.requirements = c.requirements.merge(
+        catalog_requirements(provider.get_instance_types())
+    )
+    cluster.create("provisioners", provisioner)
+    controller = ConsolidationController(
+        cluster, provider, migration="evict",
+        ledger=ledger, default_budget=default_budget,
+    )
+    owner = OwnerReference(api_version="apps/v1", kind="ReplicaSet", name="rs")
+    for i in range(n_nodes):
+        node = make_node(
+            name=f"big-{i}",
+            capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+            provisioner_name="default",
+            labels={lbl.INSTANCE_TYPE: "fake-it-19",
+                    lbl.TOPOLOGY_ZONE: "test-zone-1",
+                    lbl.CAPACITY_TYPE: "on-demand"},
+        )
+        cluster.create("nodes", node)
+        cluster.create(
+            "pods",
+            make_pod(name=f"pod-{i}", requests={"cpu": "0.5"},
+                     node_name=node.metadata.name, unschedulable=False,
+                     owner=owner),
+        )
+    return cluster, controller, provisioner
+
+
+class TestControllerEnforcement:
+    def test_count_budget_caps_the_wave(self):
+        cluster, controller, provisioner = evict_env(20, budget="2")
+        before = {n.metadata.name for n in cluster.nodes()}
+        controller.reconcile("default")
+        after = {n.metadata.name for n in cluster.nodes()}
+        # wave size is 5, but the budget admits only 2
+        assert len(before - after) == 2
+        assert controller.budget_blocked == 3
+        reasons = {e.reason for e in cluster.list("events")}
+        assert "ConsolidationBudgetBlocked" in reasons
+
+    def test_percent_budget_resolves_against_current_nodes(self):
+        cluster, controller, provisioner = evict_env(20, budget="20%")
+        before = {n.metadata.name for n in cluster.nodes()}
+        controller.reconcile("default")
+        after = {n.metadata.name for n in cluster.nodes()}
+        # 20% of 20 nodes = 4 < wave size 5
+        assert len(before - after) == 4
+        assert controller.budget_blocked == 1
+
+    def test_zero_budget_disables_without_planning(self):
+        cluster, controller, provisioner = evict_env(8, budget="0")
+        assert controller.reconcile("default") == REQUEUE_INTERVAL
+        assert len(cluster.nodes()) == 8  # nothing retired
+        assert controller.waves_executed == 0
+
+    def test_controller_default_applies_when_spec_unset(self):
+        cluster, controller, provisioner = evict_env(20, default_budget="1")
+        before = {n.metadata.name for n in cluster.nodes()}
+        controller.reconcile("default")
+        after = {n.metadata.name for n in cluster.nodes()}
+        assert len(before - after) == 1
+
+    def test_provisioner_spec_wins_over_default(self):
+        cluster, controller, provisioner = evict_env(
+            20, budget="3", default_budget="1"
+        )
+        before = {n.metadata.name for n in cluster.nodes()}
+        controller.reconcile("default")
+        after = {n.metadata.name for n in cluster.nodes()}
+        assert len(before - after) == 3
+
+    def test_unbudgeted_wave_still_paced_by_wave_size(self):
+        from karpenter_tpu.controllers.consolidation import EVICT_WAVE_SIZE
+
+        cluster, controller, provisioner = evict_env(20)
+        before = {n.metadata.name for n in cluster.nodes()}
+        controller.reconcile("default")
+        after = {n.metadata.name for n in cluster.nodes()}
+        assert len(before - after) == EVICT_WAVE_SIZE
+
+    def test_concurrent_waves_draw_from_one_budget(self):
+        # two replicas (two controller instances) sharing one ledger, as
+        # the fleet does during a shard rebalance: their in-flight waves
+        # must never exceed the budget COMBINED
+        ledger = BudgetLedger()
+        cluster, first, provisioner = evict_env(20, budget="3", ledger=ledger)
+        second = ConsolidationController(
+            cluster, first.cloud_provider, migration="evict", ledger=ledger
+        )
+        before = {n.metadata.name for n in cluster.nodes()}
+        first.reconcile("default")
+        after_first = {n.metadata.name for n in cluster.nodes()}
+        assert len(before - after_first) == 3  # first wave took the budget
+        # the first wave has NOT settled; the second replica reconciles
+        second.reconcile("default")
+        after_second = {n.metadata.name for n in cluster.nodes()}
+        # the shared account is exhausted — zero additional disruption
+        assert after_second == after_first
+
+    def test_budget_survives_serde_round_trip(self):
+        from karpenter_tpu.kube.serde import (
+            _provisioner_from_wire,
+            _provisioner_to_wire,
+        )
+
+        p = make_provisioner()
+        p.spec.disruption_budget = "20%"
+        wire = _provisioner_to_wire(p)
+        assert wire["spec"]["disruptionBudget"] == "20%"
+        back = _provisioner_from_wire(wire)
+        assert back.spec.disruption_budget == "20%"
+        # unset stays unset (not "" — "" would read as "budget configured")
+        p.spec.disruption_budget = None
+        assert _provisioner_from_wire(
+            _provisioner_to_wire(p)
+        ).spec.disruption_budget is None
+
+    def test_admission_rejects_bad_budget(self):
+        from karpenter_tpu.api.provisioner import validate_provisioner
+
+        p = make_provisioner()
+        p.spec.disruption_budget = "lots"
+        assert any("disruptionBudget" in e for e in validate_provisioner(p))
+        p.spec.disruption_budget = "20%"
+        assert not any(
+            "disruptionBudget" in e for e in validate_provisioner(p)
+        )
+
+    def test_options_flag_parses_and_validates(self):
+        from karpenter_tpu.options import Options, parse_args
+
+        opts = parse_args(["--consolidation-budget", "20%"])
+        assert opts.consolidation_budget == "20%"
+        bad = Options(consolidation_budget="banana")
+        assert any("consolidation budget" in e for e in bad.validate())
+        assert not any(
+            "consolidation budget" in e
+            for e in Options(consolidation_budget="3").validate()
+        )
+
+    def test_settled_wave_releases_the_budget(self):
+        cluster, controller, provisioner = evict_env(6, budget="2")
+        controller.reconcile("default")
+        # the wave is in flight: its victims hold the budget
+        assert controller.ledger.in_flight("default") == 2
+        # the legacy delete path removed the victims outright and no
+        # displaced pod is pending beyond the baseline — the wave settles
+        # and the budget flows back to the account
+        assert controller.wave_settled("default") is True
+        assert controller.ledger.in_flight("default") == 0

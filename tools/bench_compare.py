@@ -74,6 +74,12 @@ HEADLINE_KEYS: Dict[str, int] = {
     # reported, never fatal (the standard new-key salvage).
     "warm_hit_rate": +1,
     "time_to_ready_p99_s": -1,
+    # disruption-safe consolidation (docs/consolidation.md): capacity the
+    # storm leg actually handed back and the resulting $-delta (negative =
+    # savings, so lower is better). Missing on pre-consolidation rounds is
+    # reported, never fatal (the standard new-key salvage).
+    "consolidation_nodes_reclaimed": +1,
+    "consolidation_cost_delta_usd": -1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"
