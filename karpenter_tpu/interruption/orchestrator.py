@@ -296,6 +296,10 @@ class Orchestrator:
             terminator = self.termination.terminator
             terminator.cordon(node)
             terminator.drain(node, force=True)
+            # The provider already announced this capacity is being
+            # reclaimed, so an ownership/fence check proves nothing here
+            # (PR-6/PR-11 fencing is for leader-driven mutations).
+            # mutation-guard: exempt — cloud-notified interruption path
             terminator.terminate(node)
         logger.warning(
             "interruption deadline: force-terminated %s (%d pod(s) without "

@@ -773,9 +773,11 @@ class StreamClient:
         self._mu = threading.Lock()
         # serializes whole establish attempts (they block up to
         # ESTABLISH_TIMEOUT_S): two racing establishes would each bump
-        # the epoch, orphan the first one's receiver mid-handshake, and
-        # stall its caller the full timeout against a healthy stream
-        self._est_mu = threading.Lock()
+        # the epoch and orphan the first one's receiver mid-handshake.
+        # A flag, not a lock — holding a lock across the handshake wait
+        # would stall the loser the full timeout against a healthy
+        # stream; losers return False and take the unary fallback.
+        self._establishing = False  # guarded-by: self._mu
         self._state = "down"  # guarded-by: self._mu — down|up|closed
         self._credits = 0  # guarded-by: self._mu
         self._hint = 0.05  # guarded-by: self._mu
@@ -825,10 +827,17 @@ class StreamClient:
     def _establish(self) -> bool:
         import grpc  # noqa: F401 — establishing requires a live channel
 
-        with self._est_mu:
-            return self._establish_locked()
+        with self._mu:
+            if self._establishing:
+                return False  # another attempt owns the handshake
+            self._establishing = True
+        try:
+            return self._establish_once()
+        finally:
+            with self._mu:
+                self._establishing = False
 
-    def _establish_locked(self) -> bool:
+    def _establish_once(self) -> bool:
         out: "Queue[object]" = Queue()
         credits_evt = threading.Event()
         with self._mu:

@@ -1,0 +1,20 @@
+# karplint-fixture: expect=mutation-guard
+"""A cloud mutation reachable from a reconcile entry with no
+owned()/fenced() proof anywhere on the call-graph path — the stale-leader
+split-brain shape PR-6/PR-11 fencing exists to prevent."""
+
+
+class Expirer:
+    def __init__(self, cloud_provider, clock):
+        self.cloud_provider = cloud_provider
+        self._clock = clock
+
+    def reconcile(self):
+        for name in self._expired():
+            self._retire(name)
+
+    def _retire(self, name):
+        self.cloud_provider.delete(name)  # no guard on any path here
+
+    def _expired(self):
+        return []

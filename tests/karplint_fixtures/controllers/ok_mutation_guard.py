@@ -1,0 +1,26 @@
+# karplint-fixture: clean=mutation-guard
+"""Near-misses mutation-guard must NOT flag: a lexically prior ownership
+check, the explicit exemption marker for a cloud-notified path, and a
+mutation helper no reconcile entry can reach."""
+
+
+class Scaler:
+    def __init__(self, cloud_provider, ownership):
+        self.cloud_provider = cloud_provider
+        self.ownership = ownership
+
+    def reconcile(self):
+        for name in ("a", "b"):
+            if not self.ownership.owns(name):
+                continue
+            self.cloud_provider.delete(name)  # proof precedes the mutation
+
+    def reconcile_interruptions(self, node):
+        # the provider already reclaimed this capacity; fencing proves
+        # nothing on this path, so the exemption is explicit + grep-able
+        # mutation-guard: exempt — cloud-notified interruption path
+        self.cloud_provider.terminate(node)
+
+    def _maintenance(self, name):
+        # never called from a reconcile entry: outside the contract
+        self.cloud_provider.create(name)

@@ -1,0 +1,19 @@
+# karplint-fixture: expect=drift-flag,drift-chart
+"""A drifted flag surface: `--cache-dir` and its env twin shipped without
+a docs row, the docs table keeps a retired flag's row, the deploy
+manifest passes a flag nothing defines AND sets a real flag the chart
+cannot render, the chart template reads an undefined values key, and
+values.yaml carries a knob no template reads."""
+import argparse
+import os
+
+
+def _env(key, default):
+    return os.environ.get(key, default)
+
+
+def parse(argv=None):
+    ap = argparse.ArgumentParser(prog="sim")
+    ap.add_argument("--listen-port", default=_env("SIM_LISTEN_PORT", "8080"))
+    ap.add_argument("--cache-dir", default=_env("SIM_CACHE_DIR", ""))
+    return ap.parse_args(argv)

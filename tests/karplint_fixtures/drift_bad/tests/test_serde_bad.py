@@ -1,0 +1,4 @@
+# Fuzz-corpus stub for the drift-status fixture: it exercises
+# STATUS_ACCEPTED only, so the sibling wire.py's other constants fire
+# the never-fuzzed check. (All comments on purpose — pytest collects
+# nothing here.)

@@ -1,0 +1,14 @@
+# karplint-fixture: expect=drift-status
+"""A drifted wire-constant surface: STATUS_REJECTED is dispatched on but
+never fuzzed, and the resume capability bit below is defined on this end
+only — nothing anywhere dispatches on it."""
+
+STATUS_ACCEPTED = 0
+STATUS_REJECTED = 1
+PROTO_RESUME = 2
+
+
+def encode(status):
+    if status == STATUS_REJECTED:
+        return b"\x01"
+    return bytes([STATUS_ACCEPTED])

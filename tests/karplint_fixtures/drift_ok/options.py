@@ -1,0 +1,20 @@
+# karplint-fixture: clean=drift-flag,drift-chart
+"""A consistent flag surface the drift rules must NOT flag: every flag
+and env twin documented, the deploy manifest renders only defined flags
+(including the `--no-verbose` boolean twin), and the chart's values keys
+and template references line up exactly."""
+import argparse
+import os
+
+
+def _env(key, default):
+    return os.environ.get(key, default)
+
+
+def parse(argv=None):
+    ap = argparse.ArgumentParser(prog="sim")
+    ap.add_argument("--listen-port", default=_env("SIM_OK_LISTEN_PORT", "8080"))
+    ap.add_argument(
+        "--verbose", action=argparse.BooleanOptionalAction, default=False
+    )
+    return ap.parse_args(argv)

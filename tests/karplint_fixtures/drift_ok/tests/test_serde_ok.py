@@ -1,0 +1,4 @@
+# Fuzz-corpus stub for the drift-status near-miss: it names both of the
+# sibling wire.py's words — STATUS_READY and STATUS_BUSY — so neither
+# fires the never-fuzzed check. (All comments on purpose — pytest
+# collects nothing here.)

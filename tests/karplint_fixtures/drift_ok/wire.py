@@ -1,0 +1,12 @@
+# karplint-fixture: clean=drift-status
+"""A consistent wire-constant surface: both words are dispatched on by
+the decoder below and both appear in the sibling fuzz corpus."""
+
+STATUS_READY = 0
+STATUS_BUSY = 1
+
+
+def decode(word):
+    if word == STATUS_BUSY:
+        return "busy"
+    return "ready" if word == STATUS_READY else "?"

@@ -1,0 +1,24 @@
+# karplint-fixture: expect=lock-blocking
+"""Blocking work reachable while a lock is held — the convoy shape the
+PR-4 fetch-off-the-solve-lock invariant forbids: one interprocedural
+witness (a helper that sleeps) and one direct future wait."""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._state = {}
+
+    def refresh(self):
+        with self._state_lock:
+            self._fetch()  # callee sleeps: every reader stalls behind it
+
+    def _fetch(self):
+        time.sleep(0.5)
+        return dict(self._state)
+
+    def wait_result(self, fut):
+        with self._state_lock:
+            return fut.result(timeout=5)  # RPC wait under the lock

@@ -1,0 +1,34 @@
+# karplint-fixture: expect=lock-order
+"""Lock-order inversion reachable only through the call graph: `fill`
+orders fill_lock -> book_lock lexically, `cancel` orders book_lock ->
+fill_lock through a helper — two threads entering from different points
+deadlock. Plus the degenerate case: a helper re-acquiring the
+non-reentrant Lock its caller already holds."""
+import threading
+
+
+class Exchange:
+    def __init__(self):
+        self._fill_lock = threading.Lock()
+        self._book_lock = threading.Lock()
+
+    def fill(self):
+        with self._fill_lock:
+            with self._book_lock:  # edge: fill_lock -> book_lock
+                pass
+
+    def cancel(self):
+        with self._book_lock:
+            self._revoke()  # edge: book_lock -> fill_lock, via the callee
+
+    def _revoke(self):
+        with self._fill_lock:
+            pass
+
+    def restate(self):
+        with self._book_lock:
+            self._audit()  # re-acquires book_lock: one-thread deadlock
+
+    def _audit(self):
+        with self._book_lock:
+            pass

@@ -1,0 +1,35 @@
+# karplint-fixture: clean=lock-order,lock-blocking
+"""Near-misses the lock rules must NOT flag: one consistent global lock
+order, Condition.wait on the held lock's own condition variable (the
+sanctioned sleep-releases-the-lock pattern), and blocking work done
+after the lock is released."""
+import threading
+import time
+
+
+class Journal:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self._file_lock = threading.Lock()
+        self._flush_cond = threading.Condition(self._index_lock)
+
+    def append(self):
+        with self._index_lock:
+            with self._file_lock:  # same order everywhere: no cycle
+                pass
+
+    def compact(self):
+        with self._index_lock:
+            with self._file_lock:
+                pass
+
+    def wait_flush(self):
+        with self._flush_cond:
+            # waits on the HELD lock's own cv: the wait releases it
+            self._flush_cond.wait(timeout=0.5)
+
+    def drain(self):
+        with self._file_lock:
+            snapshot = True
+        time.sleep(0.01)  # blocking, but the lock is already released
+        return snapshot

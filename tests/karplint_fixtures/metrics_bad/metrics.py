@@ -7,3 +7,9 @@ NODES = Gauge("nodes_total", "Nodes.", namespace="karpenter")  # gauge ends _tot
 SOLVE = Histogram("solve_time", "Solve time.", namespace="karpenter")  # no unit
 GHOST = Counter("karpenter_ghost_total", "Not in docs/metrics.md.")
 WEIRD = Gauge("Karpenter__weird_", "Bad charset.")
+# documented, conventionally named — but the docs row promises labels
+# (node, zone) while the registration declares (node, reason)
+MISLABELED = Counter(
+    "karpenter_mislabeled_total", "Docs promise different labels.",
+    ["node", "reason"],
+)

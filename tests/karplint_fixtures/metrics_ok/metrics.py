@@ -5,3 +5,14 @@ from prometheus_client import Counter, Gauge, Histogram
 THINGS = Counter("ok_things_total", "Things that happened.", namespace="karpenter")
 DEPTH = Gauge("ok_queue_depth", "Items queued.", namespace="karpenter")
 DURATION = Histogram("ok_op_duration_seconds", "Op latency.", namespace="karpenter")
+# labels matching the docs row exactly, and a shared label-set constant
+# behind a parenthesized (wildcard) docs cell
+LABELED = Counter(
+    "ok_labeled_total", "Labeled things.", ["node", "reason"],
+    namespace="karpenter",
+)
+SHARED_LABELS = ["node", "zone"]
+SHARED = Gauge(
+    "ok_shared_gauge", "Shared-label gauge.", SHARED_LABELS,
+    namespace="karpenter",
+)

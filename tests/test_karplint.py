@@ -8,6 +8,7 @@ fixture can't express: baselines, fingerprints, P0 non-baselineability,
 and the analyzer's performance envelope.
 """
 
+import json
 import sys
 import time
 from pathlib import Path
@@ -47,16 +48,22 @@ def test_full_repo_analyze_under_10s():
     assert time.perf_counter() - t0 < 10.0
 
 
-def test_all_thirteen_rules_registered():
+def test_all_nineteen_rules_registered():
     from tools.karplint import rule_names
 
     assert rule_names() == [
         "bounded-wait",
         "debug-endpoint",
+        "drift-chart",
+        "drift-flag",
+        "drift-status",
         "event-decision-id",
         "kube-transport",
+        "lock-blocking",
         "lock-guard",
+        "lock-order",
         "metric-name",
+        "mutation-guard",
         "patch-literal-list",
         "reconcile-io",
         "retry-idempotent",
@@ -65,6 +72,69 @@ def test_all_thirteen_rules_registered():
         "tracer-dtype",
         "tracer-host-sync",
     ]
+
+
+def test_callgraph_is_built_once_per_fileset():
+    # every interprocedural rule (tracer pair, lock pair, mutation-guard)
+    # shares the memoized graph: a full run constructs at most two — the
+    # whole-tree graph plus the solver/-scoped one — no matter how many
+    # rules consume them
+    from tools.karplint import callgraph
+
+    before = callgraph.BUILD_COUNT
+    Analyzer(REPO_ROOT, ["karpenter_tpu"]).run(baseline=None)
+    assert callgraph.BUILD_COUNT - before <= 2
+
+
+# --- CLI surfaces: drift subcommand + SARIF ---------------------------------
+
+
+def test_drift_subcommand_runs_only_drift_rules(capsys):
+    # the drift_bad fixture tree carries flag/chart/status drift on purpose
+    rc = main([
+        "--root", str(CORPUS / "drift_bad"), "--no-baseline",
+        "--format", "json", "drift", ".",
+    ])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rules_fired = {f["rule"] for f in payload["findings"]}
+    assert rules_fired  # the seeded drift must be caught
+    assert all(r.startswith("drift-") for r in rules_fired)
+
+
+def test_drift_subcommand_clean_on_repo_tree():
+    assert main(["--root", str(REPO_ROOT), "--no-baseline",
+                 "drift", "karpenter_tpu"]) == 0
+
+
+def test_drift_subcommand_rejects_rules_without_drift(capsys):
+    rc = main([
+        "--root", str(REPO_ROOT), "--rules", "metric-name", "drift", ".",
+    ])
+    assert rc == 2
+
+
+def test_sarif_output_is_valid_and_levels_map_severity(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(LOCK_VIOLATION.format(suffix=""))
+    rc = main([
+        "--root", str(tmp_path), "--no-baseline", "--rules", "lock-guard",
+        "--format", "sarif", ".",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "karplint"
+    # the driver catalogs the active rules with default levels
+    catalog = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    assert catalog["lock-guard"]["defaultConfiguration"]["level"] == "error"
+    (result,) = run["results"]
+    assert result["ruleId"] == "lock-guard"
+    assert result["level"] == "error"  # P0 -> error
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] >= 1
+    assert run["invocations"][0]["executionSuccessful"] is True
 
 
 # --- suppression ------------------------------------------------------------

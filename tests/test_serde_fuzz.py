@@ -602,6 +602,71 @@ def test_stream_byte_flip_corpus_never_silently_differs(seed):
     )
 
 
+# -- capability word: the PROTO_* bits over the OpenSession payload ----------
+#
+# Every trailer/transport feature is gated on a capability bit the sidecar
+# advertises in its OpenSession response payload (an i32 word old clients
+# never read). The fuzz contract: every subset of the advertised bits must
+# survive the status-response codec exactly — a dropped or aliased bit
+# would make a client engage a trailer its peer can't parse (the
+# rolling-upgrade crash the bits exist to prevent).
+
+PROTO_BITS = ["PROTO_TRACE_TRAILER", "PROTO_DEADLINE", "PROTO_CHECKSUM",
+              "PROTO_STREAM"]
+
+
+def test_proto_feature_bits_distinct_and_aggregated():
+    from karpenter_tpu.solver import service
+
+    vals = [getattr(service, name) for name in PROTO_BITS]
+    assert len(set(vals)) == len(vals)
+    for a in vals:
+        assert a & (a - 1) == 0, "capability bits must be single bits"
+    agg = 0
+    for v in vals:
+        agg |= v
+    assert service.PROTO_FEATURES == agg
+
+
+@pytest.mark.parametrize("mask", range(16))
+def test_proto_capability_word_round_trips_every_subset(mask):
+    """Each of the 2^4 subsets of {PROTO_TRACE_TRAILER, PROTO_DEADLINE,
+    PROTO_CHECKSUM, PROTO_STREAM} survives OpenSession payload encode →
+    _split_status decode with every bit intact."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    bits = [getattr(service, name) for name in PROTO_BITS]
+    features = 0
+    for i, bit in enumerate(bits):
+        if mask & (1 << i):
+            features |= bit
+    frame = service._status_response(
+        service.STATUS_OK, [np.array([features], np.int32)]
+    )
+    word, payload = service.RemoteSolver._split_status(frame)
+    assert word == service.STATUS_OK
+    decoded = int(payload[0].reshape(-1)[0]) if payload else 0
+    for name, bit in zip(PROTO_BITS, bits):
+        assert bool(decoded & bit) == bool(features & bit), name
+
+
+def test_proto_old_server_advertises_nothing():
+    """A pre-capability sidecar sends a bare STATUS_OK with no payload —
+    the client must decode that as features=0 (no trailers, no stream),
+    never crash on the missing word."""
+    from karpenter_tpu.solver import service
+
+    frame = service._status_response(service.STATUS_OK)
+    word, payload = service.RemoteSolver._split_status(frame)
+    assert word == service.STATUS_OK
+    features = int(payload[0].reshape(-1)[0]) if payload else 0
+    assert features == 0
+    for name in PROTO_BITS:
+        assert not (features & getattr(service, name))
+
+
 def test_known_bad_documents_rejected():
     base = serde.to_wire("provisioners", random_provisioner(random.Random(1)))
     bad_op = json.loads(json.dumps(base))

@@ -3,9 +3,16 @@
 Usage (from the repo root)::
 
     python -m tools.karplint karpenter_tpu           # analyze the tree
+    python -m tools.karplint drift karpenter_tpu     # drift-* rules only
+    python -m tools.karplint --format sarif karpenter_tpu
     python -m tools.karplint --list-rules
     python -m tools.karplint --selftest tests/karplint_fixtures
     python -m tools.karplint --write-baseline karpenter_tpu
+
+``drift`` (a leading positional) narrows the run to the ``drift-*``
+cross-artifact rules — the fast pre-merge gate for docs/deploy/chart/test
+edits that don't touch Python. ``--format sarif`` emits SARIF 2.1.0 for
+CI annotation (text stays the default; ``json`` is the raw dump).
 
 Exit codes: 0 clean, 1 findings (or a failed selftest), 2 usage/config
 error. ``--selftest`` runs the analyzer over the fixture corpus and checks
@@ -49,7 +56,7 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--allow-p0-baseline", action="store_true")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     ap.add_argument("--selftest", metavar="CORPUS",
                     help="run the fixture corpus and verify every rule fires")
     args = ap.parse_args(argv)
@@ -60,6 +67,16 @@ def main(argv=None) -> int:
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+
+    # `karplint drift <paths>`: the cross-artifact gate, scoped to the
+    # drift-* rules (composable with --rules to narrow further)
+    if args.paths and args.paths[0] == "drift":
+        args.paths = args.paths[1:]
+        drift_rules = [r.name for r in all_rules() if r.name.startswith("drift-")]
+        rules = [r for r in rules if r in drift_rules] if rules else drift_rules
+        if not rules:
+            print("karplint: --rules excludes every drift-* rule", file=sys.stderr)
+            return 2
     root = Path(args.root)
 
     if args.selftest:
@@ -96,7 +113,9 @@ def main(argv=None) -> int:
     )
     elapsed = time.perf_counter() - t0
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_to_sarif(active, analyzer), indent=2))
+    elif args.format == "json":
         print(json.dumps(
             {
                 "findings": [f.__dict__ for f in active],
@@ -117,6 +136,69 @@ def main(argv=None) -> int:
         )
         print(summary, file=sys.stderr)
     return 1 if active or analyzer.parse_errors else 0
+
+
+def _to_sarif(active, analyzer) -> dict:
+    """SARIF 2.1.0 document for CI annotation: one run, every registered
+    rule described on the driver (so viewers can render the catalog),
+    P0 → error, P1 → warning, parse errors as tool notifications."""
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "karplint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [
+                            {
+                                "id": rule.name,
+                                "shortDescription": {"text": rule.doc},
+                                "defaultConfiguration": {
+                                    "level": (
+                                        "error"
+                                        if rule.severity == "P0"
+                                        else "warning"
+                                    ),
+                                },
+                                "properties": {"severity": rule.severity},
+                            }
+                            for rule in analyzer.rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error" if f.severity == "P0" else "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {
+                                        "uri": f.path,
+                                        "uriBaseId": "SRCROOT",
+                                    },
+                                    "region": {"startLine": f.line},
+                                }
+                            }
+                        ],
+                    }
+                    for f in active
+                ],
+                "invocations": [
+                    {
+                        "executionSuccessful": not analyzer.parse_errors,
+                        "toolExecutionNotifications": [
+                            {"level": "error", "message": {"text": err}}
+                            for err in analyzer.parse_errors
+                        ],
+                    }
+                ],
+            }
+        ],
+    }
 
 
 def _selftest(corpus: Path, rules=None) -> int:

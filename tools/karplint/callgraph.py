@@ -1,0 +1,405 @@
+"""Shared cross-file call graph (one build per fileset, memoized).
+
+Every interprocedural rule — the tracer pair over ``solver/``, the
+lock-order/lock-blocking pair and the mutation-guard rule over the whole
+tree — resolves calls through this one structure. It is built from the
+ASTs the :class:`~tools.karplint.core.Project` already parsed (no second
+parse) and memoized per (project, fileset), so an ``Analyzer.run`` with
+every rule enabled constructs at most one whole-tree graph plus one
+``solver/``-scoped graph no matter how many rules consume them.
+
+Resolution is best-effort and deliberately under-approximate:
+
+- bare names: local defs, then ``from x import f`` symbols;
+- ``mod.f`` where ``mod`` is an imported module in the fileset;
+- ``self.f()`` / ``cls.f()``: methods of the lexically enclosing class in
+  the same file (the controller-helper convention the lock and guard
+  rules need);
+- ``self.x.f()`` / ``self.x.y.f()``: when the attribute chain is typed by
+  constructor assignment (``self.x = SomeClass(...)`` anywhere in the
+  class, with ``SomeClass`` defined in the fileset), the call resolves to
+  that class's method — this is how a controller's call into its
+  orchestrator/terminator collaborators resolves across files;
+- local collaborator aliases: ``t = self.termination.terminator`` then
+  ``t.f()`` resolves through the same attribute-type map, and
+  ``p = SomeClass(...)`` then ``p.f()`` through the constructor;
+- anything else (arbitrary object attributes, dynamic dispatch,
+  parameter-injected collaborators without a constructor call) resolves
+  to nothing — silence over noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.karplint.core import (
+    Project,
+    SourceFile,
+    dotted_name,
+    import_tables,
+)
+
+JIT_WRAPPERS = ("jit", "vmap", "pmap")
+
+# how many CallGraph constructions have run — the memoization acceptance
+# test pins this so a rule can't quietly reintroduce a per-rule rebuild
+BUILD_COUNT = 0
+
+
+def walk_no_funcs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+@dataclass
+class FuncInfo:
+    file: SourceFile
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    qualname: str
+    parent: Optional["FuncInfo"]
+    cls: Optional[str] = None  # enclosing class name, if a method
+    children: List["FuncInfo"] = field(default_factory=list)
+    static_argnames: Set[str] = field(default_factory=set)
+    is_root: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class CallGraph:
+    """Function defs + best-effort resolved call edges across the fileset."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        global BUILD_COUNT
+        BUILD_COUNT += 1
+        self.files = list(files)
+        self.funcs: List[FuncInfo] = []
+        self.by_file_name: Dict[Tuple[str, str], List[FuncInfo]] = {}
+        self.by_method: Dict[Tuple[str, str, str], List[FuncInfo]] = {}
+        self.module_of: Dict[str, SourceFile] = {}
+        self.imports: Dict[str, Tuple[dict, dict]] = {}
+        self.module_consts: Dict[str, Set[str]] = {}
+        # (path, class name) -> the class exists in the fileset
+        self.classes: Set[Tuple[str, str]] = set()
+        # (path, class, attr) -> (path2, class2): self.attr was assigned a
+        # constructor call of a fileset class somewhere in the class body
+        self.attr_types: Dict[Tuple[str, str, str], Tuple[str, str]] = {}
+        for f in self.files:
+            self.module_of[f.path[:-3].replace("/", ".")] = f
+            # import tables survive across graph builds (the whole-tree and
+            # solver-scoped graphs share files) — cache on the SourceFile
+            cached = getattr(f, "_karplint_imports", None)
+            if cached is None:
+                cached = import_tables(f.tree)
+                f._karplint_imports = cached
+            self.imports[f.path] = cached
+            self.module_consts[f.path] = {
+                t.id
+                for node in f.tree.body
+                if isinstance(node, ast.Assign)
+                for t in node.targets
+                if isinstance(t, ast.Name) and isinstance(node.value, ast.Constant)
+            }
+            self._collect_funcs(f)
+        for f in self.files:
+            self._scan_roots_and_attr_types(f)
+        self._callee_cache: Dict[int, List[FuncInfo]] = {}
+        self._alias_cache: Dict[int, Dict[str, Tuple[str, str]]] = {}
+
+    def _collect_funcs(self, f: SourceFile) -> None:
+        def visit(node: ast.AST, parent: Optional[FuncInfo], prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = FuncInfo(
+                        file=f, node=child,
+                        qualname=f"{prefix}{child.name}", parent=parent, cls=cls,
+                    )
+                    info.static_argnames = _decorator_statics(child)
+                    if _decorated_jit(child):
+                        info.is_root = True
+                    self.funcs.append(info)
+                    if parent:
+                        parent.children.append(info)
+                    self.by_file_name.setdefault((f.path, child.name), []).append(info)
+                    if cls:
+                        self.by_method.setdefault(
+                            (f.path, cls, child.name), []
+                        ).append(info)
+                    # a nested def is no longer a method of the class
+                    visit(child, info, f"{info.qualname}.", None)
+                elif isinstance(child, ast.ClassDef):
+                    self.classes.add((f.path, child.name))
+                    visit(child, parent, f"{prefix}{child.name}.", child.name)
+                else:
+                    visit(child, parent, prefix, cls)
+
+        visit(f.tree, None, "", None)
+
+    def _scan_roots_and_attr_types(self, f: SourceFile) -> None:
+        """One pass over the file's nodes (reusing the parent-link index the
+        :class:`SourceFile` already built — no re-walk): mark jit/vmap/pmap/
+        pallas_call'd names as roots, and record ``self.x = SomeClass(...)``
+        constructor assignments as attribute types."""
+        for node in f.parents:
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func) or ""
+                tail = dn.rsplit(".", 1)[-1]
+                if tail in JIT_WRAPPERS or tail == "pallas_call":
+                    for target in _callable_args(node):
+                        for info in self.by_file_name.get((f.path, target), []):
+                            info.is_root = True
+                            if tail in JIT_WRAPPERS:
+                                info.static_argnames |= _call_statics(node)
+                continue
+            value, targets = None, []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            if not isinstance(value, ast.Call):
+                continue
+            attrs = [
+                t
+                for t in targets
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ]
+            if not attrs:
+                continue
+            cls = next(
+                (a.name for a in f.ancestors(node) if isinstance(a, ast.ClassDef)),
+                None,
+            )
+            if cls is None:
+                continue
+            typed = self._resolve_class(f, value.func)
+            if typed is None:
+                continue
+            for t in attrs:
+                self.attr_types[(f.path, cls, t.attr)] = typed
+
+    def _resolve_class(self, f: SourceFile, ctor: ast.AST) -> Optional[Tuple[str, str]]:
+        """(path, class) when ``ctor`` names a fileset class (same file,
+        ``from x import Cls``, or ``mod.Cls``)."""
+        dn = dotted_name(ctor)
+        if dn is None:
+            return None
+        modules, symbols = self.imports[f.path]
+        if "." not in dn:
+            if (f.path, dn) in self.classes:
+                return (f.path, dn)
+            if dn in symbols:
+                mod, sym = symbols[dn]
+                target = self._file_for_module(mod)
+                if target and (target.path, sym) in self.classes:
+                    return (target.path, sym)
+            return None
+        root, attr = dn.rsplit(".", 1)
+        if root in modules:
+            target = self._file_for_module(modules[root])
+            if target and (target.path, attr) in self.classes:
+                return (target.path, attr)
+        return None
+
+    def _walk_attr_chain(
+        self, start: Tuple[str, str], segs: Sequence[str]
+    ) -> Optional[Tuple[str, str]]:
+        cur: Optional[Tuple[str, str]] = start
+        for seg in segs:
+            if cur is None:
+                return None
+            cur = self.attr_types.get((cur[0], cur[1], seg))
+        return cur
+
+    def _local_aliases(self, fn: "FuncInfo") -> Dict[str, Tuple[str, str]]:
+        """Local names in ``fn`` bound to a typed collaborator: either
+        ``x = SomeClass(...)`` or ``x = self.a.b`` resolved through the
+        attribute-type map."""
+        cached = self._alias_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out: Dict[str, Tuple[str, str]] = {}
+        for node in walk_no_funcs(fn.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if isinstance(node.value, ast.Call):
+                typed = self._resolve_class(fn.file, node.value.func)
+                if typed:
+                    out[target.id] = typed
+                continue
+            dn = dotted_name(node.value)
+            if dn and dn.startswith("self.") and fn.cls:
+                typed = self._walk_attr_chain(
+                    (fn.file.path, fn.cls), dn.split(".")[1:]
+                )
+                if typed:
+                    out[target.id] = typed
+        self._alias_cache[id(fn)] = out
+        return out
+
+    def resolve_call(
+        self,
+        f: SourceFile,
+        call: ast.Call,
+        cls: Optional[str] = None,
+        fn: Optional["FuncInfo"] = None,
+    ) -> List[FuncInfo]:
+        """Targets of ``call`` made from file ``f`` (``cls`` = enclosing
+        class of the caller, enabling ``self.method()`` edges; ``fn`` =
+        the calling function, enabling local collaborator aliases)."""
+        modules, symbols = self.imports[f.path]
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self.by_file_name.get((f.path, func.id))
+            if local:
+                return local
+            if func.id in symbols:
+                mod, sym = symbols[func.id]
+                target = self._file_for_module(mod)
+                if target:
+                    return self.by_file_name.get((target.path, sym), [])
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        recv_dn = dotted_name(func.value)
+        if recv_dn is None:
+            return []
+        segs = recv_dn.split(".")
+        if segs[0] in ("self", "cls") and cls is not None:
+            if len(segs) == 1:
+                return self.by_method.get((f.path, cls, func.attr), [])
+            owner = self._walk_attr_chain((f.path, cls), segs[1:])
+            if owner:
+                return self.by_method.get((owner[0], owner[1], func.attr), [])
+            return []
+        if len(segs) == 1 and segs[0] in modules:
+            target = self._file_for_module(modules[segs[0]])
+            if target:
+                hit = self.by_file_name.get((target.path, func.attr))
+                if hit:
+                    return hit
+        if fn is not None:
+            aliases = self._local_aliases(fn)
+            if segs[0] in aliases:
+                owner = self._walk_attr_chain(aliases[segs[0]], segs[1:])
+                if owner:
+                    return self.by_method.get((owner[0], owner[1], func.attr), [])
+        return []
+
+    def callees(self, fn: FuncInfo) -> List[FuncInfo]:
+        """Resolved direct callees of ``fn``'s own body (not nested defs),
+        memoized — the fixpoint passes in the lock/guard rules re-walk
+        these edges many times."""
+        cached = self._callee_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out: List[FuncInfo] = []
+        for node in walk_no_funcs(fn.node):
+            if isinstance(node, ast.Call):
+                out.extend(self.resolve_call(fn.file, node, cls=fn.cls, fn=fn))
+        self._callee_cache[id(fn)] = out
+        return out
+
+    def _file_for_module(self, dotted: str) -> Optional[SourceFile]:
+        for mod, f in self.module_of.items():
+            if mod == dotted or mod.endswith("." + dotted) or dotted.endswith("." + mod):
+                return f
+        return None
+
+    def reachable(self) -> List[FuncInfo]:
+        seen: Set[int] = set()
+        work = [fn for fn in self.funcs if fn.is_root]
+        out: List[FuncInfo] = []
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.append(fn)
+            work.extend(fn.children)
+            work.extend(self.callees(fn))
+            # calls inside nested defs traverse when the child pops
+        return out
+
+
+def get_graph(project: Project, files: Optional[Sequence[SourceFile]] = None) -> CallGraph:
+    """The memoized per-project graph over ``files`` (default: every file).
+
+    Keyed by the fileset's paths, so the tracer rules' ``solver/``-scoped
+    graph and the whole-tree graph coexist without rebuilding either."""
+    files = list(files) if files is not None else project.files
+    key = ("callgraph", tuple(f.path for f in files))
+    graph = project.cache.get(key)
+    if graph is None:
+        graph = CallGraph(files)
+        project.cache[key] = graph
+    return graph
+
+
+def _callable_args(call: ast.Call) -> List[str]:
+    """Simple names passed as callables: bare ``f`` or ``partial(f, ...)``."""
+    out = []
+    for arg in call.args[:1] or []:
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Call):
+            dn = dotted_name(arg.func) or ""
+            if dn.rsplit(".", 1)[-1] == "partial" and arg.args:
+                first = arg.args[0]
+                if isinstance(first, ast.Name):
+                    out.append(first.id)
+    return out
+
+
+def _statics_from_value(value: ast.AST) -> Set[str]:
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return {value.value}
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in value.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _call_statics(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            return _statics_from_value(kw.value)
+    return set()
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = dotted_name(target) or ""
+        tail = dn.rsplit(".", 1)[-1]
+        if tail in JIT_WRAPPERS:
+            return True
+        if tail == "partial" and isinstance(dec, ast.Call) and dec.args:
+            inner = dotted_name(dec.args[0]) or ""
+            if inner.rsplit(".", 1)[-1] in JIT_WRAPPERS:
+                return True
+    return False
+
+
+def _decorator_statics(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            out |= _call_statics(dec)
+    return out

@@ -22,12 +22,30 @@ races and host syncs.
 from __future__ import annotations
 
 import ast
+import gc
 import hashlib
 import json
 import re
+from contextlib import contextmanager
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@contextmanager
+def _gc_paused():
+    """Parsing a few hundred files allocates millions of AST/container
+    objects, and every generational GC pass walks the host process's whole
+    live heap — inside a loaded pytest process that heap dwarfs the
+    analyzer's own. The analyzer builds essentially no reference cycles,
+    so pause collection for the run and let the exit sweep reclaim."""
+    was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        yield
+    finally:
+        if was_enabled:
+            gc.enable()
 
 SUPPRESS_RE = re.compile(r"#\s*karplint:\s*disable(?:=([A-Za-z0-9_\-, ]+))?")
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
@@ -92,6 +110,12 @@ class SourceFile:
         m = GUARDED_BY_RE.search(self.line_at(lineno))
         return m.group(1) if m else None
 
+    def nodes(self) -> Iterable[ast.AST]:
+        """Every node except the Module root, in ``ast.walk`` order —
+        rules iterate this instead of re-walking the tree (the parent
+        index built at load already enumerated every node once)."""
+        return self.parents.keys()
+
     def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
         cur = self.parents.get(node)
         while cur is not None:
@@ -104,6 +128,9 @@ class Project:
         self.root = root
         self.files = list(files)
         self.by_path = {f.path: f for f in self.files}
+        # cross-rule memoization (call graphs, lock maps): one AST walk
+        # per analysis structure per run, not per rule
+        self.cache: Dict[object, object] = {}
 
     def matching(self, pred: Callable[[str], bool]) -> List[SourceFile]:
         return [f for f in self.files if pred(f.path)]
@@ -170,10 +197,13 @@ def _load_rules() -> None:
     # import for side effect: each module registers its rules
     from tools.karplint.rules import (  # noqa: F401
         debug_endpoints,
+        drift,
         events,
         kube,
+        lock_order,
         locks,
         metric_names,
+        mutation_guard,
         patch,
         purity,
         retry,
@@ -272,6 +302,12 @@ class Analyzer:
         self, baseline: Optional[Baseline] = None, allow_p0_baseline: bool = False
     ) -> Tuple[List[Finding], List[Finding]]:
         """Returns (active findings, baselined findings)."""
+        with _gc_paused():
+            return self._run(baseline, allow_p0_baseline)
+
+    def _run(
+        self, baseline: Optional[Baseline], allow_p0_baseline: bool
+    ) -> Tuple[List[Finding], List[Finding]]:
         project = self.load()
         active: List[Finding] = []
         baselined: List[Finding] = []
@@ -296,6 +332,10 @@ class Analyzer:
     def fingerprints(self) -> List[Tuple[Finding, str]]:
         """(finding, fingerprint) for every unsuppressed finding — the
         ``--write-baseline`` surface."""
+        with _gc_paused():
+            return self._fingerprints()
+
+    def _fingerprints(self) -> List[Tuple[Finding, str]]:
         project = self.load()
         out = []
         for rule in self.rules:

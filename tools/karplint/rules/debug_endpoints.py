@@ -80,7 +80,7 @@ class DebugEndpointRule(Rule):
             # cheap text prefilter: no /debug/ literal, no finding
             if "/debug/" not in src.text:
                 continue
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if (
                     isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                     and node.name == "do_GET"

@@ -1,0 +1,498 @@
+"""Cross-artifact drift gate (``drift-flag`` / ``drift-chart`` /
+``drift-status``).
+
+The configuration surface lives in five places that nothing previously
+tied together: ``options.py`` (flags + env twins), ``docs/operations.md``
+(the operator-facing flag table), ``deploy/*.yaml`` (the reference
+manifests), the Helm chart (``values.yaml`` + templates), and the solver
+wire constants (``STATUS_*`` / ``PROTO_*``) with their fuzz corpus. Every
+past drift incident was a surface updated on one side only — a flag
+shipped without a docs row, a manifest arg the chart cannot render, a
+wire constant one codec end never learned. These rules parse each
+artifact and cross-check, so the gap is a finding with a fix-it hint
+instead of an operator surprise.
+
+Scoping: findings must anchor at a Python file the analyzer scanned, so
+each rule anchors at the artifact root's config surface (``options.py``
+for flag/chart drift, the wire-constants module for status drift). The
+artifact root is found by walking up from that file to the nearest
+directory containing the sibling artifacts (``docs/`` / ``deploy/`` /
+``charts/``, or ``tests/`` for the fuzz corpus) — which also lets the
+fixture corpus carry self-contained artifact trees.
+
+Deliberate non-goals: the solver/webhook entrypoints parse their own
+small arg sets; only files *named* ``options.py`` are treated as a flag
+surface. And the raw ``deploy/`` manifest is one concrete configuration
+while the chart is the configurable superset — so the chart must be able
+to render every deploy flag, but not vice versa.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.karplint.core import (
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+FLAG_TOKEN_RE = re.compile(r"(?<![\w-])--([a-z][a-z0-9-]*)")
+VALUES_REF_RE = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+WIRE_CONST_RE = re.compile(r"^(STATUS|PROTO)_[A-Z0-9_]+$")
+ENV_FNS = {"_env", "env_bool", "env_float", "env_int", "env_str"}
+
+
+def _nearest_root(project: Project, pypath: str, markers: Sequence[str]) -> Optional[str]:
+    """Nearest ancestor dir (as a ''-or-'a/b' prefix relative to the
+    project root) containing one of ``markers`` as a subdirectory."""
+    parts = pypath.split("/")[:-1]
+    while True:
+        prefix = "/".join(parts)
+        base = project.root / prefix if prefix else project.root
+        if any((base / m).is_dir() for m in markers):
+            return prefix
+        if not parts:
+            return None
+        parts.pop()
+
+
+def _read(project: Project, relpath: str) -> Optional[str]:
+    p = project.root / relpath
+    try:
+        return p.read_text(encoding="utf-8")
+    except OSError:
+        return None
+
+
+def _strip_comment(line: str) -> str:
+    stripped = line.lstrip()
+    if stripped.startswith("#"):
+        return ""
+    return line.split("#", 1)[0]
+
+
+def _manifest_flags(text: str) -> Set[str]:
+    out: Set[str] = set()
+    for line in text.splitlines():
+        out.update(FLAG_TOKEN_RE.findall(_strip_comment(line)))
+    return out
+
+
+class _FlagSurface:
+    """Flags + env twins parsed out of one ``options.py``."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        # canonical spelling -> (lineno, all spellings, is_boolean)
+        self.flags: Dict[str, Tuple[int, List[str], bool]] = {}
+        self.env_keys: Dict[str, int] = {}
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            tail = fname.rsplit(".", 1)[-1]
+            if tail == "add_argument":
+                spellings = [
+                    a.value[2:]
+                    for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                    and a.value.startswith("--")
+                ]
+                if not spellings:
+                    continue
+                boolean = any(
+                    kw.arg == "action"
+                    and (dotted_name(kw.value) or "").endswith("BooleanOptionalAction")
+                    for kw in node.keywords
+                )
+                self.flags[spellings[0]] = (node.lineno, spellings, boolean)
+            elif tail in ENV_FNS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    self.env_keys.setdefault(first.value, node.lineno)
+
+    def spellings(self) -> Set[str]:
+        return {s for _, ss, _ in self.flags.values() for s in ss}
+
+    def defined(self, token: str) -> bool:
+        """Is ``--token`` a valid spelling (incl. the --no-x boolean twin)?"""
+        all_spellings = self.spellings()
+        if token in all_spellings:
+            return True
+        if token.startswith("no-"):
+            base = token[3:]
+            return any(
+                base in ss and boolean for _, ss, boolean in self.flags.values()
+            )
+        return False
+
+    def normalize(self, token: str) -> str:
+        """Map a manifest spelling to the flag's canonical spelling
+        (``no-x`` -> ``x`` for booleans, aliases -> primary)."""
+        if token.startswith("no-") and self.defined(token):
+            token = token[3:]
+        for canon, (_ln, ss, _b) in self.flags.items():
+            if token in ss:
+                return canon
+        return token
+
+
+def _flag_surfaces(project: Project) -> List[_FlagSurface]:
+    return [
+        _FlagSurface(f)
+        for f in project.files
+        if f.path.rsplit("/", 1)[-1] == "options.py"
+    ]
+
+
+@register
+class DriftFlagRule(Rule):
+    name = "drift-flag"
+    severity = P1
+    doc = (
+        "flag/env surface drift: a defined flag or env twin missing from "
+        "docs/operations.md, a documented flag nothing defines, or a "
+        "deploy/chart manifest passing a flag no add_argument accepts."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for surface in _flag_surfaces(project):
+            root = _nearest_root(project, surface.src.path, ("docs", "deploy", "charts"))
+            if root is None:
+                continue
+            prefix = f"{root}/" if root else ""
+            docs_rel = f"{prefix}docs/operations.md"
+            docs = _read(project, docs_rel)
+            if docs is None:
+                findings.append(
+                    self.finding(
+                        surface.src.path, 1,
+                        f"{docs_rel} is missing — every flag and env twin "
+                        "must be documented there",
+                    )
+                )
+            else:
+                for canon, (lineno, spellings, _b) in sorted(surface.flags.items()):
+                    if not any(f"--{s}" in docs for s in spellings):
+                        findings.append(
+                            self.finding(
+                                surface.src.path, lineno,
+                                f"flag `--{canon}` has no row in {docs_rel} — "
+                                "add it to the flag table (operators discover "
+                                "knobs there, not in argparse help)",
+                            )
+                        )
+                for key, lineno in sorted(surface.env_keys.items()):
+                    if key not in docs:
+                        findings.append(
+                            self.finding(
+                                surface.src.path, lineno,
+                                f"env twin `{key}` is not mentioned in "
+                                f"{docs_rel} — document it beside its flag",
+                            )
+                        )
+                findings.extend(self._docs_ghosts(surface, docs, docs_rel))
+            findings.extend(self._manifest_ghosts(project, surface, prefix))
+        return findings
+
+    def _docs_ghosts(
+        self, surface: _FlagSurface, docs: str, docs_rel: str
+    ) -> List[Finding]:
+        """Documented flags nothing defines (docs rows only — prose may
+        reference other processes' flags)."""
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        for line in docs.splitlines():
+            if not line.startswith("|") or "--" not in line:
+                continue
+            cells = line.split("|")
+            if len(cells) < 3:
+                continue
+            first = cells[1]
+            if "sidecar" in first:
+                continue  # the solver entrypoint's own arg set
+            for token in FLAG_TOKEN_RE.findall(first):
+                if token in seen or surface.defined(token):
+                    continue
+                seen.add(token)
+                out.append(
+                    self.finding(
+                        surface.src.path, 1,
+                        f"{docs_rel} documents `--{token}`, which no "
+                        "add_argument defines — stale row or missing flag",
+                    )
+                )
+        return out
+
+    def _manifest_ghosts(
+        self, project: Project, surface: _FlagSurface, prefix: str
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in _controller_manifests(project, prefix):
+            text = _read(project, rel)
+            if text is None:
+                continue
+            for token in sorted(_manifest_flags(text)):
+                if not surface.defined(token):
+                    out.append(
+                        self.finding(
+                            surface.src.path, 1,
+                            f"{rel} passes `--{token}`, which no add_argument "
+                            "defines — the process would die at startup",
+                        )
+                    )
+        return out
+
+
+def _controller_manifests(project: Project, prefix: str) -> List[str]:
+    """Controller manifests under the artifact root: deploy/*controller*
+    plus every chart template named *controller*."""
+    out: List[str] = []
+    base = project.root / prefix if prefix else project.root
+    for pattern in ("deploy/*controller*.yaml", "charts/*/templates/*controller*.yaml"):
+        for p in sorted(base.glob(pattern)):
+            out.append(p.relative_to(project.root).as_posix())
+    return out
+
+
+def _parse_values_keys(text: str) -> Set[str]:
+    """Two-level key paths from a values.yaml (hand-rolled: stdlib only).
+
+    ``image: x`` -> ``image``; ``controller:`` + 2-space ``replicas:`` ->
+    ``controller.replicas``. Deeper nesting collapses into its 2-level
+    parent (templates address those via ``toYaml .Values.a.b``)."""
+    keys: Set[str] = set()
+    top: Optional[str] = None
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line or line.lstrip().startswith("#"):
+            continue
+        indent = len(line) - len(line.lstrip())
+        m = re.match(r"([A-Za-z0-9_-]+):", line.strip())
+        if not m:
+            continue
+        key = m.group(1)
+        if indent == 0:
+            top = key
+            keys.add(key)
+        elif indent == 2 and top is not None:
+            keys.add(f"{top}.{key}")
+    return keys
+
+
+@register
+class DriftChartRule(Rule):
+    name = "drift-chart"
+    severity = P1
+    doc = (
+        "deploy/chart drift: the chart template cannot render a flag the "
+        "deploy manifest sets, a template references a .Values key that "
+        "values.yaml does not define, or a values.yaml key no template "
+        "reads (a knob that silently does nothing)."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for surface in _flag_surfaces(project):
+            root = _nearest_root(project, surface.src.path, ("deploy", "charts"))
+            if root is None:
+                continue
+            prefix = f"{root}/" if root else ""
+            base = project.root / prefix if prefix else project.root
+            findings.extend(self._deploy_vs_chart(project, surface, prefix))
+            for chart_dir in sorted(base.glob("charts/*")):
+                if not chart_dir.is_dir():
+                    continue
+                rel_chart = chart_dir.relative_to(project.root).as_posix()
+                findings.extend(
+                    self._values_vs_templates(project, surface, rel_chart)
+                )
+        return findings
+
+    def _deploy_vs_chart(
+        self, project: Project, surface: _FlagSurface, prefix: str
+    ) -> List[Finding]:
+        deploy_flags: Set[str] = set()
+        chart_flags: Set[str] = set()
+        base = project.root / prefix if prefix else project.root
+        deploy_rels: List[str] = []
+        for p in sorted(base.glob("deploy/*controller*.yaml")):
+            rel = p.relative_to(project.root).as_posix()
+            deploy_rels.append(rel)
+            deploy_flags |= {
+                surface.normalize(t) for t in _manifest_flags(_read(project, rel) or "")
+            }
+        for p in sorted(base.glob("charts/*/templates/*controller*.yaml")):
+            rel = p.relative_to(project.root).as_posix()
+            chart_flags |= {
+                surface.normalize(t) for t in _manifest_flags(_read(project, rel) or "")
+            }
+        if not deploy_rels or not chart_flags:
+            return []
+        out: List[Finding] = []
+        for token in sorted(deploy_flags - chart_flags):
+            if not surface.defined(token):
+                continue  # drift-flag already reports undefined tokens
+            out.append(
+                self.finding(
+                    surface.src.path, 1,
+                    f"{deploy_rels[0]} sets `--{token}` but the chart's "
+                    "controller template cannot render it — add a values "
+                    "key + template arg so chart installs can express the "
+                    "reference configuration",
+                )
+            )
+        return out
+
+    def _values_vs_templates(
+        self, project: Project, surface: _FlagSurface, rel_chart: str
+    ) -> List[Finding]:
+        values_rel = f"{rel_chart}/values.yaml"
+        values_text = _read(project, values_rel)
+        if values_text is None:
+            return []
+        keys = _parse_values_keys(values_text)
+        refs: Set[str] = set()
+        tmpl_dir = project.root / rel_chart / "templates"
+        for p in sorted(tmpl_dir.glob("*.yaml")) if tmpl_dir.is_dir() else []:
+            refs |= set(VALUES_REF_RE.findall(p.read_text(encoding="utf-8")))
+        if not refs:
+            return []
+        out: List[Finding] = []
+
+        def covered_by_keys(ref: str) -> bool:
+            return any(
+                ref == k or ref.startswith(k + ".") or k.startswith(ref + ".")
+                for k in keys
+            )
+
+        def referenced(key: str) -> bool:
+            return any(
+                r == key or r.startswith(key + ".") or key.startswith(r + ".")
+                for r in refs
+            )
+
+        for ref in sorted(refs):
+            if not covered_by_keys(ref):
+                out.append(
+                    self.finding(
+                        surface.src.path, 1,
+                        f"chart template references `.Values.{ref}` but "
+                        f"{values_rel} defines no such key — `helm install` "
+                        "renders an empty value",
+                    )
+                )
+        for key in sorted(keys):
+            if not referenced(key):
+                out.append(
+                    self.finding(
+                        surface.src.path, 1,
+                        f"{values_rel} defines `{key}` but no template reads "
+                        "it — a knob that silently does nothing; wire it or "
+                        "delete it",
+                    )
+                )
+        return out
+
+
+@register
+class DriftStatusRule(Rule):
+    name = "drift-status"
+    severity = P1
+    doc = (
+        "wire-constant drift: a STATUS_*/PROTO_* constant that only one "
+        "codec end knows, or one the serde fuzz corpus never exercises — "
+        "the next protocol bump breaks the peer silently."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files:
+            consts = self._wire_constants(src)
+            if len(consts) < 2:
+                continue
+            findings.extend(self._check(project, src, consts))
+        return findings
+
+    @staticmethod
+    def _wire_constants(src: SourceFile) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Constant):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and WIRE_CONST_RE.match(t.id):
+                    out.setdefault(t.id, node.lineno)
+        return out
+
+    def _check(
+        self, project: Project, src: SourceFile, consts: Dict[str, int]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        # (1) both codec ends: each constant referenced somewhere beyond
+        # its own definition line (the client end may live in the same
+        # module — RemoteSolver does — so this is a same-file-allowed
+        # used-at-all check, not a cross-file one)
+        for name, lineno in sorted(consts.items()):
+            pattern = re.compile(rf"\b{re.escape(name)}\b")
+            referenced = False
+            for other in project.files:
+                for i, text_line in enumerate(other.lines, start=1):
+                    if other.path == src.path and i == lineno:
+                        continue
+                    if pattern.search(text_line):
+                        referenced = True
+                        break
+                if referenced:
+                    break
+            if not referenced:
+                out.append(
+                    self.finding(
+                        src.path, lineno,
+                        f"wire constant `{name}` is defined here but nothing "
+                        "dispatches on it — a one-sided protocol surface "
+                        "(both codec ends must know every status/capability)",
+                    )
+                )
+        # (2) fuzz coverage: every constant exercised by the serde corpus
+        root = _nearest_root(project, src.path, ("tests",))
+        if root is None:
+            return out
+        tests_dir = project.root / (f"{root}/tests" if root else "tests")
+        fuzz_texts: List[Tuple[str, str]] = []
+        for p in sorted(tests_dir.rglob("test_serde*.py")):
+            rel = p.relative_to(project.root).as_posix()
+            if rel == src.path:
+                continue
+            fuzz_texts.append((rel, p.read_text(encoding="utf-8")))
+        if not fuzz_texts:
+            out.append(
+                self.finding(
+                    src.path, 1,
+                    "no serde fuzz corpus (tests/test_serde*.py) covers "
+                    "these wire constants — codec changes land untested",
+                )
+            )
+            return out
+        combined = "\n".join(t for _, t in fuzz_texts)
+        for name, lineno in sorted(consts.items()):
+            if not re.search(rf"\b{re.escape(name)}\b", combined):
+                out.append(
+                    self.finding(
+                        src.path, lineno,
+                        f"wire constant `{name}` is never exercised by the "
+                        f"serde fuzz corpus ({fuzz_texts[0][0]}) — add it to "
+                        "the fuzzed status/capability sets",
+                    )
+                )
+        return out

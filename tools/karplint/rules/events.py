@@ -66,7 +66,7 @@ class EventDecisionIdRule(Rule):
             # cheap text prefilter: no Warning literal, no finding
             if "Warning" not in src.text:
                 continue
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 func = node.func

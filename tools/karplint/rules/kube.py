@@ -62,7 +62,7 @@ class KubeTransportRule(Rule):
             import_lines: List[int] = []
             call_lines: List[int] = []
             defines_request = False
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if isinstance(node, ast.Import):
                     if any(
                         a.name == "http.client" or a.name.startswith("http.client.")

@@ -1,0 +1,534 @@
+"""Interprocedural lock-order and blocking-call analysis.
+
+Both rules walk one shared structure (memoized per project): every
+``with <lock>:`` acquisition site across the tree, the set of locks each
+function may transitively acquire, and the first blocking operation each
+function may transitively reach — all resolved through the shared
+cross-file call graph (``tools/karplint/callgraph.py``).
+
+**lock-order (P0).** Acquiring lock B while holding lock A draws a global
+edge A→B — lexically nested ``with`` blocks and acquisitions reached
+through resolved calls both count. A cycle in that graph is a lock-order
+inversion: two threads entering the cycle from different points deadlock,
+and no unit test will ever catch it because the interleaving needs
+production concurrency. A self-edge on a non-reentrant ``threading.Lock``
+(re-acquiring the lock you hold through a helper) is the degenerate
+one-thread deadlock and reports under the same rule.
+
+**lock-blocking (P1).** A blocking operation — ``time.sleep``, a Future
+``.result()``, a tracked ``Queue.get()`` / foreign ``Event.wait()``,
+``fcntl.flock``, ``urlopen``, or (in ``solver/``) a device fetch such as
+``np.asarray`` on device output or ``.block_until_ready()`` — reachable
+while a lock is held turns that lock into a convoy: every other thread
+needing it stalls for the blocked operation's duration. This statically
+pins the PR-4 invariant that the device fetch happens OFF the solve lock
+(double-buffering depends on it). ``Condition.wait`` on the held lock's
+own condition variable is the sanctioned sleep-releases-the-lock pattern
+and is exempt.
+
+Lock identity is lexical and scope-qualified: ``self._lock`` in class C of
+file f is ``f::C._lock`` (per-class, matching the ``# guarded-by:``
+convention), a module global ``_lock`` is ``f::_lock``. Identity is
+per-declaration-site, so two instances of one class share an id — for
+ORDER analysis that is exactly right (every instance pair orders the same
+way); the self-edge check additionally requires a non-reentrant ctor
+(``threading.Lock``) to avoid flagging RLock re-entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.karplint.callgraph import CallGraph, FuncInfo, get_graph
+from tools.karplint.core import (
+    P0,
+    P1,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+# receiver-name heuristic for "this with-statement takes a lock": the last
+# dotted segment. Condition objects acquire their underlying lock on
+# `with`, so they participate in ordering too.
+_LOCKISH = ("lock", "mutex", "_mu", "_cv", "cond")
+
+# queue-family constructors whose .get() parks (mirrors rules/waits.py)
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+}
+_EVENT_CTORS = {"threading.Event", "threading.Condition", "Event", "Condition"}
+_NONREENTRANT_CTORS = {"threading.Lock", "Lock"}
+
+# numpy-ish host-materialization calls that fetch device output when they
+# appear in solver/ code (the PR-4 fetch-off-the-lock invariant)
+_DEVICE_FETCHES = {"asarray", "device_get", "block_until_ready"}
+
+
+def _lockish_name(expr: ast.AST) -> Optional[str]:
+    dn = dotted_name(expr)
+    if dn is None:
+        return None
+    tail = dn.rsplit(".", 1)[-1].lower()
+    if any(t in tail for t in _LOCKISH):
+        return dn
+    return None
+
+
+@dataclass
+class BlockWitness:
+    desc: str          # what blocks, e.g. "time.sleep"
+    path: str          # file of the blocking op
+    line: int
+    chain: List[str] = field(default_factory=list)  # call chain, outermost first
+
+
+@dataclass
+class _FnLocks:
+    # (lock id, display name, with-node) for every lexical with-lock
+    withs: List[Tuple[str, str, ast.With]] = field(default_factory=list)
+    # lock ids this function acquires lexically (for transitive ACQ)
+    lexical: Set[str] = field(default_factory=set)
+    display: Dict[str, str] = field(default_factory=dict)
+
+
+class LockAnalysis:
+    """Whole-project lock map: built once, consumed by both rules."""
+
+    def __init__(self, project: Project, graph: CallGraph):
+        self.project = project
+        self.graph = graph
+        self.fn_locks: Dict[int, _FnLocks] = {}
+        self.display: Dict[str, str] = {}
+        self.nonreentrant: Set[str] = set()
+        self.queueish: Dict[str, Set[str]] = {}   # file path -> attr/name set
+        self.eventish: Dict[str, Set[str]] = {}
+        # Condition(lock) wraps an existing lock: `with cv:` and `cv.wait()`
+        # operate on the UNDERLYING lock, so the cv's id aliases to it
+        self.cv_underlying: Dict[str, str] = {}
+        self._acq_cache: Dict[int, Set[str]] = {}
+        self._block_cache: Dict[int, Optional[BlockWitness]] = {}
+        for f in graph.files:
+            self._scan_ctors(f)
+        for fn in graph.funcs:
+            self.fn_locks[id(fn)] = self._collect(fn)
+
+    # -- per-file constructor tracking --------------------------------------
+    def _scan_ctors(self, f: SourceFile) -> None:
+        queues: Set[str] = set()
+        events: Set[str] = set()
+        # f.parents already indexes every node — no re-walk needed
+        for node in f.parents:
+            value, targets = None, []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = dotted_name(value.func)
+            if ctor is None:
+                continue
+            names = []
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    names.append(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.append(t.id)
+            if ctor in _QUEUE_CTORS:
+                queues.update(names)
+            elif ctor in _EVENT_CTORS:
+                events.update(names)
+                if ctor.rsplit(".", 1)[-1] == "Condition" and value.args:
+                    under = self._lock_id_for_expr(f, value.args[0])
+                    for t in targets:
+                        cv_id = self._lock_id_for_target(f, t)
+                        if cv_id and under:
+                            self.cv_underlying[cv_id] = under
+            elif ctor in _NONREENTRANT_CTORS:
+                for t in targets:
+                    lock_id = self._lock_id_for_target(f, t)
+                    if lock_id:
+                        self.nonreentrant.add(lock_id)
+        self.queueish[f.path] = queues
+        self.eventish[f.path] = events
+
+    def _lock_id_for_target(self, f: SourceFile, target: ast.AST) -> Optional[str]:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            cls = next(
+                (a.name for a in f.ancestors(target) if isinstance(a, ast.ClassDef)),
+                None,
+            )
+            if cls:
+                return f"{f.path}::{cls}.{target.attr}"
+        if isinstance(target, ast.Name):
+            return f"{f.path}::{target.id}"
+        return None
+
+    # an expression names a lock the same way a target does
+    _lock_id_for_expr = _lock_id_for_target
+
+    # -- per-function lock collection ---------------------------------------
+    def lock_id(self, fn: FuncInfo, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """(canonical id, display name) when ``expr`` names a lock."""
+        dn = _lockish_name(expr)
+        if dn is None:
+            return None
+        f = fn.file
+        if dn.startswith("self.") and fn.cls:
+            parts = dn.split(".")
+            if len(parts) == 2:
+                lid = f"{f.path}::{fn.cls}.{parts[1]}"
+                return self.cv_underlying.get(lid, lid), f"{fn.cls}.{parts[1]}"
+            # self.x.y — opaque but stable within the class
+            lid = f"{f.path}::{fn.cls}.{'.'.join(parts[1:])}"
+            return self.cv_underlying.get(lid, lid), dn
+        lid = f"{f.path}::{dn}"
+        return self.cv_underlying.get(lid, lid), dn
+
+    def _collect(self, fn: FuncInfo) -> _FnLocks:
+        out = _FnLocks()
+        for node in self._walk_own(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    hit = self.lock_id(fn, item.context_expr)
+                    if hit:
+                        lock_id, disp = hit
+                        out.withs.append((lock_id, disp, node))
+                        out.lexical.add(lock_id)
+                        self.display[lock_id] = disp
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr == "acquire":
+                    hit = self.lock_id(fn, node.func.value)
+                    if hit:
+                        out.lexical.add(hit[0])
+                        self.display[hit[0]] = hit[1]
+        return out
+
+    @staticmethod
+    def _walk_own(node: ast.AST):
+        """Walk a function body without descending into nested defs —
+        nested functions run at their own call time, not under this
+        function's locks."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            cur = stack.pop()
+            yield cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(cur))
+
+    # -- transitive acquisition set -----------------------------------------
+    def acquires(self, fn: FuncInfo, _stack: Optional[Set[int]] = None) -> Set[str]:
+        cached = self._acq_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        if _stack is None:
+            _stack = set()
+        if id(fn) in _stack:
+            return self.fn_locks[id(fn)].lexical  # cycle: lexical only
+        _stack.add(id(fn))
+        out = set(self.fn_locks[id(fn)].lexical)
+        for callee in self.graph.callees(fn):
+            out |= self.acquires(callee, _stack)
+        _stack.discard(id(fn))
+        self._acq_cache[id(fn)] = out
+        return out
+
+    # -- transitive blocking witness ----------------------------------------
+    def _direct_block(self, fn: FuncInfo, node: ast.AST, held: Optional[str]) -> Optional[str]:
+        """Description when ``node`` is a lexically blocking op in ``fn``."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        dn = dotted_name(func) or ""
+        tail = dn.rsplit(".", 1)[-1]
+        in_solver = "solver/" in fn.file.path
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr == "sleep":
+                return f"`{dn or attr}()`"
+            if attr == "result":
+                return "`.result()` (RPC/future wait)"
+            if attr == "flock":
+                return "`flock()` (file-lock wait)"
+            if attr == "urlopen":
+                return "`urlopen()` (network RPC)"
+            if attr == "block_until_ready":
+                return "`.block_until_ready()` (device sync)"
+            recv = (
+                func.value.attr if isinstance(func.value, ast.Attribute)
+                else func.value.id if isinstance(func.value, ast.Name)
+                else ""
+            )
+            if attr == "get" and recv in self.queueish.get(fn.file.path, ()):
+                return f"`{recv}.get()` (queue wait)"
+            if attr == "wait" and recv in self.eventish.get(fn.file.path, ()):
+                # Condition.wait on the HELD lock's own cv releases the
+                # lock while parked — the sanctioned pattern; a wait on
+                # any other object parks while still holding `held`
+                hit = self.lock_id(fn, func.value)
+                if hit and held is not None and hit[0] == held:
+                    return None
+                return f"`{recv}.wait()` (event/condition wait)"
+            if in_solver and attr in _DEVICE_FETCHES:
+                return f"`{dn or attr}()` (device fetch)"
+        elif isinstance(func, ast.Name):
+            if func.id == "sleep":
+                return "`sleep()`"
+            if func.id == "urlopen":
+                return "`urlopen()` (network RPC)"
+            if func.id == "flock":
+                return "`flock()` (file-lock wait)"
+        return None
+
+    def block_witness(
+        self, fn: FuncInfo, _stack: Optional[Set[int]] = None
+    ) -> Optional[BlockWitness]:
+        """First blocking op ``fn`` may reach (lexical, else via callees)."""
+        if id(fn) in self._block_cache:
+            return self._block_cache[id(fn)]
+        if _stack is None:
+            _stack = set()
+        if id(fn) in _stack:
+            return None
+        _stack.add(id(fn))
+        witness: Optional[BlockWitness] = None
+        for node in self._walk_own(fn.node):
+            desc = self._direct_block(fn, node, held=None)
+            if desc is not None:
+                witness = BlockWitness(desc, fn.file.path, node.lineno)
+                break
+        if witness is None:
+            for callee in self.graph.callees(fn):
+                sub = self.block_witness(callee, _stack)
+                if sub is not None:
+                    witness = BlockWitness(
+                        sub.desc, sub.path, sub.line,
+                        [callee.qualname] + sub.chain,
+                    )
+                    break
+        _stack.discard(id(fn))
+        self._block_cache[id(fn)] = witness
+        return witness
+
+
+def get_lock_analysis(project: Project) -> LockAnalysis:
+    key = "lock-analysis"
+    analysis = project.cache.get(key)
+    if analysis is None:
+        analysis = LockAnalysis(project, get_graph(project))
+        project.cache[key] = analysis
+    return analysis
+
+
+def _chain_note(w: BlockWitness) -> str:
+    if not w.chain:
+        return ""
+    return f" via `{' -> '.join(w.chain)}`"
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    severity = P0
+    doc = (
+        "lock-acquisition cycle reachable through the call graph (two "
+        "threads entering from different points deadlock), or a helper "
+        "re-acquiring a non-reentrant Lock the caller already holds."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        analysis = get_lock_analysis(project)
+        graph = analysis.graph
+        # global order edges: (A, B) -> list of (path, line, note)
+        edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        for fn in graph.funcs:
+            for lock_id, disp, with_node in analysis.fn_locks[id(fn)].withs:
+                for node in LockAnalysis._walk_own(with_node):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            hit = analysis.lock_id(fn, item.context_expr)
+                            if hit:
+                                edges.setdefault((lock_id, hit[0]), []).append(
+                                    (fn.file.path, node.lineno, "")
+                                )
+                    elif isinstance(node, ast.Call):
+                        for callee in graph.resolve_call(fn.file, node, cls=fn.cls, fn=fn):
+                            for inner in analysis.acquires(callee):
+                                edges.setdefault((lock_id, inner), []).append(
+                                    (
+                                        fn.file.path, node.lineno,
+                                        f" via `{callee.qualname}`",
+                                    )
+                                )
+        findings: List[Finding] = []
+        adj: Dict[str, Set[str]] = {}
+        for (a, b), _sites in edges.items():
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        cyclic = _nodes_on_cycles(adj)
+        seen: Set[Tuple[str, int, str, str]] = set()
+        for (a, b), sites in sorted(edges.items()):
+            disp_a = analysis.display.get(a, a)
+            disp_b = analysis.display.get(b, b)
+            if a == b:
+                if a not in analysis.nonreentrant:
+                    continue  # RLock / unknown ctor: re-entry is legal
+                for path, line, note in sites:
+                    key = (path, line, a, b)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(
+                        self.finding(
+                            path, line,
+                            f"re-acquires non-reentrant lock `{disp_a}` "
+                            f"already held here{note} — single-thread deadlock",
+                        )
+                    )
+                continue
+            if a in cyclic and b in cyclic and _reaches(adj, b, a):
+                for path, line, note in sites:
+                    key = (path, line, a, b)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(
+                        self.finding(
+                            path, line,
+                            f"lock-order inversion: acquires `{disp_b}`"
+                            f"{note} while holding `{disp_a}`, but the "
+                            f"reverse order `{disp_b}` -> `{disp_a}` is also "
+                            "reachable — two threads deadlock; pick one "
+                            "global order (docs/static-analysis.md#lock-order)",
+                        )
+                    )
+        return findings
+
+
+def _reaches(adj: Dict[str, Set[str]], src: str, dst: str) -> bool:
+    seen: Set[str] = set()
+    work = [src]
+    while work:
+        cur = work.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        work.extend(adj.get(cur, ()))
+    return False
+
+
+def _nodes_on_cycles(adj: Dict[str, Set[str]]) -> Set[str]:
+    """Nodes in a non-trivial SCC (Tarjan, iterative)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: Set[str] = set()
+    counter = [0]
+    nodes = set(adj) | {b for bs in adj.values() for b in bs}
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, child_i = work[-1]
+            if child_i == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            children = sorted(adj.get(node, ()))
+            advanced = False
+            for i in range(child_i, len(children)):
+                ch = children[i]
+                if ch not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((ch, 0))
+                    advanced = True
+                    break
+                elif ch in on_stack:
+                    low[node] = min(low[node], index[ch])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.update(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+@register
+class LockBlockingRule(Rule):
+    name = "lock-blocking"
+    severity = P1
+    doc = (
+        "blocking operation (sleep/.result()/queue wait/flock/urlopen/"
+        "device fetch) reachable while a lock is held — the lock becomes "
+        "a convoy; move the wait off the lock (the PR-4 fetch-off-the-"
+        "solve-lock invariant)."
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        analysis = get_lock_analysis(project)
+        graph = analysis.graph
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def flag(path: str, line: int, lock_disp: str, msg: str) -> None:
+            key = (path, line, lock_disp)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(self.finding(path, line, msg))
+
+        for fn in graph.funcs:
+            for lock_id, disp, with_node in analysis.fn_locks[id(fn)].withs:
+                for node in LockAnalysis._walk_own(with_node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    desc = analysis._direct_block(fn, node, held=lock_id)
+                    if desc is not None:
+                        flag(
+                            fn.file.path, node.lineno, disp,
+                            f"{desc} while holding `{disp}` — every thread "
+                            "needing the lock stalls behind this wait; move "
+                            "it off the lock",
+                        )
+                        continue
+                    for callee in graph.resolve_call(fn.file, node, cls=fn.cls, fn=fn):
+                        w = analysis.block_witness(callee)
+                        if w is not None:
+                            flag(
+                                fn.file.path, node.lineno, disp,
+                                f"call to `{callee.qualname}` may block "
+                                f"({w.desc} at {w.path}:{w.line}"
+                                f"{_chain_note(w)}) while holding `{disp}` — "
+                                "move the blocking work off the lock",
+                            )
+                            break
+        return findings

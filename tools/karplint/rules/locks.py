@@ -98,7 +98,7 @@ class LockGuardRule(Rule):
     def _check_file(self, src: SourceFile) -> List[Finding]:
         # class qualname -> {attr -> lock}; "" -> module globals
         guarded: Dict[str, Dict[str, str]] = {}
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             target = None
             if isinstance(node, ast.AnnAssign):
                 target = node.target
@@ -125,7 +125,7 @@ class LockGuardRule(Rule):
         if not guarded:
             return []
         findings: List[Finding] = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             hit = self._mutation(src, node, guarded)
             if hit is None:
                 continue

@@ -12,7 +12,12 @@ exposed name (``namespace_subsystem_name``), and asserts:
   (``_seconds``, ``_bytes``, ...);
 - no two metrics expose the same full name;
 - every full name is listed in ``docs/metrics.md`` — an undocumented
-  metric is a dashboard nobody can find and a rename nobody will notice.
+  metric is a dashboard nobody can find and a rename nobody will notice;
+- the docs row's **labels** cell matches the registered label set — a
+  doc that promises a ``provisioner`` label the metric doesn't carry
+  breaks every dashboard query written from it. A parenthesized cell
+  (``(node gauge labels)``) is shorthand for a shared set and is not
+  checked; ``—`` means no labels.
 """
 
 from __future__ import annotations
@@ -54,6 +59,67 @@ def _resolve_kwarg(call: ast.Call, name: str, module_consts: dict) -> Optional[s
     return None
 
 
+def _str_list(node: Optional[ast.AST], list_consts: dict) -> Optional[List[str]]:
+    """A list/tuple of string constants (inline or via a module-level
+    Name like NODE_GAUGE_LABELS), else None."""
+    if isinstance(node, ast.Name):
+        return list_consts.get(node.id)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for el in node.elts:
+            s = _const_str(el)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def _metric_labels(call: ast.Call, list_consts: dict) -> Optional[List[str]]:
+    """The label names a Counter/Gauge/Histogram registration declares:
+    the third positional argument or the ``labelnames=`` kwarg. Returns
+    [] for an explicitly label-less metric, None when undeterminable."""
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return _str_list(kw.value, list_consts)
+    if len(call.args) >= 3:
+        return _str_list(call.args[2], list_consts)
+    if len(call.args) == 2 and all(
+        kw.arg not in (None, "labelnames") for kw in call.keywords
+    ):
+        return []  # (name, doc, **opts) — no label slot at all
+    return None
+
+
+_DOC_ROW_RE = re.compile(r"^\s*\|\s*`([a-z][a-z0-9_]*)`\s*\|")
+
+
+def _docs_label_cells(docs_text: str) -> dict:
+    """full metric name -> raw labels cell from the docs/metrics.md
+    tables (``| `name` | type | labels | meaning |``)."""
+    cells = {}
+    for line in docs_text.splitlines():
+        m = _DOC_ROW_RE.match(line)
+        if not m:
+            continue
+        parts = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(parts) >= 3:
+            cells[m.group(1)] = parts[2]
+    return cells
+
+
+def _parse_docs_labels(cell: str) -> Optional[List[str]]:
+    """The label names a docs row promises. None = unchecked (shared-set
+    shorthand like ``(node gauge labels)``), [] = explicitly label-less
+    (``—``/``-``/empty)."""
+    cell = cell.strip()
+    if cell.startswith("("):
+        return None
+    if cell in ("", "—", "-", "–"):
+        return []
+    return [tok.strip().strip("`") for tok in cell.split(",") if tok.strip()]
+
+
 @register
 class MetricNameRule(Rule):
     name = "metric-name"
@@ -72,6 +138,7 @@ class MetricNameRule(Rule):
             return []
         docs_path = project.root / "docs" / "metrics.md"
         docs_text = docs_path.read_text() if docs_path.exists() else None
+        docs_labels = _docs_label_cells(docs_text) if docs_text else {}
 
         findings: List[Finding] = []
         seen: dict = {}
@@ -85,6 +152,14 @@ class MetricNameRule(Rule):
                 and isinstance(node.value, ast.Constant)
                 and isinstance(node.value.value, str)
             }
+            list_consts = {}
+            for node in src.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name):
+                        vals = _str_list(node.value, {})
+                        if vals is not None:
+                            list_consts[t.id] = vals
             if docs_text is None:
                 findings.append(
                     self.finding(
@@ -114,7 +189,7 @@ class MetricNameRule(Rule):
                             (dotted_name(stmt.value.func) or "").rsplit(".", 1)[-1],
                             stmt.value,
                         )
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 dn = dotted_name(node.func) or ""
@@ -181,4 +256,22 @@ class MetricNameRule(Rule):
                             f"metric `{full}` is not listed in docs/metrics.md",
                         )
                     )
+                elif full in docs_labels:
+                    promised = _parse_docs_labels(docs_labels[full])
+                    declared = _metric_labels(inner, list_consts)
+                    if (
+                        promised is not None
+                        and declared is not None
+                        and sorted(promised) != sorted(declared)
+                    ):
+                        findings.append(
+                            self.finding(
+                                src.path, line,
+                                f"metric `{full}` labels "
+                                f"{sorted(declared)} don't match the "
+                                f"docs/metrics.md row's labels cell "
+                                f"{sorted(promised)} — dashboard queries "
+                                "written from the doc will break",
+                            )
+                        )
         return findings

@@ -1,0 +1,169 @@
+"""Ownership/fencing reachability for cloud mutations (``mutation-guard``).
+
+The karpenter-tpu fencing contract: before a controller mutates cloud
+state — creating a fleet, deleting or terminating capacity — it must have
+proven it still OWNS the resource and holds a valid fence (PR-6/PR-11:
+a stale leader that kept deleting nodes after losing its lease). The
+proof is a call to one of the guard predicates (``owned()`` / ``fenced()``
+/ ``_owns()``) somewhere on every call-graph path from the reconcile
+entry point to the mutation call site.
+
+This rule checks exactly that, interprocedurally, over ``controllers/``,
+``launch/`` and ``interruption/``:
+
+- **mutation sites**: calls spelled ``<recv>.create(...)``,
+  ``<recv>.create_fleet(...)``, ``<recv>.delete(...)`` or
+  ``<recv>.terminate(...)`` whose receiver chain names a cloud surface
+  (``cloud_provider`` / ``provider`` / ``terminator``);
+- **guarded**: the enclosing function performs a guard call lexically
+  before the mutation line, or every unguarded call-graph path from a
+  ``reconcile*`` entry is cut by a function that performs a guard call;
+- **exempt**: the site (or the line above it) carries
+  ``# mutation-guard: exempt — <why>``. The marker is for paths where
+  the cloud itself is the source of truth (e.g. interruption handling:
+  the provider already announced the capacity is going away, so fencing
+  adds nothing) and makes the exemption grep-able instead of implicit.
+
+A mutation helper that is never reachable from any reconcile entry is
+not flagged — the contract is about the reconcile loops, and dead or
+externally-driven code would only produce noise. P0: an unfenced delete
+from a stale leader is split-brain capacity loss, never baselineable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.karplint.callgraph import FuncInfo, get_graph, walk_no_funcs
+from tools.karplint.core import (
+    P0,
+    Finding,
+    Project,
+    Rule,
+    dotted_name,
+    register,
+)
+
+MUTATING_ATTRS = {"create", "create_fleet", "delete", "terminate"}
+CLOUD_RECEIVERS = ("cloud_provider", "provider", "terminator")
+GUARD_TAILS = {"owned", "owns", "fenced", "_owns", "is_owned", "is_fenced"}
+EXEMPT_RE = re.compile(r"#\s*mutation-guard:\s*exempt")
+
+SCOPED_DIRS = ("controllers/", "launch/", "interruption/")
+
+
+def _is_mutation(call: ast.Call) -> Optional[str]:
+    """Dotted receiver chain when ``call`` is a cloud mutation, else None."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in MUTATING_ATTRS:
+        return None
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    segments = recv.split(".")
+    if any(seg in CLOUD_RECEIVERS for seg in segments):
+        return f"{recv}.{func.attr}"
+    return None
+
+
+def _is_guard_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    if dn is None:
+        return False
+    return dn.rsplit(".", 1)[-1] in GUARD_TAILS
+
+
+def _checks_guard(fn: FuncInfo) -> bool:
+    return any(_is_guard_call(n) for n in walk_no_funcs(fn.node))
+
+
+def _guard_line_before(fn: FuncInfo, lineno: int) -> bool:
+    """A guard call lexically at or before ``lineno`` in this function —
+    covers both ``if not self.owned(): return`` prologues and guards in
+    the ``if self.fenced(...):`` test whose body holds the mutation."""
+    for node in walk_no_funcs(fn.node):
+        if _is_guard_call(node) and node.lineno <= lineno:
+            return True
+    return False
+
+
+def _exempt(fn: FuncInfo, lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if EXEMPT_RE.search(fn.file.line_at(ln)):
+            return True
+    return False
+
+
+@register
+class MutationGuardRule(Rule):
+    name = "mutation-guard"
+    severity = P0
+    doc = (
+        "cloud mutation (create/create_fleet/delete/terminate) reachable "
+        "from a reconcile entry with no owned()/fenced() check on the "
+        "path — a stale leader would mutate capacity it no longer owns; "
+        "guard it or mark `# mutation-guard: exempt — <why>`."
+    )
+    path_must_contain = SCOPED_DIRS
+
+    def run(self, project: Project) -> List[Finding]:
+        scoped = self.files(project)
+        if not scoped:
+            return []
+        graph = get_graph(project)
+        scoped_paths = {f.path for f in scoped}
+
+        # BFS from reconcile* entries; a function that itself checks a
+        # guard cuts the walk — everything it calls runs post-proof.
+        unguarded: Set[int] = set()
+        work: List[FuncInfo] = [
+            fn
+            for fn in graph.funcs
+            if fn.file.path in scoped_paths and fn.name.startswith("reconcile")
+        ]
+        while work:
+            fn = work.pop()
+            if id(fn) in unguarded:
+                continue
+            unguarded.add(id(fn))
+            if _checks_guard(fn):
+                continue
+            work.extend(graph.callees(fn))
+
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+        for fn in graph.funcs:
+            if fn.file.path not in scoped_paths:
+                continue
+            if id(fn) not in unguarded:
+                continue
+            for node in walk_no_funcs(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _is_mutation(node)
+                if target is None:
+                    continue
+                if _guard_line_before(fn, node.lineno):
+                    continue
+                if _exempt(fn, node.lineno):
+                    continue
+                key = (fn.file.path, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    self.finding(
+                        fn.file.path, node.lineno,
+                        f"cloud mutation `{target}` in `{fn.qualname}` is "
+                        "reachable from a reconcile entry with no owned()/"
+                        "fenced() check on the path — a stale leader would "
+                        "mutate capacity it no longer owns; add the guard "
+                        "or `# mutation-guard: exempt — <why>`",
+                    )
+                )
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

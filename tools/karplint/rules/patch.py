@@ -73,7 +73,7 @@ class PatchLiteralListRule(Rule):
         for src in project.files:
             if src.path.endswith("kube/patch.py"):
                 continue  # the helpers themselves build the lists
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if not (
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)

@@ -56,7 +56,7 @@ class ReconcileIORule(Rule):
         findings: List[Finding] = []
         for src in self.files(project):
             sleep_aliases = self._sleep_aliases(src)
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and _is_reconcile(node):
                     self._check_body(src, node, sleep_aliases, findings)
         return findings
@@ -64,7 +64,7 @@ class ReconcileIORule(Rule):
     @staticmethod
     def _sleep_aliases(src: SourceFile) -> set:
         out = set()
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if isinstance(node, ast.ImportFrom) and node.module == "time":
                 for alias in node.names:
                     if alias.name == "sleep":

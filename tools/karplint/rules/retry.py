@@ -120,7 +120,7 @@ class RetryIdempotentRule(Rule):
         # policy name -> max_attempts, from RetryPolicy(...) constructions
         policies: Dict[str, int] = {}
         local_defs: Dict[str, ast.AST] = {}
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 local_defs.setdefault(node.name, node)
             if not isinstance(node, ast.Assign) or len(node.targets) != 1:
@@ -147,7 +147,7 @@ class RetryIdempotentRule(Rule):
 
         if not policies:
             return
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)

@@ -32,7 +32,7 @@ from tools.karplint.core import (
     dotted_name,
     register,
 )
-from tools.karplint.rules.tracer import CallGraph, walk_no_funcs
+from tools.karplint.callgraph import get_graph, walk_no_funcs
 
 OBS_MODULE = "karpenter_tpu.obs"
 
@@ -80,7 +80,7 @@ class SpanClosedRule(Rule):
         for f in project.files:
             if _in_obs_package(f.path):
                 continue  # the implementation (and its tests' fixtures)
-            for node in ast.walk(f.tree):
+            for node in f.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 # match the attribute/name directly, not via dotted_name:
@@ -109,7 +109,7 @@ class SpanClosedRule(Rule):
         files = project.matching(lambda p: "solver/" in p)
         if not files:
             return
-        graph = CallGraph(files)
+        graph = get_graph(project, files)
         reachable = graph.reachable()
         for fn in reachable:
             aliases = _obs_aliases(fn.file)

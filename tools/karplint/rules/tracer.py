@@ -30,9 +30,9 @@ traced.
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Set, Tuple
 
+from tools.karplint.callgraph import get_graph, walk_no_funcs
 from tools.karplint.core import (
     P0,
     P1,
@@ -41,204 +41,14 @@ from tools.karplint.core import (
     Rule,
     SourceFile,
     dotted_name,
-    import_tables,
     register,
 )
 
-JIT_WRAPPERS = ("jit", "vmap", "pmap")
 STATIC_CALLS = {
     "len", "max", "min", "abs", "int", "float", "bool", "range", "tuple",
     "divmod", "sorted", "isinstance",
 }
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
-
-
-def walk_no_funcs(node: ast.AST) -> Iterable[ast.AST]:
-    """ast.walk that does not descend into nested function/class defs."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        cur = stack.pop()
-        yield cur
-        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
-            continue
-        stack.extend(ast.iter_child_nodes(cur))
-
-
-@dataclass
-class FuncInfo:
-    file: SourceFile
-    node: ast.AST  # FunctionDef / AsyncFunctionDef
-    qualname: str
-    parent: Optional["FuncInfo"]
-    children: List["FuncInfo"] = field(default_factory=list)
-    static_argnames: Set[str] = field(default_factory=set)
-    is_root: bool = False
-
-    @property
-    def name(self) -> str:
-        return self.node.name
-
-
-class CallGraph:
-    """Function defs + best-effort resolved call edges across the fileset."""
-
-    def __init__(self, files: Sequence[SourceFile]):
-        self.files = list(files)
-        self.funcs: List[FuncInfo] = []
-        self.by_file_name: Dict[Tuple[str, str], List[FuncInfo]] = {}
-        self.module_of: Dict[str, SourceFile] = {}
-        self.imports: Dict[str, Tuple[dict, dict]] = {}
-        self.module_consts: Dict[str, Set[str]] = {}
-        for f in self.files:
-            self.module_of[f.path[:-3].replace("/", ".")] = f
-            self.imports[f.path] = import_tables(f.tree)
-            self.module_consts[f.path] = {
-                t.id
-                for node in f.tree.body
-                if isinstance(node, ast.Assign)
-                for t in node.targets
-                if isinstance(t, ast.Name) and isinstance(node.value, ast.Constant)
-            }
-            self._collect_funcs(f)
-        self._mark_roots()
-
-    def _collect_funcs(self, f: SourceFile) -> None:
-        def visit(node: ast.AST, parent: Optional[FuncInfo], prefix: str) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    info = FuncInfo(
-                        file=f, node=child,
-                        qualname=f"{prefix}{child.name}", parent=parent,
-                    )
-                    info.static_argnames = _decorator_statics(child)
-                    if _decorated_jit(child):
-                        info.is_root = True
-                    self.funcs.append(info)
-                    if parent:
-                        parent.children.append(info)
-                    self.by_file_name.setdefault((f.path, child.name), []).append(info)
-                    visit(child, info, f"{info.qualname}.")
-                elif isinstance(child, ast.ClassDef):
-                    visit(child, parent, f"{prefix}{child.name}.")
-                else:
-                    visit(child, parent, prefix)
-
-        visit(f.tree, None, "")
-
-    def _mark_roots(self) -> None:
-        """Names passed to jit/vmap/pmap or pallas_call become roots."""
-        for f in self.files:
-            for node in ast.walk(f.tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                dn = dotted_name(node.func) or ""
-                tail = dn.rsplit(".", 1)[-1]
-                if tail in JIT_WRAPPERS or tail == "pallas_call":
-                    for target in _callable_args(node):
-                        for info in self.by_file_name.get((f.path, target), []):
-                            info.is_root = True
-                            if tail in JIT_WRAPPERS:
-                                info.static_argnames |= _call_statics(node)
-
-    def resolve_call(self, f: SourceFile, call: ast.Call) -> List[FuncInfo]:
-        modules, symbols = self.imports[f.path]
-        func = call.func
-        if isinstance(func, ast.Name):
-            local = self.by_file_name.get((f.path, func.id))
-            if local:
-                return local
-            if func.id in symbols:
-                mod, sym = symbols[func.id]
-                target = self._file_for_module(mod)
-                if target:
-                    return self.by_file_name.get((target.path, sym), [])
-            return []
-        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
-            alias = func.value.id
-            if alias in modules:
-                target = self._file_for_module(modules[alias])
-                if target:
-                    return self.by_file_name.get((target.path, func.attr), [])
-        return []
-
-    def _file_for_module(self, dotted: str) -> Optional[SourceFile]:
-        for mod, f in self.module_of.items():
-            if mod == dotted or mod.endswith("." + dotted) or dotted.endswith("." + mod):
-                return f
-        return None
-
-    def reachable(self) -> List[FuncInfo]:
-        seen: Set[int] = set()
-        work = [fn for fn in self.funcs if fn.is_root]
-        out: List[FuncInfo] = []
-        while work:
-            fn = work.pop()
-            if id(fn) in seen:
-                continue
-            seen.add(id(fn))
-            out.append(fn)
-            work.extend(fn.children)
-            for node in walk_no_funcs(fn.node):
-                if isinstance(node, ast.Call):
-                    work.extend(self.resolve_call(fn.file, node))
-            # calls inside nested defs traverse when the child pops
-        return out
-
-
-def _callable_args(call: ast.Call) -> List[str]:
-    """Simple names passed as callables: bare ``f`` or ``partial(f, ...)``."""
-    out = []
-    for arg in call.args[:1] or []:
-        if isinstance(arg, ast.Name):
-            out.append(arg.id)
-        elif isinstance(arg, ast.Call):
-            dn = dotted_name(arg.func) or ""
-            if dn.rsplit(".", 1)[-1] == "partial" and arg.args:
-                first = arg.args[0]
-                if isinstance(first, ast.Name):
-                    out.append(first.id)
-    return out
-
-
-def _statics_from_value(value: ast.AST) -> Set[str]:
-    if isinstance(value, ast.Constant) and isinstance(value.value, str):
-        return {value.value}
-    if isinstance(value, (ast.Tuple, ast.List)):
-        return {
-            e.value
-            for e in value.elts
-            if isinstance(e, ast.Constant) and isinstance(e.value, str)
-        }
-    return set()
-
-
-def _call_statics(call: ast.Call) -> Set[str]:
-    for kw in call.keywords:
-        if kw.arg in ("static_argnames", "static_argnums"):
-            return _statics_from_value(kw.value)
-    return set()
-
-
-def _decorated_jit(fn: ast.AST) -> bool:
-    for dec in getattr(fn, "decorator_list", []):
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        dn = dotted_name(target) or ""
-        tail = dn.rsplit(".", 1)[-1]
-        if tail in JIT_WRAPPERS:
-            return True
-        if tail == "partial" and isinstance(dec, ast.Call) and dec.args:
-            inner = dotted_name(dec.args[0]) or ""
-            if inner.rsplit(".", 1)[-1] in JIT_WRAPPERS:
-                return True
-    return False
-
-
-def _decorator_statics(fn: ast.AST) -> Set[str]:
-    out: Set[str] = set()
-    for dec in getattr(fn, "decorator_list", []):
-        if isinstance(dec, ast.Call):
-            out |= _call_statics(dec)
-    return out
 
 
 class _TaintScope:
@@ -361,7 +171,7 @@ def _run_tracer(rule: Rule, project: Project, check: str) -> List[Finding]:
     files = rule.files(project)
     if not files:
         return []
-    graph = CallGraph(files)
+    graph = get_graph(project, files)
     reachable = graph.reachable()
     reachable_ids = {id(fn) for fn in reachable}
     findings: List[Finding] = []
@@ -577,7 +387,7 @@ class TracerDtypeRule(Rule):
         contract = _parse_contract(sig)
         findings: List[Finding] = []
         for f in files:
-            for node in ast.walk(f.tree):
+            for node in f.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 base = token = None

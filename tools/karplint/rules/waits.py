@@ -77,7 +77,7 @@ class BoundedWaitRule(Rule):
         findings: List[Finding] = []
         for src in self.files(project):
             waiters, getters = self._tracked(src)
-            for node in ast.walk(src.tree):
+            for node in src.nodes():
                 if not isinstance(node, ast.Call) or not isinstance(
                     node.func, ast.Attribute
                 ):
@@ -127,7 +127,7 @@ class BoundedWaitRule(Rule):
         (getters) anywhere in this file."""
         waiters: Set[str] = set()
         getters: Set[str] = set()
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             value = None
             targets = []
             if isinstance(node, ast.Assign):
