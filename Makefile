@@ -89,9 +89,13 @@ stream-chaos:  ## streamed-transport proof: stream lifecycle suite + the >=5x ov
 	$(PY) -m pytest tests/test_solver_stream.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --overload-storm 300 --overload-stream
 
-corruption-chaos:  ## pack-integrity proof: checksum/canary/quarantine suites + the 4-mode corruption storm leg
+corruption-chaos:  ## pack-integrity proof: checksum/canary/quarantine suites + the 5-mode corruption storm leg
 	$(PY) -m pytest tests/test_integrity.py tests/test_serde_fuzz.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --corruption-storm 200
+
+delta-chaos:  ## resident-delta proof: parity/epoch-guard/residency suites + the stale_delta + restart storm leg
+	$(PY) -m pytest tests/test_delta.py tests/test_serde_fuzz.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --delta-storm 240
 
 partition-chaos:  ## control-plane partition proof: transport/fencing suites + the apiserver blip/brownout/blackout storm leg
 	$(PY) -m pytest tests/test_partition.py -q -m 'not slow' $(TESTFLAGS)
@@ -138,5 +142,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace profile-smoke benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos partition-chaos consolidation-chaos forecast-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos delta-chaos partition-chaos consolidation-chaos forecast-chaos dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

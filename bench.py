@@ -242,6 +242,7 @@ def bench_once(
     seed: int = 42,
     wire_telemetry: bool = False,
     record_decisions: str = "",
+    delta=None,
 ):
     """One solve scenario, ``iters`` measured iterations.
 
@@ -261,7 +262,10 @@ def bench_once(
     c.requirements = c.requirements.merge(catalog_requirements(catalog))
     pods = diverse_pods(n_pods, random.Random(seed))
     cluster = Cluster()
-    scheduler = Scheduler(cluster, rng=random.Random(1))
+    # delta=None defers to the KARPENTER_SOLVER_DELTA env twin; the
+    # headline legs pass True so the resident-encoding steady state
+    # (docs/delta-encoding.md) is what gets measured
+    scheduler = Scheduler(cluster, rng=random.Random(1), solver_delta=delta)
 
     prev_packer = os.environ.get("KARPENTER_PACKER")
     os.environ["KARPENTER_PACKER"] = packer
@@ -377,6 +381,26 @@ def bench_once(
         if any(backends):
             out["packer_backend"] = max(set(b for b in backends if b),
                                         key=backends.count)
+        # resident delta attribution (docs/delta-encoding.md): host share
+        # is the per-solve HOST-side cost — sort + inject + encode +
+        # decode in whichever variant (delta or full-rebuild) each stage
+        # took; delta_hit_rate is the fraction of measured iterations any
+        # stage served from resident state. The headline bar is
+        # host_share_ms < 10 at the 10k-pod leg in steady state.
+        host_keys = ("sort_s", "sort_delta_s", "inject_s", "inject_delta_s",
+                     "encode_s", "encode_delta_s", "decode_s",
+                     "decode_delta_s")
+        shares = [
+            sum(p.get(k, 0.0) for k in host_keys) for p in profiles if p
+        ]
+        if shares:
+            out["host_share_ms"] = round(statistics.median(shares) * 1e3, 2)
+            out["delta_hit_rate"] = round(
+                sum(
+                    1 for p in profiles
+                    if any(k.endswith("_delta_s") for k in p)
+                ) / len(profiles), 4,
+            )
     if decision_log is not None:
         solve_total = sum(times)
         out["explain_overhead_pct"] = round(
@@ -881,11 +905,12 @@ def bench_streamed(n_pods: int, iters: int, coalesce_threads: int = 2):
         probe.close()
 
         # -- full scheduler solves over each transport --------------------
-        def run_leg(stream: bool, shm: str = ""):
+        def run_leg(stream: bool, shm: str = "", delta: bool = False):
             sched = Scheduler(
                 Cluster(), rng=random.Random(1),
                 solver_service_address=address,
                 solver_stream=stream, solver_shm_dir=shm,
+                solver_delta=delta,
             )
             sched.solve(provisioner, catalog, pods)  # warm + open + establish
             sched.solve(provisioner, catalog, pods)
@@ -900,16 +925,29 @@ def bench_streamed(n_pods: int, iters: int, coalesce_threads: int = 2):
             med = lambda k: round(  # noqa: E731
                 stats.median(p.get(k, 0.0) for p in profiles) * 1e3, 3
             )
+            host_keys = ("sort_s", "sort_delta_s", "inject_s",
+                         "inject_delta_s", "encode_s", "encode_delta_s",
+                         "decode_s", "decode_delta_s")
             return {
                 "pods_per_sec": round(scheduled / min(times), 1),
                 "p99_s": round(_p99(times), 4),
                 "wire_ser_ms": med("wire_ser_s"),
                 "wire_deser_ms": med("wire_deser_s"),
                 "transport": profiles[-1].get("solver_transport", "unary"),
+                "host_share_ms": round(stats.median(
+                    sum(p.get(k, 0.0) for k in host_keys) for p in profiles
+                ) * 1e3, 2),
+                "delta_hit_rate": round(sum(
+                    1 for p in profiles
+                    if any(k.endswith("_delta_s") for k in p)
+                ) / max(len(profiles), 1), 4),
             }
 
         unary_leg = run_leg(stream=False)
-        streamed_leg = run_leg(stream=True)
+        streamed_leg = run_leg(stream=True, delta=True)
+        # the shm sub-leg keeps delta OFF: delta frames ride inline by
+        # design (the resident base must outlive recycling arena slots),
+        # so measuring the arena requires full-pod-set frames
         shm_leg = run_leg(stream=True, shm=shm_dir)
         out["unary_pods_per_sec"] = unary_leg["pods_per_sec"]
         out["unary_wire_ser_ms"] = unary_leg["wire_ser_ms"]
@@ -919,6 +957,8 @@ def bench_streamed(n_pods: int, iters: int, coalesce_threads: int = 2):
         out["streamed_transport"] = streamed_leg["transport"]
         out["streamed_wire_ser_ms"] = streamed_leg["wire_ser_ms"]
         out["streamed_wire_deser_ms"] = streamed_leg["wire_deser_ms"]
+        out["streamed_host_share_ms"] = streamed_leg["host_share_ms"]
+        out["streamed_delta_hit_rate"] = streamed_leg["delta_hit_rate"]
         out["streamed_shm"] = shm_leg
 
         # -- cross-stream coalescing phase --------------------------------
@@ -2352,7 +2392,9 @@ def bench_corruption_storm(
     provisions against a solver sidecar pool whose SERVING member emits
     seeded corrupt frames — one phase per mode (payload bit-flip, frame
     truncation, stale-session replay, NaN injection into the result
-    tensors) at 100% corruption to prove per-mode detection + quarantine
+    tensors, stale-delta epoch garbling — degrading to a bit flip here
+    since this storm runs delta-off; --delta-storm is the delta-on twin)
+    at 100% corruption to prove per-mode detection + quarantine
     latency, then a mixed phase at the configured rate. Wire checksums and
     the canary cross-check are ON. Acceptance: corrupt_packs_bound=0 /
     detection_rate=1.0 (no corruption ever reaches a bind — a post-storm
@@ -2554,6 +2596,189 @@ def bench_corruption_storm(
             "canary_solves": totals.get("canary_solves", 0),
             "pool_failovers_total": _sample(
                 m, "karpenter_solver_pool_failovers_total"
+            ),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        if packer_before is None:
+            os.environ.pop("KARPENTER_PACKER", None)
+        else:
+            os.environ["KARPENTER_PACKER"] = packer_before
+        rt.stop()
+        sidecars.stop_all()
+
+
+def bench_delta_storm(
+    n_pods: int = 240,
+    pool_size: int = 2,
+    corrupt_rate: float = 0.5,
+    seed: int = 20260807,
+):
+    """Delta-residency chaos leg (docs/delta-encoding.md): the full
+    runtime provisions with resident delta encoding ON against a chaos
+    sidecar pool. Three phases: (1) steady pod churn — elide/patch deltas
+    flow across the wire; (2) stale_delta injection — checksum-VALID
+    requests whose epoch words lie, the wire shape of an out-of-order or
+    dropped delta, refused by the sidecar's digest recompute and healed
+    by counted full re-establishes; (3) a mid-round sidecar restart —
+    empty pod store, the NEEDS_DELTA_BASE/NEEDS_CATALOG ladder re-pins.
+    Acceptance: zero stale-tensor binds (the corruption-storm post-run
+    cluster scan), delta_epoch_mismatches > 0 with every one healed
+    (full re-encodes COUNTED, never silent), provision success rate
+    1.0."""
+    from karpenter_tpu import metrics as m
+    from karpenter_tpu.cloudprovider.simulated import SimCloudAPI, SimulatedCloudProvider
+    from karpenter_tpu.main import build_runtime
+    from karpenter_tpu.options import Options
+    from karpenter_tpu.solver import integrity
+    from karpenter_tpu.testing.chaos import ChaosPolicy, SidecarChaos
+    from karpenter_tpu.testing.factories import make_pod
+    from karpenter_tpu.utils import resources as res
+
+    t_start = time.perf_counter()
+    # pin the device path: these small batches would route native, and a
+    # storm that never ships a delta frame proves nothing about the guard
+    packer_before = os.environ.get("KARPENTER_PACKER")
+    os.environ["KARPENTER_PACKER"] = "device"
+    integrity.reset()
+
+    def sample(name: str) -> float:
+        return _sample(m, name)
+
+    mm0 = sample("karpenter_solver_delta_epoch_mismatches_total")
+    fr0 = sample("karpenter_solver_delta_full_reencodes_total")
+    ap0 = sample("karpenter_solver_delta_applied_total")
+    sidecars = SidecarChaos(n=pool_size)
+    cluster = Cluster()
+    rt = build_runtime(
+        Options(
+            solver_service_address=sidecars.address_spec,
+            pack_checksum=True,
+            solver_delta=True,
+        ),
+        cluster=cluster,
+        cloud_provider=SimulatedCloudProvider(api=SimCloudAPI()),
+    )
+    rt.manager.start()
+    created = 0
+
+    def create_pods(prefix: str, n: int) -> list:
+        nonlocal created
+        names = []
+        for i in range(n):
+            name = f"{prefix}-{i}"
+            names.append(name)
+            cluster.create(
+                "pods", make_pod(name=name, requests={"cpu": "0.25"})
+            )
+        created += n
+        return names
+
+    def wait_bound(names: list, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        want = set(names)
+        while time.time() < deadline:
+            live = {
+                p.metadata.name: p for p in cluster.pods()
+                if p.metadata.name in want
+            }
+            if len(live) == len(want) and all(
+                p.spec.node_name for p in live.values()
+            ):
+                return
+            time.sleep(0.05)
+
+    try:
+        cluster.create("provisioners", make_provisioner(solver="tpu"))
+        deadline = time.time() + 10
+        while time.time() < deadline and not rt.provisioning.workers:
+            time.sleep(0.02)
+        assert rt.provisioning.workers, "provisioner worker never started"
+        worker = next(iter(rt.provisioning.workers.values()))
+        worker.batcher.idle_duration = 0.1
+
+        # ---- phase 1: steady churn — deltas flow, no chaos yet
+        n_phase = max(n_pods // 4, 10)
+        wait_bound(create_pods("churn-a", n_phase))
+        wait_bound(create_pods("churn-b", n_phase))
+        applied_steady = sample(
+            "karpenter_solver_delta_applied_total"
+        ) - ap0
+        victim = sidecars.busiest()
+
+        # ---- phase 2: stale_delta injection on the serving member —
+        # every refused frame must heal into a counted re-establish, and
+        # NO refused (or garbled-but-accepted) frame may produce a bind
+        # computed from stale resident tensors
+        sidecars.restart(victim, policy=ChaosPolicy(seed=seed))
+        proxy = sidecars.proxies[victim]
+        wait_bound(create_pods("repin", 6))
+        proxy.policy = ChaosPolicy(
+            corrupt_rate=corrupt_rate, corruption_modes=("stale_delta",),
+            methods=frozenset({"solve_bytes"}), seed=seed + 1,
+        )
+        wait_bound(create_pods("stale", n_phase), timeout=180)
+        proxy.policy = ChaosPolicy(seed=seed)
+        injected = proxy.corrupted_total()
+
+        # ---- phase 3: mid-round sidecar restart — resident base AND
+        # session store gone; the recovery ladder re-establishes
+        sidecars.restart(victim, policy=ChaosPolicy(seed=seed + 2))
+        wait_bound(create_pods("restart", max(n_pods - created, 10)),
+                   timeout=180)
+
+        # ---- settle, then judge with the corruption-storm bind scan:
+        # a stale-tensor bind surfaces as an oversubscribed node or a
+        # bind against state that never existed
+        all_names = [p.metadata.name for p in cluster.pods()]
+        wait_bound(all_names, timeout=60)
+        pods = list(cluster.pods())
+        bound = [p for p in pods if p.spec.node_name]
+        node_names = {n.metadata.name for n in cluster.nodes()}
+        anomalies = []
+        by_node: dict = {}
+        for p in bound:
+            reqs = res.requests_for_pods(p)
+            if any(not math.isfinite(v) for v in reqs.values()):
+                anomalies.append(f"pod {p.metadata.name}: non-finite requests")
+            if p.spec.node_name not in node_names:
+                anomalies.append(
+                    f"pod {p.metadata.name}: bound to missing node "
+                    f"{p.spec.node_name}"
+                )
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        for node in cluster.nodes():
+            members = by_node.get(node.metadata.name, [])
+            if not members or not node.status.allocatable:
+                continue
+            totals = res.merge(*[res.requests_for_pods(p) for p in members])
+            if not res.fits(totals, node.status.allocatable):
+                anomalies.append(
+                    f"node {node.metadata.name}: oversubscribed "
+                    f"({res.to_string(totals)})"
+                )
+        mismatches = sample(
+            "karpenter_solver_delta_epoch_mismatches_total"
+        ) - mm0
+        reencodes = sample(
+            "karpenter_solver_delta_full_reencodes_total"
+        ) - fr0
+        applied = sample("karpenter_solver_delta_applied_total") - ap0
+        return {
+            "pods": created,
+            "pool_size": pool_size,
+            "corrupt_member": victim,
+            "stale_delta_rate": corrupt_rate,
+            "seed": seed,
+            "injected_stale_deltas": injected,
+            "delta_applied": int(applied),
+            "delta_applied_steady_phase": int(applied_steady),
+            "delta_epoch_mismatches": int(mismatches),
+            "delta_full_reencodes": int(reencodes),
+            "stale_tensor_binds": len(anomalies),
+            "bind_anomalies": anomalies[:5],
+            "delta_provision_success_rate": round(
+                len(bound) / max(created, 1), 4
             ),
             "wall_s": round(time.perf_counter() - t_start, 2),
         }
@@ -4250,6 +4475,20 @@ def main():
                     help="CI gate: run the headline leg with and without the "
                          "sampling profiler, report both, exit 1 if the "
                          "profiler's self-accounted overhead is >=1%%")
+    ap.add_argument("--no-solver-delta", action="store_true",
+                    help="disable resident delta encoding on the headline/"
+                         "device legs (docs/delta-encoding.md) — the "
+                         "host_share_ms comparison point: full sort/inject/"
+                         "encode rebuild every solve")
+    ap.add_argument("--delta-storm", type=int, metavar="N_PODS", default=0,
+                    help="delta-residency chaos leg (docs/delta-encoding.md):"
+                         " the full runtime with --solver-delta against a "
+                         "chaos sidecar pool injecting stale_delta frames "
+                         "(checksum-valid, epoch words lying — the wire "
+                         "shape of out-of-order/dropped deltas) plus a "
+                         "mid-round sidecar restart; acceptance: zero "
+                         "stale-tensor binds, epoch-mismatch full "
+                         "re-encodes counted, provision success rate 1.0")
     ap.add_argument("--no-explain", action="store_true",
                     help="disable the decision observability plane for this "
                          "run — the explain-overhead acceptance bar compares "
@@ -4497,7 +4736,8 @@ def main():
         print(json.dumps({
             "metric": (
                 f"corruption-storm ({r['pods']} pods, "
-                f"{r['pool_size']}-member pool, 4 corruption modes, "
+                f"{r['pool_size']}-member pool, "
+                f"{len(r['per_mode'])} corruption modes, "
                 "checksums + canary on)"
             ),
             "value": r["detection_rate"],
@@ -4505,6 +4745,37 @@ def main():
             "integrity_ok": ok,
             **{k: v for k, v in r.items() if k != "detection_rate"},
             "detection_rate": r["detection_rate"],
+        }))
+        return
+
+    if args.delta_storm:
+        r = bench_delta_storm(
+            args.delta_storm,
+            pool_size=args.fleet_pool,
+            seed=args.chaos_seed,
+        )
+        ok = (
+            r["stale_tensor_binds"] == 0
+            and r["delta_provision_success_rate"] == 1.0
+            # the refusals were COUNTED, not silent: chaos injected stale
+            # epochs, so mismatches and their healing re-encodes must show
+            and (r["injected_stale_deltas"] == 0
+                 or (r["delta_epoch_mismatches"] > 0
+                     and r["delta_full_reencodes"] > 0))
+            # and the steady phase actually rode the delta path
+            and r["delta_applied_steady_phase"] > 0
+        )
+        print(json.dumps({
+            "metric": (
+                f"delta-storm ({r['pods']} pods, "
+                f"{r['pool_size']}-member pool, stale_delta injection + "
+                "mid-round sidecar restart, resident delta encoding on)"
+            ),
+            "value": r["stale_tensor_binds"],
+            "unit": "stale-tensor binds (bar: 0)",
+            "delta_ok": ok,
+            **{k: v for k, v in r.items() if k != "stale_tensor_binds"},
+            "stale_tensor_binds": r["stale_tensor_binds"],
         }))
         return
 
@@ -4763,6 +5034,7 @@ def main():
             args.pods, args.iters, args.solver,
             breakdown=args.solver == "tpu", wire_telemetry=args.solver == "tpu",
             record_decisions=_explain_ctx.name if _explain_ctx else "",
+            delta=not args.no_solver_delta,
         )
     finally:
         if _explain_ctx is not None:
@@ -4795,6 +5067,7 @@ def main():
         line["profile_samples"] = psnap["samples"]
         line["profile_top"] = psnap["top"]
     for k in ("packer_backend", "wire_in_path", "breakdown_ms", "worst_iter",
+              "host_share_ms", "delta_hit_rate",
               "trace_critical_path_ms",
               "slo_solve_p99_ok", "slo_solve_p99_s",
               "slo_online_offline_delta_pct", "slo_burn_rates",
@@ -4826,8 +5099,10 @@ def main():
             dev = bench_once(
                 args.pods, max(2, args.iters // 2), "tpu",
                 breakdown=True, packer="fused", wire_telemetry=True,
+                delta=not args.no_solver_delta,
             )
             for k in ("pods_per_sec", "mean_s", "p99_s",
+                      "host_share_ms", "delta_hit_rate",
                       "rtt_per_solve_samples", "mean_minus_rtt_each_s",
                       "p90_minus_rtt_each_s", "p99_minus_rtt_each_s",
                       "worst_iter", "trace_critical_path_ms",

@@ -77,6 +77,7 @@ class ProvisionerWorker:
         canary_rate: Optional[float] = None,
         solver_stream: Optional[bool] = None,
         solver_shm_dir: Optional[str] = None,
+        solver_delta: Optional[bool] = None,
         unschedulable_event_rounds: int = 3,
         warm_pool: bool = False,
     ):
@@ -104,6 +105,7 @@ class ProvisionerWorker:
             cluster, solver_service_address=solver_service_address,
             pack_checksum=pack_checksum, canary_rate=canary_rate,
             solver_stream=solver_stream, solver_shm_dir=solver_shm_dir,
+            solver_delta=solver_delta,
         )
         # bounded, priority-aware admission (docs/overload.md): a full
         # queue sheds the oldest lowest-priority pod instead of growing
@@ -784,6 +786,7 @@ class ProvisioningController:
         canary_rate: Optional[float] = None,
         solver_stream: Optional[bool] = None,
         solver_shm_dir: Optional[str] = None,
+        solver_delta: Optional[bool] = None,
         unschedulable_event_rounds: int = 3,
         warm_pool: bool = False,
     ):
@@ -807,6 +810,8 @@ class ProvisioningController:
         # KARPENTER_SOLVER_STREAM / KARPENTER_SOLVER_SHM_DIR env twins)
         self.solver_stream = solver_stream
         self.solver_shm_dir = solver_shm_dir
+        # resident delta encoding (None = the KARPENTER_SOLVER_DELTA twin)
+        self.solver_delta = solver_delta
         self.journal = journal  # write-ahead launch journal, shared by workers
         # fleet.ShardManager (or None = this replica owns everything):
         # reconcile only runs workers for owned shards, and each worker's
@@ -944,6 +949,7 @@ class ProvisioningController:
                 canary_rate=self.canary_rate,
                 solver_stream=self.solver_stream,
                 solver_shm_dir=self.solver_shm_dir,
+                solver_delta=self.solver_delta,
                 unschedulable_event_rounds=self.unschedulable_event_rounds,
                 warm_pool=self.warm_pool,
             )

@@ -82,6 +82,14 @@ class Cluster:
         self._pod_node_of: Dict[Tuple[str, str], str] = {}
 
     # -- generic helpers ---------------------------------------------------
+    def version(self) -> int:
+        """Monotonic store version: bumped by every mutation (and by
+        ``seed``). A matching version proves NO object in any store moved
+        between two reads — what the resident plan-reuse guard
+        (solver/delta.py) keys topology-round reuse on. Reading the int is
+        atomic under the GIL; no lock needed."""
+        return self._version
+
     def _key(self, obj) -> Tuple[str, str]:
         return (obj.metadata.namespace, obj.metadata.name)
 
@@ -124,6 +132,10 @@ class Cluster:
         not as a long-lived view."""
         with self._lock:
             self._stores[kind].objects[self._key(obj)] = obj
+            # the store's content moved even though the object is untouched:
+            # version-keyed consumers (the resident plan-reuse guard in
+            # solver/delta.py) must see seeded state as a new cluster state
+            self._version += 1
         if kind == "pods":
             self._index_pod("ADDED", obj)  # no events, but the index must see it
         return obj

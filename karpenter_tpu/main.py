@@ -321,6 +321,8 @@ def build_runtime(
         # (docs/solver-transport.md § Streaming)
         solver_stream=options.solver_stream,
         solver_shm_dir=options.solver_shm_dir,
+        # resident delta encoding (docs/delta-encoding.md)
+        solver_delta=options.solver_delta,
         # decision observability (docs/decisions.md): the consecutive-
         # failure threshold behind PodUnschedulable Warning events
         unschedulable_event_rounds=options.unschedulable_event_rounds,

@@ -299,6 +299,55 @@ SOLVER_ENCODE_CACHE_MISSES = Counter(
     registry=REGISTRY,
 )
 
+# Resident delta encoding (docs/delta-encoding.md): the steady-state path
+# keeps encoded tensors resident across rounds and patches them from
+# per-pod deltas. A spiking full_reencodes rate is the "solves got slow"
+# smoking gun (operations.md has the runbook row); epoch mismatches are the
+# fail-loud guard firing — each one is a stale-tensor solve that did NOT
+# happen.
+SOLVER_DELTA_APPLIED = Counter(
+    "delta_applied_total",
+    "Rounds served by the resident delta path instead of a full re-encode "
+    "(path: host = resident host tensors, wire = elided/patched v3 frame, "
+    "device = reused/patched device-resident pod upload).",
+    ["path"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_DELTA_FULL_REENCODES = Counter(
+    "delta_full_reencodes_total",
+    "Delta-mode rounds that fell back to a full re-encode, by reason "
+    "(cold, epoch, table, topology, wire).",
+    ["reason"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_DELTA_EPOCH_MISMATCHES = Counter(
+    "delta_epoch_mismatches_total",
+    "Delta frames refused because the resident base epoch was missing or "
+    "the patched content failed its epoch check (side: client, sidecar). "
+    "Every one is a would-have-been stale-tensor solve caught loud.",
+    ["side"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
+SOLVER_DELTA_RESIDENT_BYTES = Gauge(
+    "delta_resident_bytes",
+    "Bytes of pod-side tensors held resident for the delta path "
+    "(side: host = controller resident batch, sidecar = the wire store, "
+    "device = the resident device upload).",
+    ["side"],
+    namespace=NAMESPACE,
+    subsystem="solver",
+    registry=REGISTRY,
+)
+
 # Tracing subsystem (karpenter_tpu/obs): span volume and ring-buffer loss
 # must be observable — a silently-dropping exporter reads as "nothing slow
 # happened", and the flight recorder's write rate IS the slow-solve rate.

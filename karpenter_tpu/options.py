@@ -62,6 +62,13 @@ class Options:
     solver_shm_dir: str = field(
         default_factory=lambda: _env("KARPENTER_SOLVER_SHM_DIR", "")
     )
+    # resident delta encoding (docs/delta-encoding.md): keep the encoded
+    # cluster resident across rounds and patch it from per-round deltas,
+    # epoch-guarded so staleness fails loud into a full re-encode. Off by
+    # default like --solver-stream; ON in deploy/chart.
+    solver_delta: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_SOLVER_DELTA")
+    )
     consolidation_enabled: bool = field(
         default_factory=lambda: env_bool("KARPENTER_CONSOLIDATION")
     )
@@ -320,6 +327,17 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         "fleets interop; docs/solver-transport.md)",
     )
     ap.add_argument(
+        "--solver-delta",
+        action=argparse.BooleanOptionalAction,
+        default=opts.solver_delta,
+        help="resident delta encoding: keep the encoded cluster resident "
+        "across rounds (host tensors + the sidecar's wire store) and ship "
+        "per-round deltas instead of re-encoding from scratch; "
+        "epoch-guarded — staleness forces a counted full re-encode "
+        "(capability-gated on PROTO_DELTA for the wire side, so "
+        "mixed-version fleets interop; docs/delta-encoding.md)",
+    )
+    ap.add_argument(
         "--solver-shm-dir", default=opts.solver_shm_dir,
         help="zero-copy colocated fast path: a directory shared with the "
         "sidecar on the same host; pod arrays move via an mmap'd arena "
@@ -505,6 +523,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         default_solver=ns.default_solver,
         solver_service_address=ns.solver_service_address,
         solver_stream=ns.solver_stream,
+        solver_delta=ns.solver_delta,
         solver_shm_dir=ns.solver_shm_dir,
         consolidation_enabled=ns.consolidation,
         consolidation_wave_size=ns.consolidation_wave_size,

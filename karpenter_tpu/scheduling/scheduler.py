@@ -28,6 +28,7 @@ class Scheduler:
         canary_rate: Optional[float] = None,
         solver_stream: Optional[bool] = None,
         solver_shm_dir: Optional[str] = None,
+        solver_delta: Optional[bool] = None,
     ):
         self.cluster = cluster
         self.ffd = FFDScheduler(cluster, rng=rng)
@@ -42,6 +43,8 @@ class Scheduler:
         # (docs/solver-transport.md § Streaming; None = env twins)
         self._solver_stream = solver_stream
         self._solver_shm_dir = solver_shm_dir
+        # resident delta encoding (docs/delta-encoding.md; None = env twin)
+        self._solver_delta = solver_delta
 
     def _tpu_scheduler(self):
         if self._tpu is None:
@@ -53,6 +56,7 @@ class Scheduler:
                 canary_rate=self._canary_rate,
                 solver_stream=self._solver_stream,
                 solver_shm_dir=self._solver_shm_dir,
+                solver_delta=self._solver_delta,
             )
         return self._tpu
 

@@ -408,14 +408,43 @@ def encode(
             uniq_vecs.append(res.to_scaled_vector(requests, axes))
         reqid_l[i] = rid
 
-    pod_core = np.array(core_l, np.int32)
-    pod_host = np.array(host_l, np.int32)
-    pod_host_in_base = np.array(hib_l, bool)
-    pod_open_host = np.array(openh_l, np.int32)
+    return finish_encode(
+        table, usable, axes, daemon, pods,
+        np.array(core_l, np.int32),
+        np.array(host_l, np.int32),
+        np.array(hib_l, bool),
+        np.array(openh_l, np.int32),
+        np.array(reqid_l, np.int32),
+        cores, hostnames, uniq_vecs, base_has_hostname,
+    )
+
+
+def finish_encode(
+    table: SignatureTable,
+    usable: np.ndarray,
+    axes: Sequence[str],
+    daemon: Dict[str, float],
+    pods: Sequence[Pod],
+    pod_core: np.ndarray,
+    pod_host: np.ndarray,
+    pod_host_in_base: np.ndarray,
+    pod_open_host: np.ndarray,
+    pod_req_id_core: np.ndarray,
+    cores: List[Core],
+    hostnames: List[str],
+    uniq_vecs: List[np.ndarray],
+    base_has_hostname: bool,
+) -> EncodedBatch:
+    """The shared tail of ``encode``: batch-local vocab arrays → signature
+    closure → axis trim → pod padding → EncodedBatch. ``delta.py``'s
+    resident path reconstructs the vocab arrays from cached per-pod rows and
+    calls this directly, so a delta-built batch is bit-exact against a full
+    re-encode by construction — both run the identical closure/trim/pad
+    code on identical inputs."""
+    n = len(pods)
     R = usable.shape[1]
     # final row = zeros, backing the padding pods
     uniq_req = np.vstack(uniq_vecs + [np.zeros(R, np.float32)]).astype(np.float32)
-    pod_req_id_core = np.array(reqid_l, np.int32)
     pod_req = uniq_req[pod_req_id_core]
 
     # signature closure over THIS batch's cores, scoped to the reachable

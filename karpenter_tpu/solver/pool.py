@@ -120,6 +120,7 @@ class SolverPool:
         checksum: bool = False,
         stream: bool = False,
         shm_dir: str = "",
+        delta: bool = False,
     ):
         addresses = [a.strip() for a in addresses if a.strip()]
         self._clock = clock
@@ -136,6 +137,7 @@ class SolverPool:
             lambda addr: RemoteSolver(
                 addr, timeout=timeout, cold_timeout=cold_timeout,
                 checksum=checksum, stream=stream, shm_dir=shm_dir,
+                delta=delta,
             )
         )
         from karpenter_tpu.resilience import BreakerBoard

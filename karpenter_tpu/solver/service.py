@@ -146,6 +146,14 @@ STATUS_OVERLOADED = 3
 # non-retryable-on-the-SAME-member client-side (the pool quarantines the
 # path and fails over; retrying corrupt transport would be a coin flip).
 STATUS_INTEGRITY = 4
+# NEEDS_DELTA_BASE: a delta-framed Pack referenced a resident pod base
+# (by its 16-byte epoch digest) the sidecar does not hold — restart, LRU
+# eviction, or a patch whose recomputed content digest disagreed with the
+# epoch it claimed to produce. Retryable exactly like NEEDS_CATALOG: the
+# client rebuilds a full ``DELTA_ESTABLISH`` frame and redispatches. A
+# stale base NEVER solves — the digest recompute is the guard
+# (docs/delta-encoding.md).
+STATUS_NEEDS_DELTA_BASE = 5
 
 # capability bits a sidecar advertises in its OpenSession RESPONSE payload
 # (old clients never read that payload; old servers never send it — the one
@@ -169,8 +177,17 @@ PROTO_CHECKSUM = 4
 # keeps serving unary forever — so rolling upgrades interop in either
 # order, exactly like the trailer capabilities.
 PROTO_STREAM = 8
+# PROTO_DELTA advertises the resident pod-side store (docs/delta-encoding.md):
+# a client that saw the bit may frame Pack requests as per-round deltas
+# against a pod base the sidecar keeps resident — establish / elide / patch,
+# addressed by content-keyed epoch digests. An old sidecar never advertises
+# it (the client keeps shipping full pod arrays); an old client never sets
+# PACK_FLAG_DELTA (the server parses the classic positional layout) — the
+# same either-order rolling-upgrade contract as every other bit.
+PROTO_DELTA = 16
 PROTO_FEATURES = (
     PROTO_TRACE_TRAILER | PROTO_DEADLINE | PROTO_CHECKSUM | PROTO_STREAM
+    | PROTO_DELTA
 )
 
 # Pack-request flags (optional third word of the n_max array; old servers
@@ -179,6 +196,10 @@ PROTO_FEATURES = (
 # echo the session key the solve ran against — the client's stale-session /
 # wrong-catalog-generation guard.
 PACK_FLAG_ECHO_SESSION = 1
+# bit 1 marks a delta-framed request (PROTO_DELTA peers only): the array
+# after the vals word is the i32[10] delta header, and the pod arrays that
+# follow depend on its kind — see the delta framing block below.
+PACK_FLAG_DELTA = 2
 
 # admission-control defaults (docs/overload.md): the executor admits
 # max_inflight concurrent solves, queues queue_depth more, and refuses the
@@ -257,8 +278,78 @@ def publish_device_headroom() -> Optional[int]:
 # built around (see EncodedBatch.pack_args).
 N_POD_ARRAYS = 7
 
+# ---------------------------------------------------------------------------
+# delta framing (docs/delta-encoding.md)
+# ---------------------------------------------------------------------------
+#
+# With PACK_FLAG_DELTA set, the array right after the vals word is an
+# i32[10] header — [kind, n_idx, base_epoch (4×i32 = 16 bytes), new_epoch
+# (4×i32)] — shape-distinct from every other trailer (the trace context is
+# i32[6], the session echo i32[4]), so shape/dtype-addressed parsers stay
+# unambiguous. The epoch is a blake2b-16 content digest of the 7 pod-side
+# arrays; what follows the header depends on kind:
+#
+# - ESTABLISH: the 7 full pod arrays. The sidecar verifies their digest IS
+#   new_epoch (a claim that disagrees with the content is refused as
+#   INTEGRITY, exactly like the session-key check) and pins them resident.
+# - ELIDE: nothing — the pod side is byte-identical to the resident base
+#   named by new_epoch. A miss answers NEEDS_DELTA_BASE.
+# - PATCH: one i32[n_idx] row-index array, then the 7 arrays sliced to the
+#   changed rows. The sidecar copies the base, applies the rows, and
+#   RECOMPUTES the digest — disagreement with new_epoch answers
+#   NEEDS_DELTA_BASE (epoch mismatch counted), never a stale-tensor solve.
+DELTA_HEADER_WORDS = 10
+DELTA_ESTABLISH = 0
+DELTA_ELIDE = 1
+DELTA_PATCH = 2
+# arrays after the header, per kind (patch = idx + 7 row slices)
+_DELTA_BODY_ARRAYS = {
+    DELTA_ESTABLISH: N_POD_ARRAYS,
+    DELTA_ELIDE: 0,
+    DELTA_PATCH: N_POD_ARRAYS + 1,
+}
+# resident pod bases the sidecar retains (LRU): the steady state is ONE
+# per client, advanced in place by each patch — the small cap only bounds
+# a fleet of clients churning epochs faster than they solve
+POD_STORE_MAX = 8
+
 _DTYPES = {0: np.dtype(np.bool_), 1: np.dtype(np.int32), 2: np.dtype(np.float32)}
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+def pod_epoch_key(pod_arrays) -> bytes:
+    """16-byte content digest of the 7 pod-side arrays — the delta
+    protocol's epoch. Content-addressed like :func:`catalog_session_key`
+    (dtype+shape folded in) so identical pod sets converge on one resident
+    base and any drift mints a new epoch."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in pod_arrays:
+        a = np.asarray(a)
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def delta_header(kind: int, n_idx: int, base: bytes, new: bytes) -> np.ndarray:
+    """Build the i32[10] delta header array."""
+    return np.frombuffer(
+        struct.pack("<2i", kind, n_idx) + base + new, np.int32
+    )
+
+
+def _delta_span(arrays: Sequence[np.ndarray]) -> Optional[int]:
+    """Arrays consumed by a delta frame starting at index 2 (header +
+    kind-dependent body), or None when the header is malformed — the
+    caller refuses with INTEGRITY instead of mis-slicing trailers."""
+    if len(arrays) < 3:
+        return None
+    h = np.asarray(arrays[2]).reshape(-1)
+    if h.dtype != np.int32 or h.size != DELTA_HEADER_WORDS:
+        return None
+    n_body = _DELTA_BODY_ARRAYS.get(int(h[0]))
+    if n_body is None or len(arrays) < 3 + n_body:
+        return None
+    return 1 + n_body
 
 
 # ---------------------------------------------------------------------------
@@ -689,6 +780,20 @@ class SolverService:
         # retry) would report ~0.5 hit rate instead of ~0.
         self._sessions: "OrderedDict[bytes, list]" = OrderedDict()  # guarded-by: self._sessions_lock
         self._sessions_lock = threading.Lock()
+        # resident pod bases (docs/delta-encoding.md): epoch digest ->
+        # the 7 pod-side arrays a delta-framed Pack may elide or patch
+        # against. Host-side numpy (the device upload happens per solve,
+        # as ever) — what deltas kill is the client's re-serialize and
+        # the wire bytes, not the sidecar's upload. LRU-bounded; a restart
+        # empties it and clients recover through NEEDS_DELTA_BASE.
+        self._pod_store: "OrderedDict[bytes, list]" = OrderedDict()  # guarded-by: self._pod_lock
+        self._pod_lock = threading.Lock()
+        # delta accounting the chaos harness asserts on (zero stale binds
+        # means every miss/mismatch is VISIBLE here, not absorbed)
+        self.delta_stats: dict = {
+            "established": 0, "elided": 0, "patched": 0,
+            "base_misses": 0, "epoch_mismatches": 0,
+        }  # guarded-by: self._stats_lock
 
     # -- overload accounting ------------------------------------------------
 
@@ -897,6 +1002,128 @@ class SolverService:
         with self._sessions_lock:
             return len(self._sessions)
 
+    # -- resident pod bases (docs/delta-encoding.md) -------------------------
+
+    def _count_delta(self, what: str) -> None:
+        with self._stats_lock:
+            self.delta_stats[what] = self.delta_stats.get(what, 0) + 1
+
+    def _publish_pod_store_bytes(
+        self, resident: List[List[np.ndarray]]
+    ) -> None:
+        # Summing nbytes is pure host work, but it runs OFF the store
+        # lock regardless: the lock only guards the OrderedDict.
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_DELTA_RESIDENT_BYTES.labels(side="sidecar").set(
+                sum(int(np.asarray(a).nbytes) for pods in resident for a in pods)
+            )
+        except Exception:
+            pass  # trimmed registries
+
+    def _store_pods(self, epoch: bytes, pods: List[np.ndarray]) -> None:
+        with self._pod_lock:
+            self._pod_store[epoch] = [pods, self._clock()]
+            self._pod_store.move_to_end(epoch)
+            while len(self._pod_store) > POD_STORE_MAX:
+                self._pod_store.popitem(last=False)
+            resident = [entry[0] for entry in self._pod_store.values()]
+        self._publish_pod_store_bytes(resident)
+
+    def _pods_for(self, epoch: bytes) -> Optional[List[np.ndarray]]:
+        with self._pod_lock:
+            hit = self._pod_store.get(epoch)
+            if hit is None:
+                return None
+            hit[1] = self._clock()
+            self._pod_store.move_to_end(epoch)
+            return hit[0]
+
+    def pod_store_count(self) -> int:
+        with self._pod_lock:
+            return len(self._pod_store)
+
+    def _resolve_delta(
+        self, arrays: Sequence[np.ndarray]
+    ) -> Tuple[Optional[List[np.ndarray]], Optional[int]]:
+        """Resolve one delta-framed Pack into its concrete 7 pod arrays:
+        ``(pod_arrays, None)`` or ``(None, refusal_status)``. Shared by the
+        unary and streamed parse paths so both enforce the identical
+        ladder: malformed framing is INTEGRITY, a missing base or a patch
+        whose recomputed digest disagrees with the epoch it claims is
+        NEEDS_DELTA_BASE — the stale-tensor guard. The digest recompute is
+        deliberate: a sidecar NEVER trusts the client's bookkeeping about
+        what the patched state should be, it proves it."""
+        span = _delta_span(arrays)
+        if span is None:
+            return None, STATUS_INTEGRITY
+        h = np.asarray(arrays[2]).reshape(-1)
+        kind, n_idx = int(h[0]), int(h[1])
+        base_epoch = h[2:6].tobytes()
+        new_epoch = h[6:10].tobytes()
+        body = [np.asarray(a) for a in arrays[3:2 + span]]
+        if kind == DELTA_ESTABLISH:
+            if pod_epoch_key(body) != new_epoch:
+                # the claimed epoch is not the content's digest: client
+                # bug or corruption the checksum missed — refuse like the
+                # open_session key check, never pin a mislabeled base
+                self._count_delta("epoch_mismatches")
+                self._count_delta_mismatch_metric()
+                return None, STATUS_INTEGRITY
+            self._store_pods(new_epoch, body)
+            self._count_delta("established")
+            return body, None
+        if kind == DELTA_ELIDE:
+            pods = self._pods_for(new_epoch)
+            if pods is None:
+                self._count_delta("base_misses")
+                return None, STATUS_NEEDS_DELTA_BASE
+            self._count_delta("elided")
+            return pods, None
+        # DELTA_PATCH
+        base = self._pods_for(base_epoch)
+        if base is None:
+            self._count_delta("base_misses")
+            return None, STATUS_NEEDS_DELTA_BASE
+        idx = body[0].reshape(-1)
+        slices = body[1:]
+        if idx.dtype != np.int32 or idx.size != n_idx:
+            return None, STATUS_INTEGRITY
+        n_pods = int(np.asarray(base[0]).shape[0])
+        if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n_pods):
+            return None, STATUS_INTEGRITY
+        pods = []
+        for cur, rows in zip(base, slices):
+            cur = np.asarray(cur)
+            rows = np.asarray(rows)
+            if rows.shape != (idx.size,) + cur.shape[1:] or rows.dtype != cur.dtype:
+                return None, STATUS_INTEGRITY
+            patched = cur.copy()
+            patched[idx] = rows
+            pods.append(patched)
+        if pod_epoch_key(pods) != new_epoch:
+            # the patch applied cleanly but does NOT produce the state the
+            # client believes exists: a missed/misordered delta. The base
+            # stays resident (it is still exactly what its own epoch says);
+            # the client falls back to a full establish — fail loud, never
+            # solve stale
+            self._count_delta("epoch_mismatches")
+            self._count_delta_mismatch_metric()
+            return None, STATUS_NEEDS_DELTA_BASE
+        self._store_pods(new_epoch, pods)
+        self._count_delta("patched")
+        return pods, None
+
+    @staticmethod
+    def _count_delta_mismatch_metric() -> None:
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_DELTA_EPOCH_MISMATCHES.labels(side="sidecar").inc()
+        except Exception:
+            pass  # trimmed registries
+
     # -- lifecycle ----------------------------------------------------------
 
     def warmup(self) -> None:
@@ -990,7 +1217,21 @@ class SolverService:
             return self._reject_corrupt("pack")
         checksummed = verdict == "ok"
         arrays = [a for a in unpack_arrays(request) if not is_checksum_array(a)]
-        trailer = arrays[2 + N_POD_ARRAYS:]
+        # the trailer offset depends on the framing: a delta frame's body
+        # is header + kind-dependent arrays, not the fixed 7 — and a patch
+        # idx array that landed in the trailer slice could masquerade as
+        # an i32[6] trace context, so the span must be computed, not assumed
+        vals0 = np.asarray(arrays[1]).reshape(-1) if len(arrays) > 1 else np.zeros(0, np.int32)
+        flags0 = int(vals0[2]) if vals0.size > 2 else 0
+        if flags0 & PACK_FLAG_DELTA:
+            span = _delta_span(arrays)
+            if span is None:
+                return self._seal(
+                    _status_response(STATUS_INTEGRITY), checksummed
+                )
+            trailer = arrays[2 + span:]
+        else:
+            trailer = arrays[2 + N_POD_ARRAYS:]
         ctx, deadline_s = _parse_trailers(trailer)
         deadline = (
             None if deadline_s is None
@@ -1036,7 +1277,6 @@ class SolverService:
         from karpenter_tpu.solver.pallas_kernel import pack_best
 
         key_arr, n_max_arr = arrays[0], arrays[1]
-        pod_arrays = arrays[2:2 + N_POD_ARRAYS]
         key = key_arr.tobytes()
         vals = n_max_arr.reshape(-1)
         n_max = int(vals[0])
@@ -1044,10 +1284,17 @@ class SolverService:
         # stats (shadow probes, saturation re-dispatches — one logical
         # solve must count once, matching the in-process path)
         record = bool(vals[1]) if vals.size > 1 else True
-        # optional third word (PROTO_CHECKSUM peers only): feature flags —
-        # bit 0 asks for the session-key echo so the client can reject a
-        # wrong-catalog-generation pack instead of decoding it
+        # optional third word (PROTO_CHECKSUM / PROTO_DELTA peers only):
+        # feature flags — bit 0 asks for the session-key echo so the
+        # client can reject a wrong-catalog-generation pack instead of
+        # decoding it; bit 1 marks the delta framing
         flags = int(vals[2]) if vals.size > 2 else 0
+        if flags & PACK_FLAG_DELTA:
+            pod_arrays, refusal = self._resolve_delta(arrays)
+            if refusal is not None:
+                return _status_response(refusal)
+        else:
+            pod_arrays = arrays[2:2 + N_POD_ARRAYS]
         echo = (
             [_key_array(key)] if flags & PACK_FLAG_ECHO_SESSION else []
         )
@@ -1160,6 +1407,9 @@ class SolverService:
         if len(arrays) < 3 or np.asarray(arrays[1]).reshape(-1).size < 1:
             return self._seal(_status_response(STATUS_INTEGRITY), checksummed)
         shm = arena is not None
+        key_arr, n_max_arr = arrays[0], arrays[1]
+        vals = n_max_arr.reshape(-1)
+        flags = int(vals[2]) if vals.size > 2 else 0
         if arena is not None:
             desc = arrays[2]
             trailer = arrays[3:]
@@ -1174,6 +1424,18 @@ class SolverService:
                 return self._seal(
                     _status_response(STATUS_INTEGRITY), checksummed
                 )
+        elif flags & PACK_FLAG_DELTA:
+            # delta frames resolve into concrete pod arrays HERE, at parse
+            # time (the one place the framing is positional), so the
+            # coalescer and solve_stream_group never see a delta — their
+            # group keys and vmapped dispatch are unchanged. A refusal
+            # (missing base, digest mismatch, malformed header) answers
+            # straight from the reader thread, like the deadline shed.
+            pod_arrays, refusal = self._resolve_delta(arrays)
+            if refusal is not None:
+                return self._seal(_status_response(refusal), checksummed)
+            span = _delta_span(arrays)
+            trailer = arrays[2 + span:]
         else:
             pod_arrays = arrays[2:2 + N_POD_ARRAYS]
             trailer = arrays[2 + N_POD_ARRAYS:]
@@ -1181,8 +1443,6 @@ class SolverService:
                 return self._seal(
                     _status_response(STATUS_INTEGRITY), checksummed
                 )
-        key_arr, n_max_arr = arrays[0], arrays[1]
-        vals = n_max_arr.reshape(-1)
         ctx, deadline_s = _parse_trailers(trailer)
         return StreamSolve(
             key=key_arr.tobytes(),
@@ -1621,11 +1881,25 @@ class RemoteSolver:
         checksum: bool = False,
         stream: bool = False,
         shm_dir: str = "",
+        delta: bool = False,
     ):
         import grpc
 
         self.address = address
         self.timeout = timeout
+        # resident pod-side deltas (docs/delta-encoding.md): when enabled
+        # AND the sidecar advertised PROTO_DELTA, Pack requests frame the
+        # pod side as establish/elide/patch against the base the sidecar
+        # keeps resident — the steady state ships a 40-byte header instead
+        # of re-serializing ~MBs of unchanged pod tensors every round
+        self.delta = bool(delta)
+        # (epoch, pod array refs) last shipped; the refs keep the identity
+        # memo below valid and give the patch planner its diff base
+        self._delta_base: Optional[Tuple[bytes, List[np.ndarray]]] = None  # guarded-by: self._lock
+        # identity-memoized pod epochs, CatalogKeyMemo-style: the host
+        # ResidentEncoder returns the SAME batch object on no-churn rounds,
+        # so the hot path never re-hashes megabytes of pod tensors
+        self._pod_epoch_memo: "OrderedDict[tuple, tuple]" = OrderedDict()  # guarded-by: self._lock
         # streaming transport (docs/solver-transport.md § Streaming):
         # when enabled AND the sidecar advertised PROTO_STREAM, solves
         # multiplex over one persistent stream (credit flow control,
@@ -1930,6 +2204,80 @@ class RemoteSolver:
             f"unknown solver status word {status} from {self.address}"
         )
 
+    # -- pod-side deltas (docs/delta-encoding.md) ----------------------------
+
+    POD_EPOCH_MEMO_MAX = 4
+    # a patch only pays off while the changed-row slice is a fraction of
+    # the full pod set; past a quarter of the rows the establish frame is
+    # simpler and barely bigger
+    PATCH_MAX_ROW_FRACTION = 4
+
+    def _pod_epoch(self, pod_np: List[np.ndarray]) -> bytes:
+        """Identity-memoized :func:`pod_epoch_key`: the no-churn round
+        re-presents the same array objects, so the steady state skips the
+        multi-MB blake2b entirely."""
+        id_key = tuple(map(id, pod_np))
+        with self._lock:
+            hit = self._pod_epoch_memo.get(id_key)
+            if hit is not None:
+                self._pod_epoch_memo.move_to_end(id_key)
+                return hit[1]
+        epoch = pod_epoch_key(pod_np)
+        with self._lock:
+            self._pod_epoch_memo[id_key] = (tuple(pod_np), epoch)
+            while len(self._pod_epoch_memo) > self.POD_EPOCH_MEMO_MAX:
+                self._pod_epoch_memo.popitem(last=False)
+        return epoch
+
+    def _plan_delta(
+        self, epoch: bytes, pod_np: List[np.ndarray], p: int
+    ) -> Tuple[int, List[np.ndarray], bytes]:
+        """Choose the delta frame kind against the last-shipped base:
+        ``(kind, body arrays, base_epoch)``. Same epoch → elide; same
+        shapes with few changed rows → patch; anything else → establish.
+        The choice is pure optimization — every kind names ``epoch`` as
+        its new_epoch, and the sidecar PROVES the resolved content hashes
+        to it."""
+        with self._lock:
+            base = self._delta_base
+        if base is not None and base[0] == epoch:
+            return DELTA_ELIDE, [], epoch
+        if base is not None and all(
+            b.shape == a.shape and b.dtype == a.dtype
+            for b, a in zip(base[1], pod_np)
+        ):
+            changed = np.zeros(p, dtype=bool)
+            for b, a in zip(base[1], pod_np):
+                diff = b != a
+                changed |= diff.any(axis=tuple(range(1, diff.ndim))) if diff.ndim > 1 else diff
+            idx = np.flatnonzero(changed).astype(np.int32)
+            if idx.size and idx.size <= max(1, p // self.PATCH_MAX_ROW_FRACTION):
+                return DELTA_PATCH, [idx] + [a[idx] for a in pod_np], base[0]
+        return DELTA_ESTABLISH, list(pod_np), b"\x00" * 16
+
+    def _remember_delta_base(self, epoch: bytes, pod_np: List[np.ndarray]) -> None:
+        with self._lock:
+            self._delta_base = (epoch, list(pod_np))
+
+    @staticmethod
+    def _count_delta_applied() -> None:
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_DELTA_APPLIED.labels(path="wire").inc()
+        except Exception:
+            pass  # trimmed registries
+
+    @staticmethod
+    def _count_delta_base_miss() -> None:
+        try:
+            from karpenter_tpu import metrics
+
+            metrics.SOLVER_DELTA_EPOCH_MISMATCHES.labels(side="client").inc()
+            metrics.SOLVER_DELTA_FULL_REENCODES.labels(reason="wire").inc()
+        except Exception:
+            pass  # trimmed registries
+
     # -- solves -------------------------------------------------------------
 
     def pack_begin(
@@ -1972,11 +2320,37 @@ class RemoteSolver:
         # capability: frame checksums both ways + the session-key echo that
         # rejects a wrong-catalog-generation pack before decode
         integrity_on = bool(self.checksum and (features & PROTO_CHECKSUM))
-        vals = [n_max, 1 if record else 0]
+        # pod-side deltas (docs/delta-encoding.md), gated like every other
+        # capability: only after the sidecar advertised PROTO_DELTA
+        delta_on = bool(self.delta and (features & PROTO_DELTA))
+        flags = 0
         if integrity_on:
-            vals.append(PACK_FLAG_ECHO_SESSION)
+            flags |= PACK_FLAG_ECHO_SESSION
+        if delta_on:
+            flags |= PACK_FLAG_DELTA
+        vals = [n_max, 1 if record else 0]
+        if flags:
+            vals.append(flags)
         head = [_key_array(key), np.asarray(vals, np.int32)]
         pod_np = [np.asarray(a) for a in pod_side]
+        epoch = None
+        delta_body: List[np.ndarray] = []
+        if delta_on:
+            epoch = self._pod_epoch(pod_np)
+            kind, body, base_epoch = self._plan_delta(epoch, pod_np, p)
+            n_idx = int(body[0].size) if kind == DELTA_PATCH else 0
+            delta_body = [delta_header(kind, n_idx, base_epoch, epoch)] + body
+            # optimistic: if the dispatch sheds before the sidecar pins
+            # the new epoch, the next round's elide/patch misses and the
+            # NEEDS_DELTA_BASE recovery re-establishes — fail loud, cheap
+            self._remember_delta_base(epoch, pod_np)
+            if kind != DELTA_ESTABLISH:
+                self._count_delta_applied()
+            if prof is not None:
+                prof["delta_kind"] = (
+                    "elide" if kind == DELTA_ELIDE
+                    else "patch" if kind == DELTA_PATCH else "establish"
+                )
         # optional trailers, each capability-gated on the bits the sidecar
         # advertised in its OpenSession response — an untraced (or
         # old-peer) frame is byte-identical to before, so rolling upgrades
@@ -1994,9 +2368,20 @@ class RemoteSolver:
             trailers.append(np.asarray([budget.remaining()], np.float32))
 
         def build_inline() -> bytes:
-            req = pack_arrays(head + pod_np + trailers)
+            req = pack_arrays(
+                head + (delta_body if delta_on else pod_np) + trailers
+            )
             # checksum LAST, over the final bytes: the digest covers
             # every trailer
+            return append_checksum(req) if integrity_on else req
+
+        def build_establish() -> bytes:
+            """The NEEDS_DELTA_BASE (or post-re-open) fallback frame: the
+            full pod set under a DELTA_ESTABLISH header — satisfiable by
+            ANY delta-capable sidecar state, including a cold restart."""
+            hdr = delta_header(DELTA_ESTABLISH, 0, b"\x00" * 16, epoch)
+            self._remember_delta_base(epoch, pod_np)
+            req = pack_arrays(head + [hdr] + pod_np + trailers)
             return append_checksum(req) if integrity_on else req
 
         # transport selection ladder (docs/solver-transport.md):
@@ -2016,7 +2401,11 @@ class RemoteSolver:
         transport = "unary"
         stream = self._stream_for(features)
         if stream is not None:
-            wrote = stream.write_arena(pod_np)
+            # delta frames always ride inline: a resident base must
+            # outlive the arena slot it would arrive in (slots recycle
+            # per solve), and the steady-state elide/patch payload is
+            # already tiny — the arena only ever carried the full pod set
+            wrote = None if delta_on else stream.write_arena(pod_np)
             if wrote is not None:
                 arena_token, desc = wrote
                 shm_req = pack_arrays(head + [desc] + trailers)
@@ -2119,10 +2508,23 @@ class RemoteSolver:
                 # pre-checksum build recovers on the in-flight retry
                 # instead of waiting out another breaker cool-off
                 require = integrity_on
-                for attempt in (0, 1):
+                # each distinct refusal reason earns ONE synchronous
+                # recovery + redispatch (the overlap is already lost);
+                # the same reason twice fails loud. Bounded: three
+                # possible reasons, so ≤ 4 receives ever happen — a
+                # sidecar restart legitimately chains two (delta base
+                # gone AND catalog gone) and still converges.
+                recovered: set = set()
+                for _ in range(4):
                     status, payload = self._receive(response, require)
                     if status == STATUS_NEEDS_CATALOG:
                         reason = "not resident"
+                    elif status == STATUS_NEEDS_DELTA_BASE:
+                        # the sidecar no longer holds (or could not
+                        # reproduce) the pod base this delta named —
+                        # restart, LRU eviction, or a missed delta; the
+                        # full establish below is satisfiable by any state
+                        reason = "delta base missing"
                     else:
                         if status != STATUS_OK:
                             # typed verdicts (deadline/overload/integrity)
@@ -2150,13 +2552,22 @@ class RemoteSolver:
                             "%s; re-opening", self.address,
                             echoed.hex()[:12], key.hex()[:12],
                         )
-                    if attempt == 1:
+                    if reason in recovered:
                         if reason == "wrong-session echo":
                             raise IntegrityError(
                                 f"solver {self.address} kept answering with "
                                 f"the wrong catalog session (want "
                                 f"{key.hex()[:12]})",
                                 address=self.address, kind="session",
+                            )
+                        if reason == "delta base missing":
+                            # the establish retry carried the FULL pod set
+                            # and was still refused: the store is broken
+                            # or thrashing — the caller's breaker turns
+                            # this into the in-process fallback
+                            raise RuntimeError(
+                                "solver delta establish did not take "
+                                f"(catalog key {key.hex()[:12]})"
                             )
                         # fail loud: something is evicting faster than we
                         # open (session_max=0, or a thrashing key) — the
@@ -2166,30 +2577,45 @@ class RemoteSolver:
                             "solver session re-open did not take "
                             f"(catalog key {key.hex()[:12]})"
                         )
-                    # sidecar restarted, evicted this catalog, or served the
-                    # wrong generation: re-open and retry ONCE, synchronously
-                    # (the overlap is already lost)
+                    recovered.add(reason)
                     logger.info(
-                        "solver session %s %s; re-opening",
+                        "solver session %s %s; recovering",
                         key.hex()[:12], reason,
                     )
-                    wsp.set_attribute("needs_catalog_retry", True)
-                    self._open_session(
-                        key, catalog_side, timeout, force=True, record=record
-                    )
-                    with self._lock:
-                        # DOWNWARD-only refresh: the server seals iff the
-                        # REQUEST carried a checksum, and the retried
-                        # request is the original bytes — so a re-open
-                        # that just learned PROTO_CHECKSUM (pre-checksum
-                        # member upgraded mid-flight) must not raise the
-                        # expectation above what this request asked for
-                        require = require and bool(
-                            self._server_features & PROTO_CHECKSUM
+                    if reason == "delta base missing":
+                        wsp.set_attribute("delta_establish_retry", True)
+                        self._count_delta_base_miss()
+                    else:
+                        # sidecar restarted, evicted this catalog, or
+                        # served the wrong generation: re-open, then retry
+                        wsp.set_attribute("needs_catalog_retry", True)
+                        self._open_session(
+                            key, catalog_side, timeout, force=True,
+                            record=record,
                         )
-                    if request is None:
+                        with self._lock:
+                            # DOWNWARD-only refresh: the server seals iff
+                            # the REQUEST carried a checksum, and the
+                            # retried request is the original bytes — so a
+                            # re-open that just learned PROTO_CHECKSUM
+                            # (pre-checksum member upgraded mid-flight)
+                            # must not raise the expectation above what
+                            # this request asked for
+                            require = require and bool(
+                                self._server_features & PROTO_CHECKSUM
+                            )
+                    if delta_on:
+                        # ANY recovery redispatch ships the full pod set:
+                        # an elide/patch retried against a re-opened but
+                        # restarted sidecar would only bounce once more
+                        request = build_establish()
+                    elif request is None:
                         request = build_inline()
                     response = redispatch(request)
+                else:
+                    raise RuntimeError(
+                        f"solver {self.address} retry loop exhausted"
+                    )  # unreachable: ≤3 distinct reasons, repeats raise above
                 with self._lock:
                     self._warm_shapes.add(shape)
                 t1 = time.perf_counter()

@@ -58,7 +58,7 @@ CHAOS_METHODS = frozenset({
 # speak python objects, where "corruption" has no byte representation.
 CORRUPT_METHODS = frozenset({"solve_bytes", "open_session_bytes"})
 
-# The four seeded corruption modes the corruption-storm leg must prove are
+# The seeded corruption modes the corruption-storm leg must prove are
 # all detected (bench.py --corruption-storm):
 # - bit_flip: one random bit of the request or response frame — what the
 #   checksum layer exists for;
@@ -68,8 +68,15 @@ CORRUPT_METHODS = frozenset({"solve_bytes", "open_session_bytes"})
 #   session-generation guard can reject;
 # - nan_inject: the f32 NaN bit pattern written over the first result word
 #   and the checksum RECOMPUTED — device SDC's shape: a perfectly framed,
-#   checksum-valid pack computed wrong, caught by the host-side screen.
-CORRUPTION_MODES = ("bit_flip", "truncate", "stale_session", "nan_inject")
+#   checksum-valid pack computed wrong, caught by the host-side screen;
+# - stale_delta: a delta-framed request's epoch words garbled and the
+#   checksum RECOMPUTED — a missed/misordered delta's shape
+#   (docs/delta-encoding.md): perfectly framed, checksum-valid, naming pod
+#   bases that do not exist or cannot produce the claimed state. Only the
+#   sidecar's digest-recompute epoch guard can refuse it (NEEDS_DELTA_BASE
+#   → the client re-establishes) — a stale-tensor solve must never bind.
+CORRUPTION_MODES = ("bit_flip", "truncate", "stale_session", "nan_inject",
+                    "stale_delta")
 
 # exponential p95 = mean * ln(20); invert to calibrate the mean from a p95
 _LN20 = 2.9957322735539909
@@ -192,10 +199,12 @@ class ChaosProxy:
                         mode = self._rng.choice(
                             list(self.policy.corruption_modes)
                         )
-                        # bit flips hit either direction; the structured
-                        # modes model a corrupt RESPONSE (stale replay and
-                        # SDC both happen server/device-side)
-                        request_side = (
+                        # bit flips hit either direction; stale_delta is a
+                        # REQUEST-side mode (the delta header rides the
+                        # Pack request); the other structured modes model
+                        # a corrupt RESPONSE (stale replay and SDC both
+                        # happen server/device-side)
+                        request_side = mode == "stale_delta" or (
                             mode == "bit_flip" and self._rng.random() < 0.5
                         )
                         seed = self._rng.randrange(2**31)
@@ -275,6 +284,8 @@ def _corrupt_frame(frame: bytes, mode: str, seed: int) -> bytes:
         return _stale_session(bytes(frame), seed)
     if mode == "nan_inject":
         return _nan_inject(bytes(frame), seed)
+    if mode == "stale_delta":
+        return _stale_delta(bytes(frame), seed)
     return _bit_flip(bytes(frame), seed)
 
 
@@ -328,6 +339,51 @@ def _stale_session(frame: bytes, seed: int) -> bytes:
             swapped = True
             break
     if not swapped:
+        return _bit_flip(frame, seed)
+    out = service.pack_arrays(arrays)
+    return service.append_checksum(out) if had_checksum else out
+
+
+def _stale_delta(frame: bytes, seed: int) -> bytes:
+    """Garble the epoch words of a delta-framed request's i32[10] header
+    and RECOMPUTE the checksum — the shape a missed or misordered delta
+    takes on the wire: perfectly framed, checksum-valid, but naming a base
+    epoch the sidecar does not hold (or a new epoch the patched content
+    cannot hash to). Only the sidecar's digest-recompute epoch guard
+    (docs/delta-encoding.md) can refuse it. Frames without a delta header
+    degrade to a bit flip."""
+    import random
+
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    rng = random.Random(seed)
+    try:
+        arrays = service.unpack_arrays(frame)
+    except Exception:
+        return _bit_flip(frame, seed)
+    had_checksum = bool(arrays) and service.is_checksum_array(arrays[-1])
+    arrays = [np.array(a) for a in arrays if not service.is_checksum_array(a)]
+    hit = False
+    for i, a in enumerate(arrays):
+        # the delta header: i32[DELTA_HEADER_WORDS] right after the
+        # key/n_max prelude — shape-distinct from the trace context (6
+        # words) and the session echo (4 words)
+        if (
+            i > 1
+            and a.dtype == np.int32
+            and a.ndim == 1
+            and a.size == service.DELTA_HEADER_WORDS
+        ):
+            # words 2..10 hold base_epoch + new_epoch (4 i32 each); keep
+            # the kind/n_idx words so the frame still parses as a delta
+            a[2:] = np.frombuffer(
+                bytes(rng.randrange(256) for _ in range(32)), np.int32
+            )
+            hit = True
+            break
+    if not hit:
         return _bit_flip(frame, seed)
     out = service.pack_arrays(arrays)
     return service.append_checksum(out) if had_checksum else out

@@ -374,7 +374,7 @@ def test_v3_integrity_status_raises_typed_verdict():
     assert ei.value.address == "fuzz:0" and ei.value.kind == "checksum"
 
 
-@pytest.mark.parametrize("status", [5, 6, 17, -1, 2**20])
+@pytest.mark.parametrize("status", [6, 17, -1, 2**20])
 def test_v3_unknown_status_word_fails_loudly(status):
     """A status word neither side knows is a protocol error, not a retry
     signal — silent tolerance here would be the status-plane version of a
@@ -387,6 +387,78 @@ def test_v3_unknown_status_word_fails_loudly(status):
     word, payload = service.RemoteSolver._split_status(frame)
     with pytest.raises(RuntimeError, match=f"unknown solver status word {status}"):
         solver._check_status(word, payload)
+
+
+def test_v3_needs_delta_base_word_round_trips_and_is_distinct():
+    """STATUS_NEEDS_DELTA_BASE is flow control the dispatch loop consumes
+    (rebuild a full DELTA_ESTABLISH and redispatch), not a terminal
+    verdict — but on the wire it is a status word like any other and must
+    survive the codec exactly and collide with nothing."""
+    from karpenter_tpu.solver import service
+
+    words = [
+        service.STATUS_OK,
+        service.STATUS_NEEDS_CATALOG,
+        service.STATUS_DEADLINE_EXCEEDED,
+        service.STATUS_OVERLOADED,
+        service.STATUS_INTEGRITY,
+        service.STATUS_NEEDS_DELTA_BASE,
+    ]
+    assert len(set(words)) == len(words)
+    frame = service._status_response(service.STATUS_NEEDS_DELTA_BASE)
+    word, payload = service.RemoteSolver._split_status(frame)
+    assert word == service.STATUS_NEEDS_DELTA_BASE
+    assert payload == []
+
+
+@pytest.mark.parametrize("kind", [0, 1, 2])
+def test_v3_delta_header_round_trips_and_spans(kind):
+    """The i32[10] delta header (kind, n_idx, base_epoch, new_epoch)
+    survives pack/unpack bit-exactly and _delta_span consumes exactly the
+    arrays its kind declares — a wrong span would misread the trailing
+    trace/deadline arrays as pod rows (the v3 framing bug class)."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    base, new = bytes(range(16)), bytes(range(16, 32))
+    n_idx = 3 if kind == service.DELTA_PATCH else 0
+    hdr = service.delta_header(kind, n_idx, base, new)
+    assert hdr.dtype == np.int32 and hdr.size == service.DELTA_HEADER_WORDS
+    n_body = {0: service.N_POD_ARRAYS, 1: 0, 2: 1 + service.N_POD_ARRAYS}[kind]
+    body = [np.zeros((n_idx or 2,), np.int32) for _ in range(n_body)]
+    key = np.frombuffer(b"\x01" * 16, np.int32)
+    vals = np.asarray([4, 0, service.PACK_FLAG_DELTA], np.int64)
+    arrays = [np.asarray(a) for a in service.unpack_arrays(
+        service.pack_arrays([key, vals, hdr] + body)
+    )]
+    got = arrays[2]
+    assert got.tobytes() == hdr.tobytes()
+    assert int(got[0]) == kind and int(got[1]) == n_idx
+    assert got[2:6].tobytes() == base and got[6:10].tobytes() == new
+    assert service._delta_span(arrays) == 1 + n_body
+
+
+def test_v3_malformed_delta_header_yields_no_span():
+    """A delta-flagged frame whose third array is NOT a well-formed header
+    must resolve to span None (→ sealed STATUS_INTEGRITY), never a guess —
+    guessing is how a patch idx array masquerades as a trace context."""
+    import numpy as np
+
+    from karpenter_tpu.solver import service
+
+    key = np.frombuffer(b"\x02" * 16, np.int32)
+    vals = np.asarray([4, 0, service.PACK_FLAG_DELTA], np.int64)
+    bad_headers = [
+        np.zeros((6,), np.int32),                      # trace-shaped
+        np.zeros((service.DELTA_HEADER_WORDS,), np.float32),  # wrong dtype
+        service.delta_header(7, 0, b"\x00" * 16, b"\x00" * 16),  # bad kind
+    ]
+    for hdr in bad_headers:
+        arrays = [np.asarray(a) for a in service.unpack_arrays(
+            service.pack_arrays([key, vals, hdr])
+        )]
+        assert service._delta_span(arrays) is None
 
 
 @pytest.mark.parametrize("seed", range(5))
@@ -612,7 +684,7 @@ def test_stream_byte_flip_corpus_never_silently_differs(seed):
 # rolling-upgrade crash the bits exist to prevent).
 
 PROTO_BITS = ["PROTO_TRACE_TRAILER", "PROTO_DEADLINE", "PROTO_CHECKSUM",
-              "PROTO_STREAM"]
+              "PROTO_STREAM", "PROTO_DELTA"]
 
 
 def test_proto_feature_bits_distinct_and_aggregated():
@@ -628,11 +700,11 @@ def test_proto_feature_bits_distinct_and_aggregated():
     assert service.PROTO_FEATURES == agg
 
 
-@pytest.mark.parametrize("mask", range(16))
+@pytest.mark.parametrize("mask", range(32))
 def test_proto_capability_word_round_trips_every_subset(mask):
-    """Each of the 2^4 subsets of {PROTO_TRACE_TRAILER, PROTO_DEADLINE,
-    PROTO_CHECKSUM, PROTO_STREAM} survives OpenSession payload encode →
-    _split_status decode with every bit intact."""
+    """Each of the 2^5 subsets of {PROTO_TRACE_TRAILER, PROTO_DEADLINE,
+    PROTO_CHECKSUM, PROTO_STREAM, PROTO_DELTA} survives OpenSession payload
+    encode → _split_status decode with every bit intact."""
     import numpy as np
 
     from karpenter_tpu.solver import service

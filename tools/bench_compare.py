@@ -80,6 +80,14 @@ HEADLINE_KEYS: Dict[str, int] = {
     # reported, never fatal (the standard new-key salvage).
     "consolidation_nodes_reclaimed": +1,
     "consolidation_cost_delta_usd": -1,
+    # resident delta encoding (docs/delta-encoding.md): the headline leg's
+    # steady-state host-side cost per solve (sort+inject+encode+decode,
+    # bar: < 10ms at the 10k-pod leg) and the fraction of measured
+    # iterations any stage served from resident state. Missing on
+    # pre-delta rounds is reported, never fatal (the standard new-key
+    # salvage).
+    "host_share_ms": -1,
+    "delta_hit_rate": +1,
 }
 
 DEFAULT_ALLOWLIST = "tools/bench_allowlist.json"
