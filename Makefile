@@ -110,6 +110,13 @@ forecast-chaos:  ## predictive-provisioning proof: forecast/warm-pool/what-if su
 	$(PY) -m pytest tests/test_forecast.py tests/test_warmpool.py tests/test_whatif.py -q -m 'not slow' $(TESTFLAGS)
 	$(PY) bench.py --forecast-storm $(FORECAST_STORM_S)
 
+sentinel-chaos:  ## regression-sentinel proof: detector/incident/persistence suites + the injected-latency-step storm leg (bars: 0 steady false positives, step detected + attributed, evidence complete)
+	$(PY) -m pytest tests/test_sentinel.py -q -m 'not slow' $(TESTFLAGS)
+	$(PY) bench.py --regression-storm 80
+
+sentinel-smoke:  ## sentinel-overhead gate: headline leg with and without the regression sentinel hooked (<1% self-accounted bar)
+	$(PY) bench.py --sentinel-overhead-check --pods 2000 --iters 6 --solver ffd
+
 dryrun-multichip:  ## validate the multi-chip sharding on a virtual CPU mesh
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -142,5 +149,5 @@ solver-sidecar:  ## start the TPU solver sidecar
 	$(PY) -m karpenter_tpu.solver.service
 
 .PHONY: dev test analyze analyze-baseline lint battletest deflake benchmark bench-compare benchmark-notrace profile-smoke benchmark-grid \
-	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos delta-chaos partition-chaos consolidation-chaos forecast-chaos dryrun-multichip run solver-sidecar \
+	benchmark-consolidation benchmark-storm benchmark-router-parity benchmark-affinity-dense benchmark-streamed chaos fleet-chaos crash-chaos overload-chaos stream-chaos corruption-chaos delta-chaos partition-chaos consolidation-chaos forecast-chaos sentinel-chaos sentinel-smoke dryrun-multichip run solver-sidecar \
 	image chart apply webhook-certs webhook-cabundle

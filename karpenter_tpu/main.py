@@ -63,6 +63,7 @@ class Runtime:
     brownout: object = None  # BrownoutController when --brownout is on
     warmpool: WarmPoolController = None  # when --warm-pool is on
     forecast: object = None  # the ArrivalForecaster THIS runtime installed
+    sentinel: object = None  # the SentinelEngine THIS runtime installed
     consolidation: ConsolidationController = None
     _gc_freeze_cancel: object = None  # set by _freeze_gc_when_warm
 
@@ -104,6 +105,12 @@ class Runtime:
             from karpenter_tpu import obs
 
             obs.shutdown_forecast(engine=self.forecast)
+        # detach the regression sentinel this runtime installed (same
+        # discipline; shutdown final-persists its baselines)
+        if self.sentinel is not None:
+            from karpenter_tpu import obs
+
+            obs.shutdown_sentinel(engine=self.sentinel)
         # same ownership-checked teardown for the profiler and the
         # telemetry plane this runtime installed
         if self.profiler is not None or self.telemetry is not None:
@@ -215,6 +222,11 @@ def _serve_endpoints(runtime: Runtime) -> None:
                 # the decision audit log: newest provisioning-round
                 # records (?limit=/?provisioner= narrow the window)
                 self._send(json.dumps(obs.debug_decisions_payload(query)).encode())
+            elif self.path.startswith("/debug/incidents"):
+                # the regression sentinel's correlated incident records
+                # (?id= for one full record with its evidence) + the
+                # learned baseline table
+                self._send(json.dumps(obs.debug_incidents_payload(query)).encode())
             elif self.path.startswith("/debug/forecast"):
                 # per-provisioner arrival-rate predictions + warm-pool
                 # horizon from the arrival forecaster ({} until one is
@@ -508,6 +520,17 @@ def run_controller_process(options: Optional[Options] = None, serve: bool = True
         model=runtime.options.forecast_model,
         alpha=runtime.options.forecast_alpha,
     )
+    # the regression sentinel (docs/observability.md): online latency
+    # baselines + change-point detection off the same span stream, minting
+    # correlated incident records at /debug/incidents; --sentinel-dir
+    # persists baselines so a restart resumes instead of re-learning
+    if runtime.options.sentinel_enabled:
+        from karpenter_tpu.kube.events import recorder_for
+
+        runtime.sentinel = obs.configure_sentinel(
+            directory=runtime.options.sentinel_dir,
+            recorder=recorder_for(runtime.cluster),
+        )
     # the decision audit log (docs/decisions.md): /debug/decisions and
     # /debug/explain answer from the memory ring either way; a configured
     # --decision-dir additionally persists replayable records

@@ -1151,6 +1151,48 @@ KUBE_RELISTS = Counter(
     registry=REGISTRY,
 )
 
+# Regression sentinel (obs/sentinel.py, docs/observability.md): online
+# per-(stage, route, shape) latency baselines learned off the tracer
+# finish-hook, a windowed-median change-point detector, and the correlated
+# incident plane (obs/incidents.py) sustained deviations escalate into.
+SENTINEL_BASELINES = Counter(
+    "baselines_total",
+    "Sentinel baseline lifecycle events, by event: \"learned\" = a new "
+    "(stage, route, shape) key entered the table, \"loaded\" = baselines "
+    "restored from --sentinel-dir at startup, \"persisted\" = a successful "
+    "baseline-file write, \"persist_failed\" = an unwritable/full "
+    "--sentinel-dir degraded the store to memory-only (counted, never "
+    "fatal), \"corrupt\" = the baseline file failed to parse and the "
+    "sentinel re-learns from scratch.",
+    ["event"],
+    namespace=NAMESPACE,
+    subsystem="sentinel",
+    registry=REGISTRY,
+)
+
+SENTINEL_DEVIATIONS = Counter(
+    "deviations_total",
+    "Sustained latency deviations detected by the sentinel's change-point "
+    "check (windowed median past the learned level's threshold, held for "
+    "the sustain count), by span stage — each one either minted an "
+    "incident or attached to the open one.",
+    ["stage"],
+    namespace=NAMESPACE,
+    subsystem="sentinel",
+    registry=REGISTRY,
+)
+
+SENTINEL_INCIDENTS = Counter(
+    "incidents_total",
+    "Incident records minted by the sentinel (one per regime change, not "
+    "per deviating window — correlated deviations attach instead), by the "
+    "first deviating span stage.",
+    ["stage"],
+    namespace=NAMESPACE,
+    subsystem="sentinel",
+    registry=REGISTRY,
+)
+
 FLEET_FENCED = Gauge(
     "fenced",
     "1 while this replica is FENCED: the apiserver has been unreachable "

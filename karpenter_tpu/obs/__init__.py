@@ -17,6 +17,10 @@ Public surface:
 - ``configure_telemetry(...)`` — the fleet telemetry plane
   (obs/collector.py): periodic flush + cross-process collection/stitch;
   ``telemetry()`` reads it back.
+- ``configure_sentinel(...)`` — the regression sentinel
+  (obs/sentinel.py): online latency baselines + change-point detection
+  escalating into the correlated incident plane (obs/incidents.py);
+  ``sentinel()`` reads it back, ``GET /debug/incidents`` serves it.
 - ``debug_*_payload`` helpers — the ONE body builder per ``/debug/*``
   endpoint, shared by the controller and sidecar health servers (karplint
   ``debug-endpoint`` enforces that handlers route through these).
@@ -49,7 +53,9 @@ from karpenter_tpu.obs.collector import (  # noqa: F401
     wire_attribution,
 )
 from karpenter_tpu.obs.decisions import DecisionLog  # noqa: F401
+from karpenter_tpu.obs.incidents import IncidentLog  # noqa: F401
 from karpenter_tpu.obs.profiler import SamplingProfiler  # noqa: F401
+from karpenter_tpu.obs.sentinel import SentinelEngine  # noqa: F401
 from karpenter_tpu.obs.slo import (  # noqa: F401
     DEFAULT_OBJECTIVES,
     SIDECAR_OBJECTIVES,
@@ -303,6 +309,63 @@ def shutdown_forecast(engine=None) -> None:
     unregister_state("forecast")
 
 
+# -- the regression sentinel (obs/sentinel.py + obs/incidents.py) ------------
+
+_sentinel: Optional[SentinelEngine] = None  # guarded-by: _lock
+
+
+def configure_sentinel(
+    directory: str = "",
+    recorder=None,
+    incident_cap: Optional[int] = None,
+    **tuning,
+) -> SentinelEngine:
+    """Install (or replace) the regression sentinel on the default tracer:
+    a span finish-hook learning per-(stage, route, shape) latency
+    baselines (persisted under ``directory`` when set), a change-point
+    detector, and the correlated incident plane behind
+    ``GET /debug/incidents``. ``recorder`` (an EventRecorder) makes every
+    minted incident land as an ``IncidentDetected`` Warning event.
+    ``tuning`` passes through SentinelEngine knobs (window, min_events,
+    sustain, ...) — bench and tests tighten warm-up there."""
+    inc_kwargs = {"recorder": recorder}
+    if incident_cap is not None:
+        inc_kwargs["cap"] = incident_cap
+    eng = SentinelEngine(
+        incidents=IncidentLog(**inc_kwargs), directory=directory, **tuning
+    )
+    global _sentinel
+    with _lock:
+        if _sentinel is not None:
+            _tracer.remove_hook(_sentinel)
+        _sentinel = eng
+    _tracer.add_hook(eng)
+    register_state("sentinel", eng.panel)
+    return eng
+
+
+def sentinel() -> Optional[SentinelEngine]:
+    with _lock:
+        return _sentinel
+
+
+def shutdown_sentinel(engine: Optional[SentinelEngine] = None) -> None:
+    """Detach (hook + state panel) and final-persist the baselines.
+    Ownership-checked like ``shutdown_slo``: pass the engine you installed
+    so a stopped replica cannot tear down a LATER configure's engine;
+    ``None`` detaches unconditionally (reset_for_tests)."""
+    global _sentinel
+    with _lock:
+        if engine is not None and _sentinel is not engine:
+            return  # someone else's engine is current — not ours to kill
+        if _sentinel is not None:
+            _tracer.remove_hook(_sentinel)
+        old, _sentinel = _sentinel, None
+    if old is not None:
+        old.close()
+    unregister_state("sentinel")
+
+
 # -- the decision audit log (obs/decisions.py) -------------------------------
 
 # memory-only default: /debug/decisions and /debug/explain answer from the
@@ -456,6 +519,36 @@ def debug_explain_payload(query: str = "") -> dict:
     }
 
 
+def debug_incidents_payload(query: str = "") -> dict:
+    """``GET /debug/incidents``: the sentinel's correlated incident
+    records plus its baseline disposition. ``?id=`` returns one FULL
+    record (span tree, pinned flight records, decision ids, profiler
+    folds, state panels); the default listing serves bounded summaries
+    (``?limit=`` bounds the count, default 20). ({} halves while no
+    sentinel is configured.)"""
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    eng = sentinel()
+    if eng is None:
+        return {"incidents": [], "sentinel": {}}
+    incident_id = (q.get("id") or [None])[0] or None
+    if incident_id:
+        return {
+            "incident": eng.incidents.get(incident_id),
+            "sentinel": eng.snapshot(),
+        }
+    limit = 20
+    try:
+        limit = max(int(q["limit"][0]), 0)
+    except (KeyError, ValueError, IndexError):
+        pass
+    return {
+        "incidents": eng.incidents.summaries(limit=limit),
+        "sentinel": eng.snapshot(),
+    }
+
+
 def debug_forecast_payload(query: str = "") -> dict:
     """``GET /debug/forecast``: per-provisioner arrival predictions, the
     measured launch-to-ready horizon, and the model parameters ({} while
@@ -495,6 +588,7 @@ def reset_for_tests() -> None:
     old_decisions.close()
     shutdown_forecast()
     shutdown_slo()
+    shutdown_sentinel()
     shutdown_profiler()
     shutdown_telemetry()
     from karpenter_tpu.obs import decisions as _dec

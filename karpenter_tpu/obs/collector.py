@@ -426,6 +426,7 @@ class HttpTelemetryBackend:
             slo = self._get_json(f"{url}/debug/slo") or {}
             profile = self._get_json(f"{url}/debug/profile") or {}
             decisions = self._get_json(f"{url}/debug/decisions?limit=16") or {}
+            incidents = self._get_json(f"{url}/debug/incidents?limit=8") or {}
             out.append({
                 "version": PAYLOAD_VERSION,
                 "identity": name,
@@ -435,6 +436,7 @@ class HttpTelemetryBackend:
                 "slo": slo.get("histograms") or {},
                 "profile": profile.get("profile") or {},
                 "decisions": decisions.get("decisions") or [],
+                "incidents": incidents.get("incidents") or [],
             })
         return out
 
@@ -454,6 +456,7 @@ def member_payload(identity: str, role: str) -> Dict[str, Any]:
     eng = obs.slo_engine()
     prof = obs.profiler()
     exp = obs.exporter()
+    sent = obs.sentinel()
     return {
         "version": PAYLOAD_VERSION,
         "identity": identity,
@@ -465,6 +468,11 @@ def member_payload(identity: str, role: str) -> Dict[str, Any]:
         "slo": eng.histogram_snapshot() if eng is not None else {},
         "profile": prof.snapshot(top_n=10) if prof is not None else {},
         "decisions": obs.decision_log().summaries(),
+        # bounded sentinel incident summaries: a dead member's regressions
+        # stay visible in /debug/fleet as long as its last payload does
+        "incidents": (
+            sent.incidents.summaries(limit=8) if sent is not None else []
+        ),
     }
 
 
@@ -635,6 +643,26 @@ class TelemetryCollector:
         out.sort(key=lambda d: -float(d.get("recorded_at") or 0.0))
         return out[:limit]
 
+    def fleet_incidents(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Cross-member regression-incident index, newest first — the
+        fleet twin of :meth:`fleet_decisions`: every member's flushed
+        sentinel incident summaries tagged with who opened them, deduped
+        by incident id (a scraped member can also flush to the file
+        backend)."""
+        with self._lock:
+            payloads = list(self._members.items())
+        out: List[Dict[str, Any]] = []
+        seen: set = set()
+        for identity, p in payloads:
+            for inc in p.get("incidents") or []:
+                iid = inc.get("id")
+                if not iid or iid in seen:
+                    continue
+                seen.add(iid)
+                out.append({**inc, "member": identity})
+        out.sort(key=lambda i: -float(i.get("opened_at") or 0.0))
+        return out[:limit]
+
     def fleet_payload(self) -> Dict[str, Any]:
         """The ``GET /debug/fleet`` body."""
         self._refresh_if_stale()
@@ -663,6 +691,7 @@ class TelemetryCollector:
             "members": self.members(),
             "slo": self.fleet_slo(),
             "decisions": self.fleet_decisions(),
+            "incidents": self.fleet_incidents(),
             "traces": {
                 "roots": len(roots),
                 "stitched": sum(1 for e in index if e["stitched"]),

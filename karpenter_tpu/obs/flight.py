@@ -144,6 +144,10 @@ class FlightRecorder:
         self.records_written = 0
         self._lock = threading.Lock()
         self._last_rule_record = 0.0  # guarded-by: self._lock
+        # filename -> incident id: records pinned against pruning because
+        # an incident record references them (obs/incidents.py); bounded —
+        # the oldest pins release once PIN_CAP incidents have come and gone
+        self._pinned: Dict[str, str] = {}  # guarded-by: self._lock
         os.makedirs(directory, exist_ok=True)
 
     def _rule_cooled_down(self) -> bool:
@@ -223,11 +227,67 @@ class FlightRecorder:
             n for n in os.listdir(self.directory)
             if n.startswith("flight-") and n.endswith(".json")
         )
-        for victim in names[: max(len(names) - self.cap, 0)]:
+        excess = max(len(names) - self.cap, 0)
+        removed = 0
+        for victim in names:
+            if removed >= excess:
+                break
+            if victim in self._pinned:
+                continue  # incident evidence outlives the ring's age-out
             try:
                 os.remove(os.path.join(self.directory, victim))
             except OSError:
                 pass
+            removed += 1
+
+    # -- the incident plane's evidence hook ----------------------------------
+    PIN_CAP = 16
+
+    def pin_for_incident(
+        self, incident_id: str, limit: int = 3
+    ) -> List[Dict[str, Any]]:
+        """Pin the newest ``limit`` records against pruning and return
+        their payloads tagged with ``incident_id`` — the incident record
+        (obs/incidents.py) references these files, and an unreferenced
+        prune would sever the evidence an operator follows from
+        ``/debug/incidents`` into ``/debug/flight``. Pins are bounded:
+        past ``PIN_CAP`` the oldest-pinned files release back to the
+        normal ring age-out."""
+        try:
+            names = sorted(
+                (
+                    n for n in os.listdir(self.directory)
+                    if n.startswith("flight-") and n.endswith(".json")
+                ),
+                reverse=True,
+            )[:limit]
+        except OSError:
+            return []
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for n in names:
+                self._pinned[n] = incident_id
+            while len(self._pinned) > self.PIN_CAP:
+                self._pinned.pop(next(iter(self._pinned)))
+        for n in names:
+            try:
+                with open(os.path.join(self.directory, n), encoding="utf-8") as f:
+                    payload = json.load(f)
+            except Exception:
+                continue  # half-written or pruned-under-us
+            out.append({
+                "file": n,
+                "incident_id": incident_id,
+                "name": payload.get("name"),
+                "trace_id": payload.get("trace_id"),
+                "duration_s": payload.get("duration_s"),
+                "recorded_at": payload.get("recorded_at"),
+            })
+        return out
+
+    def pinned(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._pinned)
 
     # -- the /debug/flight surface ------------------------------------------
     def recent(self, limit: int = 20) -> List[Dict[str, Any]]:

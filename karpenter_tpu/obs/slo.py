@@ -234,8 +234,18 @@ def load_objectives(path: str) -> List[str]:
 # -- sliding-window state -----------------------------------------------------
 
 
+# Raw values retained per slice for SMALL-sample exactness: the sketch's
+# ~2.5% bucket quantization is fine at volume but dominates a 12-event
+# bench window, where a p99 IS the max and a bucket midpoint can miss it
+# by a whole bucket (BENCH_r07: 8% online-vs-offline delta on the device
+# leg). While a window's raw list is COMPLETE (no slice hit the cap) the
+# quantile is answered exactly; past the cap the sketch takes over and
+# its error bound is back to the bucket scheme's.
+RAW_SAMPLE_CAP = 64
+
+
 class _Slice:
-    __slots__ = ("index", "counts", "exemplars", "good", "bad", "breach")
+    __slots__ = ("index", "counts", "exemplars", "good", "bad", "breach", "raw")
 
     def __init__(self, index: int):
         self.index = index
@@ -244,6 +254,7 @@ class _Slice:
         self.good = 0
         self.bad = 0
         self.breach: Optional[str] = None  # last budget-breaching trace id
+        self.raw: List[float] = []  # first RAW_SAMPLE_CAP values, exact
 
 
 class SlidingWindow:
@@ -293,6 +304,8 @@ class SlidingWindow:
                 sl.counts[b] = sl.counts.get(b, 0) + 1
                 if trace_id:
                     sl.exemplars[b] = trace_id
+                if len(sl.raw) < RAW_SAMPLE_CAP:
+                    sl.raw.append(value)
             if bad:
                 sl.bad += 1
                 if trace_id:
@@ -312,6 +325,7 @@ class SlidingWindow:
         exemplars: Dict[int, str] = {}
         good = bad = 0
         breach: Optional[str] = None
+        raw: List[float] = []
         with self._lock:
             # merge under the lock: the newest slice's dicts are live —
             # a concurrent record() growing them mid-iteration would raise
@@ -325,9 +339,13 @@ class SlidingWindow:
                 bad += s.bad
                 if s.breach is not None:
                     breach = s.breach
+                raw.extend(s.raw)
         return {
             "counts": counts, "exemplars": exemplars,
             "good": good, "bad": bad, "breach": breach,
+            # complete iff len(raw) == sum(counts.values()): no slice in
+            # the window overflowed its cap, so exact stats are available
+            "raw": raw,
         }
 
 
@@ -400,6 +418,15 @@ class Histogram:
         h.good = int(merged.get("good") or 0)
         h.bad = int(merged.get("bad") or 0)
         return h
+
+
+def _quantile_exact(values: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile over raw values — the SAME rank formula as
+    the sketch walk below (and the bench's offline ``_p99``), so a small
+    complete window agrees with the offline cross-check to the float."""
+    vs = sorted(values)
+    rank = min(max(math.ceil(q * len(vs)), 1), len(vs))
+    return vs[rank - 1]
 
 
 def _quantile(counts: Dict[int, int], q: float) -> Optional[float]:
@@ -497,6 +524,15 @@ class _ObjectiveState:
     def _value(self, merged: Dict[str, Any]) -> Optional[float]:
         obj = self.objective
         if obj.kind == "latency":
+            raw = merged.get("raw") or []
+            total = sum(merged["counts"].values())
+            if raw and len(raw) == total:
+                # small complete window: answer exactly instead of off the
+                # sketch (the sketch's bucket quantization dominates at
+                # bench-scale sample counts — see RAW_SAMPLE_CAP)
+                if obj.quantile is not None:
+                    return _quantile_exact(raw, obj.quantile)
+                return sum(raw) / len(raw)
             if obj.quantile is not None:
                 return _quantile(merged["counts"], obj.quantile)
             return _mean(merged["counts"])

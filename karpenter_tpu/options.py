@@ -217,6 +217,18 @@ class Options:
     forecast_alpha: float = field(
         default_factory=lambda: float(_env("KARPENTER_FORECAST_ALPHA", "0.3"))
     )
+    # regression sentinel (obs/sentinel.py, docs/observability.md):
+    # online per-(stage, route, shape) latency baselines off the span
+    # stream + change-point detection; sustained deviations mint
+    # correlated incident records at /debug/incidents. sentinel_dir
+    # persists the baseline table across restarts ('' = memory-only,
+    # re-learns each boot).
+    sentinel_enabled: bool = field(
+        default_factory=lambda: env_bool("KARPENTER_SENTINEL", default=True)
+    )
+    sentinel_dir: str = field(
+        default_factory=lambda: _env("KARPENTER_SENTINEL_DIR", "")
+    )
 
     def validate(self) -> List[str]:
         errs = []
@@ -492,6 +504,21 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         help="forecaster level smoothing factor in (0, 1]",
     )
     ap.add_argument(
+        "--sentinel",
+        action=argparse.BooleanOptionalAction,
+        default=opts.sentinel_enabled,
+        help="regression sentinel: online latency baselines per (stage, "
+        "route, shape) + change-point detection over the span stream; "
+        "sustained deviations mint correlated incident records "
+        "(--no-sentinel disables; /debug/incidents serves them — "
+        "docs/observability.md)",
+    )
+    ap.add_argument(
+        "--sentinel-dir", default=opts.sentinel_dir,
+        help="directory the sentinel persists its learned baselines into "
+        "so a restart resumes instead of re-learning ('' = memory-only)",
+    )
+    ap.add_argument(
         "--consolidation",
         action=argparse.BooleanOptionalAction,
         default=opts.consolidation_enabled,
@@ -557,6 +584,8 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         explain_enabled=ns.explain,
         decision_dir=ns.decision_dir,
         unschedulable_event_rounds=ns.unschedulable_event_rounds,
+        sentinel_enabled=ns.sentinel,
+        sentinel_dir=ns.sentinel_dir,
     )
     errs = out.validate()
     if errs:

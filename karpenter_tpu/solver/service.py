@@ -1341,6 +1341,9 @@ class SolverService:
             attrs={
                 "session": key.hex()[:12],
                 "admission_wait_s": round(admission_wait_s, 6),
+                # batch size: the regression sentinel's shape-class key —
+                # a 4-pod and a 400-pod pack must not share a baseline
+                "pods": int(len(pod_arrays[0])),
             },
         ) as sp:
             t0 = time.perf_counter()
@@ -1828,6 +1831,10 @@ def _serve_health(service: SolverService, port: int):
                 elif self.path.startswith("/debug/explain"):
                     body = _json.dumps(
                         obs.debug_explain_payload(query)
+                    ).encode()
+                elif self.path.startswith("/debug/incidents"):
+                    body = _json.dumps(
+                        obs.debug_incidents_payload(query)
                     ).encode()
                 else:
                     code, ctype, body = 404, "text/plain", b"not found"
@@ -2728,6 +2735,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "folds into ('' disables; docs/telemetry.md)")
     ap.add_argument("--telemetry-flush-interval", type=float, default=10.0,
                     help="seconds between telemetry flushes")
+    ap.add_argument("--sentinel", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="regression sentinel over the sidecar's own span "
+                         "stream (sidecar.pack and the solve stages): "
+                         "online latency baselines + change-point "
+                         "detection; GET /debug/incidents serves the "
+                         "incident records")
+    ap.add_argument("--sentinel-dir", default="",
+                    help="directory the sentinel persists learned baselines "
+                         "into across restarts ('' = memory-only)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from karpenter_tpu import obs
@@ -2752,6 +2769,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         # always-on sampling profiler: the sidecar's device/serialize hot
         # loops are exactly the frames a fleet-wide slow solve needs named
         obs.configure_profiler(hz=args.profile_hz)
+    if args.sentinel:
+        # the sidecar learns baselines for its OWN stages (the pack span
+        # plus the device solve/fetch legs) — the controller's sentinel
+        # only sees wire totals, so device-side regressions attribute here
+        obs.configure_sentinel(
+            directory=args.sentinel_dir,
+            watch=("sidecar.pack", "sidecar.solve", "sidecar.fetch"),
+        )
     if args.telemetry_dir:
         # flush-only member of the fleet telemetry plane: the controller's
         # collector stitches this ring's sidecar.pack trees into its own

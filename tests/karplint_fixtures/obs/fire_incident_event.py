@@ -1,0 +1,18 @@
+# karplint-fixture: expect=event-decision-id
+"""An incident plane (obs/incidents.py shape) emitting its Warning
+WITHOUT the decision-id keyword: incident files are decision-path even
+under obs/ — an IncidentDetected event that can't be walked back into
+/debug/decisions is the same audit dead end as an unannotated
+LaunchFailed."""
+
+
+class IncidentLog:
+    def __init__(self, recorder):
+        self.recorder = recorder
+
+    def emit(self, record):
+        # Warning from an incident file, no decision_id= — must fire
+        self.recorder.event(
+            "Provisioner", record["route"], "IncidentDetected",
+            "latency regression detected", type="Warning",
+        )

@@ -1,0 +1,25 @@
+# karplint-fixture: clean=event-decision-id
+"""The sanctioned incident-plane shape: the IncidentDetected Warning
+carries the first correlated decision id (empty when the incident window
+held no provisioning round — honest and allowed), and Normal events need
+no id."""
+
+
+class IncidentLog:
+    def __init__(self, recorder):
+        self.recorder = recorder
+
+    def emit(self, record):
+        decisions = record.get("decisions") or []
+        self.recorder.event(
+            "Provisioner", record["route"], "IncidentDetected",
+            "latency regression detected", type="Warning",
+            decision_id=decisions[0]["id"] if decisions else "",
+        )
+
+    def closed(self, record):
+        # Normal events carry no decision obligation
+        self.recorder.event(
+            "Provisioner", record["route"], "IncidentResolved",
+            "stage recovered",
+        )

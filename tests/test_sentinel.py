@@ -1,0 +1,616 @@
+"""The regression sentinel + correlated incident plane (obs/sentinel.py,
+obs/incidents.py): online baselines, change-point detection, persistence
+across restarts, evidence correlation, and the /debug/incidents surface.
+"""
+
+import json
+import math
+import os
+import socket
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs.incidents import IncidentLog
+from karpenter_tpu.obs.sentinel import (
+    BASELINE_FILE,
+    SentinelEngine,
+    route_of,
+    shape_class,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class FakeSpan:
+    """Sentinel-facing span stand-in: the engine reads name/duration/attrs,
+    the incident plane additionally serializes via to_dict()."""
+
+    def __init__(self, name, duration_s, attrs=None, error=None,
+                 trace_id="t" * 32):
+        self.name = name
+        self.duration_s = duration_s
+        self.attrs = attrs or {}
+        self.error = error
+        self.trace_id = trace_id
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "duration_s": self.duration_s,
+            "attrs": dict(self.attrs),
+            "children": [],
+        }
+
+
+def tight_engine(**kw):
+    """Bench/test-scale knobs: warm in 8 events, 4-wide windows, trip on
+    2 sustained deviating windows, floors low enough for ~1ms stages."""
+    defaults = dict(min_events=8, window=4, sustain=2, abs_floor_s=0.0005)
+    defaults.update(kw)
+    return SentinelEngine(**defaults)
+
+
+def feed(eng, n, duration, name="solver.solve", attrs=None):
+    for _ in range(n):
+        eng(FakeSpan(name, duration, attrs=attrs))
+
+
+# ---------------------------------------------------------------------------
+# key derivation
+
+
+class TestKeying:
+    def test_shape_class_power_of_two_buckets(self):
+        assert shape_class(4000) == "4096"
+        assert shape_class(3900) == "4096"  # same workload shape
+        assert shape_class(400) == "512"    # different shape
+        assert shape_class(1) == "1"
+        assert shape_class(0) == "0"
+        assert shape_class(-3) == "0"
+        assert shape_class(None) == "-"
+        assert shape_class("nope") == "-"
+
+    def test_route_of_prefers_transport_then_backend(self):
+        assert route_of(FakeSpan("w", 0.0, {"transport": "stream_shm"})) == "stream_shm"
+        assert route_of(FakeSpan("w", 0.0, {"backend": "cpsat"})) == "cpsat"
+        assert route_of(FakeSpan("w", 0.0, {"address": "h:50051"})) == "remote"
+        assert route_of(FakeSpan("w", 0.0, {})) == "-"
+
+    def test_routes_and_shapes_learn_separate_baselines(self):
+        eng = tight_engine()
+        feed(eng, 4, 0.001, attrs={"transport": "stream", "pods": 100})
+        feed(eng, 4, 0.010, attrs={"transport": "unary", "pods": 100})
+        feed(eng, 4, 0.050, attrs={"transport": "stream", "pods": 4000})
+        assert eng.baseline_count() == 3
+
+    def test_unwatched_span_is_ignored(self):
+        eng = tight_engine()
+        feed(eng, 10, 0.001, name="not.watched")
+        assert eng.baseline_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# detection
+
+
+class TestDetection:
+    def test_sustained_step_mints_exactly_one_incident(self):
+        eng = tight_engine()
+        feed(eng, 12, 0.001)
+        assert eng.incidents.count() == 0  # steady traffic is quiet
+        feed(eng, 12, 0.003)               # a 3x sustained step
+        assert eng.incidents.count() == 1  # one regime change, one incident
+        rec = eng.incidents.recent()[0]
+        assert rec["stage"] == "solver.solve"
+        row = rec["stages"][0]
+        assert row["observed_s"] == pytest.approx(0.003, rel=0.01)
+        assert row["baseline_s"] == pytest.approx(0.001, rel=0.05)
+        assert row["observed_s"] > row["threshold_s"]
+
+    def test_single_outlier_never_trips(self):
+        eng = tight_engine()
+        feed(eng, 12, 0.001)
+        feed(eng, 1, 0.050)   # one slow solve is an outlier, not a step
+        feed(eng, 12, 0.001)
+        assert eng.incidents.count() == 0
+        # and the gated update kept the outlier out of the baseline
+        snap = eng.snapshot()["baselines"][0]
+        assert snap["level_s"] == pytest.approx(0.001, rel=0.05)
+
+    def test_warmup_is_quiet(self):
+        # fewer than min_events observations can never produce a verdict,
+        # no matter how wild the values look
+        eng = tight_engine(min_events=100)
+        feed(eng, 20, 0.001)
+        feed(eng, 20, 0.100)
+        assert eng.incidents.count() == 0
+
+    def test_recovery_after_rebaseline_is_quiet(self):
+        eng = tight_engine()
+        feed(eng, 12, 0.001)
+        feed(eng, 12, 0.003)
+        assert eng.incidents.count() == 1
+        # the incident re-baselined to the new regime: tracking it and
+        # even recovering (a downward step) stays quiet
+        feed(eng, 20, 0.003)
+        feed(eng, 20, 0.001)
+        assert eng.incidents.count() == 1
+
+    def test_persisting_regression_is_one_incident_not_a_siren(self):
+        eng = tight_engine()
+        feed(eng, 12, 0.001)
+        feed(eng, 60, 0.004)  # regression persists for many windows
+        assert eng.incidents.count() == 1
+
+    def test_observe_failure_never_raises(self):
+        eng = tight_engine()
+        # attrs raising inside _observe must be contained by the hook
+        class Hostile:
+            name = "solver.solve"
+            duration_s = 0.001
+            trace_id = "t" * 32
+
+            @property
+            def attrs(self):
+                raise RuntimeError("hostile span")
+
+        eng(Hostile())  # must not raise
+        assert eng.baseline_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# incident correlation
+
+
+def _trip(log, stage="solver.wire", route="stream", shape="4096",
+          observed=0.004, baseline=0.001):
+    return log.deviation(
+        stage=stage, route=route, shape=shape,
+        span=FakeSpan(stage, observed, {"transport": route}),
+        baseline={
+            "observed_s": observed, "baseline_s": baseline,
+            "baseline_std_s": 0.0001, "threshold_s": baseline * 2,
+            "observations": 50,
+        },
+    )
+
+
+class TestIncidentCorrelation:
+    def test_deviation_in_window_attaches_as_extra_stage(self):
+        t = [1000.0]
+        log = IncidentLog(clock=lambda: t[0])
+        _trip(log, stage="solver.wire")
+        t[0] += 10.0  # inside the 30s correlation window
+        _trip(log, stage="sidecar.pack", route="session-1")
+        assert log.count() == 1  # wire+device correlate under ONE id
+        rec = log.recent()[0]
+        assert [s["stage"] for s in rec["stages"]] == [
+            "solver.wire", "sidecar.pack",
+        ]
+        assert rec["last_deviation_at"] == 1010.0
+
+    def test_deviation_past_window_mints_new_incident(self):
+        t = [1000.0]
+        log = IncidentLog(clock=lambda: t[0])
+        _trip(log)
+        t[0] += 31.0
+        _trip(log)
+        assert log.count() == 2
+        assert len({r["id"] for r in log.recent()}) == 2
+
+    def test_open_summary_tracks_the_correlation_window(self):
+        t = [1000.0]
+        log = IncidentLog(clock=lambda: t[0])
+        assert log.open_summary() is None
+        rec = _trip(log)
+        assert log.open_summary() == {"id": rec["id"], "stage": "solver.wire"}
+        t[0] += 31.0
+        assert log.open_summary() is None  # window closed: quiet again
+
+    def test_stage_attachment_is_bounded(self):
+        t = [1000.0]
+        log = IncidentLog(clock=lambda: t[0])
+        for i in range(20):
+            _trip(log, stage=f"stage.{i}")
+            t[0] += 1.0
+        assert log.count() == 1
+        assert len(log.recent()[0]["stages"]) == 8  # MAX_STAGES
+
+    def test_ring_is_bounded_and_get_by_id_works(self):
+        t = [1000.0]
+        log = IncidentLog(cap=3, clock=lambda: t[0])
+        ids = []
+        for _ in range(5):
+            ids.append(_trip(log)["id"])
+            t[0] += 31.0
+        assert log.count() == 5             # opened counter is cumulative
+        assert len(log.recent(limit=10)) == 3  # ring keeps the newest cap
+        assert log.get(ids[0]) is None      # aged out
+        assert log.get(ids[-1])["id"] == ids[-1]
+
+    def test_summaries_are_bounded_and_newest_first(self):
+        t = [1000.0]
+        log = IncidentLog(clock=lambda: t[0])
+        for _ in range(3):
+            _trip(log)
+            t[0] += 31.0
+        summ = log.summaries(limit=2)
+        assert len(summ) == 2
+        assert summ[0]["opened_at"] > summ[1]["opened_at"]
+        for s in summ:
+            assert set(s) == {
+                "id", "opened_at", "stage", "stages", "trace_id",
+                "decision_ids", "flight_count",
+            }
+
+
+class TestIncidentEvidence:
+    def test_incident_correlates_flight_decisions_and_state(self, tmp_path):
+        import time as _time
+
+        obs.configure_flight(str(tmp_path / "flight"), budget_s=10.0)
+        prof = obs.configure_profiler(hz=200.0)
+        # a provisioning round recorded just before the trip is in-window
+        round_rec = obs.decision_log().record_round("default", [], [], context={})
+        assert round_rec is not None
+        deadline = _time.time() + 5.0
+        while (_time.time() < deadline
+               and not prof.flight_panel()["window_samples"]):
+            _time.sleep(0.01)
+        log = IncidentLog()
+        rec = _trip(log)
+        # the triggering span tree rides the record even though it was
+        # under the flight budget (force-recorded + pinned)
+        assert rec["trace"]["name"] == "solver.wire"
+        assert len(rec["flights"]) >= 1
+        assert rec["flights"][0]["incident_id"] == rec["id"]
+        assert round_rec["id"] in [d["id"] for d in rec["decisions"]]
+        # the profiler's in-window folds ride along (the key is the
+        # flight panel's top_folds — pinned: a wrong key reads as "no
+        # profiler configured" and silently empties the evidence)
+        assert len(rec["profile_top"]) >= 1
+        assert rec["profile_top"][0]["samples"] >= 1
+        assert isinstance(rec["state"], dict)
+        summ = log.summaries()[0]
+        assert round_rec["id"] in summ["decision_ids"]
+        assert summ["flight_count"] >= 1
+
+    def test_incident_event_carries_decision_id(self):
+        events = []
+
+        class RecorderStub:
+            def event(self, kind, name, **kw):
+                events.append((kind, name, kw))
+
+        obs.decision_log().record_round("default", [], [], context={})
+        log = IncidentLog(recorder=RecorderStub())
+        _trip(log, route="stream_shm")
+        assert len(events) == 1
+        kind, name, kw = events[0]
+        assert (kind, name) == ("Provisioner", "stream_shm")
+        assert kw["reason"] == "IncidentDetected"
+        assert kw["type"] == "Warning"
+        # the cross-link: the Warning names the in-window decision
+        assert kw["decision_id"] == log.recent()[0]["decisions"][0]["id"]
+
+    def test_event_decision_id_empty_when_no_round_in_window(self):
+        events = []
+
+        class RecorderStub:
+            def event(self, kind, name, **kw):
+                events.append(kw)
+
+        log = IncidentLog(recorder=RecorderStub())
+        _trip(log)
+        assert events[0]["decision_id"] == ""  # honest and allowed
+
+    def test_deviation_never_raises_on_broken_evidence(self):
+        log = IncidentLog()
+
+        class NoDict:  # lacks to_dict(): evidence assembly must contain it
+            name = "solver.wire"
+            duration_s = 0.004
+            attrs = {}
+            trace_id = "t" * 32
+
+        assert log.deviation(
+            stage="solver.wire", route="-", shape="-",
+            span=NoDict(), baseline={},
+        ) is None
+
+    def test_pinned_flight_evidence_survives_ring_pruning(self, tmp_path):
+        from karpenter_tpu.obs.flight import FlightRecorder
+
+        rec = FlightRecorder(str(tmp_path), budget_s=0.0, cap=2)
+        for i in range(3):
+            rec.record(FakeSpan("solver.solve", 0.5, trace_id=f"{i:032d}"))
+        pins = rec.pin_for_incident("i-deadbeef", limit=2)
+        assert len(pins) == 2
+        for _ in range(6):  # push the ring well past cap
+            rec.record(FakeSpan("solver.solve", 0.5))
+        on_disk = set(os.listdir(str(tmp_path)))
+        for p in pins:
+            assert p["file"] in on_disk  # incident evidence outlives age-out
+
+
+# ---------------------------------------------------------------------------
+# persistence (satellite: restart-resume, corrupt, unwritable)
+
+
+class TestPersistence:
+    def test_restart_resumes_from_persisted_baselines(self, tmp_path):
+        d = str(tmp_path / "sentinel")
+        eng1 = tight_engine(directory=d)
+        feed(eng1, 16, 0.001)
+        assert eng1.save() is True
+        assert os.path.exists(os.path.join(d, BASELINE_FILE))
+
+        eng2 = tight_engine(directory=d)
+        assert eng2.baseline_count() == 1
+        row = eng2.snapshot()["baselines"][0]
+        assert row["restored"] is True
+        assert row["level_s"] == pytest.approx(0.001, rel=0.05)
+        assert row["observations"] >= eng2.min_events
+        # restart mid-stream: steady traffic NEVER mints a warm-up
+        # false incident (the restored baseline already knows normal) ...
+        feed(eng2, 30, 0.001)
+        assert eng2.incidents.count() == 0
+        # ... and a real step trips immediately, no re-warm-up needed
+        feed(eng2, 12, 0.005)
+        assert eng2.incidents.count() == 1
+
+    def test_corrupt_baseline_file_degrades_to_fresh_table(self, tmp_path):
+        d = str(tmp_path / "sentinel")
+        os.makedirs(d)
+        with open(os.path.join(d, BASELINE_FILE), "w") as f:
+            f.write("{not json")
+        eng = tight_engine(directory=d)
+        assert eng.baseline_count() == 0  # fresh table, not half-loaded
+        assert eng.directory == d         # next save overwrites forensics
+        feed(eng, 16, 0.001)
+        assert eng.save() is True         # recovered persistence
+        assert tight_engine(directory=d).baseline_count() == 1
+
+    def test_wrong_version_is_corrupt(self, tmp_path):
+        d = str(tmp_path / "sentinel")
+        os.makedirs(d)
+        with open(os.path.join(d, BASELINE_FILE), "w") as f:
+            json.dump({"version": 99, "baselines": [
+                {"key": ["a", "b", "c"], "level": 1.0},
+            ]}, f)
+        assert tight_engine(directory=d).baseline_count() == 0
+
+    def test_uncreatable_directory_degrades_to_memory_only(self, tmp_path):
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        eng = tight_engine(directory=str(blocker / "sub"))
+        assert eng.directory == ""  # memory-only, counted
+        feed(eng, 12, 0.001)        # detection keeps running on what it has
+        assert eng.baseline_count() == 1
+        assert eng.save() is False
+
+    def test_save_failure_degrades_to_memory_only(self, tmp_path):
+        eng = tight_engine(directory=str(tmp_path / "ok"))
+        feed(eng, 12, 0.001)
+        blocker = tmp_path / "f"
+        blocker.write_text("x")
+        eng.directory = str(blocker / "sub")  # ENOSPC/read-only stand-in
+        assert eng.save() is False
+        assert eng.directory == ""            # degraded, detection lives on
+        feed(eng, 4, 0.001)
+
+    def test_close_persists(self, tmp_path):
+        d = str(tmp_path / "sentinel")
+        eng = tight_engine(directory=d)
+        feed(eng, 12, 0.001)
+        eng.close()
+        assert tight_engine(directory=d).baseline_count() == 1
+
+    def test_key_cap_evicts_oldest(self):
+        eng = tight_engine(key_cap=4)
+        for i in range(8):
+            feed(eng, 2, 0.001, attrs={"transport": f"r{i}"})
+        assert eng.baseline_count() == 4
+        routes = {b["route"] for b in eng.snapshot()["baselines"]}
+        assert routes == {"r4", "r5", "r6", "r7"}
+
+
+# ---------------------------------------------------------------------------
+# the obs facade + /debug/incidents
+
+
+class TestObsWiring:
+    def test_configure_sentinel_hooks_the_tracer(self):
+        eng = obs.configure_sentinel()
+        assert obs.sentinel() is eng
+        with obs.tracer().span("solver.solve"):
+            pass
+        with obs.tracer().span("not.watched"):
+            pass
+        assert eng.baseline_count() == 1
+        snap = eng.snapshot()
+        assert snap["baselines"][0]["stage"] == "solver.solve"
+        assert snap["overhead_ratio"] < 1.0
+
+    def test_sentinel_contributes_a_state_panel(self):
+        from karpenter_tpu.obs.flight import state_snapshot
+
+        obs.configure_sentinel()
+        panel = state_snapshot()["sentinel"]
+        assert set(panel) == {
+            "baselines", "incidents", "open_incident", "overhead_ratio",
+        }
+        obs.shutdown_sentinel()
+        assert "sentinel" not in state_snapshot()
+
+    def test_shutdown_is_ownership_checked(self):
+        eng1 = obs.configure_sentinel()
+        eng2 = obs.configure_sentinel()
+        obs.shutdown_sentinel(engine=eng1)  # stale owner: not ours to kill
+        assert obs.sentinel() is eng2
+        obs.shutdown_sentinel(engine=eng2)
+        assert obs.sentinel() is None
+
+    def test_shutdown_final_persists(self, tmp_path):
+        d = str(tmp_path / "sentinel")
+        eng = obs.configure_sentinel(directory=d, min_events=4)
+        feed(eng, 8, 0.001)
+        obs.shutdown_sentinel(engine=eng)
+        assert os.path.exists(os.path.join(d, BASELINE_FILE))
+
+    def test_reset_for_tests_detaches(self):
+        obs.configure_sentinel()
+        obs.reset_for_tests()
+        assert obs.sentinel() is None
+
+    def test_tuning_kwargs_pass_through(self):
+        eng = obs.configure_sentinel(
+            min_events=3, window=2, sustain=1, incident_cap=5,
+        )
+        assert (eng.min_events, eng.window, eng.sustain) == (3, 2, 1)
+        assert eng.incidents.cap == 5
+
+
+class TestDebugIncidentsPayload:
+    def test_empty_halves_when_unconfigured(self):
+        assert obs.debug_incidents_payload("") == {
+            "incidents": [], "sentinel": {},
+        }
+
+    def test_listing_and_detail(self):
+        eng = obs.configure_sentinel(
+            min_events=8, window=4, sustain=2, abs_floor_s=0.0005,
+        )
+        feed(eng, 12, 0.001)
+        feed(eng, 12, 0.003)
+        body = obs.debug_incidents_payload("")
+        assert len(body["incidents"]) == 1
+        assert body["sentinel"]["baseline_count"] == 1
+        assert body["sentinel"]["watch"]  # disposition rides every answer
+        iid = body["incidents"][0]["id"]
+        detail = obs.debug_incidents_payload(f"id={iid}")
+        assert detail["incident"]["id"] == iid
+        assert detail["incident"]["trace"]["name"] == "solver.solve"
+        assert obs.debug_incidents_payload("id=i-nope")["incident"] is None
+        assert obs.debug_incidents_payload("limit=0")["incidents"] == []
+
+    def test_sidecar_health_server_serves_incidents(self):
+        from karpenter_tpu.solver.service import SolverService, _serve_health
+
+        eng = obs.configure_sentinel(
+            min_events=8, window=4, sustain=2, abs_floor_s=0.0005,
+            watch=("sidecar.pack",),
+        )
+        feed(eng, 12, 0.001, name="sidecar.pack")
+        feed(eng, 12, 0.004, name="sidecar.pack")
+        service = SolverService()
+        service.ready.set()
+        port = free_port()
+        httpd = _serve_health(service, port)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/incidents", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+            assert len(body["incidents"]) == 1
+            assert body["incidents"][0]["stages"][0]["stage"] == "sidecar.pack"
+            iid = body["incidents"][0]["id"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/incidents?id={iid}", timeout=5
+            ) as resp:
+                detail = json.loads(resp.read())
+            assert detail["incident"]["id"] == iid
+        finally:
+            httpd.shutdown()
+
+    def test_member_payload_ships_incident_summaries(self):
+        from karpenter_tpu.obs.collector import member_payload
+
+        eng = obs.configure_sentinel(
+            min_events=8, window=4, sustain=2, abs_floor_s=0.0005,
+        )
+        feed(eng, 12, 0.001)
+        feed(eng, 12, 0.003)
+        payload = member_payload("ctl-0", "controller")
+        assert len(payload["incidents"]) == 1
+        assert payload["incidents"][0]["stages"][0]["stage"] == "solver.solve"
+
+    def test_fleet_incidents_merge_and_dedupe(self):
+        from karpenter_tpu.obs.collector import TelemetryCollector
+
+        inc_a = {"id": "i-aaa", "opened_at": 100.0, "stage": "solver.wire"}
+        inc_b = {"id": "i-bbb", "opened_at": 200.0, "stage": "sidecar.pack"}
+
+        class Backend:
+            def poll(self):
+                return [
+                    {"identity": "ctl-0", "traces": [],
+                     "incidents": [inc_a, inc_b]},
+                    {"identity": "side-0", "traces": [],
+                     "incidents": [inc_b]},  # double-reported: deduped
+                ]
+
+        coll = TelemetryCollector([Backend()])
+        coll.refresh()
+        fleet = coll.fleet_incidents()
+        assert [i["id"] for i in fleet] == ["i-bbb", "i-aaa"]  # newest first
+        assert fleet[0]["member"] == "ctl-0"
+        assert [i["id"] for i in coll.fleet_payload()["incidents"]] == [
+            "i-bbb", "i-aaa",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# SLO small-sample exactness (the BENCH_r07 device-leg regression:
+# 8.03% online/offline delta at 12-iteration sample counts came from
+# bucket-midpoint quantization; raw samples answer exactly while complete)
+
+
+class TestSloSmallSampleExactness:
+    @staticmethod
+    def _offline_p99(values):
+        # bench.py's _p99: exact nearest-rank over the sorted sample
+        vs = sorted(values)
+        return vs[min(len(vs) - 1, max(math.ceil(0.99 * len(vs)) - 1, 0))]
+
+    def test_online_equals_offline_at_bench_sample_counts(self):
+        for n in (6, 12, 24, 64):
+            obs.shutdown_slo()
+            eng = obs.configure_slo()
+            durations = [0.001 + 0.0017 * ((i * 7) % n) for i in range(n)]
+            for d in durations:
+                eng(FakeSpan("solver.solve", d))
+            online = eng.snapshot()["objectives"]["solve_p99"]["value"]
+            offline = self._offline_p99(durations)
+            # the <5% bench bar, pinned at its strongest: exact agreement
+            assert online == pytest.approx(offline, abs=1e-12), (
+                f"n={n}: online {online} != offline {offline}"
+            )
+
+    def test_sketch_takes_over_past_raw_cap(self):
+        from karpenter_tpu.obs.slo import RAW_SAMPLE_CAP
+
+        eng = obs.configure_slo()
+        n = RAW_SAMPLE_CAP * 4
+        durations = [0.001 * (1 + i % 100) for i in range(n)]
+        for d in durations:
+            eng(FakeSpan("solver.solve", d))
+        online = eng.snapshot()["objectives"]["solve_p99"]["value"]
+        offline = self._offline_p99(durations)
+        assert abs(online - offline) / offline < 0.05  # the sketch bar

@@ -34,6 +34,12 @@ from tools.karplint.core import (
 
 def _on_decision_path(path: str) -> bool:
     base = path.rsplit("/", 1)[-1]
+    # incident files (the regression sentinel's IncidentDetected site)
+    # are decision-path even under obs/: an incident whose window held
+    # provisioning rounds must annotate one of their decision ids, or the
+    # operator's path from the Warning into /debug/decisions is severed
+    if "incident" in base:
+        return True
     return ("provision" in base or "consolidation" in base) and not (
         "/obs/" in path or path.startswith("obs/")
     )
